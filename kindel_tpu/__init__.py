@@ -1,0 +1,41 @@
+"""kindel-tpu — TPU-native indel-aware consensus calling framework.
+
+A ground-up JAX/XLA re-design of the capabilities of bede/kindel v1.2.1
+(reference: /root/reference/kindel/__init__.py:1-3): infer a majority
+consensus sequence, with indel and soft-clip awareness, from an aligned
+SAM/BAM file.
+
+Architecture (TPU-first, not a port):
+
+  L0  host I/O        — first-party BGZF/BAM/SAM decoders producing columnar
+                        numpy arrays (kindel_tpu.io), FASTA/TSV writers
+  L1  event engine    — vectorized CIGAR expansion into flat (position,
+                        channel) event streams (kindel_tpu.events), reduced
+                        into dense count tensors (kindel_tpu.pileup) either
+                        with numpy (oracle backend) or jax.ops.segment-sum
+                        style scatters under jit (kindel_tpu.pileup_jax)
+  L2  realign engine  — clip-dominant-region detection + gap closure over the
+                        dense tensors (kindel_tpu.realign)
+  L3  call kernels    — vectorized argmax/tie/threshold consensus calling
+                        (kindel_tpu.call, kindel_tpu.call_jax)
+  L4  workloads       — bam_to_consensus / weights / features / variants /
+                        plot (kindel_tpu.workloads)
+  L5  CLI             — kindel_tpu.cli (python -m kindel_tpu)
+
+Sharding/scale-out lives in kindel_tpu.parallel: the genomic position axis is
+the sequence-parallel axis, sharded over a jax.sharding.Mesh with halo
+exchange bounded by read length.
+"""
+
+__version__ = "0.1.0"
+
+from kindel_tpu.workloads import (  # noqa: F401
+    bam_to_consensus,
+    weights,
+    features,
+    variants,
+    plot_clips,
+)
+from kindel_tpu.compat import parse_bam  # noqa: F401
+from kindel_tpu.call import consensus  # noqa: F401
+from kindel_tpu.realign import merge_by_lcs, cdrp_consensuses  # noqa: F401

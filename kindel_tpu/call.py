@@ -1,0 +1,220 @@
+"""L3 — consensus calling and sequence assembly.
+
+Per-position decision semantics replicated exactly from the reference
+(`consensus_sequence`, /root/reference/kindel/kindel.py:384-430):
+
+  1. CDR patch starting here (and seq not None) → emit patch.seq lowercased,
+     skip (end-start-1) following positions (:396-401)
+  2. deletion: del_freq > 0.5 * acgt_depth → emit nothing, change 'D' (:413)
+  3. low coverage: acgt_depth < min_depth → emit 'N', change 'N' (:415-417)
+  4. else: insertion first — ins_freq > min(0.5*acgt_depth,
+     0.5*acgt_depth_next) → emit lowercase majority insertion ('N' on tie),
+     change 'I' (:419-422); then the base — argmax over A,T,G,C,N, 'N' on
+     tie (:423-424)
+  5. trim_ends strips 'N' (uppercase only) from both ends; uppercase
+     upcases everything (:425-428)
+
+Split into two stages: `compute_masks` — fully vectorized per-position
+decisions (numpy here; the device twin is kindel_tpu.call_jax) — and
+`assemble` — the host splice of the rare variable-length emissions
+(insertions, CDR patches) into the final string.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from kindel_tpu.events import BASES
+from kindel_tpu.pileup import Pileup, argmax_base_and_tie
+from kindel_tpu.realign import Region
+
+BASE_ASCII = np.frombuffer(BASES, dtype=np.uint8)  # b"ATGCN"
+_N = ord("N")
+
+
+def consensus(weight: dict) -> tuple:
+    """Per-site consensus over a {base: count} mapping — the reference's
+    public helper (/root/reference/kindel/kindel.py:369-381), kept for API
+    parity. Returns (base, freq, proportion, tie)."""
+    total = sum(weight.values())
+    if total:
+        base, freq = max(weight.items(), key=lambda kv: kv[1])
+    else:
+        base, freq = "N", 0
+    tie = bool(freq) and freq in [v for k, v in weight.items() if k != base]
+    proportion = round(freq / total, 2) if total else 0
+    return (base, freq, proportion, tie)
+
+
+@dataclass
+class CallResult:
+    sequence: str
+    #: change marker per reference position: None/'D'/'N'/'I'
+    changes: list
+
+
+@dataclass
+class CallMasks:
+    """Per-position call decisions (device- or host-computed)."""
+
+    #: ASCII byte to emit at each position (tie→N already applied)
+    base_char: np.ndarray  # uint8[L]
+    del_mask: np.ndarray  # bool[L]
+    n_mask: np.ndarray  # bool[L]
+    ins_mask: np.ndarray  # bool[L]
+
+
+def compute_masks(
+    weights: np.ndarray,
+    deletions: np.ndarray,
+    ins_totals: np.ndarray,
+    min_depth: int,
+) -> CallMasks:
+    """Vectorized per-position decisions over a [L,5] count block.
+    `deletions`/`ins_totals` are the first L entries of their tensors."""
+    L = len(weights)
+    acgt_depth = weights[:, :4].sum(axis=1)
+    depth_next = np.r_[acgt_depth[1:], 0]  # lookahead halo (:405-410)
+
+    base_idx, _freq, tie = argmax_base_and_tie(weights)
+    base_char = BASE_ASCII[base_idx]
+    base_char = np.where(tie, np.uint8(_N), base_char)
+
+    # integer-exact thresholds (d > 0.5*a ⟺ 2d > a) — avoids float temporaries
+    del_mask = deletions[:L].astype(np.int64) * 2 > acgt_depth
+    n_mask = ~del_mask & (acgt_depth < min_depth)
+    ins_mask = (
+        ~del_mask
+        & ~n_mask
+        & (ins_totals[:L] * 2 > np.minimum(acgt_depth, depth_next))
+    )
+    return CallMasks(base_char, del_mask, n_mask, ins_mask)
+
+
+def _insertion_calls(ins):
+    """Majority insertion string (or None on tie) per position with any
+    insertion observations (`ins` is an InsertionTable). Ties across
+    distinct strings with equal max counts yield 'N'
+    (/root/reference/kindel/kindel.py:421)."""
+    calls: dict[int, bytes | None] = {}
+    if len(ins.pos) == 0:
+        return calls
+    order = np.lexsort((-ins.count, ins.pos))
+    pos_sorted = ins.pos[order]
+    cnt_sorted = ins.count[order]
+    id_sorted = ins.str_id[order]
+    starts = np.flatnonzero(np.r_[True, pos_sorted[1:] != pos_sorted[:-1]])
+    ends = np.r_[starts[1:], len(pos_sorted)]
+    for s, e in zip(starts, ends):
+        p = int(pos_sorted[s])
+        best = cnt_sorted[s]
+        if e - s > 1 and cnt_sorted[s + 1] == best:
+            calls[p] = None  # tie → 'N'
+        else:
+            calls[p] = ins.strings[id_sorted[s]]
+    return calls
+
+
+def resolve_patches(cdr_patches, L: int) -> list[tuple[int, int, bytes]]:
+    """Resolve CDR patches into the non-overlapping applied spans the
+    reference's scan-with-skip produces (:393-401): first patch in list
+    order wins at a given start; a patch starting inside an applied span is
+    skipped; each patch consumes max(span, 1) positions."""
+    applied: list[tuple[int, int, bytes]] = []
+    if not cdr_patches:
+        return applied
+    by_start: dict[int, Region] = {}
+    for r in cdr_patches:
+        if r.seq and 0 <= r.start < L and r.start not in by_start:
+            by_start[r.start] = r
+    cursor = 0
+    for start in sorted(by_start):
+        if start < cursor:
+            continue
+        r = by_start[start]
+        span = r.end - r.start
+        applied.append((start, start + span, r.seq.lower().encode()))
+        cursor = start + max(span, 1)
+    return applied
+
+
+def assemble(
+    masks: CallMasks,
+    ins_calls: dict,
+    cdr_patches,
+    trim_ends: bool,
+    min_depth: int,
+    uppercase: bool,
+    build_changes: bool = True,
+) -> CallResult:
+    L = len(masks.base_char)
+    applied = resolve_patches(cdr_patches, L)
+
+    emit_chars = np.where(masks.n_mask, np.uint8(_N), masks.base_char)
+    keep = ~masks.del_mask
+    ins_mask = masks.ins_mask
+
+    parts: list[bytes] = []
+
+    def emit_segment(a: int, b: int):
+        if a >= b:
+            return
+        prev = a
+        for off in np.flatnonzero(ins_mask[a:b]):
+            p = a + int(off)
+            parts.append(emit_chars[prev:p][keep[prev:p]].tobytes())
+            s = ins_calls.get(p)
+            parts.append(s.lower() if s is not None else b"N")
+            prev = p
+        parts.append(emit_chars[prev:b][keep[prev:b]].tobytes())
+
+    seg_start = 0
+    for start, end, seq in applied:
+        emit_segment(seg_start, min(start, L))
+        parts.append(seq)
+        seg_start = max(seg_start, min(max(end, start + 1), L))
+    emit_segment(seg_start, L)
+
+    seq = b"".join(parts).decode("ascii")
+    if trim_ends:
+        seq = seq.strip("N")
+    if uppercase:
+        seq = seq.upper()
+
+    changes: list = []
+    if build_changes:
+        changes = [None] * L
+        patch_skip = np.zeros(L, dtype=bool)
+        for start, end, _ in applied:
+            patch_skip[start : min(max(end, start + 1), L)] = True
+        for p in np.flatnonzero(masks.del_mask & ~patch_skip):
+            changes[p] = "D"
+        for p in np.flatnonzero(masks.n_mask & ~patch_skip):
+            changes[p] = "N"
+        for p in np.flatnonzero(ins_mask & ~patch_skip):
+            changes[p] = "I"
+    return CallResult(sequence=seq, changes=changes)
+
+
+def call_consensus(
+    pileup: Pileup,
+    cdr_patches: list[Region] | None = None,
+    trim_ends: bool = False,
+    min_depth: int = 1,
+    uppercase: bool = False,
+    build_changes: bool = True,
+) -> CallResult:
+    L = pileup.ref_len
+    masks = compute_masks(
+        pileup.weights,
+        pileup.deletions[:L],
+        pileup.ins.totals[:L].astype(np.int64),
+        min_depth,
+    )
+    ins_calls = _insertion_calls(pileup.ins) if masks.ins_mask.any() else {}
+    return assemble(
+        masks, ins_calls, cdr_patches, trim_ends, min_depth, uppercase,
+        build_changes,
+    )

@@ -1,0 +1,222 @@
+"""L3-jax — fused device pipeline: event scatter + consensus call in one jit.
+
+Transfer-minimal by design. The host↔device link can be the bottleneck
+(axon-tunneled TPUs move ~6 MB/s up, ~16 MB/s down), so the kernel:
+
+  * uploads match events as *op spans* — (ref_start, length) per CIGAR
+    run (~KBs) plus 4-bit-packed base codes — and reconstructs per-base
+    positions on device with a searchsorted over the span offsets;
+  * downloads one 4-bit emission code per position (deletion-skip / base /
+    N), plus bit-packed decision masks and two depth scalars for reports.
+
+For a 6.1 Mb reference that is ~1.3 MB up / ~4 MB down instead of
+~14 MB up / ~146 MB down for naive event upload + count-tensor download.
+
+Only the rare variable-length splices (insertion strings, CDR patches) stay
+on host — the reference's per-position Python loop
+(/root/reference/kindel/kindel.py:384-430) is otherwise entirely on device.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kindel_tpu.call import CallMasks, CallResult, _insertion_calls, assemble
+from kindel_tpu.events import BASES, EventSet, N_CHANNELS
+from kindel_tpu.pileup import build_insertion_table
+from kindel_tpu.pileup_jax import PAD_POS, _bucket, _pad
+
+#: emission encoding: 0 = emit nothing (deletion call), 1..5 = A,T,G,C,N
+EMIT_ASCII = np.frombuffer(b"\x00" + BASES, dtype=np.uint8)
+
+
+def compress_match_events(match_pos: np.ndarray, match_base: np.ndarray):
+    """Lossless compression of the match-event stream into contiguous op
+    spans. match_pos is a concatenation of ascending unit-stride runs (one
+    per M/=/X op), so span boundaries are exactly the non-unit steps."""
+    E = len(match_pos)
+    if E == 0:
+        return (
+            np.empty(0, np.int32),
+            np.empty(0, np.int32),
+            np.empty(0, np.uint8),
+        )
+    boundary = np.r_[True, np.diff(match_pos) != 1]
+    starts_idx = np.flatnonzero(boundary)
+    op_r_start = match_pos[starts_idx].astype(np.int32)
+    op_off = starts_idx.astype(np.int32)  # exclusive event offsets
+    # pack 0..4 base codes two-per-byte
+    base = match_base.astype(np.uint8)
+    if E % 2:
+        base = np.r_[base, np.uint8(0)]
+    packed = (base[0::2] << 4) | base[1::2]
+    return op_r_start, op_off, packed
+
+
+@partial(jax.jit, static_argnames=("length", "n_events", "want_masks"))
+def fused_call_kernel(
+    op_r_start,  # int32[O_pad] span start positions (pad: PAD_POS)
+    op_off,  # int32[O_pad] exclusive event offsets (pad: n_events)
+    base_packed,  # uint8[E_pad//2] 4-bit base codes
+    del_pos,  # int32[D_pad] (pad: PAD_POS)
+    ins_pos,  # int32[I_pad] (pad: PAD_POS)
+    ins_cnt,  # int32[I_pad]
+    min_depth,  # int32 scalar
+    *,
+    length: int,
+    n_events: int,
+    want_masks: bool,
+):
+    """Reconstruct match events, scatter counts, call every position.
+
+    Returns (emit_packed, masks_or_none, depth_min, depth_max).
+    """
+    E_pad = base_packed.shape[0] * 2
+    # unpack 4-bit base codes
+    base = jnp.stack(
+        [base_packed >> 4, base_packed & 0xF], axis=1
+    ).reshape(E_pad).astype(jnp.int32)
+
+    k = jnp.arange(E_pad, dtype=jnp.int32)
+    op_id = jnp.searchsorted(op_off, k, side="right") - 1
+    op_id = jnp.clip(op_id, 0, op_off.shape[0] - 1)
+    pos = op_r_start[op_id] + (k - op_off[op_id])
+    pos = jnp.where(k < n_events, pos, PAD_POS)
+
+    weights = (
+        jnp.zeros(length * N_CHANNELS, jnp.int32)
+        .at[pos * N_CHANNELS + base]
+        .add(1, mode="drop")
+        .reshape(length, N_CHANNELS)
+    )
+    deletions = jnp.zeros(length, jnp.int32).at[del_pos].add(1, mode="drop")
+    ins_totals = (
+        jnp.zeros(length, jnp.int32).at[ins_pos].add(ins_cnt, mode="drop")
+    )
+
+    acgt_depth = weights[:, :4].sum(axis=1)
+    depth_next = jnp.concatenate([acgt_depth[1:], jnp.zeros(1, jnp.int32)])
+
+    freq = weights.max(axis=1)
+    base_idx = jnp.argmax(weights, axis=1)  # first max wins, order A,T,G,C,N
+    tie = (freq > 0) & ((weights == freq[:, None]).sum(axis=1) > 1)
+    base_idx = jnp.where(weights.sum(axis=1) == 0, N_CHANNELS - 1, base_idx)
+    base_code = jnp.where(tie, N_CHANNELS - 1, base_idx) + 1  # 1..5
+
+    # integer-exact thresholds: d > 0.5*a  ⟺  2d > a
+    del_mask = deletions * 2 > acgt_depth
+    n_mask = ~del_mask & (acgt_depth < min_depth)
+    ins_mask = (
+        ~del_mask
+        & ~n_mask
+        & (ins_totals * 2 > jnp.minimum(acgt_depth, depth_next))
+    )
+
+    emit = jnp.where(del_mask, 0, jnp.where(n_mask, N_CHANNELS, base_code))
+    emit = emit.astype(jnp.uint8)
+    if emit.shape[0] % 2:
+        emit = jnp.concatenate([emit, jnp.zeros(1, jnp.uint8)])
+    emit_packed = (emit[0::2] << 4) | emit[1::2]
+
+    masks_packed = None
+    if want_masks:
+        masks_packed = (
+            jnp.packbits(del_mask),
+            jnp.packbits(n_mask),
+            jnp.packbits(ins_mask),
+        )
+    return emit_packed, masks_packed, acgt_depth.min(), acgt_depth.max()
+
+
+def _rid_events(ev: EventSet, rid: int):
+    L = int(ev.ref_lens[rid])
+    sel = ev.match_rid == rid
+    mp = ev.match_pos[sel]
+    mb = ev.match_base[sel]
+    sel = ev.del_rid == rid
+    dp = ev.del_pos[sel]
+    dp = dp[dp < L].astype(np.int32)
+    ipos, icnt = [], []
+    for (r, p, _s), c in ev.insertions.items():
+        if r == rid and p < L:
+            ipos.append(p)
+            icnt.append(c)
+    return L, mp, mb, dp, np.asarray(ipos, np.int32), np.asarray(icnt, np.int32)
+
+
+def device_call(ev: EventSet, rid: int, min_depth: int = 1,
+                want_masks: bool = True):
+    """Run the fused kernel for one reference.
+
+    Returns (emit_codes uint8[L] (0=skip,1..5=ATGCN), CallMasks|None,
+    depth_min, depth_max)."""
+    L, mp, mb, dp, ip, ic = _rid_events(ev, rid)
+
+    op_r_start, op_off, base_packed = compress_match_events(mp, mb)
+    n_events = len(mp)
+    O_pad = _bucket(len(op_r_start), 256)
+    B_pad = _bucket(len(base_packed), 1024)
+    D_pad = _bucket(len(dp), 256)
+    I_pad = _bucket(len(ip), 256)
+
+    emit_packed, masks_packed, dmin, dmax = fused_call_kernel(
+        jnp.asarray(_pad(op_r_start, O_pad, PAD_POS)),
+        jnp.asarray(_pad(op_off, O_pad, np.int32(n_events))),
+        jnp.asarray(_pad(base_packed, B_pad, 0)),
+        jnp.asarray(_pad(dp, D_pad, PAD_POS)),
+        jnp.asarray(_pad(ip, I_pad, PAD_POS)),
+        jnp.asarray(_pad(ic, I_pad, 0)),
+        jnp.int32(min_depth),
+        length=L,
+        n_events=n_events,
+        want_masks=want_masks,
+    )
+    emit_b = np.asarray(emit_packed)
+    emit = np.empty(emit_b.shape[0] * 2, dtype=np.uint8)
+    emit[0::2] = emit_b >> 4
+    emit[1::2] = emit_b & 0xF
+    emit = emit[:L]
+
+    masks = None
+    if want_masks:
+        db, nb, ib = (np.asarray(x) for x in masks_packed)
+        masks = CallMasks(
+            base_char=EMIT_ASCII[np.where(emit == 0, N_CHANNELS, emit)],
+            del_mask=np.unpackbits(db)[:L].astype(bool),
+            n_mask=np.unpackbits(nb)[:L].astype(bool),
+            ins_mask=np.unpackbits(ib)[:L].astype(bool),
+        )
+    return emit, masks, int(dmin), int(dmax)
+
+
+def call_consensus_fused(
+    ev: EventSet,
+    rid: int,
+    pileup=None,
+    cdr_patches=None,
+    trim_ends: bool = False,
+    min_depth: int = 1,
+    uppercase: bool = False,
+    build_changes: bool = True,
+) -> tuple[CallResult, int, int]:
+    """Fused-device equivalent of kindel_tpu.call.call_consensus. `pileup`
+    supplies insertion-string majority resolution when insertions emit.
+
+    Returns (CallResult, depth_min, depth_max) — the depth scalars feed the
+    per-reference report without any count-tensor download."""
+    _emit, masks, dmin, dmax = device_call(
+        ev, rid, min_depth, want_masks=True
+    )
+    ins_calls = {}
+    if masks.ins_mask.any():
+        ins_table = pileup.ins if pileup is not None else build_insertion_table(ev, rid)
+        ins_calls = _insertion_calls(ins_table)
+    res = assemble(
+        masks, ins_calls, cdr_patches, trim_ends, min_depth, uppercase,
+        build_changes,
+    )
+    return res, dmin, dmax

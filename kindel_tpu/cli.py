@@ -1,0 +1,196 @@
+"""L5 — command-line interface.
+
+Subcommand surface matches the reference CLI (consensus / weights /
+features / plot / version, /root/reference/kindel/cli.py:9-70) plus the
+`variants` subcommand its README promised (README.md:106). Every data
+subcommand takes `--backend {numpy,jax}`. Flag names and defaults replicate
+the reference — including the CLI default min_overlap=7 vs the Python API's 9
+(/root/reference/kindel/cli.py:13 vs kindel.py:492; SURVEY §2.1).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from kindel_tpu import __version__, workloads
+
+
+def _add_backend(p: argparse.ArgumentParser):
+    p.add_argument(
+        "--backend",
+        choices=workloads.BACKENDS,
+        default="numpy",
+        help="compute backend: numpy (host oracle) or jax (TPU/jit)",
+    )
+
+
+def _consensus_parser(sub):
+    p = sub.add_parser(
+        "consensus", help="infer consensus sequence(s) from a SAM/BAM file"
+    )
+    p.add_argument("bam_path", help="path to SAM/BAM file")
+    p.add_argument(
+        "-r", "--realign", action="store_true",
+        help="attempt to reconstruct reference around soft-clip boundaries",
+    )
+    p.add_argument(
+        "--min-depth", type=int, default=1,
+        help="substitute Ns at coverage depths beneath this value",
+    )
+    p.add_argument(
+        "--min-overlap", type=int, default=7,
+        help="match length required to close soft-clipped gaps",
+    )
+    p.add_argument(
+        "-c", "--clip-decay-threshold", type=float, default=0.1,
+        help="read depth fraction at which to cease clip extension",
+    )
+    p.add_argument(
+        "--mask-ends", type=int, default=50,
+        help="ignore clip dominant positions within n positions of termini",
+    )
+    p.add_argument(
+        "-t", "--trim-ends", action="store_true",
+        help="trim ambiguous nucleotides (Ns) from sequence ends",
+    )
+    p.add_argument(
+        "-u", "--uppercase", action="store_true",
+        help="close gaps using uppercase alphabet",
+    )
+    _add_backend(p)
+
+
+def cmd_consensus(args) -> int:
+    res = workloads.bam_to_consensus(
+        args.bam_path,
+        realign=args.realign,
+        min_depth=args.min_depth,
+        min_overlap=args.min_overlap,
+        clip_decay_threshold=args.clip_decay_threshold,
+        mask_ends=args.mask_ends,
+        trim_ends=args.trim_ends,
+        uppercase=args.uppercase,
+        backend=args.backend,
+    )
+    print("\n".join(res.refs_reports.values()), file=sys.stderr)
+    for record in res.consensuses:
+        print(f">{record.name}")
+        print(record.sequence)
+    return 0
+
+
+def cmd_weights(args) -> int:
+    df = workloads.weights(
+        args.bam_path,
+        relative=args.relative,
+        confidence=not args.no_confidence,
+        confidence_alpha=args.confidence_alpha,
+        backend=args.backend,
+    )
+    df.to_csv(sys.stdout, sep="\t", index=False)
+    return 0
+
+
+def cmd_features(args) -> int:
+    df = workloads.features(args.bam_path, backend=args.backend)
+    df.to_csv(sys.stdout, sep="\t", index=False)
+    return 0
+
+
+def cmd_variants(args) -> int:
+    df = workloads.variants(
+        args.bam_path,
+        min_count=args.min_count,
+        min_frequency=args.min_frequency,
+        indels=not args.no_indels,
+        backend=args.backend,
+    )
+    df.to_csv(sys.stdout, sep="\t", index=False)
+    return 0
+
+
+def cmd_plot(args) -> int:
+    workloads.plot_clips(args.bam_path, backend=args.backend)
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="kindel-tpu",
+        description="TPU-native indel-aware consensus from aligned BAMs",
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    _consensus_parser(sub)
+
+    p = sub.add_parser(
+        "weights", help="per-site nucleotide frequencies and coverage"
+    )
+    p.add_argument("bam_path", help="path to SAM/BAM file")
+    p.add_argument(
+        "-r", "--relative", action="store_true",
+        help="output relative nucleotide frequencies",
+    )
+    p.add_argument(
+        "-n", "--no-confidence", action="store_true",
+        help="skip consensus confidence intervals",
+    )
+    p.add_argument(
+        "-c", "--confidence-alpha", type=float, default=0.01,
+        help="confidence interval alpha",
+    )
+    _add_backend(p)
+
+    p = sub.add_parser(
+        "features",
+        help="relative per-site nucleotide frequencies incl. indels",
+    )
+    p.add_argument("bam_path", help="path to SAM/BAM file")
+    _add_backend(p)
+
+    p = sub.add_parser(
+        "variants",
+        help="variants exceeding absolute and relative frequency thresholds",
+    )
+    p.add_argument("bam_path", help="path to SAM/BAM file")
+    p.add_argument(
+        "-a", "--min-count", type=int, default=1,
+        help="minimum absolute observation count",
+    )
+    p.add_argument(
+        "-f", "--min-frequency", type=float, default=0.0,
+        help="minimum relative frequency",
+    )
+    p.add_argument(
+        "--no-indels", action="store_true",
+        help="exclude insertion/deletion variants",
+    )
+    _add_backend(p)
+
+    p = sub.add_parser(
+        "plot", help="sitewise depth/soft-clipping HTML dashboard"
+    )
+    p.add_argument("bam_path", help="path to SAM/BAM file")
+    _add_backend(p)
+
+    sub.add_parser("version", help="show version")
+    return parser
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.command == "version":
+        print(f"kindel-tpu {__version__}")
+        return 0
+    return {
+        "consensus": cmd_consensus,
+        "weights": cmd_weights,
+        "features": cmd_features,
+        "variants": cmd_variants,
+        "plot": cmd_plot,
+    }[args.command](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,101 @@
+"""Reference-shaped compatibility API.
+
+Exposes the dense kindel-tpu tensors through the exact object shapes the
+reference's public Python API returns — `parse_bam(path)` yielding an
+OrderedDict of 12-field `alignment` namedtuples whose weights are lists of
+{"A","T","G","C","N"} dicts (/root/reference/kindel/kindel.py:97-128,
+131-153) — so code (and tests) written against the reference run unmodified
+against this framework.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict, defaultdict, namedtuple
+
+import numpy as np
+
+from kindel_tpu.events import BASES, N_CHANNELS, extract_events
+from kindel_tpu.io import load_alignment
+from kindel_tpu.pileup import InsertionTable, Pileup, build_pileups
+
+alignment = namedtuple(
+    "alignment",
+    [
+        "ref_id",
+        "weights",
+        "insertions",
+        "deletions",
+        "clip_starts",
+        "clip_ends",
+        "clip_start_weights",
+        "clip_end_weights",
+        "clip_start_depth",
+        "clip_end_depth",
+        "clip_depth",
+        "consensus_depth",
+    ],
+)
+
+_BASE_STRS = [chr(b) for b in BASES]
+
+
+def _dicts(arr: np.ndarray) -> list[dict]:
+    """[L,5] count block → list of per-position dicts in reference key order."""
+    return [dict(zip(_BASE_STRS, map(int, row))) for row in arr]
+
+
+def pileup_to_alignment(p: Pileup) -> alignment:
+    ins_list = [defaultdict(int) for _ in range(p.ref_len + 1)]
+    for pos, sid, cnt in zip(p.ins.pos, p.ins.str_id, p.ins.count):
+        ins_list[int(pos)][p.ins.strings[int(sid)].decode("ascii")] = int(cnt)
+    return alignment(
+        ref_id=p.ref_id,
+        weights=_dicts(p.weights),
+        insertions=ins_list,
+        deletions=[int(x) for x in p.deletions],
+        clip_starts=[int(x) for x in p.clip_starts],
+        clip_ends=[int(x) for x in p.clip_ends],
+        clip_start_weights=_dicts(p.clip_start_weights),
+        clip_end_weights=_dicts(p.clip_end_weights),
+        clip_start_depth=[int(x) for x in p.clip_start_depth],
+        clip_end_depth=[int(x) for x in p.clip_end_depth],
+        clip_depth=[int(x) for x in p.clip_depth],
+        consensus_depth=np.asarray(p.consensus_depth),
+    )
+
+
+def parse_bam(bam_path) -> OrderedDict:
+    """Reference-shaped parse: OrderedDict[ref_id -> alignment namedtuple]."""
+    pileups = build_pileups(extract_events(load_alignment(bam_path)))
+    return OrderedDict(
+        (ref_id, pileup_to_alignment(p)) for ref_id, p in pileups.items()
+    )
+
+
+def pileup_from_reference_arrays(weights, deletions, clip_start_weights,
+                                 clip_end_weights) -> Pileup:
+    """Build a dense Pileup from reference-shaped lists-of-dicts (the
+    argument convention of the reference's cdrp_consensuses,
+    /root/reference/kindel/kindel.py:278-287)."""
+    L = len(weights)
+
+    def _block(lod):
+        arr = np.zeros((L, N_CHANNELS), dtype=np.int32)
+        for i, w in enumerate(lod):
+            for j, b in enumerate(_BASE_STRS):
+                arr[i, j] = w.get(b, 0)
+        return arr
+
+    dels = np.zeros(L + 1, dtype=np.int32)
+    dels[: len(deletions)] = np.asarray(deletions[: L + 1], dtype=np.int32)
+    return Pileup(
+        ref_id="",
+        ref_len=L,
+        weights=_block(weights),
+        clip_start_weights=_block(clip_start_weights),
+        clip_end_weights=_block(clip_end_weights),
+        clip_starts=np.zeros(L + 1, dtype=np.int32),
+        clip_ends=np.zeros(L + 1, dtype=np.int32),
+        deletions=dels,
+        ins=InsertionTable.empty(L),
+    )

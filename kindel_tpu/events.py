@@ -1,0 +1,383 @@
+"""L1a — vectorized CIGAR expansion into flat event streams.
+
+The reference accumulates per-position Python dicts by walking every read's
+CIGAR one base at a time (/root/reference/kindel/kindel.py:21-128,
+`parse_records`). kindel-tpu instead expands all reads' CIGARs in one
+vectorized pass into flat (reference, position, channel) event arrays; the
+dense count tensors are then pure scatter-adds (numpy bincount on host,
+segment-sum on device) — an order-independent reduction, which is what makes
+the position axis shardable across a TPU mesh.
+
+Accumulator semantics replicated exactly from the reference
+(/root/reference/kindel/kindel.py:40-81):
+
+  * records skipped when unmapped (FLAG 0x4) or len(seq) <= 1 (:43-46)
+  * M/=/X      count read base at r_pos into weights; advance both (:49-54)
+  * I          whole inserted string counted at (unadvanced) r_pos (:55-58)
+  * D          deletions[r_pos+k] += 1 for k<len; advance ref (:59-62)
+  * N          *ignored entirely* — no coordinate advance (no branch exists;
+               quirk documented in SURVEY.md §2.1, consciously replicated)
+  * S at i==0  clip_ends[r_pos] += 1; clipped bases projected leftwards into
+               clip_end_weights[r_pos-len+gap_i] for gap_i with index >= 0;
+               query advances (:63-73)
+  * S at i>0   clip_starts[r_pos-1] += 1; clipped bases projected rightwards
+               into clip_start_weights while r_pos < ref_len, advancing BOTH
+               r_pos and q_pos only while in range (:74-81)
+  * H/P        ignored.
+
+Python negative-index wrap-around (e.g. clip_starts[-1] when r_pos == 0)
+is replicated explicitly. Bases outside {A,T,G,C,N} are counted as N
+(divergence: the reference would raise KeyError; none occur in practice).
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from dataclasses import dataclass
+
+import numpy as np
+
+from kindel_tpu.io.records import (
+    ReadBatch,
+    ragged_indices,
+    ragged_local_offsets,
+    segment_exclusive_cumsum,
+    FLAG_UNMAPPED,
+    OP_M,
+    OP_I,
+    OP_D,
+    OP_S,
+    OP_EQ,
+    OP_X,
+)
+
+#: channel order matches the reference's dict insertion order
+#: {"A","T","G","C","N"} (/root/reference/kindel/kindel.py:29) — argmax ties
+#: resolve to the first maximum in this order, exactly like Python max().
+BASES = b"ATGCN"
+N_CHANNELS = 5
+
+#: ASCII byte → channel code (unknown → N)
+BASE_CODE = np.full(256, 4, dtype=np.uint8)
+for _i, _b in enumerate(BASES):
+    BASE_CODE[_b] = _i
+
+
+@dataclass
+class EventSet:
+    """Flat event streams for one decoded alignment file.
+
+    All positions are *local* to their reference (rid indexes ref_names).
+    weights/clip-weight positions index [0, ref_len); clip_starts/clip_ends/
+    deletions/insertions positions index [0, ref_len] (the reference's arrays
+    have ref_len+1 entries, /root/reference/kindel/kindel.py:36-39).
+    """
+
+    ref_names: list[str]
+    ref_lens: np.ndarray
+    #: reference ids with >=1 record (any FLAG), in first-appearance order —
+    #: the reference's output ordering (/root/reference/kindel/kindel.py:143-151)
+    present_ref_ids: list[int]
+
+    match_rid: np.ndarray
+    match_pos: np.ndarray
+    match_base: np.ndarray
+
+    del_rid: np.ndarray
+    del_pos: np.ndarray
+
+    cs_rid: np.ndarray  # clip_starts events
+    cs_pos: np.ndarray
+    ce_rid: np.ndarray  # clip_ends events
+    ce_pos: np.ndarray
+
+    csw_rid: np.ndarray  # clip_start_weights base events
+    csw_pos: np.ndarray
+    csw_base: np.ndarray
+    cew_rid: np.ndarray  # clip_end_weights base events
+    cew_pos: np.ndarray
+    cew_base: np.ndarray
+
+    #: (rid, pos, inserted string) -> count
+    insertions: Counter
+
+
+def _advances(op_code, op_len, op_i):
+    """Reference-rule ref/query advances per op (fast path: trailing-S
+    unclamped; reads needing the clamp are routed to the exact path)."""
+    is_m = (op_code == OP_M) | (op_code == OP_EQ) | (op_code == OP_X)
+    is_ts = (op_code == OP_S) & (op_i > 0)
+    ref_adv = np.where(is_m | (op_code == OP_D) | is_ts, op_len, 0)
+    qry_adv = np.where(
+        is_m | (op_code == OP_I) | (op_code == OP_S), op_len, 0
+    )
+    return ref_adv, qry_adv, is_m, is_ts
+
+
+def extract_events(batch: ReadBatch) -> EventSet:
+    ref_lens = batch.ref_lens
+    n_reads = batch.n_reads
+
+    # Output ordering: refs in order of first record appearance (any FLAG).
+    present_mask = batch.ref_id >= 0
+    if present_mask.any():
+        rids = batch.ref_id[present_mask]
+        uniq, first_idx = np.unique(rids, return_index=True)
+        present_ref_ids = [int(r) for r in uniq[np.argsort(first_idx)]]
+    else:
+        present_ref_ids = []
+
+    seq_lens = batch.seq_len()
+    keep = (
+        (batch.ref_id >= 0)
+        & ((batch.flag & FLAG_UNMAPPED) == 0)
+        & (seq_lens > 1)
+    )
+    kept = np.flatnonzero(keep)
+
+    out = {
+        "match": ([], [], []),
+        "del": ([], []),
+        "cs": ([], []),
+        "ce": ([], []),
+        "csw": ([], [], []),
+        "cew": ([], [], []),
+    }
+    insertions: Counter = Counter()
+
+    if len(kept):
+        n_ops_per = (batch.cig_off[1:] - batch.cig_off[:-1])[kept]
+        has_ops = n_ops_per > 0
+        kept_ops = kept[has_ops]
+        n_ops_per = n_ops_per[has_ops]
+        flat_idx = ragged_indices(batch.cig_off[:-1][kept_ops], n_ops_per)
+        op_code = batch.cig_op[flat_idx]
+        op_len = batch.cig_len[flat_idx]
+        op_i = ragged_local_offsets(n_ops_per)
+        op_read = np.repeat(np.arange(len(kept_ops)), n_ops_per)
+
+        rid_op = batch.ref_id[kept_ops][op_read].astype(np.int64)
+        L_op = ref_lens[rid_op]
+
+        ref_adv, qry_adv, is_m, is_ts = _advances(op_code, op_len, op_i)
+
+        # exclusive cumsums restarting per read
+        seg_starts = np.cumsum(n_ops_per) - n_ops_per
+        r_excl = segment_exclusive_cumsum(ref_adv, seg_starts, n_ops_per)
+        q_excl = segment_exclusive_cumsum(qry_adv, seg_starts, n_ops_per)
+
+        r_start = batch.pos[kept_ops][op_read] + r_excl
+        q_abs = batch.seq_off[:-1][kept_ops][op_read] + q_excl
+
+        # Exact-path routing: a trailing S that would clamp (r_pos would pass
+        # ref_len, so q_pos stops advancing) followed by any op that still
+        # consumes coordinates makes the unclamped cumsum wrong for that read.
+        clamped = is_ts & (r_start + op_len > L_op)
+        matters = is_m | np.isin(op_code, (OP_I, OP_D, OP_S))
+        first_clamped = np.full(len(kept_ops), np.iinfo(np.int64).max)
+        np.minimum.at(first_clamped, op_read, np.where(clamped, op_i, np.iinfo(np.int64).max))
+        last_matters = np.full(len(kept_ops), -1)
+        np.maximum.at(last_matters, op_read, np.where(matters, op_i, -1))
+        slow_read = first_clamped < last_matters
+        fast_op = ~slow_read[op_read]
+
+        _fast_events(
+            out, insertions, batch, kept_ops,
+            op_code[fast_op], op_len[fast_op], op_i[fast_op],
+            op_read[fast_op], rid_op[fast_op], L_op[fast_op],
+            r_start[fast_op], q_abs[fast_op],
+        )
+        for k in np.flatnonzero(slow_read):
+            _exact_read_events(out, insertions, batch, int(kept_ops[k]))
+
+    def _cat(parts, dtype):
+        if not parts:
+            return np.empty(0, dtype=dtype)
+        return np.concatenate([np.asarray(p, dtype=dtype) for p in parts])
+
+    return EventSet(
+        ref_names=batch.ref_names,
+        ref_lens=ref_lens,
+        present_ref_ids=present_ref_ids,
+        match_rid=_cat(out["match"][0], np.int64),
+        match_pos=_cat(out["match"][1], np.int64),
+        match_base=_cat(out["match"][2], np.uint8),
+        del_rid=_cat(out["del"][0], np.int64),
+        del_pos=_cat(out["del"][1], np.int64),
+        cs_rid=_cat(out["cs"][0], np.int64),
+        cs_pos=_cat(out["cs"][1], np.int64),
+        ce_rid=_cat(out["ce"][0], np.int64),
+        ce_pos=_cat(out["ce"][1], np.int64),
+        csw_rid=_cat(out["csw"][0], np.int64),
+        csw_pos=_cat(out["csw"][1], np.int64),
+        csw_base=_cat(out["csw"][2], np.uint8),
+        cew_rid=_cat(out["cew"][0], np.int64),
+        cew_pos=_cat(out["cew"][1], np.int64),
+        cew_base=_cat(out["cew"][2], np.uint8),
+        insertions=insertions,
+    )
+
+
+def _wrap(idx, modulus):
+    """Python negative-index semantics: idx in [-m, 0) wraps to idx+m."""
+    return np.where(idx < 0, idx + modulus, idx)
+
+
+def _fast_events(out, insertions, batch, kept_ops, op_code, op_len, op_i,
+                 op_read, rid_op, L_op, r_start, q_abs):
+    seq = batch.seq
+    is_m = (op_code == OP_M) | (op_code == OP_EQ) | (op_code == OP_X)
+
+    # --- M/=/X: one weighted event per aligned base ---
+    m = np.flatnonzero(is_m)
+    if len(m):
+        lens = op_len[m]
+        pos = ragged_indices(r_start[m], lens)
+        qidx = ragged_indices(q_abs[m], lens)
+        rid = np.repeat(rid_op[m], lens)
+        L = np.repeat(L_op[m], lens)
+        pos = _wrap(pos, L)
+        ok = (pos >= 0) & (pos < L)
+        out["match"][0].append(rid[ok])
+        out["match"][1].append(pos[ok])
+        out["match"][2].append(BASE_CODE[seq[qidx[ok]]])
+
+    # --- D: one event per deleted reference position ---
+    d = np.flatnonzero(op_code == OP_D)
+    if len(d):
+        lens = op_len[d]
+        pos = ragged_indices(r_start[d], lens)
+        rid = np.repeat(rid_op[d], lens)
+        L1 = np.repeat(L_op[d] + 1, lens)
+        pos = _wrap(pos, L1)
+        ok = (pos >= 0) & (pos < L1)
+        out["del"][0].append(rid[ok])
+        out["del"][1].append(pos[ok])
+
+    # --- I: dictionary-encoded on host (rare events) ---
+    iops = np.flatnonzero(op_code == OP_I)
+    if len(iops):
+        for j in iops:
+            rid = int(rid_op[j])
+            L1 = int(L_op[j]) + 1
+            p = int(r_start[j])
+            if p < 0:
+                p += L1
+            if 0 <= p < L1:
+                q0 = int(q_abs[j])
+                nts = bytes(seq[q0 : q0 + int(op_len[j])])
+                insertions[(rid, p, nts)] += 1
+
+    # --- S at i==0: clip_ends event + leftward projection ---
+    s0 = np.flatnonzero((op_code == OP_S) & (op_i == 0))
+    if len(s0):
+        L1 = L_op[s0] + 1
+        p = _wrap(r_start[s0], L1)
+        ok = (p >= 0) & (p < L1)
+        out["ce"][0].append(rid_op[s0][ok])
+        out["ce"][1].append(p[ok])
+        lens = op_len[s0]
+        gap_i = ragged_local_offsets(lens)
+        rel = np.repeat(r_start[s0] - op_len[s0], lens) + gap_i
+        qidx = ragged_indices(q_abs[s0], lens)
+        rid = np.repeat(rid_op[s0], lens)
+        L = np.repeat(L_op[s0], lens)
+        ok = (rel >= 0) & (rel < L)  # reference guards rel >= 0 (:71)
+        out["cew"][0].append(rid[ok])
+        out["cew"][1].append(rel[ok])
+        out["cew"][2].append(BASE_CODE[seq[qidx[ok]]])
+
+    # --- S at i>0: clip_starts event + rightward projection (bounded) ---
+    s1 = np.flatnonzero((op_code == OP_S) & (op_i > 0))
+    if len(s1):
+        L1 = L_op[s1] + 1
+        p = _wrap(r_start[s1] - 1, L1)
+        ok = (p >= 0) & (p < L1)
+        out["cs"][0].append(rid_op[s1][ok])
+        out["cs"][1].append(p[ok])
+        lens = op_len[s1]
+        pos = ragged_indices(r_start[s1], lens)
+        qidx = ragged_indices(q_abs[s1], lens)
+        rid = np.repeat(rid_op[s1], lens)
+        L = np.repeat(L_op[s1], lens)
+        ok = pos < L  # writes stop when r_pos reaches ref_len (:78)
+        pos = _wrap(pos, L)
+        ok &= pos >= 0
+        out["csw"][0].append(rid[ok])
+        out["csw"][1].append(pos[ok])
+        out["csw"][2].append(BASE_CODE[seq[qidx[ok]]])
+
+
+def _exact_read_events(out, insertions, batch, read_idx):
+    """Sequential exact accumulator for reads whose trailing-S clamp affects
+    later ops — bit-for-bit the reference's per-read walk."""
+    rid = int(batch.ref_id[read_idx])
+    L = int(batch.ref_lens[rid])
+    seq = batch.seq[batch.seq_off[read_idx] : batch.seq_off[read_idx + 1]]
+    seq_bytes = seq.tobytes()
+    ops = slice(batch.cig_off[read_idx], batch.cig_off[read_idx + 1])
+    codes = batch.cig_op[ops]
+    lens = batch.cig_len[ops]
+    r = int(batch.pos[read_idx])
+    q = 0
+    match_p, match_b = [], []
+    del_p, cs_p, ce_p = [], [], []
+    csw_p, csw_b, cew_p, cew_b = [], [], [], []
+    for i, (code, ln) in enumerate(zip(codes, lens)):
+        ln = int(ln)
+        if code in (OP_M, OP_EQ, OP_X):
+            for _ in range(ln):
+                p = r if r >= 0 else r + L
+                if 0 <= p < L:
+                    match_p.append(p)
+                    match_b.append(BASE_CODE[seq[q]])
+                r += 1
+                q += 1
+        elif code == OP_I:
+            p = r if r >= 0 else r + L + 1
+            if 0 <= p <= L:
+                insertions[(rid, p, seq_bytes[q : q + ln])] += 1
+            q += ln
+        elif code == OP_D:
+            for k in range(ln):
+                p = r + k if r + k >= 0 else r + k + L + 1
+                if 0 <= p <= L:
+                    del_p.append(p)
+            r += ln
+        elif code == OP_S:
+            if i == 0:
+                p = r if r >= 0 else r + L + 1
+                if 0 <= p <= L:
+                    ce_p.append(p)
+                for gap_i in range(ln):
+                    rel = r - ln + gap_i
+                    if 0 <= rel < L:
+                        cew_p.append(rel)
+                        cew_b.append(BASE_CODE[seq[gap_i]])
+                q += ln
+            else:
+                p = r - 1 if r - 1 >= 0 else r - 1 + L + 1
+                if 0 <= p <= L:
+                    cs_p.append(p)
+                for _ in range(ln):
+                    if r < L:
+                        p = r if r >= 0 else r + L
+                        if 0 <= p < L:
+                            csw_p.append(p)
+                            csw_b.append(BASE_CODE[seq[q]])
+                        r += 1
+                        q += 1
+        # N/H/P: ignored, no advance (reference has no branch for them)
+    for key, plist, blist in (
+        ("match", match_p, match_b),
+        ("csw", csw_p, csw_b),
+        ("cew", cew_p, cew_b),
+    ):
+        if plist:
+            out[key][0].append(np.full(len(plist), rid, dtype=np.int64))
+            out[key][1].append(np.asarray(plist, dtype=np.int64))
+            out[key][2].append(np.asarray(blist, dtype=np.uint8))
+    for key, plist in (("del", del_p), ("cs", cs_p), ("ce", ce_p)):
+        if plist:
+            out[key][0].append(np.full(len(plist), rid, dtype=np.int64))
+            out[key][1].append(np.asarray(plist, dtype=np.int64))

@@ -1,0 +1,42 @@
+"""L0 host I/O: alignment decode (BGZF/BAM/SAM) and FASTA output."""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from kindel_tpu.io import bgzf
+from kindel_tpu.io.bam import parse_bam_bytes
+from kindel_tpu.io.records import ReadBatch
+from kindel_tpu.io.sam import parse_sam_bytes
+
+
+def load_alignment(path) -> ReadBatch:
+    """Sniff and decode a SAM/BAM file into a columnar ReadBatch.
+
+    Prefers the native C++ decoder (kindel_tpu.io.native) when built; falls
+    back to the vectorized numpy decoder.
+    """
+    data = Path(path).read_bytes()
+    if bgzf.is_gzipped(data):
+        decompressed = None
+        try:
+            from kindel_tpu.io import native
+
+            if native.available():
+                decompressed = native.bgzf_decompress(data)
+        except Exception:
+            decompressed = None
+        data = decompressed if decompressed is not None else bgzf.decompress(data)
+    if data[:4] == b"BAM\x01":
+        try:
+            from kindel_tpu.io import native
+
+            if native.available():
+                return native.parse_bam_bytes(data)
+        except Exception:
+            pass
+        return parse_bam_bytes(data)
+    batch = parse_sam_bytes(data)
+    if not batch.ref_names and batch.n_reads == 0:
+        raise ValueError(f"{path}: not a recognizable SAM/BAM file")
+    return batch

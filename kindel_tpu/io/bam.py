@@ -1,0 +1,118 @@
+"""First-party BAM binary decoder → columnar ReadBatch.
+
+Replaces the reference's `simplesam.Reader` + `samtools view` subprocess
+pipeline (/root/reference/kindel/kindel.py:131-153) with an in-process,
+vectorized decode: record boundaries are walked once, then every field is
+extracted with batched numpy gathers — no per-base or per-field Python.
+
+Layout per BAM spec v1 (little-endian):
+  magic "BAM\\1" | l_text | text | n_ref | (l_name name l_ref)*
+  records: block_size | refID | pos | l_read_name mapq bin | n_cigar flag |
+           l_seq | next_refID next_pos tlen | read_name | cigar u32*n |
+           seq nibbles | qual | tags
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+from kindel_tpu.io.records import ReadBatch, ragged_indices, ragged_local_offsets
+
+#: BAM 4-bit sequence code → ASCII (SAM spec table)
+SEQ_NT16 = np.frombuffer(b"=ACMGRSVTWYHKDBN", dtype=np.uint8)
+
+
+def _gather_scalar(buf: np.ndarray, offs: np.ndarray, dtype, width: int):
+    """Vectorized fixed-width field gather at the given byte offsets."""
+    if len(offs) == 0:
+        return np.empty(0, dtype=dtype)
+    idx = offs[:, None] + np.arange(width, dtype=np.int64)[None, :]
+    return buf[idx].reshape(-1).view(dtype)
+
+
+def parse_bam_bytes(data: bytes) -> ReadBatch:
+    """Decode an (already decompressed) BAM byte string."""
+    if data[:4] != b"BAM\x01":
+        raise ValueError("not a BAM stream (bad magic)")
+    l_text = struct.unpack_from("<i", data, 4)[0]
+    off = 8 + l_text
+    n_ref = struct.unpack_from("<i", data, off)[0]
+    off += 4
+    ref_names: list[str] = []
+    ref_lens = np.empty(n_ref, dtype=np.int64)
+    for i in range(n_ref):
+        l_name = struct.unpack_from("<i", data, off)[0]
+        name = data[off + 4 : off + 4 + l_name - 1].decode("ascii")
+        l_ref = struct.unpack_from("<i", data, off + 4 + l_name)[0]
+        ref_names.append(name)
+        ref_lens[i] = l_ref
+        off += 8 + l_name
+
+    # Walk record boundaries (data-dependent chain; cheap — one unpack per
+    # read; the native decoder does this in C++ for very large inputs).
+    offsets = []
+    n = len(data)
+    while off + 4 <= n:
+        block_size = struct.unpack_from("<i", data, off)[0]
+        if block_size < 32 or off + 4 + block_size > n:
+            raise ValueError(
+                f"corrupt BAM record at byte {off}: block_size={block_size}"
+            )
+        offsets.append(off + 4)  # start of record body
+        off += 4 + block_size
+
+    offs = np.asarray(offsets, dtype=np.int64)
+    return _fields_from_offsets(data, offs, ref_names, ref_lens)
+
+
+def _fields_from_offsets(data: bytes, offs: np.ndarray, ref_names, ref_lens) -> ReadBatch:
+    """Vectorized field extraction given record-body byte offsets (shared by
+    the pure-Python and native decoders)."""
+    buf = np.frombuffer(data, dtype=np.uint8)
+
+    ref_id = _gather_scalar(buf, offs, "<i4", 4).astype(np.int32)
+    pos = _gather_scalar(buf, offs + 4, "<i4", 4).astype(np.int64)
+    l_read_name = _gather_scalar(buf, offs + 8, np.uint8, 1).astype(np.int64)
+    mapq = _gather_scalar(buf, offs + 9, np.uint8, 1)
+    n_cigar = _gather_scalar(buf, offs + 12, "<u2", 2).astype(np.int64)
+    flag = _gather_scalar(buf, offs + 14, "<u2", 2)
+    l_seq = _gather_scalar(buf, offs + 16, "<i4", 4).astype(np.int64)
+
+    # CIGAR: u32 little-endian words, len<<4 | op
+    cig_starts = offs + 32 + l_read_name
+    cig_bytes = buf[ragged_indices(cig_starts, 4 * n_cigar)]
+    cig_u32 = cig_bytes.view("<u4").astype(np.int64)
+    cig_op = (cig_u32 & 0xF).astype(np.uint8)
+    cig_len = (cig_u32 >> 4).astype(np.int64)
+    cig_off = np.zeros(len(offs) + 1, dtype=np.int64)
+    np.cumsum(n_cigar, out=cig_off[1:])
+
+    # SEQ: 4-bit packed, high nibble first
+    seq_starts = cig_starts + 4 * n_cigar
+    seq_nbytes = (l_seq + 1) // 2
+    packed = buf[ragged_indices(seq_starts, seq_nbytes)]
+    nibbles = np.empty(2 * len(packed), dtype=np.uint8)
+    nibbles[0::2] = packed >> 4
+    nibbles[1::2] = packed & 0xF
+    # Trim odd-length padding nibble per read
+    local = ragged_local_offsets(2 * seq_nbytes)
+    keep = local < np.repeat(l_seq, 2 * seq_nbytes)
+    seq = SEQ_NT16[nibbles[keep]]
+    seq_off = np.zeros(len(offs) + 1, dtype=np.int64)
+    np.cumsum(l_seq, out=seq_off[1:])
+
+    return ReadBatch(
+        ref_names=ref_names,
+        ref_lens=ref_lens,
+        ref_id=ref_id,
+        pos=pos,
+        flag=flag.astype(np.uint16),
+        seq=seq,
+        seq_off=seq_off,
+        cig_op=cig_op,
+        cig_len=cig_len,
+        cig_off=cig_off,
+        mapq=mapq,
+    )

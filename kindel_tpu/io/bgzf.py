@@ -1,0 +1,69 @@
+"""BGZF (block gzip) decompression — first-party replacement for the
+samtools/simplesam subprocess decode path the reference uses
+(/root/reference/kindel/kindel.py:131-153 shells out to `samtools view`).
+
+A BGZF file is a series of standard gzip members, each carrying a BSIZE
+extra field (RFC1952 XFLG subfield "BC"). Any conforming gzip reader can
+decode the concatenation; we walk members explicitly so the decode can be
+chunked/streamed and later handed to the native C++ decoder.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+
+#: BGZF EOF marker — an empty gzip member appended to well-formed files.
+BGZF_EOF = bytes.fromhex(
+    "1f8b08040000000000ff0600424302001b0003000000000000000000"
+)
+
+_GZIP_MAGIC = b"\x1f\x8b"
+
+
+def is_gzipped(data: bytes) -> bool:
+    return data[:2] == _GZIP_MAGIC
+
+
+def _member_bsize(data: bytes, off: int) -> int | None:
+    """Return the BGZF BSIZE (total member length) if member at `off` carries
+    the BC extra subfield, else None."""
+    if data[off : off + 2] != _GZIP_MAGIC:
+        raise ValueError(f"not a gzip member at offset {off}")
+    flg = data[off + 3]
+    if not flg & 4:  # no FEXTRA
+        return None
+    xlen = struct.unpack_from("<H", data, off + 10)[0]
+    xoff = off + 12
+    xend = xoff + xlen
+    while xoff + 4 <= xend:
+        si1, si2, slen = struct.unpack_from("<BBH", data, xoff)
+        if si1 == 66 and si2 == 67 and slen == 2:  # "BC"
+            return struct.unpack_from("<H", data, xoff + 4)[0] + 1
+        xoff += 4 + slen
+    return None
+
+
+def decompress(data: bytes) -> bytes:
+    """Decompress a BGZF (or plain single/multi-member gzip) byte string."""
+    out = []
+    off = 0
+    n = len(data)
+    while off < n:
+        bsize = _member_bsize(data, off)
+        if bsize is not None:
+            # Deflate payload sits between the 18-byte BGZF header and the
+            # 8-byte CRC/ISIZE trailer.
+            payload = data[off + 18 : off + bsize - 8]
+            out.append(zlib.decompress(payload, wbits=-15))
+            off += bsize
+        else:
+            # Generic gzip member: let zlib find the member end.
+            dobj = zlib.decompressobj(wbits=31)
+            out.append(dobj.decompress(data[off:]))
+            out.append(dobj.flush())
+            consumed = len(data) - off - len(dobj.unused_data)
+            if consumed <= 0:
+                break
+            off += consumed
+    return b"".join(out)

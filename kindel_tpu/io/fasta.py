@@ -1,0 +1,42 @@
+"""Minimal first-party FASTA record type and reader/writer.
+
+Replaces the reference's dnaio dependency (`dnaio.Sequence`,
+/root/reference/kindel/kindel.py:433-434).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from pathlib import Path
+
+
+@dataclass
+class Sequence:
+    name: str
+    sequence: str
+    qualities: str | None = None
+
+    def __iter__(self):  # tuple-like unpacking convenience
+        yield self.name
+        yield self.sequence
+
+
+def read_fasta(path) -> list[Sequence]:
+    records: list[Sequence] = []
+    name = None
+    chunks: list[str] = []
+    for line in Path(path).read_text().splitlines():
+        if line.startswith(">"):
+            if name is not None:
+                records.append(Sequence(name, "".join(chunks)))
+            name = line[1:].split()[0] if len(line) > 1 else ""
+            chunks = []
+        elif line:
+            chunks.append(line.strip())
+    if name is not None:
+        records.append(Sequence(name, "".join(chunks)))
+    return records
+
+
+def format_fasta(records) -> str:
+    return "".join(f">{r.name}\n{r.sequence}\n" for r in records)

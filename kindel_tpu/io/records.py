@@ -1,0 +1,107 @@
+"""Columnar alignment-record batch — the L0 output format.
+
+Where the reference materializes a Python object per read
+(/root/reference/kindel/kindel.py:143-148 groups `simplesam` records
+per-rname in RAM), kindel-tpu decodes straight into flat numpy arrays:
+one row per read, with ragged sequence/CIGAR payloads stored as
+concatenated buffers + offset arrays. This is the layout the vectorized
+event extractor (kindel_tpu.events) and the device backends consume.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+#: CIGAR operation codes, in BAM encoding order.
+CIGAR_OPS = b"MIDNSHP=X"
+OP_M, OP_I, OP_D, OP_N, OP_S, OP_H, OP_P, OP_EQ, OP_X = range(9)
+
+#: Whether each op consumes reference / query, per SAM spec (for reference
+#: only — the accumulator applies the *reference implementation's* rules,
+#: which differ for N and trailing S; see kindel_tpu.events).
+FLAG_UNMAPPED = 0x4
+
+
+@dataclass
+class ReadBatch:
+    """Columnar batch of alignment records for one SAM/BAM file."""
+
+    #: reference names in header (@SQ) order
+    ref_names: list[str]
+    #: reference lengths, parallel to ref_names
+    ref_lens: np.ndarray  # int64[n_refs]
+    #: per-read reference index into ref_names; -1 for unmapped ("*")
+    ref_id: np.ndarray  # int32[n_reads]
+    #: per-read 0-based leftmost mapping position
+    pos: np.ndarray  # int64[n_reads]
+    #: per-read FLAG field
+    flag: np.ndarray  # uint16[n_reads]
+    #: concatenated read sequences, uppercase ASCII
+    seq: np.ndarray  # uint8[total_seq]
+    #: per-read offsets into seq (n_reads+1)
+    seq_off: np.ndarray  # int64
+    #: concatenated CIGAR op codes (BAM encoding, 0..8)
+    cig_op: np.ndarray  # uint8[total_ops]
+    #: concatenated CIGAR op lengths
+    cig_len: np.ndarray  # int64[total_ops]
+    #: per-read offsets into cig_op/cig_len (n_reads+1)
+    cig_off: np.ndarray  # int64
+    #: per-read mapping quality (not used by the consensus path; kept for API)
+    mapq: np.ndarray | None = None
+
+    @property
+    def n_reads(self) -> int:
+        return len(self.pos)
+
+    def seq_len(self) -> np.ndarray:
+        return self.seq_off[1:] - self.seq_off[:-1]
+
+    def n_ops(self) -> np.ndarray:
+        return self.cig_off[1:] - self.cig_off[:-1]
+
+
+def ragged_indices(starts: np.ndarray, lens: np.ndarray) -> np.ndarray:
+    """Flat gather indices for ragged ranges [starts[i], starts[i]+lens[i]).
+
+    The core vectorization primitive: replaces per-element Python loops with
+    one repeat/arange pass.
+    """
+    starts = np.asarray(starts, dtype=np.int64)
+    lens = np.asarray(lens, dtype=np.int64)
+    total = int(lens.sum())
+    if total == 0:
+        return np.empty(0, dtype=np.int64)
+    # within-range offsets 0..len-1 for each range
+    ends = np.cumsum(lens)
+    flat = np.arange(total, dtype=np.int64)
+    base = np.repeat(ends - lens, lens)
+    return np.repeat(starts, lens) + (flat - base)
+
+
+def ragged_local_offsets(lens: np.ndarray) -> np.ndarray:
+    """For ragged ranges of the given lengths, the 0..len-1 offset of each
+    flattened element within its range."""
+    lens = np.asarray(lens, dtype=np.int64)
+    total = int(lens.sum())
+    if total == 0:
+        return np.empty(0, dtype=np.int64)
+    ends = np.cumsum(lens)
+    return np.arange(total, dtype=np.int64) - np.repeat(ends - lens, lens)
+
+
+def segment_exclusive_cumsum(values: np.ndarray, seg_starts: np.ndarray,
+                             seg_lens: np.ndarray) -> np.ndarray:
+    """Exclusive cumulative sum of `values` restarting at each segment.
+
+    seg_starts/seg_lens delimit contiguous segments covering a prefix-ordered
+    view of `values` (i.e. values is the concatenation of the segments).
+    """
+    values = np.asarray(values, dtype=np.int64)
+    c = np.cumsum(values)
+    excl = c - values
+    if len(seg_starts) == 0:
+        return excl
+    seg_base = excl[seg_starts]
+    return excl - np.repeat(seg_base, seg_lens)

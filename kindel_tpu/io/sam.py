@@ -1,0 +1,99 @@
+"""SAM text decoder → columnar ReadBatch.
+
+Covers the reference's text-mode path (`simplesam.Reader` over an
+uncompressed SAM, /root/reference/kindel/kindel.py:136-148). Positions are
+converted to 0-based at decode time (the reference does `record.pos - 1`,
+/root/reference/kindel/kindel.py:42).
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from kindel_tpu.io.records import ReadBatch, CIGAR_OPS
+
+_CIG_RE = re.compile(rb"(\d+)([MIDNSHP=X])")
+_OP_CODE = {bytes([op]): i for i, op in enumerate(CIGAR_OPS)}
+
+
+def parse_sam_bytes(data: bytes) -> ReadBatch:
+    ref_names: list[str] = []
+    ref_lens: list[int] = []
+    name_to_id: dict[bytes, int] = {}
+
+    ref_id_l, pos_l, flag_l = [], [], []
+    seq_parts, seq_lens = [], []
+    cig_ops_l, cig_lens_l, cig_counts = [], [], []
+    mapq_l = []
+
+    for line in data.split(b"\n"):
+        if not line:
+            continue
+        if line.startswith(b"@"):
+            if line.startswith(b"@SQ"):
+                sn, ln = None, None
+                for field in line.split(b"\t")[1:]:
+                    if field.startswith(b"SN:"):
+                        sn = field[3:]
+                    elif field.startswith(b"LN:"):
+                        ln = int(field[3:])
+                if sn is not None and ln is not None:
+                    name_to_id[sn] = len(ref_names)
+                    ref_names.append(sn.decode("ascii"))
+                    ref_lens.append(ln)
+            continue
+        fields = line.split(b"\t")
+        if len(fields) < 11:
+            continue
+        flag = int(fields[1])
+        rname = fields[2]
+        pos = int(fields[3]) - 1  # SAM is 1-based
+        mapq = int(fields[4])
+        cigar = fields[5]
+        seq = fields[9].upper()
+
+        ref_id_l.append(name_to_id.get(rname, -1))
+        pos_l.append(pos)
+        flag_l.append(flag)
+        mapq_l.append(mapq)
+        seq_parts.append(seq)
+        seq_lens.append(len(seq))
+        n_ops = 0
+        if cigar != b"*":
+            consumed = 0
+            for m in _CIG_RE.finditer(cigar):
+                if m.start() != consumed:
+                    break
+                consumed = m.end()
+                cig_lens_l.append(int(m.group(1)))
+                cig_ops_l.append(_OP_CODE[m.group(2)])
+                n_ops += 1
+            if consumed != len(cigar):
+                raise ValueError(
+                    f"malformed CIGAR {cigar.decode('ascii', 'replace')!r} "
+                    f"for read {fields[0].decode('ascii', 'replace')!r}"
+                )
+        cig_counts.append(n_ops)
+
+    n = len(pos_l)
+    seq = np.frombuffer(b"".join(seq_parts), dtype=np.uint8)
+    seq_off = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(seq_lens, out=seq_off[1:])
+    cig_off = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(cig_counts, out=cig_off[1:])
+
+    return ReadBatch(
+        ref_names=ref_names,
+        ref_lens=np.asarray(ref_lens, dtype=np.int64),
+        ref_id=np.asarray(ref_id_l, dtype=np.int32),
+        pos=np.asarray(pos_l, dtype=np.int64),
+        flag=np.asarray(flag_l, dtype=np.uint16),
+        seq=seq,
+        seq_off=seq_off,
+        cig_op=np.asarray(cig_ops_l, dtype=np.uint8),
+        cig_len=np.asarray(cig_lens_l, dtype=np.int64),
+        cig_off=cig_off,
+        mapq=np.asarray(mapq_l, dtype=np.uint8),
+    )

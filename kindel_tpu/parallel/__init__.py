@@ -1,0 +1,9 @@
+"""Scale-out: mesh construction, position-axis (sequence-parallel) sharding,
+data-parallel sample batching, and the halo exchange at shard boundaries."""
+
+from kindel_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    bucket_events_by_position,
+    sharded_call,
+    batched_sharded_call,
+)

@@ -1,0 +1,278 @@
+"""Mesh-sharded consensus pipeline (sequence/context parallelism).
+
+The genomic position axis is this framework's long-context axis (SURVEY §5:
+the reference's cost scales with positions, not reads — 9.3 kb → 0.5 s vs
+6.1 Mb → 88 s). Here the axis is sharded over a jax.sharding.Mesh:
+
+  * events are bucketed on host by target position block (every event's
+    final write position is known before the reduction — clip projections
+    included — so no cross-shard scatter is needed),
+  * each device scatter-reduces its block of the dense [L, 5] tensor,
+  * the only cross-device dependency in calling is the one-position
+    lookahead `aligned_depth_next` (/root/reference/kindel/kindel.py:406-408)
+    — a single-element halo exchanged with lax.ppermute over the mesh axis,
+  * CDR/patch metadata (rare, tiny) is gathered to host.
+
+A second mesh axis shards a batch of samples (data parallel): the
+v5e-pod workload of BASELINE.json config 5 (1k BAMs) maps samples over
+`dp` and positions over `sp`.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from kindel_tpu.events import N_CHANNELS, BASES
+from kindel_tpu.pileup_jax import PAD_POS, _bucket, _pad
+
+BASE_ASCII_J = jnp.asarray(np.frombuffer(BASES, dtype=np.uint8))
+_N = np.uint8(ord("N"))
+
+
+def make_mesh(axes: dict[str, int] | None = None) -> Mesh:
+    """Build a Mesh over available devices. Default: all devices on one
+    sequence-parallel axis ("sp")."""
+    devices = np.asarray(jax.devices())
+    if axes is None:
+        axes = {"sp": len(devices)}
+    shape = tuple(axes.values())
+    n = int(np.prod(shape))
+    return Mesh(devices[:n].reshape(shape), tuple(axes.keys()))
+
+
+def bucket_events_by_position(pos, payloads, n_shards: int, block: int,
+                              pad_fill=0):
+    """Host-side bucketing of events into equal-size per-shard blocks.
+
+    Returns (pos_blocks [n_shards, E], payload_blocks...) with positions
+    rebased to their block and padding at PAD_POS (dropped by the scatter).
+    """
+    shard = pos // block
+    order = np.argsort(shard, kind="stable")
+    pos_sorted = pos[order]
+    shard_sorted = shard[order]
+    payloads_sorted = [payload[order] for payload in payloads]
+    counts = np.bincount(shard_sorted, minlength=n_shards)
+    emax = _bucket(int(counts.max()) if len(counts) else 0, 16)
+    pos_out = np.full((n_shards, emax), PAD_POS, dtype=np.int32)
+    payload_out = [
+        np.full((n_shards, emax), pad_fill, dtype=np.int32) for _ in payloads
+    ]
+    starts = np.cumsum(counts) - counts
+    for s in range(n_shards):
+        a, b = starts[s], starts[s] + counts[s]
+        local = pos_sorted[a:b] - s * block
+        pos_out[s, : b - a] = local
+        for i, payload_sorted in enumerate(payloads_sorted):
+            payload_out[i][s, : b - a] = payload_sorted[a:b]
+    return pos_out, payload_out
+
+
+def _local_call(match_pos, match_base, del_pos, ins_pos, ins_cnt, min_depth,
+                *, block: int, axis: str):
+    """Per-shard block of the fused call kernel + halo exchange.
+
+    Runs under shard_map: arrays are this device's event bucket; output is
+    this device's [block, 5] tensor and call decision vectors.
+    """
+    weights = (
+        jnp.zeros(block * N_CHANNELS, jnp.int32)
+        .at[match_pos * N_CHANNELS + match_base]
+        .add(1, mode="drop")
+        .reshape(block, N_CHANNELS)
+    )
+    deletions = jnp.zeros(block, jnp.int32).at[del_pos].add(1, mode="drop")
+    ins_totals = (
+        jnp.zeros(block, jnp.int32).at[ins_pos].add(ins_cnt, mode="drop")
+    )
+
+    acgt_depth = weights[:, :4].sum(axis=1)
+
+    # halo: neighbor's first element becomes this shard's lookahead at its
+    # last position; the final shard's lookahead past L is 0 (:406-410)
+    n = jax.lax.axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+    recv = jax.lax.ppermute(
+        acgt_depth[:1], axis, [((i + 1) % n, i) for i in range(n)]
+    )
+    recv = jnp.where(idx == n - 1, 0, recv)
+    depth_next = jnp.concatenate([acgt_depth[1:], recv])
+
+    freq = weights.max(axis=1)
+    base_idx = jnp.argmax(weights, axis=1)
+    tie = (freq > 0) & ((weights == freq[:, None]).sum(axis=1) > 1)
+    base_idx = jnp.where(weights.sum(axis=1) == 0, N_CHANNELS - 1, base_idx)
+    base_char = jnp.where(tie, _N, BASE_ASCII_J[base_idx])
+
+    del_mask = deletions * 2 > acgt_depth
+    n_mask = ~del_mask & (acgt_depth < min_depth)
+    ins_mask = (
+        ~del_mask
+        & ~n_mask
+        & (ins_totals * 2 > jnp.minimum(acgt_depth, depth_next))
+    )
+    return weights, base_char, del_mask, n_mask, ins_mask
+
+
+@partial(
+    jax.jit, static_argnames=("mesh", "block", "axis")
+)
+def _sharded_call_jit(match_pos, match_base, del_pos, ins_pos, ins_cnt,
+                      min_depth, *, mesh: Mesh, block: int, axis: str):
+    fn = partial(_local_call, block=block, axis=axis)
+    ev_spec = P(axis, None)  # [n_shards, E] event buckets
+    mapped = jax.shard_map(
+        lambda mp, mb, dp, ip, ic, md: tuple(
+            x[None] for x in fn(mp[0], mb[0], dp[0], ip[0], ic[0], md)
+        ),
+        mesh=mesh,
+        in_specs=(ev_spec, ev_spec, ev_spec, ev_spec, ev_spec, P()),
+        out_specs=(P(axis, None, None), P(axis, None), P(axis, None),
+                   P(axis, None), P(axis, None)),
+    )
+    w, bc, dm, nm, im = mapped(
+        match_pos, match_base, del_pos, ins_pos, ins_cnt, min_depth
+    )
+    L = block * mesh.shape[axis]
+    return (
+        w.reshape(L, N_CHANNELS),
+        bc.reshape(L),
+        dm.reshape(L),
+        nm.reshape(L),
+        im.reshape(L),
+    )
+
+
+def sharded_call(ev, rid: int, mesh: Mesh, min_depth: int = 1,
+                 axis: str = "sp"):
+    """Position-sharded fused call for one reference over `mesh`.
+
+    Returns host-side (weights[L,5], CallMasks) identical to the single-
+    device kernel — outputs are sliced back to ref_len after the padded
+    sharded compute.
+    """
+    from kindel_tpu.call import CallMasks
+
+    n = mesh.shape[axis]
+    L = int(ev.ref_lens[rid])
+    block = -(-L // n)  # ceil; padded positions produce zero counts
+
+    sel = ev.match_rid == rid
+    mp, mb = ev.match_pos[sel], ev.match_base[sel].astype(np.int64)
+    pos_b, (base_b,) = bucket_events_by_position(mp, [mb], n, block)
+    sel = ev.del_rid == rid
+    dpos = ev.del_pos[sel]
+    dpos = dpos[dpos < L]  # deletions at index L are outside the call range
+    dpos_b, _ = bucket_events_by_position(dpos, [], n, block)
+    ipos, icnt = [], []
+    for (r, p, _s), c in ev.insertions.items():
+        if r == rid and p < L:
+            ipos.append(p)
+            icnt.append(c)
+    ipos = np.asarray(ipos, dtype=np.int64)
+    icnt = np.asarray(icnt, dtype=np.int64)
+    ipos_b, (icnt_b,) = bucket_events_by_position(ipos, [icnt], n, block)
+
+    with mesh:
+        w, bc, dm, nm, im = _sharded_call_jit(
+            jnp.asarray(pos_b), jnp.asarray(base_b), jnp.asarray(dpos_b),
+            jnp.asarray(ipos_b), jnp.asarray(icnt_b), jnp.int32(min_depth),
+            mesh=mesh, block=block, axis=axis,
+        )
+    masks = CallMasks(
+        base_char=np.asarray(bc[:L]),
+        del_mask=np.asarray(dm[:L]),
+        n_mask=np.asarray(nm[:L]),
+        ins_mask=np.asarray(im[:L]),
+    )
+    return np.asarray(w[:L]), masks
+
+
+# ---------------------------------------------------------------------------
+# Batched (data-parallel × sequence-parallel) step — BASELINE config 5 shape
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("mesh", "block"))
+def _batched_call_jit(match_pos, match_base, del_pos, ins_pos, ins_cnt,
+                      min_depth, *, mesh: Mesh, block: int):
+    """Full dp×sp step: [B, n_sp, E] event buckets → per-sample call masks.
+
+    Samples shard over 'dp', position blocks over 'sp' — the mapping of
+    BASELINE.json config 5 (1k-sample batch) onto a pod slice.
+    """
+
+    def local(mp, mb, dp, ip, ic, md):
+        # mp: [B_local, 1, E] — one position block per device, B_local samples
+        f = partial(_local_call, block=block, axis="sp")
+        outs = jax.vmap(lambda a, b, c, d, e: f(a[0], b[0], c[0], d[0], e[0], md))(
+            mp, mb, dp, ip, ic
+        )
+        w, bc, dm, nm, im = outs
+        return (w[:, None], bc[:, None], dm[:, None], nm[:, None], im[:, None])
+
+    ev_spec = P("dp", "sp", None)
+    mapped = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(ev_spec,) * 5 + (P(),),
+        out_specs=(
+            P("dp", "sp", None, None),
+            P("dp", "sp", None),
+            P("dp", "sp", None),
+            P("dp", "sp", None),
+            P("dp", "sp", None),
+        ),
+    )
+    return mapped(match_pos, match_base, del_pos, ins_pos, ins_cnt, min_depth)
+
+
+def batched_sharded_call(event_batches, ref_len: int, mesh: Mesh,
+                         min_depth: int = 1):
+    """Run the dp×sp step over a batch of per-sample event dicts, each with
+    keys match_pos/match_base/del_pos/ins_pos/ins_cnt (host arrays)."""
+    n_sp = mesh.shape["sp"]
+    block = -(-ref_len // n_sp)
+    B = len(event_batches)
+
+    def stack(key, payload_key=None):
+        pos_rows, pay_rows = [], []
+        for sample in event_batches:
+            pos = sample[key]
+            pays = [sample[payload_key]] if payload_key else []
+            pb, payb = bucket_events_by_position(pos, pays, n_sp, block)
+            pos_rows.append(pb)
+            if payload_key:
+                pay_rows.append(payb[0])
+        emax = max(r.shape[1] for r in pos_rows)
+        pos_out = np.full((B, n_sp, emax), PAD_POS, dtype=np.int32)
+        pay_out = np.zeros((B, n_sp, emax), dtype=np.int32)
+        for i, r in enumerate(pos_rows):
+            pos_out[i, :, : r.shape[1]] = r
+            if payload_key:
+                pay_out[i, :, : r.shape[1]] = pay_rows[i]
+        return pos_out, pay_out
+
+    mp, mb = stack("match_pos", "match_base")
+    dp, _ = stack("del_pos")
+    ip, ic = stack("ins_pos", "ins_cnt")
+
+    with mesh:
+        w, bc, dm, nm, im = _batched_call_jit(
+            jnp.asarray(mp), jnp.asarray(mb), jnp.asarray(dp),
+            jnp.asarray(ip), jnp.asarray(ic), jnp.int32(min_depth),
+            mesh=mesh, block=block,
+        )
+    L = ref_len
+    n = block * n_sp
+    return (
+        np.asarray(w).reshape(B, n, N_CHANNELS)[:, :L],
+        np.asarray(bc).reshape(B, n)[:, :L],
+        np.asarray(dm).reshape(B, n)[:, :L],
+        np.asarray(nm).reshape(B, n)[:, :L],
+        np.asarray(im).reshape(B, n)[:, :L],
+    )

@@ -1,0 +1,186 @@
+"""L1b — dense count tensors (numpy oracle backend).
+
+Reduces the flat event streams from kindel_tpu.events into the dense
+per-reference tensors that every downstream stage consumes:
+
+  weights            int32[L, 5]    aligned base counts (A,T,G,C,N)
+  clip_start_weights int32[L, 5]    rightward clip projections
+  clip_end_weights   int32[L, 5]    leftward clip projections
+  clip_starts        int32[L+1]     right-clip events at position-1
+  clip_ends          int32[L+1]     left-clip events
+  deletions          int32[L+1]     per-position deletion counts
+  insertions         sparse         (pos, string-id) -> count
+
+These correspond one-to-one to the lists-of-dicts the reference builds in
+`parse_records` (/root/reference/kindel/kindel.py:29-39) and the derived
+depth vectors (:83-96), but as dense arrays a TPU can reduce and shard.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from kindel_tpu.events import EventSet, N_CHANNELS, BASES
+
+ACGT = slice(0, 4)  # channels A,T,G,C (N excluded), order per events.BASES
+
+
+@dataclass
+class InsertionTable:
+    """Dictionary-encoded insertion observations at one reference."""
+
+    pos: np.ndarray  # int64[k] position of each distinct (pos, string)
+    str_id: np.ndarray  # int32[k]
+    count: np.ndarray  # int32[k]
+    strings: list[bytes]  # id -> inserted sequence
+    totals: np.ndarray  # int64[L+1] total insertion obs per position
+
+    @classmethod
+    def empty(cls, ref_len: int) -> "InsertionTable":
+        return cls(
+            pos=np.empty(0, dtype=np.int64),
+            str_id=np.empty(0, dtype=np.int32),
+            count=np.empty(0, dtype=np.int32),
+            strings=[],
+            totals=np.zeros(ref_len + 1, dtype=np.int64),
+        )
+
+    def at(self, pos: int) -> dict[bytes, int]:
+        sel = self.pos == pos
+        return {
+            self.strings[i]: int(c)
+            for i, c in zip(self.str_id[sel], self.count[sel])
+        }
+
+
+@dataclass
+class Pileup:
+    """Dense per-reference pileup counts + derived depths."""
+
+    ref_id: str
+    ref_len: int
+    weights: np.ndarray  # int32[L, 5]
+    clip_start_weights: np.ndarray  # int32[L, 5]
+    clip_end_weights: np.ndarray  # int32[L, 5]
+    clip_starts: np.ndarray  # int32[L+1]
+    clip_ends: np.ndarray  # int32[L+1]
+    deletions: np.ndarray  # int32[L+1]
+    ins: InsertionTable
+
+    # ------- derived depths (reference kindel.py:83-96) -------
+    @property
+    def aligned_depth(self) -> np.ndarray:
+        """Total aligned depth incl. N (:83)."""
+        return self.weights.sum(axis=1)
+
+    @property
+    def acgt_depth(self) -> np.ndarray:
+        """ACGT-only aligned depth (used by the caller, :404)."""
+        return self.weights[:, ACGT].sum(axis=1)
+
+    @property
+    def consensus_depth(self) -> np.ndarray:
+        """Depth of the argmax base (:84-89)."""
+        return self.weights.max(axis=1)
+
+    @property
+    def discordant_depth(self) -> np.ndarray:
+        return self.aligned_depth - self.weights.max(axis=1)
+
+    @property
+    def clip_start_depth(self) -> np.ndarray:
+        """ACGT-only clip-start projection depth (:90-92)."""
+        return self.clip_start_weights[:, ACGT].sum(axis=1)
+
+    @property
+    def clip_end_depth(self) -> np.ndarray:
+        return self.clip_end_weights[:, ACGT].sum(axis=1)
+
+    @property
+    def clip_depth(self) -> np.ndarray:
+        return self.clip_start_depth + self.clip_end_depth
+
+
+def _weighted_counts(rid, pos, base, sel_rid, L) -> np.ndarray:
+    sel = rid == sel_rid
+    flat = np.bincount(
+        pos[sel] * N_CHANNELS + base[sel], minlength=L * N_CHANNELS
+    )
+    return flat.reshape(L, N_CHANNELS).astype(np.int32)
+
+
+def _scalar_counts(rid, pos, sel_rid, L1) -> np.ndarray:
+    sel = rid == sel_rid
+    return np.bincount(pos[sel], minlength=L1).astype(np.int32)
+
+
+def build_insertion_table(ev: EventSet, rid: int) -> InsertionTable:
+    """Dictionary-encoded insertion observations for one reference."""
+    L = int(ev.ref_lens[rid])
+    ins = InsertionTable.empty(L)
+    string_ids: dict[bytes, int] = {}
+    ipos, iid, icnt = [], [], []
+    for (r, p, s), c in ev.insertions.items():
+        if r != rid:
+            continue
+        sid = string_ids.setdefault(s, len(string_ids))
+        ipos.append(p)
+        iid.append(sid)
+        icnt.append(c)
+    if ipos:
+        ins.pos = np.asarray(ipos, dtype=np.int64)
+        ins.str_id = np.asarray(iid, dtype=np.int32)
+        ins.count = np.asarray(icnt, dtype=np.int32)
+        ins.strings = [None] * len(string_ids)
+        for s, sid in string_ids.items():
+            ins.strings[sid] = s
+        ins.totals = np.bincount(
+            ins.pos, weights=ins.count, minlength=L + 1
+        ).astype(np.int64)
+    return ins
+
+
+def build_pileup(ev: EventSet, rid: int) -> Pileup:
+    """Dense counts for one reference id from the event streams."""
+    L = int(ev.ref_lens[rid])
+    ins = build_insertion_table(ev, rid)
+
+    return Pileup(
+        ref_id=ev.ref_names[rid],
+        ref_len=L,
+        weights=_weighted_counts(ev.match_rid, ev.match_pos, ev.match_base, rid, L),
+        clip_start_weights=_weighted_counts(
+            ev.csw_rid, ev.csw_pos, ev.csw_base, rid, L
+        ),
+        clip_end_weights=_weighted_counts(
+            ev.cew_rid, ev.cew_pos, ev.cew_base, rid, L
+        ),
+        clip_starts=_scalar_counts(ev.cs_rid, ev.cs_pos, rid, L + 1),
+        clip_ends=_scalar_counts(ev.ce_rid, ev.ce_pos, rid, L + 1),
+        deletions=_scalar_counts(ev.del_rid, ev.del_pos, rid, L + 1),
+        ins=ins,
+    )
+
+
+def build_pileups(ev: EventSet) -> dict[str, Pileup]:
+    """All present references, in the reference's output order."""
+    return {
+        ev.ref_names[rid]: build_pileup(ev, rid) for rid in ev.present_ref_ids
+    }
+
+
+def argmax_base_and_tie(counts: np.ndarray):
+    """Vectorized per-position consensus call over a [L, 5] count block.
+
+    Returns (base_idx, freq, tie) with Python-max semantics: first maximum in
+    channel order A,T,G,C,N wins; tie is flagged when the max count (if > 0)
+    recurs in another channel (/root/reference/kindel/kindel.py:369-381).
+    Zero-depth positions call N with freq 0 (:374).
+    """
+    freq = counts.max(axis=1)
+    base_idx = counts.argmax(axis=1)
+    tie = (freq > 0) & ((counts == freq[:, None]).sum(axis=1) > 1)
+    base_idx = np.where(counts.sum(axis=1) == 0, len(BASES) - 1, base_idx)
+    return base_idx, freq, tie

@@ -1,0 +1,205 @@
+"""L2 — clip-dominant-region (CDR) realignment engine.
+
+Re-design of the reference's local-reassembly mode
+(/root/reference/kindel/kindel.py:156-366): positions where soft-clip
+projection depth dominates aligned depth trigger a bounded decay extension
+that reads a consensus out of the clip-projection tensor; facing extensions
+are paired and merged about their longest common substring.
+
+kindel-tpu computes the trigger masks and decay conditions as whole-axis
+vectorized ops over the dense pileup tensors (the reference re-walks Python
+dict lists per position); only the rare per-candidate bookkeeping runs on
+host. Extension semantics, tie-breaking, pairing order and merge behavior
+replicate the reference exactly (citations inline).
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import namedtuple
+
+import numpy as np
+
+from kindel_tpu.pileup import Pileup, argmax_base_and_tie
+
+#: public Region type, field-compatible with the reference
+#: (/root/reference/kindel/kindel.py:18)
+Region = namedtuple("Region", ["start", "end", "seq", "direction"])
+
+
+def _span_consensus(weight_block: np.ndarray) -> str:
+    """Consensus string over a [k, 5] clip-weight block: per-row argmax with
+    first-max-wins tie-breaking (ties do NOT become N here — the reference's
+    CDR extension uses consensus()[0] directly,
+    /root/reference/kindel/kindel.py:203,261); zero-depth rows call N."""
+    idx, _freq, _tie = argmax_base_and_tie(weight_block)
+    from kindel_tpu.call import BASE_ASCII
+
+    return BASE_ASCII[idx].tobytes().decode("ascii")
+
+
+def _masked_all(mask_ends: int, L: int) -> bool:
+    # Python slicing quirk replicated: positions[-0:] is the whole list, so
+    # mask_ends == 0 masks every position (SURVEY.md §2.1; kindel.py:168).
+    return mask_ends == 0 or 2 * mask_ends >= L
+
+
+def _in_claimed(pos: int, claimed: list[tuple[int, int]]) -> bool:
+    return any(s <= pos < e for s, e in claimed)
+
+
+def cdr_start_consensuses(pileup: Pileup, clip_decay_threshold: float,
+                          mask_ends: int) -> list[Region]:
+    """Rightward ('→') clip consensuses (reference kindel.py:156-213)."""
+    L = pileup.ref_len
+    regions: list[Region] = []
+    if _masked_all(mask_ends, L):
+        return regions
+    csd = pileup.clip_start_depth.astype(np.float64)
+    w_sum = pileup.aligned_depth.astype(np.float64)
+    d = pileup.deletions[:L].astype(np.float64)
+    trigger = csd / (w_sum + d + 1.0) > 0.5
+    trigger[:mask_ends] = False
+    trigger[L - mask_ends :] = False
+    # decay condition: csd > (aligned incl. N + deletions) * threshold; the
+    # reference's sum(w_.values(), d_) feeds deletions via sum()'s start arg
+    # (kindel.py:202; SURVEY §2.1)
+    cond = csd > (w_sum + d) * clip_decay_threshold
+    claimed: list[tuple[int, int]] = []
+    for pos in np.flatnonzero(trigger):
+        pos = int(pos)
+        if _in_claimed(pos, claimed):
+            continue
+        tail = cond[pos:]
+        fail = np.flatnonzero(~tail)
+        if len(fail):
+            ext = int(fail[0])
+            end_pos = pos + ext  # failing position (kindel.py:198)
+        else:
+            ext = L - pos
+            end_pos = L - 1  # loop exhausted without break
+        seq = _span_consensus(pileup.clip_start_weights[pos : pos + ext])
+        regions.append(Region(pos, end_pos, seq, "→"))
+        claimed.append((pos, end_pos))
+        logging.debug(regions[-1])
+    return regions
+
+
+def cdr_end_consensuses(pileup: Pileup, clip_decay_threshold: float,
+                        mask_ends: int) -> list[Region]:
+    """Leftward ('←') clip consensuses from a reverse scan
+    (reference kindel.py:216-275)."""
+    L = pileup.ref_len
+    regions: list[Region] = []
+    if _masked_all(mask_ends, L):
+        return regions
+    ced = pileup.clip_end_depth.astype(np.float64)
+    w_sum = pileup.aligned_depth.astype(np.float64)
+    d = pileup.deletions[:L].astype(np.float64)
+    trigger = ced / (w_sum + d + 1.0) > 0.5
+    trigger[:mask_ends] = False
+    trigger[L - mask_ends :] = False
+    cond = ced > (w_sum + d) * clip_decay_threshold
+    claimed: list[tuple[int, int]] = []
+    for pos in np.flatnonzero(trigger)[::-1]:
+        pos = int(pos)
+        if _in_claimed(pos, claimed):
+            continue
+        end_pos = pos + 1
+        # extension walks pos-1, pos-2, ... 0; find first failing index
+        head = cond[:pos][::-1]  # cond at pos-1, pos-2, ...
+        fail = np.flatnonzero(~head)
+        if len(fail):
+            n_acc = int(fail[0])  # accepted count
+            start_pos = pos - 1 - n_acc  # failing position (kindel.py:252)
+        else:
+            n_acc = pos
+            start_pos = 0 if pos else pos  # exhausted (or no iterations)
+        if n_acc:
+            # accepted span ascends pos-n_acc .. pos-1, plus the one-base lag
+            # compensation at pos (kindel.py:257-261), reversed to ascending:
+            seq = _span_consensus(
+                pileup.clip_end_weights[pos - n_acc : pos + 1]
+            )
+        else:
+            seq = ""
+        regions.append(Region(start_pos, end_pos, seq, "←"))
+        claimed.append((start_pos, end_pos))
+        logging.debug(regions[-1])
+    return regions
+
+
+def cdrp_consensuses(pileup_or_weights, deletions=None, clip_start_weights=None,
+                     clip_end_weights=None, clip_start_depth=None,
+                     clip_end_depth=None, clip_decay_threshold=0.1,
+                     mask_ends=50) -> list[tuple[Region, Region]]:
+    """Pair facing '→'/'←' regions whose spans intersect
+    (reference kindel.py:278-320). Accepts either a Pileup (native API) or
+    the reference's seven positional arrays (compat API, used by the
+    reference test suite via kindel_tpu.compat)."""
+    if isinstance(pileup_or_weights, Pileup):
+        pileup = pileup_or_weights
+    else:
+        from kindel_tpu.compat import pileup_from_reference_arrays
+
+        pileup = pileup_from_reference_arrays(
+            pileup_or_weights, deletions, clip_start_weights,
+            clip_end_weights,
+        )
+    fwd = cdr_start_consensuses(pileup, clip_decay_threshold, mask_ends)
+    rev = cdr_end_consensuses(pileup, clip_decay_threshold, mask_ends)
+    pairs: list[tuple[Region, Region]] = []
+    for f in fwd:
+        for r in rev:
+            # non-empty range intersection
+            if max(f.start, r.start) < min(f.end, r.end):
+                pairs.append((f, r))
+                break
+    return pairs
+
+
+def _longest_common_substring(s1: str, s2: str) -> str:
+    """DP longest common substring with the reference's first-encounter
+    tie-break (row-major scan, strictly-greater updates; kindel.py:326-338),
+    with the inner loop vectorized over s2."""
+    if not s1 or not s2:
+        return ""
+    a = np.frombuffer(s1.encode("ascii"), dtype=np.uint8)
+    b = np.frombuffer(s2.encode("ascii"), dtype=np.uint8)
+    prev = np.zeros(len(b) + 1, dtype=np.int32)
+    cur = np.zeros(len(b) + 1, dtype=np.int32)
+    longest, x_longest = 0, 0
+    for x in range(1, len(a) + 1):
+        np.multiply(prev[:-1] + 1, b == a[x - 1], out=cur[1:])
+        row_max = int(cur.max())
+        if row_max > longest:
+            longest, x_longest = row_max, x
+        prev, cur = cur, prev
+    return s1[x_longest - longest : x_longest]
+
+
+def merge_by_lcs(s1: str, s2: str, min_overlap: int) -> str | None:
+    """Superstring of s1,s2 about their longest common substring; None when
+    the overlap is shorter than min_overlap (reference kindel.py:323-347)."""
+    lcs = _longest_common_substring(s1, s2)
+    if len(lcs) < min_overlap:
+        return None
+    left = s1.split(lcs, 1)[0]
+    right = s2.split(lcs, 1)[1]
+    return left + lcs + right
+
+
+def merge_cdrps(cdrps, min_overlap: int) -> list[Region]:
+    """Merge each paired CDR; a failed merge keeps seq None and logs a
+    warning (reference kindel.py:350-366) — the caller then falls back to
+    the unpatched per-position consensus."""
+    merged: list[Region] = []
+    for fwd, rev in cdrps:
+        seq = merge_by_lcs(fwd.seq, rev.seq, min_overlap)
+        if not seq:
+            logging.warning(
+                f"No overlap found for clip dominant region spanning "
+                f"positions {fwd.start}-{rev.end} (min_overlap = {min_overlap})"
+            )
+        merged.append(Region(fwd.start, rev.end, seq, None))
+    return merged

@@ -1,0 +1,450 @@
+"""L4 — workload orchestrators: the public Python API.
+
+Mirrors the reference's API surface (/root/reference/kindel/kindel.py:488-703)
+— `bam_to_consensus`, `weights`, `features`, `plotly_clips`-equivalent
+`plot_clips` — plus the `variants` workload the reference README documents
+but never implemented (README.md:106; SURVEY.md §2.1). Every workload takes
+`backend={"numpy","jax"}`: numpy is the reference-exact oracle; jax runs the
+count reduction and calling kernels jitted (and mesh-sharded) on TPU.
+"""
+
+from __future__ import annotations
+
+from collections import namedtuple
+
+import numpy as np
+
+from kindel_tpu.call import call_consensus
+from kindel_tpu.events import extract_events
+from kindel_tpu.io import load_alignment
+from kindel_tpu.io.fasta import Sequence
+from kindel_tpu.pileup import Pileup, build_pileups
+from kindel_tpu.realign import cdrp_consensuses, merge_cdrps
+
+result = namedtuple("result", ["consensuses", "refs_changes", "refs_reports"])
+
+BACKENDS = ("numpy", "jax")
+
+
+def _load_pileups(bam_path, backend: str) -> dict[str, Pileup]:
+    ev = extract_events(load_alignment(bam_path))
+    if backend == "jax":
+        from kindel_tpu.pileup_jax import build_pileups_jax
+
+        return build_pileups_jax(ev)
+    return build_pileups(ev)
+
+
+def build_report(ref_id, depth_min, depth_max, changes, cdr_patches, bam_path,
+                 realign, min_depth, min_overlap, clip_decay_threshold,
+                 trim_ends, uppercase) -> str:
+    """Per-reference text report, byte-compatible with the reference's
+    (/root/reference/kindel/kindel.py:437-485)."""
+    ambiguous, ins_sites, del_sites = [], [], []
+    for pos, change in enumerate(changes, start=1):
+        if change == "N":
+            ambiguous.append(str(pos))
+        elif change == "I":
+            ins_sites.append(str(pos))
+        elif change == "D":
+            del_sites.append(str(pos))
+    cdr_fmt = (
+        ["{}-{}: {}".format(r.start, r.end, r.seq) for r in cdr_patches]
+        if cdr_patches
+        else ""
+    )
+    report = "========================= REPORT ===========================\n"
+    report += "reference: {}\n".format(ref_id)
+    report += "options:\n"
+    report += "- bam_path: {}\n".format(bam_path)
+    report += "- min_depth: {}\n".format(min_depth)
+    report += "- realign: {}\n".format(realign)
+    report += "    - min_overlap: {}\n".format(min_overlap)
+    report += "    - clip_decay_threshold: {}\n".format(clip_decay_threshold)
+    report += "- trim_ends: {}\n".format(trim_ends)
+    report += "- uppercase: {}\n".format(uppercase)
+    report += "observations:\n"
+    report += "- min, max observed depth: {}, {}\n".format(
+        depth_min, depth_max
+    )
+    report += "- ambiguous sites: {}\n".format(", ".join(ambiguous))
+    report += "- insertion sites: {}\n".format(", ".join(ins_sites))
+    report += "- deletion sites: {}\n".format(", ".join(del_sites))
+    report += "- clip-dominant regions: {}\n".format(", ".join(cdr_fmt))
+    return report
+
+
+def bam_to_consensus(
+    bam_path,
+    realign: bool = False,
+    min_depth: int = 1,
+    min_overlap: int = 9,
+    clip_decay_threshold: float = 0.1,
+    mask_ends: int = 50,
+    trim_ends: bool = False,
+    uppercase: bool = False,
+    backend: str = "numpy",
+):
+    """Infer consensus for every reference with aligned reads.
+
+    API-compatible with the reference (/root/reference/kindel/kindel.py:488-555,
+    including its Python-API default min_overlap=9 vs the CLI's 7 — SURVEY §2.1).
+    """
+    consensuses = []
+    refs_changes = {}
+    refs_reports = {}
+    ev = extract_events(load_alignment(bam_path))
+    from kindel_tpu.pileup import build_pileup
+
+    for rid in ev.present_ref_ids:
+        ref_id = ev.ref_names[rid]
+        if realign or backend == "numpy":
+            # realign's CDR detection consumes the full clip tensors —
+            # tiny event counts, reduced host-side even under the jax
+            # backend (SURVEY §5: CDR/patch metadata is host-gathered)
+            pileup = build_pileup(ev, rid)
+        else:
+            pileup = None
+        if realign:
+            cdrps = cdrp_consensuses(
+                pileup,
+                clip_decay_threshold=clip_decay_threshold,
+                mask_ends=mask_ends,
+            )
+            cdr_patches = merge_cdrps(cdrps, min_overlap)
+        else:
+            cdr_patches = None
+
+        if backend == "jax":
+            from kindel_tpu.call_jax import call_consensus_fused
+
+            res, depth_min, depth_max = call_consensus_fused(
+                ev, rid, pileup=pileup, cdr_patches=cdr_patches,
+                trim_ends=trim_ends, min_depth=min_depth, uppercase=uppercase,
+            )
+        else:
+            res = call_consensus(
+                pileup,
+                cdr_patches=cdr_patches,
+                trim_ends=trim_ends,
+                min_depth=min_depth,
+                uppercase=uppercase,
+            )
+            acgt = pileup.acgt_depth
+            depth_min = int(acgt.min()) if len(acgt) else 0
+            depth_max = int(acgt.max()) if len(acgt) else 0
+
+        refs_reports[ref_id] = build_report(
+            ref_id, depth_min, depth_max, res.changes, cdr_patches, bam_path,
+            realign, min_depth, min_overlap, clip_decay_threshold, trim_ends,
+            uppercase,
+        )
+        refs_changes[ref_id] = res.changes
+        consensuses.append(Sequence(name=f"{ref_id}_cns", sequence=res.sequence))
+    return result(consensuses, refs_changes, refs_reports)
+
+
+def weights(bam_path, relative: bool = False, confidence: bool = True,
+            confidence_alpha: float = 0.01, backend: str = "numpy"):
+    """Per-site nucleotide frequency table (reference kindel.py:558-630).
+
+    Divergence (documented; SURVEY §2.1): the reference indexes
+    insertions/deletions/clip columns with a shifted 1-based counter, putting
+    the `insertions` column one position late relative to the base columns.
+    kindel-tpu aligns every column to the same 0-based position p (1-based
+    `pos` = p+1): insertions immediately preceding p, deletions/clip events at p.
+    """
+    import pandas as pd
+
+    rows = []
+    for chrom, p in _load_pileups(bam_path, backend).items():
+        L = p.ref_len
+        df = pd.DataFrame(
+            {
+                "chrom": chrom,
+                "pos": np.arange(1, L + 1),
+                "A": p.weights[:, 0],
+                "C": p.weights[:, 3],
+                "G": p.weights[:, 2],
+                "T": p.weights[:, 1],
+                "N": p.weights[:, 4],
+                "insertions": p.ins.totals[:L].astype(np.int64),
+                "deletions": p.deletions[:L].astype(np.int64),
+                "clip_starts": p.clip_starts[:L].astype(np.int64),
+                "clip_ends": p.clip_ends[:L].astype(np.int64),
+            }
+        )
+        rows.append(df)
+    weights_df = (
+        pd.concat(rows, ignore_index=True)
+        if rows
+        else __empty_weights_df()
+    )
+    nt_cols = ["A", "C", "G", "T", "N", "deletions"]
+    weights_df["depth"] = weights_df[nt_cols].sum(axis=1)
+    consensus_depths = weights_df[nt_cols].max(axis=1)
+    weights_df["consensus"] = consensus_depths.divide(weights_df.depth)
+
+    rel = weights_df[nt_cols].divide(weights_df.depth, axis=0).round(4)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        weights_df["shannon"] = _shannon(rel[["A", "C", "G", "T"]].values)
+
+    if confidence:
+        lower, upper = _jeffreys_ci(
+            consensus_depths.values.astype(np.float64),
+            weights_df["depth"].values.astype(np.float64),
+            confidence_alpha,
+        )
+        weights_df["lower_ci"] = lower
+        weights_df["upper_ci"] = upper
+
+    if relative:
+        for nt in ["A", "C", "G", "T", "N"]:
+            weights_df[nt] = rel[nt]
+
+    return weights_df.round(
+        dict(consensus=3, lower_ci=3, upper_ci=3, shannon=3)
+    )
+
+
+def __empty_weights_df():
+    import pandas as pd
+
+    return pd.DataFrame(
+        columns=["chrom", "pos", "A", "C", "G", "T", "N", "insertions",
+                 "deletions", "clip_starts", "clip_ends"]
+    )
+
+
+def _shannon(rel: np.ndarray) -> np.ndarray:
+    """Shannon entropy rows of a relative-frequency matrix, matching
+    scipy.stats.entropy semantics (normalizes rows; 0·log0 = 0)."""
+    totals = rel.sum(axis=1, keepdims=True)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        pk = rel / totals
+        terms = np.where(pk > 0, -pk * np.log(pk), 0.0)
+        out = terms.sum(axis=1)
+        out = np.where(np.isnan(rel).any(axis=1) | (totals[:, 0] == 0),
+                       np.nan, out)
+    return out
+
+
+def _jeffreys_ci(count, nobs, alpha):
+    """Jeffreys binomial proportion CI — beta.interval(1-alpha, c+0.5,
+    n-c+0.5) (reference kindel.py:569-574)."""
+    import scipy.stats
+
+    lower, upper = scipy.stats.beta.interval(
+        1 - alpha, count + 0.5, nobs - count + 0.5
+    )
+    return lower, upper
+
+
+def features(bam_path, backend: str = "numpy"):
+    """Relative per-site frequencies incl. indel fractions + entropy
+    (reference kindel.py:633-664).
+
+    Divergence (documented; SURVEY §2.1): the reference fills the indel
+    columns from whichever reference was last in scope, indexed by global row
+    number — wrong for multi-reference BAMs. kindel-tpu computes indel
+    fractions per reference. Single-reference output is identical.
+    """
+    import pandas as pd
+
+    rows = []
+    for chrom, p in _load_pileups(bam_path, backend).items():
+        L = p.ref_len
+        df = pd.DataFrame(
+            {
+                "chrom": chrom,
+                "pos": np.arange(1, L + 1),
+                "A": p.weights[:, 0].astype(np.float64),
+                "C": p.weights[:, 3].astype(np.float64),
+                "G": p.weights[:, 2].astype(np.float64),
+                "T": p.weights[:, 1].astype(np.float64),
+                "N": p.weights[:, 4].astype(np.float64),
+                "i": p.ins.totals[:L].astype(np.float64),
+                "d": p.deletions[:L].astype(np.float64),
+            }
+        )
+        rows.append(df)
+    if not rows:
+        return pd.DataFrame(
+            columns=["chrom", "pos", "A", "C", "G", "T", "N", "i", "d",
+                     "depth", "consensus", "shannon"]
+        )
+    df = pd.concat(rows, ignore_index=True)
+    nt_cols = ["A", "C", "G", "T", "N", "d"]
+    df["depth"] = df[nt_cols].sum(axis=1)
+    df["consensus"] = df[["A", "C", "G", "T", "N"]].max(axis=1).divide(df.depth)
+    for nt in ["A", "C", "G", "T", "N", "i", "d"]:
+        df[nt] = df[nt].divide(df.depth, axis=0)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        df["shannon"] = _shannon(df[["A", "C", "G", "T", "i", "d"]].values)
+    return df.round(3)
+
+
+def variants(bam_path, min_count: int = 1, min_frequency: float = 0.0,
+             indels: bool = True, backend: str = "numpy"):
+    """Variant sites exceeding absolute and relative frequency thresholds.
+
+    New workload: the reference README documents a `variants` subcommand
+    ("Output variants exceeding specified absolute and relative frequency
+    thresholds", README.md:106) that v1.2.1 never shipped (SURVEY §2.1);
+    spec realized here over the weights tensor. Reports every non-consensus
+    base (and optionally indel) with count >= min_count and
+    count/depth >= min_frequency.
+    """
+    import pandas as pd
+
+    recs = []
+    base_cols = ["A", "T", "G", "C", "N"]
+    for chrom, p in _load_pileups(bam_path, backend).items():
+        L = p.ref_len
+        w = p.weights
+        depth = w.sum(axis=1) + p.deletions[:L]
+        cons_idx = w.argmax(axis=1)
+        for ch in range(5):
+            count = w[:, ch]
+            sel = (
+                (count >= max(min_count, 1))
+                & (cons_idx != ch)
+                & (depth > 0)
+                & (count / np.maximum(depth, 1) >= min_frequency)
+            )
+            for pos in np.flatnonzero(sel):
+                recs.append(
+                    (chrom, int(pos) + 1, base_cols[cons_idx[pos]],
+                     base_cols[ch], int(count[pos]), int(depth[pos]),
+                     round(float(count[pos] / depth[pos]), 4))
+                )
+        if indels:
+            dels = p.deletions[:L]
+            sel = (dels >= max(min_count, 1)) & (depth > 0) & (
+                dels / np.maximum(depth, 1) >= min_frequency
+            )
+            for pos in np.flatnonzero(sel):
+                recs.append(
+                    (chrom, int(pos) + 1, base_cols[cons_idx[pos]], "DEL",
+                     int(dels[pos]), int(depth[pos]),
+                     round(float(dels[pos] / depth[pos]), 4))
+                )
+            ins_tot = p.ins.totals[:L]
+            sel = (ins_tot >= max(min_count, 1)) & (depth > 0) & (
+                ins_tot / np.maximum(depth, 1) >= min_frequency
+            )
+            for pos in np.flatnonzero(sel):
+                recs.append(
+                    (chrom, int(pos) + 1, base_cols[cons_idx[pos]], "INS",
+                     int(ins_tot[pos]), int(depth[pos]),
+                     round(float(ins_tot[pos] / depth[pos]), 4))
+                )
+    df = pd.DataFrame(
+        recs,
+        columns=["chrom", "pos", "consensus", "alt", "count", "depth",
+                 "frequency"],
+    )
+    return df.sort_values(["chrom", "pos", "alt"]).reset_index(drop=True)
+
+
+def plot_clips(bam_path, out_path=None, backend: str = "numpy"):
+    """Interactive HTML depth/clip dashboard for the first reference.
+
+    First-party replacement for the reference's plotly Scattergl page
+    (/root/reference/kindel/kindel.py:667-703): same eight traces, rendered
+    by a small self-contained SVG/JS pan-zoom chart — no plotly dependency.
+    Writes <stem>.plot.html to the CWD like the reference (:702-703).
+    """
+    import json
+    import os
+
+    pileups = _load_pileups(bam_path, backend)
+    if not pileups:
+        raise ValueError(f"{bam_path}: no references with aligned reads")
+    p = next(iter(pileups.values()))
+    L = p.ref_len
+    traces = [
+        ("Aligned depth", "lines", p.aligned_depth),
+        ("Soft clip total depth", "lines", p.clip_depth),
+        ("Soft clip start depth", "lines", p.clip_start_depth),
+        ("Soft clip end depth", "lines", p.clip_end_depth),
+        ("Soft clip starts", "markers", p.clip_starts[:L]),
+        ("Soft clip ends", "markers", p.clip_ends[:L]),
+        ("Insertions", "markers", p.ins.totals[:L]),
+        ("Deletions", "markers", p.deletions[:L]),
+    ]
+    payload = [
+        {"name": name, "mode": mode, "y": np.asarray(y).tolist()}
+        for name, mode, y in traces
+    ]
+    html = _PLOT_TEMPLATE.replace("__DATA__", json.dumps(payload)).replace(
+        "__TITLE__", str(bam_path)
+    )
+    if out_path is None:
+        stem = os.path.splitext(os.path.split(str(bam_path))[1])[0]
+        out_path = stem + ".plot.html"
+    with open(out_path, "w") as fh:
+        fh.write(html)
+    return out_path
+
+
+_PLOT_TEMPLATE = """<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>kindel-tpu: __TITLE__</title>
+<style>
+ body{font-family:sans-serif;margin:12px}
+ #legend span{margin-right:14px;cursor:pointer;user-select:none}
+ #legend .off{opacity:.3}
+ svg{border:1px solid #ccc;width:100%;height:480px}
+</style></head><body>
+<h3>kindel-tpu clip/depth plot — __TITLE__</h3>
+<div id="legend"></div>
+<svg id="chart" viewBox="0 0 1200 480" preserveAspectRatio="none"></svg>
+<p>drag to pan, wheel to zoom (x)</p>
+<script>
+const data = __DATA__;
+const colors = ["#1f77b4","#ff7f0e","#2ca02c","#d62728","#9467bd","#8c564b","#e377c2","#7f7f7f"];
+const svg = document.getElementById("chart");
+const W = 1200, H = 480, PAD = 40;
+let x0 = 0, x1 = Math.max(...data.map(t => t.y.length));
+const vis = data.map(() => true);
+function ymax(){let m=1;data.forEach((t,i)=>{if(!vis[i])return;
+  const a=Math.max(0,Math.floor(x0)),b=Math.min(t.y.length,Math.ceil(x1));
+  for(let j=a;j<b;j++) if(t.y[j]>m) m=t.y[j];});return m;}
+function render(){
+  const ym = ymax();
+  const sx = (W-2*PAD)/(x1-x0), sy = (H-2*PAD)/ym;
+  let out = `<line x1="${PAD}" y1="${H-PAD}" x2="${W-PAD}" y2="${H-PAD}" stroke="#333"/>`;
+  out += `<line x1="${PAD}" y1="${PAD}" x2="${PAD}" y2="${H-PAD}" stroke="#333"/>`;
+  out += `<text x="${PAD}" y="${PAD-8}" font-size="12">${ym}</text>`;
+  out += `<text x="${W-PAD-60}" y="${H-PAD+24}" font-size="12">${Math.round(x1)}</text>`;
+  out += `<text x="${PAD}" y="${H-PAD+24}" font-size="12">${Math.round(x0)+1}</text>`;
+  data.forEach((t,i)=>{ if(!vis[i]) return;
+    const a=Math.max(0,Math.floor(x0)), b=Math.min(t.y.length,Math.ceil(x1));
+    const step=Math.max(1,Math.floor((b-a)/4000));
+    if(t.mode==="lines"){
+      let pts=[];
+      for(let j=a;j<b;j+=step) pts.push(`${PAD+(j-x0)*sx},${H-PAD-t.y[j]*sy}`);
+      out+=`<polyline fill="none" stroke="${colors[i%8]}" stroke-width="1" points="${pts.join(" ")}"/>`;
+    } else {
+      for(let j=a;j<b;j+=step) if(t.y[j]>0)
+        out+=`<circle cx="${PAD+(j-x0)*sx}" cy="${H-PAD-t.y[j]*sy}" r="1.6" fill="${colors[i%8]}"/>`;
+    }});
+  svg.innerHTML = out;
+}
+const leg = document.getElementById("legend");
+data.forEach((t,i)=>{const s=document.createElement("span");
+  s.textContent="■ "+t.name; s.style.color=colors[i%8];
+  s.onclick=()=>{vis[i]=!vis[i];s.classList.toggle("off");render();};
+  leg.appendChild(s);});
+let drag=null;
+svg.addEventListener("mousedown",e=>drag={x:e.clientX,x0,x1});
+window.addEventListener("mouseup",()=>drag=null);
+window.addEventListener("mousemove",e=>{if(!drag)return;
+  const dx=(e.clientX-drag.x)/svg.clientWidth*(drag.x1-drag.x0);
+  x0=drag.x0-dx; x1=drag.x1-dx; render();});
+svg.addEventListener("wheel",e=>{e.preventDefault();
+  const f=e.deltaY>0?1.2:1/1.2, c=(x0+x1)/2;
+  x0=c-(c-x0)*f; x1=c+(x1-c)*f; render();});
+render();
+</script></body></html>
+"""

@@ -1,0 +1,121 @@
+// Native BAM decode helpers for kindel-tpu.
+//
+// The only data-dependent sequential stage of L0 is walking BAM record
+// boundaries (each record's offset depends on the previous block_size) —
+// everything downstream is vectorized numpy / device code. This walk is done
+// here in C++; a BGZF block inflater is included for large inputs where
+// Python's gzip member loop becomes measurable.
+//
+// Exposed via ctypes (kindel_tpu/io/native.py). Build: make -C src/native
+
+#include <cstdint>
+#include <cstring>
+
+#include <zlib.h>
+
+extern "C" {
+
+// Walk alignment-record boundaries of a decompressed BAM stream.
+// `start` is the byte offset of the first record (after header+refs).
+// Writes record-body offsets (start of refID field) into `out` (capacity
+// `cap`). Returns the number of records, or -1 on malformed input / -2 if
+// capacity is exhausted.
+int64_t bam_scan_offsets(const uint8_t* data, int64_t len, int64_t start,
+                         int64_t* out, int64_t cap) {
+    int64_t off = start;
+    int64_t n = 0;
+    while (off + 4 <= len) {
+        int32_t block_size;
+        std::memcpy(&block_size, data + off, 4);
+        if (block_size < 32 || off + 4 + block_size > len) return -1;
+        if (n >= cap) return -2;
+        out[n++] = off + 4;
+        off += 4 + static_cast<int64_t>(block_size);
+    }
+    return n;
+}
+
+// Inflate a BGZF byte stream (concatenated gzip members with BC extra
+// fields). Returns the decompressed size, or -1 on error / -2 if `out_cap`
+// is too small. Each member's payload sits between the 18-byte BGZF header
+// and the 8-byte CRC/ISIZE trailer; ISIZE gives the member's output size.
+int64_t bgzf_inflate(const uint8_t* data, int64_t len, uint8_t* out,
+                     int64_t out_cap) {
+    int64_t off = 0;
+    int64_t written = 0;
+    while (off < len) {
+        if (off + 18 > len) return -1;
+        if (data[off] != 0x1f || data[off + 1] != 0x8b) return -1;
+        // find BSIZE in the extra field (FLG.FEXTRA with "BC" subfield)
+        if (!(data[off + 3] & 4)) return -1;
+        uint16_t xlen;
+        std::memcpy(&xlen, data + off + 10, 2);
+        int64_t xoff = off + 12, xend = xoff + xlen;
+        int64_t bsize = -1;
+        while (xoff + 4 <= xend) {
+            uint8_t si1 = data[xoff], si2 = data[xoff + 1];
+            uint16_t slen;
+            std::memcpy(&slen, data + xoff + 2, 2);
+            if (si1 == 66 && si2 == 67 && slen == 2) {
+                uint16_t bs;
+                std::memcpy(&bs, data + xoff + 4, 2);
+                bsize = static_cast<int64_t>(bs) + 1;
+                break;
+            }
+            xoff += 4 + slen;
+        }
+        if (bsize < 26 || off + bsize > len) return -1;
+        uint32_t isize;
+        std::memcpy(&isize, data + off + bsize - 4, 4);
+        if (written + isize > out_cap) return -2;
+
+        z_stream zs;
+        std::memset(&zs, 0, sizeof(zs));
+        if (inflateInit2(&zs, -15) != Z_OK) return -1;
+        zs.next_in = const_cast<uint8_t*>(data + off + 18);
+        zs.avail_in = static_cast<uInt>(bsize - 26);
+        zs.next_out = out + written;
+        zs.avail_out = static_cast<uInt>(out_cap - written);
+        int rc = inflate(&zs, Z_FINISH);
+        uLong total_out = zs.total_out;
+        inflateEnd(&zs);
+        if (rc != Z_STREAM_END || total_out != isize) return -1;
+        written += isize;
+        off += bsize;
+    }
+    return written;
+}
+
+// Sum of ISIZE fields — exact decompressed size for preallocation.
+int64_t bgzf_decompressed_size(const uint8_t* data, int64_t len) {
+    int64_t off = 0;
+    int64_t total = 0;
+    while (off < len) {
+        if (off + 18 > len || data[off] != 0x1f || data[off + 1] != 0x8b ||
+            !(data[off + 3] & 4))
+            return -1;
+        uint16_t xlen;
+        std::memcpy(&xlen, data + off + 10, 2);
+        int64_t xoff = off + 12, xend = xoff + xlen;
+        int64_t bsize = -1;
+        while (xoff + 4 <= xend) {
+            uint16_t slen;
+            std::memcpy(&slen, data + xoff + 2, 2);
+            if (data[xoff] == 66 && data[xoff + 1] == 67 && slen == 2) {
+                uint16_t bs;
+                std::memcpy(&bs, data + xoff + 4, 2);
+                bsize = static_cast<int64_t>(bs) + 1;
+                break;
+            }
+            xoff += 4 + slen;
+        }
+        if (bsize < 0 || off + bsize > len) return -1;
+        uint32_t isize;
+        std::memcpy(&isize, data + off + bsize - 4, 4);
+        total += isize;
+        off += bsize;
+    }
+    return total;
+}
+
+}  // extern "C"

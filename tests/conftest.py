@@ -1,0 +1,50 @@
+"""Test configuration.
+
+Tests run JAX on a virtual 8-device CPU mesh (the TPU-world analogue of the
+reference's "real data, no mocks" stance — see SURVEY.md §4): sharding and
+collective behavior is validated without a pod. These env vars must be set
+before jax is imported anywhere.
+"""
+
+import os
+import sys
+from pathlib import Path
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# The container's sitecustomize imports jax (registering the TPU plugin)
+# before this conftest runs, so the env vars above are latched too late —
+# override through the config API before any backend initializes.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+import pytest  # noqa: E402
+
+#: Golden corpus: the reference's test data, mounted read-only. Overridable
+#: so the suite can run against a relocated copy.
+DATA_ROOT = Path(
+    os.environ.get("KINDEL_TPU_TEST_DATA", "/root/reference/tests")
+)
+
+
+def require_data(*rel) -> Path:
+    path = DATA_ROOT.joinpath(*rel)
+    if not path.exists():
+        pytest.skip(f"golden corpus not available: {path}")
+    return path
+
+
+@pytest.fixture(scope="session")
+def data_root() -> Path:
+    if not DATA_ROOT.exists():
+        pytest.skip(f"golden corpus not available: {DATA_ROOT}")
+    return DATA_ROOT

@@ -1,0 +1,131 @@
+"""Functional tier — golden-file FASTA parity.
+
+Every BAM/SAM in the corpus runs through the kindel-tpu CLI (in-process) and
+the FASTA output is compared case-insensitively against the reference
+repository's checked-in expected outputs — the same contract the reference's
+own functional tests enforce (/root/reference/tests/test_kindel.py:114-278).
+"""
+
+import io
+from contextlib import redirect_stderr, redirect_stdout
+
+import pytest
+
+from kindel_tpu.cli import main
+from kindel_tpu.io.fasta import read_fasta
+
+
+def run_consensus(path, *flags) -> dict[str, str]:
+    out, err = io.StringIO(), io.StringIO()
+    with redirect_stdout(out), redirect_stderr(err):
+        rc = main(["consensus", *flags, str(path)])
+    assert rc == 0
+    records = {}
+    name = None
+    for line in out.getvalue().splitlines():
+        if line.startswith(">"):
+            name = line[1:]
+            records[name] = ""
+        elif name is not None:
+            records[name] += line
+    return records
+
+
+def expected_records(fa_path) -> dict[str, str]:
+    return {r.name: r.sequence for r in read_fasta(fa_path)}
+
+
+def _bams(data_root, sub, suffix=".bam"):
+    d = data_root / sub
+    return sorted(p for p in d.iterdir() if p.suffix == suffix)
+
+
+# ---- bwa_mem corpus: single-ref HCV BAMs ----
+
+@pytest.mark.parametrize("i", range(1, 7))
+def test_bwa_default(data_root, i):
+    path = data_root / "data_bwa_mem" / f"{i}.1.sub_test.bam"
+    expected = next(iter(expected_records(path.with_suffix(".fa")).values()))
+    observed = next(iter(run_consensus(path).values()))
+    assert observed.upper() == expected.upper()
+
+
+@pytest.mark.parametrize("i", range(1, 7))
+def test_bwa_realign(data_root, i):
+    path = data_root / "data_bwa_mem" / f"{i}.1.sub_test.bam"
+    expected = next(
+        iter(expected_records(path.with_suffix(".realign.fa")).values())
+    )
+    observed = next(iter(run_consensus(path, "-r").values()))
+    assert observed.upper() == expected.upper()
+
+
+# ---- minimap2 corpus: multi-contig + gp120 ----
+
+def test_mm2_default(data_root):
+    for path in _bams(data_root, "data_minimap2"):
+        expected = expected_records(path.with_suffix(".fa"))
+        observed = run_consensus(path)
+        for name, seq in expected.items():
+            assert observed[name].upper() == seq.upper(), path.name
+
+
+def test_mm2_realign(data_root):
+    for path in _bams(data_root, "data_minimap2"):
+        fa = path.with_suffix(".realign.fa")
+        if not fa.exists():
+            continue
+        expected = expected_records(fa)
+        observed = run_consensus(path, "-r")
+        for name, seq in expected.items():
+            assert observed[name].upper() == seq.upper(), path.name
+
+
+# ---- ext corpus: five-contig SAMs from issue 23 ----
+
+EXT_DEFAULT = ["1.issue23.debug.sam", "2.issue23.bc63.sam", "3.issue23.bc75.sam"]
+EXT_REALIGN = ["1.issue23.debug.sam", "2.issue23.bc63.sam"]
+# 3.issue23.bc75.sam realign is a known-failure in the reference itself
+# ("Kindel 1.2 adds an unwanted insertion at 1284",
+# /root/reference/tests/test_kindel.py:281-299) — excluded there, excluded here.
+
+
+@pytest.mark.parametrize("fn", EXT_DEFAULT)
+def test_ext_default(data_root, fn):
+    path = data_root / "data_ext" / fn
+    expected = next(iter(expected_records(path.with_suffix(".fa")).values()))
+    observed = next(iter(run_consensus(path).values()))
+    assert observed.upper() == expected.upper()
+
+
+@pytest.mark.parametrize("fn", EXT_REALIGN)
+def test_ext_realign(data_root, fn):
+    path = data_root / "data_ext" / fn
+    expected = next(
+        iter(expected_records(path.with_suffix(".realign.fa")).values())
+    )
+    observed = next(iter(run_consensus(path, "-r").values()))
+    assert observed.upper() == expected.upper()
+
+
+# ---- CDR engine: exact clip-consensus strings ----
+
+def test_cdrp_strings(data_root):
+    from kindel_tpu.events import extract_events
+    from kindel_tpu.io import load_alignment
+    from kindel_tpu.pileup import build_pileups
+    from kindel_tpu.realign import cdrp_consensuses
+
+    ev = extract_events(
+        load_alignment(data_root / "data_bwa_mem" / "1.1.sub_test.bam")
+    )
+    pileup = next(iter(build_pileups(ev).values()))
+    cdrps = cdrp_consensuses(pileup, clip_decay_threshold=0.1, mask_ends=10)
+    assert (
+        cdrps[0][0].seq
+        == "AACTGCCGCTAGGGGCGCGTTCGGGCTCGCCAACATCTTCAGTCCGGGCGCTAAGCAGAACATCCAGCTGATCAACA"
+    )
+    assert (
+        cdrps[0][1].seq
+        == "AGCGTCGATGCAGATACCTACACCACCGGGGGAACTGCCGCTAGGGGCGCGTTCGGGCTCGCCAACATCTTCAGTCCGGGCGCTAAGCAGAACA"
+    )

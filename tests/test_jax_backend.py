@@ -1,0 +1,134 @@
+"""numpy↔jax backend equivalence + mesh sharding tests.
+
+Runs on a virtual 8-device CPU mesh (conftest sets
+xla_force_host_platform_device_count) — SURVEY §4's TPU-world analogue of
+the reference's real-data testing.
+"""
+
+import numpy as np
+import pytest
+
+from kindel_tpu.events import extract_events
+from kindel_tpu.io import load_alignment
+from kindel_tpu.pileup import build_pileups
+
+
+@pytest.fixture(scope="module")
+def bwa_events(data_root):
+    return extract_events(
+        load_alignment(data_root / "data_bwa_mem" / "1.1.sub_test.bam")
+    )
+
+
+def test_pileup_jax_equivalence(bwa_events):
+    from kindel_tpu.pileup_jax import build_pileups_jax
+
+    np_p = next(iter(build_pileups(bwa_events).values()))
+    jx_p = next(iter(build_pileups_jax(bwa_events).values()))
+    np.testing.assert_array_equal(np_p.weights, jx_p.weights)
+    np.testing.assert_array_equal(np_p.clip_start_weights, jx_p.clip_start_weights)
+    np.testing.assert_array_equal(np_p.clip_end_weights, jx_p.clip_end_weights)
+    np.testing.assert_array_equal(np_p.clip_starts, jx_p.clip_starts)
+    np.testing.assert_array_equal(np_p.clip_ends, jx_p.clip_ends)
+    np.testing.assert_array_equal(np_p.deletions, jx_p.deletions)
+    np.testing.assert_array_equal(np_p.ins.totals, jx_p.ins.totals)
+
+
+def test_fused_call_equivalence(bwa_events):
+    from kindel_tpu.call import call_consensus
+    from kindel_tpu.call_jax import call_consensus_fused
+
+    rid = bwa_events.present_ref_ids[0]
+    pileup = next(iter(build_pileups(bwa_events).values()))
+    np_res = call_consensus(pileup)
+    jx_res, dmin, dmax = call_consensus_fused(bwa_events, rid, pileup=pileup)
+    assert np_res.sequence == jx_res.sequence
+    assert np_res.changes == jx_res.changes
+    assert dmin == int(pileup.acgt_depth.min())
+    assert dmax == int(pileup.acgt_depth.max())
+
+
+def test_cli_backend_jax_matches_numpy(data_root):
+    from tests.test_consensus_golden import run_consensus
+
+    path = data_root / "data_minimap2" / "1.1.multi.bam"
+    np_out = run_consensus(path)
+    jx_out = run_consensus(path, "--backend", "jax")
+    assert np_out == jx_out
+
+
+def test_device_call_masks_match_numpy(bwa_events):
+    from kindel_tpu.call import compute_masks
+    from kindel_tpu.call_jax import device_call
+
+    rid = bwa_events.present_ref_ids[0]
+    pileup = next(iter(build_pileups(bwa_events).values()))
+    L = pileup.ref_len
+    np_masks = compute_masks(
+        pileup.weights, pileup.deletions[:L],
+        pileup.ins.totals[:L].astype(np.int64), min_depth=1,
+    )
+    emit, jx_masks, dmin, dmax = device_call(bwa_events, rid)
+    np.testing.assert_array_equal(np_masks.base_char, jx_masks.base_char)
+    np.testing.assert_array_equal(np_masks.del_mask, jx_masks.del_mask)
+    np.testing.assert_array_equal(np_masks.n_mask, jx_masks.n_mask)
+    np.testing.assert_array_equal(np_masks.ins_mask, jx_masks.ins_mask)
+    assert dmin == int(pileup.acgt_depth.min())
+    assert dmax == int(pileup.acgt_depth.max())
+
+
+def test_sharded_call_equivalence(bwa_events):
+    """Position-sharded (sp=8) fused call == numpy oracle, halo incl."""
+    import jax
+
+    from kindel_tpu.call import compute_masks
+    from kindel_tpu.parallel import make_mesh, sharded_call
+
+    assert len(jax.devices()) >= 8, "virtual device mesh missing"
+    mesh = make_mesh({"sp": 8})
+    rid = bwa_events.present_ref_ids[0]
+    pileup = next(iter(build_pileups(bwa_events).values()))
+    L = pileup.ref_len
+    np_masks = compute_masks(
+        pileup.weights, pileup.deletions[:L],
+        pileup.ins.totals[:L].astype(np.int64), min_depth=1,
+    )
+    w_sharded, masks_sharded = sharded_call(bwa_events, rid, mesh)
+    np.testing.assert_array_equal(w_sharded, pileup.weights)
+    np.testing.assert_array_equal(masks_sharded.base_char, np_masks.base_char)
+    np.testing.assert_array_equal(masks_sharded.del_mask, np_masks.del_mask)
+    np.testing.assert_array_equal(masks_sharded.n_mask, np_masks.n_mask)
+    np.testing.assert_array_equal(masks_sharded.ins_mask, np_masks.ins_mask)
+
+
+def test_batched_dp_sp_step(bwa_events):
+    """dp×sp batched step: two samples (same events) over a 2×4 mesh."""
+    import numpy as np
+
+    from kindel_tpu.call import compute_masks
+    from kindel_tpu.parallel import make_mesh, batched_sharded_call
+
+    mesh = make_mesh({"dp": 2, "sp": 4})
+    rid = bwa_events.present_ref_ids[0]
+    L = int(bwa_events.ref_lens[rid])
+    sel = bwa_events.match_rid == rid
+    sample = {
+        "match_pos": bwa_events.match_pos[sel],
+        "match_base": bwa_events.match_base[sel].astype(np.int64),
+        "del_pos": bwa_events.del_pos[
+            (bwa_events.del_rid == rid) & (bwa_events.del_pos < L)
+        ],
+        "ins_pos": np.empty(0, dtype=np.int64),
+        "ins_cnt": np.empty(0, dtype=np.int64),
+    }
+    w, bc, dm, nm, im = batched_sharded_call([sample, sample], L, mesh)
+    pileup = next(iter(build_pileups(bwa_events).values()))
+    np_masks = compute_masks(
+        pileup.weights, pileup.deletions[:L],
+        np.zeros(L, dtype=np.int64),  # insertions excluded from the batch
+        min_depth=1,
+    )
+    np.testing.assert_array_equal(w[0], pileup.weights)
+    np.testing.assert_array_equal(w[0], w[1])
+    np.testing.assert_array_equal(bc[0], np_masks.base_char)
+    np.testing.assert_array_equal(dm[0], np_masks.del_mask)

@@ -1,0 +1,38 @@
+"""Native C++ decoder ↔ pure-Python decoder equivalence."""
+
+import numpy as np
+import pytest
+
+from kindel_tpu.io import bgzf
+from kindel_tpu.io.bam import parse_bam_bytes
+
+
+@pytest.fixture(scope="module")
+def native():
+    from kindel_tpu.io import native as mod
+
+    if not mod.available():
+        pytest.skip("native library not built (make -C src/native)")
+    return mod
+
+
+def test_native_bgzf_matches_python(native, data_root):
+    raw = (data_root / "data_bwa_mem" / "1.1.sub_test.bam").read_bytes()
+    assert native.bgzf_decompress(raw) == bgzf.decompress(raw)
+
+
+def test_native_bam_decode_matches_python(native, data_root):
+    raw = (data_root / "data_minimap2" / "1.1.multi.bam").read_bytes()
+    data = bgzf.decompress(raw)
+    py = parse_bam_bytes(data)
+    nt = native.parse_bam_bytes(data)
+    assert py.ref_names == nt.ref_names
+    np.testing.assert_array_equal(py.pos, nt.pos)
+    np.testing.assert_array_equal(py.flag, nt.flag)
+    np.testing.assert_array_equal(py.seq, nt.seq)
+    np.testing.assert_array_equal(py.cig_op, nt.cig_op)
+    np.testing.assert_array_equal(py.cig_len, nt.cig_len)
+
+
+def test_native_rejects_garbage(native):
+    assert native.bgzf_decompress(b"\x1f\x8b" + b"junkjunkjunkjunkjunk") is None

@@ -1,0 +1,69 @@
+"""Curated pileup assertions — the reference's hand-verified counts
+("Curated in Tablet / Samtools depth",
+/root/reference/tests/test_kindel.py:68-89) asserted against the dense
+accumulator tensors, pinning accumulator semantics independent of the CLI."""
+
+import pytest
+
+from kindel_tpu.events import extract_events
+from kindel_tpu.io import load_alignment
+from kindel_tpu.pileup import build_pileups
+
+A, T, G, C, N = range(5)
+
+
+@pytest.fixture(scope="module")
+def bwa_pileup(data_root):
+    ev = extract_events(load_alignment(data_root / "data_bwa_mem" / "1.1.sub_test.bam"))
+    return next(iter(build_pileups(ev).values()))
+
+
+@pytest.fixture(scope="module")
+def ext_pileup(data_root):
+    ev = extract_events(load_alignment(data_root / "data_ext" / "3.issue23.bc75.sam"))
+    return next(iter(build_pileups(ev).values()))
+
+
+def test_ref_identity(bwa_pileup):
+    assert bwa_pileup.ref_id == "ENA|EU155341|EU155341.2"
+    assert bwa_pileup.ref_len == 9306
+    assert bwa_pileup.weights.shape == (9306, 5)
+
+
+def test_known_weights(bwa_pileup, ext_pileup):
+    assert bwa_pileup.weights[0, A] == 22
+    assert bwa_pileup.weights[23, A] == 57
+    assert ext_pileup.weights[68, G] == 1
+    assert ext_pileup.weights[2368, T] == 13
+
+
+def test_known_deletions(ext_pileup):
+    for pos, count in [(399, 14), (402, 14), (411, 15),
+                       (1048, 14), (1049, 14), (1050, 14)]:
+        assert ext_pileup.deletions[pos] == count
+
+
+def test_known_clips(bwa_pileup, ext_pileup):
+    assert ext_pileup.clip_ends[1748] == 12
+    assert bwa_pileup.clip_starts[525] == 16
+    assert bwa_pileup.clip_starts[1437] == 84
+
+
+def test_known_insertions(ext_pileup):
+    # insertion strings are registered at the following reference position
+    # (reference kindel.py:55-58; asserted with the same +1 the reference's
+    # own tests use, tests/test_kindel.py:88-89)
+    assert ext_pileup.ins.totals[452 + 1] == 14
+    assert ext_pileup.ins.totals[456 + 1] == 14
+
+
+def test_compat_parse_bam(data_root):
+    """The reference-shaped compat API returns identical dict views."""
+    from kindel_tpu.compat import parse_bam
+
+    aln = list(parse_bam(data_root / "data_bwa_mem" / "1.1.sub_test.bam").values())[0]
+    assert aln.ref_id == "ENA|EU155341|EU155341.2"
+    assert len(aln.weights) == 9306
+    assert aln.weights[0]["A"] == 22
+    assert aln.weights[23]["A"] == 57
+    assert aln.clip_starts[525] == 16

@@ -1,0 +1,78 @@
+"""Unit tier — pure-function checks mirroring the reference's unit tests
+(/root/reference/tests/test_kindel.py:22-57) plus kindel-tpu-specific
+primitives."""
+
+import numpy as np
+
+from kindel_tpu import consensus, merge_by_lcs
+from kindel_tpu.io.records import (
+    ragged_indices,
+    ragged_local_offsets,
+)
+from kindel_tpu.pileup import argmax_base_and_tie
+
+
+def test_consensus_caller():
+    pos_weight = {"A": 1, "C": 2, "G": 3, "T": 4, "N": 5}
+    assert consensus(pos_weight)[0] == "N"
+    assert consensus(pos_weight)[1] == 5
+    assert consensus(pos_weight)[2] == 0.33
+    assert consensus(pos_weight)[3] is False
+    pos_weight_tie = {"A": 5, "C": 5, "G": 3, "T": 4, "N": 1}
+    assert consensus(pos_weight_tie)[3] is True
+    assert consensus({"A": 0, "C": 0, "G": 0, "T": 0, "N": 0}) == ("N", 0, 0, False)
+
+
+def test_merge_by_lcs():
+    one = (
+        "AACTGCCGCTAGGGGCGCGTTCGGGCTCGCCAACATCTTCAGTCCGGG",
+        "GCCGCTAGGGGCGCGTTCGGGCTCGCCAACATCTTCAGTCCGGGCGCTAAGCAGAACA",
+    )
+    two = (
+        "AACTGCCGCTAGGGGCGCGTTCGGGCTCGCCAACATCTTCAGTCCGGGCGCTAAGCAGAACATC",
+        "GCAGATACCTACACCACCGGGGGAACTGCCGCTAGGGGCGCGTTCGGGCTCGCCAACATCTTCAGTCCGGGCGCTAAGCAGAACA",
+    )
+    assert (
+        merge_by_lcs(*one, min_overlap=7)
+        == "AACTGCCGCTAGGGGCGCGTTCGGGCTCGCCAACATCTTCAGTCCGGGCGCTAAGCAGAACA"
+    )
+    assert (
+        merge_by_lcs(*two, min_overlap=7)
+        == "AACTGCCGCTAGGGGCGCGTTCGGGCTCGCCAACATCTTCAGTCCGGGCGCTAAGCAGAACA"
+    )
+    assert merge_by_lcs("AT", "CG", min_overlap=7) is None
+
+
+def test_ragged_primitives():
+    starts = np.array([5, 10, 0])
+    lens = np.array([3, 0, 2])
+    np.testing.assert_array_equal(
+        ragged_indices(starts, lens), [5, 6, 7, 0, 1]
+    )
+    np.testing.assert_array_equal(
+        ragged_local_offsets(lens), [0, 1, 2, 0, 1]
+    )
+
+
+def test_argmax_base_tie_semantics():
+    counts = np.array(
+        [
+            [3, 1, 0, 0, 0],  # clear A
+            [2, 2, 0, 0, 0],  # tie A/T -> argmax picks A, tie flagged
+            [0, 0, 0, 0, 0],  # zero depth -> N, no tie
+            [0, 0, 0, 0, 7],  # N wins outright
+        ],
+        dtype=np.int32,
+    )
+    idx, freq, tie = argmax_base_and_tie(counts)
+    np.testing.assert_array_equal(idx, [0, 0, 4, 4])
+    np.testing.assert_array_equal(freq, [3, 2, 0, 7])
+    np.testing.assert_array_equal(tie, [False, True, False, False])
+
+
+def test_version_cli(capsys):
+    from kindel_tpu.cli import main
+
+    assert main(["version"]) == 0
+    out = capsys.readouterr().out
+    assert out.startswith("kindel-tpu ")
