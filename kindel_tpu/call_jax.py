@@ -122,13 +122,17 @@ def fused_call_kernel(
         emit = jnp.concatenate([emit, jnp.zeros(1, jnp.uint8)])
     emit_packed = (emit[0::2] << 4) | emit[1::2]
 
-    masks_packed = None
     if want_masks:
         masks_packed = (
             jnp.packbits(del_mask),
             jnp.packbits(n_mask),
             jnp.packbits(ins_mask),
         )
+    else:
+        # emit codes alone reconstruct the sequence; insertion emission is
+        # only needed at the (rare) positions that observed insertions —
+        # gather the mask there instead of shipping it densely
+        masks_packed = ins_mask[jnp.where(ins_pos < length, ins_pos, 0)]
     return emit_packed, masks_packed, acgt_depth.min(), acgt_depth.max()
 
 
@@ -181,14 +185,27 @@ def device_call(ev: EventSet, rid: int, min_depth: int = 1,
     emit[1::2] = emit_b & 0xF
     emit = emit[:L]
 
-    masks = None
+    base_char = EMIT_ASCII[np.where(emit == 0, N_CHANNELS, emit)]
     if want_masks:
         db, nb, ib = (np.asarray(x) for x in masks_packed)
         masks = CallMasks(
-            base_char=EMIT_ASCII[np.where(emit == 0, N_CHANNELS, emit)],
+            base_char=base_char,
             del_mask=np.unpackbits(db)[:L].astype(bool),
             n_mask=np.unpackbits(nb)[:L].astype(bool),
             ins_mask=np.unpackbits(ib)[:L].astype(bool),
+        )
+    else:
+        # emit codes already fold the N substitutions in; reconstruct only
+        # the deletion skips and the sparse insertion emissions
+        ins_mask = np.zeros(L, dtype=bool)
+        if len(ip):
+            flags = np.asarray(masks_packed)[: len(ip)]
+            ins_mask[ip[flags]] = True
+        masks = CallMasks(
+            base_char=base_char,
+            del_mask=emit == 0,
+            n_mask=np.zeros(L, dtype=bool),
+            ins_mask=ins_mask,
         )
     return emit, masks, int(dmin), int(dmax)
 
@@ -207,9 +224,11 @@ def call_consensus_fused(
     supplies insertion-string majority resolution when insertions emit.
 
     Returns (CallResult, depth_min, depth_max) — the depth scalars feed the
-    per-reference report without any count-tensor download."""
+    per-reference report without any count-tensor download. When the caller
+    does not need per-position change markers, the dense decision masks are
+    not shipped at all — the sequence reconstructs from emission codes."""
     _emit, masks, dmin, dmax = device_call(
-        ev, rid, min_depth, want_masks=True
+        ev, rid, min_depth, want_masks=build_changes
     )
     ins_calls = {}
     if masks.ins_mask.any():

@@ -48,6 +48,17 @@ def test_fused_call_equivalence(bwa_events):
     assert dmax == int(pileup.acgt_depth.max())
 
 
+def test_emit_only_fast_path(bwa_events):
+    """build_changes=False skips the dense mask download; sequence must be
+    identical to the full-masks path."""
+    from kindel_tpu.call_jax import call_consensus_fused
+
+    rid = bwa_events.present_ref_ids[0]
+    full, _, _ = call_consensus_fused(bwa_events, rid, build_changes=True)
+    fast, _, _ = call_consensus_fused(bwa_events, rid, build_changes=False)
+    assert full.sequence == fast.sequence
+
+
 def test_cli_backend_jax_matches_numpy(data_root):
     from tests.test_consensus_golden import run_consensus
 
