@@ -57,23 +57,21 @@ def compress_match_events(match_pos: np.ndarray, match_base: np.ndarray):
     return op_r_start, op_off, packed
 
 
-@partial(jax.jit, static_argnames=("length", "n_events", "want_masks"))
-def fused_call_kernel(
+def _call_core(
     op_r_start,  # int32[O_pad] span start positions (pad: PAD_POS)
     op_off,  # int32[O_pad] exclusive event offsets (pad: n_events)
     base_packed,  # uint8[E_pad//2] 4-bit base codes
     del_pos,  # int32[D_pad] (pad: PAD_POS)
     ins_pos,  # int32[I_pad] (pad: PAD_POS)
     ins_cnt,  # int32[I_pad]
+    n_events,  # int32 scalar (traced — varies per sample without recompile)
     min_depth,  # int32 scalar
-    *,
     length: int,
-    n_events: int,
     want_masks: bool,
 ):
     """Reconstruct match events, scatter counts, call every position.
 
-    Returns (emit_packed, masks_or_none, depth_min, depth_max).
+    Returns (emit_packed, masks, depth_min, depth_max).
     """
     E_pad = base_packed.shape[0] * 2
     # unpack 4-bit base codes
@@ -136,6 +134,62 @@ def fused_call_kernel(
     return emit_packed, masks_packed, acgt_depth.min(), acgt_depth.max()
 
 
+@partial(jax.jit, static_argnames=("length", "want_masks"))
+def fused_call_kernel(op_r_start, op_off, base_packed, del_pos, ins_pos,
+                      ins_cnt, n_events, min_depth, *, length: int,
+                      want_masks: bool):
+    return _call_core(
+        op_r_start, op_off, base_packed, del_pos, ins_pos, ins_cnt,
+        n_events, min_depth, length, want_masks,
+    )
+
+
+@partial(jax.jit, static_argnames=("length",))
+def batched_call_kernel(op_r_start, op_off, base_packed, del_pos, ins_pos,
+                        ins_cnt, n_events, min_depth, *, length: int):
+    """vmapped fused call over a batch of samples (leading axis B).
+
+    Data-parallel by construction: under a mesh with the batch axis sharded
+    ('dp'), XLA partitions this embarrassingly-parallel program with no
+    collectives. Returns per-sample (emit_packed, ins_flags, dmin, dmax).
+    """
+
+    def one(ors, oo, bp, dp, ip, ic, ne):
+        return _call_core(
+            ors, oo, bp, dp, ip, ic, ne, min_depth, length, False
+        )
+
+    return jax.vmap(one)(
+        op_r_start, op_off, base_packed, del_pos, ins_pos, ins_cnt, n_events
+    )
+
+
+def unpack_emit(emit_packed: np.ndarray, L: int) -> np.ndarray:
+    """4-bit emission codes → uint8[L] (0=deletion-skip, 1..5=A,T,G,C,N)."""
+    emit = np.empty(emit_packed.shape[0] * 2, dtype=np.uint8)
+    emit[0::2] = emit_packed >> 4
+    emit[1::2] = emit_packed & 0xF
+    return emit[:L]
+
+
+def masks_from_emit(emit: np.ndarray, ins_pos: np.ndarray,
+                    ins_flags: np.ndarray) -> CallMasks:
+    """Reconstruct assembler inputs from emission codes alone: emit already
+    folds the N substitutions in, so only the deletion skips and the sparse
+    insertion emissions need rebuilding."""
+    L = len(emit)
+    ins_mask = np.zeros(L, dtype=bool)
+    if len(ins_pos):
+        flags = np.asarray(ins_flags)[: len(ins_pos)]
+        ins_mask[ins_pos[flags]] = True
+    return CallMasks(
+        base_char=EMIT_ASCII[np.where(emit == 0, N_CHANNELS, emit)],
+        del_mask=emit == 0,
+        n_mask=np.zeros(L, dtype=bool),
+        ins_mask=ins_mask,
+    )
+
+
 def _rid_events(ev: EventSet, rid: int):
     L = int(ev.ref_lens[rid])
     sel = ev.match_rid == rid
@@ -174,39 +228,23 @@ def device_call(ev: EventSet, rid: int, min_depth: int = 1,
         jnp.asarray(_pad(dp, D_pad, PAD_POS)),
         jnp.asarray(_pad(ip, I_pad, PAD_POS)),
         jnp.asarray(_pad(ic, I_pad, 0)),
+        jnp.int32(n_events),
         jnp.int32(min_depth),
         length=L,
-        n_events=n_events,
         want_masks=want_masks,
     )
-    emit_b = np.asarray(emit_packed)
-    emit = np.empty(emit_b.shape[0] * 2, dtype=np.uint8)
-    emit[0::2] = emit_b >> 4
-    emit[1::2] = emit_b & 0xF
-    emit = emit[:L]
+    emit = unpack_emit(np.asarray(emit_packed), L)
 
-    base_char = EMIT_ASCII[np.where(emit == 0, N_CHANNELS, emit)]
     if want_masks:
         db, nb, ib = (np.asarray(x) for x in masks_packed)
         masks = CallMasks(
-            base_char=base_char,
+            base_char=EMIT_ASCII[np.where(emit == 0, N_CHANNELS, emit)],
             del_mask=np.unpackbits(db)[:L].astype(bool),
             n_mask=np.unpackbits(nb)[:L].astype(bool),
             ins_mask=np.unpackbits(ib)[:L].astype(bool),
         )
     else:
-        # emit codes already fold the N substitutions in; reconstruct only
-        # the deletion skips and the sparse insertion emissions
-        ins_mask = np.zeros(L, dtype=bool)
-        if len(ip):
-            flags = np.asarray(masks_packed)[: len(ip)]
-            ins_mask[ip[flags]] = True
-        masks = CallMasks(
-            base_char=base_char,
-            del_mask=emit == 0,
-            n_mask=np.zeros(L, dtype=bool),
-            ins_mask=ins_mask,
-        )
+        masks = masks_from_emit(emit, ip, np.asarray(masks_packed))
     return emit, masks, int(dmin), int(dmax)
 
 

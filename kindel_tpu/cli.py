@@ -58,21 +58,38 @@ def _consensus_parser(sub):
         "-u", "--uppercase", action="store_true",
         help="close gaps using uppercase alphabet",
     )
+    p.add_argument(
+        "--profile", action="store_true",
+        help="print per-phase wall-time report to stderr "
+             "(set KINDEL_TPU_TRACE_DIR for an XLA profiler trace)",
+    )
     _add_backend(p)
 
 
 def cmd_consensus(args) -> int:
-    res = workloads.bam_to_consensus(
-        args.bam_path,
-        realign=args.realign,
-        min_depth=args.min_depth,
-        min_overlap=args.min_overlap,
-        clip_decay_threshold=args.clip_decay_threshold,
-        mask_ends=args.mask_ends,
-        trim_ends=args.trim_ends,
-        uppercase=args.uppercase,
-        backend=args.backend,
-    )
+    timer = None
+    if args.profile:
+        from kindel_tpu.utils.profiling import disable_profiling, enable_profiling
+
+        timer = enable_profiling()
+        timer.start_trace()
+    try:
+        res = workloads.bam_to_consensus(
+            args.bam_path,
+            realign=args.realign,
+            min_depth=args.min_depth,
+            min_overlap=args.min_overlap,
+            clip_decay_threshold=args.clip_decay_threshold,
+            mask_ends=args.mask_ends,
+            trim_ends=args.trim_ends,
+            uppercase=args.uppercase,
+            backend=args.backend,
+        )
+    finally:
+        if timer is not None:
+            timer.stop_trace()
+            timer.print_report()
+            disable_profiling()
     print("\n".join(res.refs_reports.values()), file=sys.stderr)
     for record in res.consensuses:
         print(f">{record.name}")

@@ -1,0 +1,74 @@
+"""Device-side statistics kernels for the weights/features workloads.
+
+The reference computes per-site Shannon entropy and Jeffreys binomial
+confidence intervals with one scipy call per site
+(/root/reference/kindel/kindel.py:614-624 — flagged HOT in SURVEY §3.2).
+Here both are jitted whole-axis reductions:
+
+  * entropy — plain jnp vector math over the [L, 4] relative-frequency
+    block (scipy semantics: rows renormalized, 0·log0 = 0, all-zero → nan);
+  * Jeffreys CI — beta.ppf(α/2 | c+½, n−c+½) has no closed form and no
+    jax primitive, so it is inverted from jax.scipy.special.betainc by
+    fixed-iteration bisection (60 rounds ⇒ ~1e-18 interval width, far
+    below the 3-decimal rounding of the TSV output).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def entropy_rows(rel: jnp.ndarray) -> jnp.ndarray:
+    """Shannon entropy per row with scipy.stats.entropy semantics."""
+    totals = rel.sum(axis=1, keepdims=True)
+    pk = rel / totals
+    terms = jnp.where(pk > 0, -pk * jnp.log(pk), 0.0)
+    out = terms.sum(axis=1)
+    bad = jnp.isnan(rel).any(axis=1) | (totals[:, 0] == 0)
+    return jnp.where(bad, jnp.nan, out)
+
+
+@partial(jax.jit, static_argnames=("iters",))
+def beta_ppf(q, a, b, iters: int = 60):
+    """Inverse regularized incomplete beta by bisection on [0, 1]."""
+    lo = jnp.zeros_like(q)
+    hi = jnp.ones_like(q)
+
+    def body(_, carry):
+        lo, hi = carry
+        mid = 0.5 * (lo + hi)
+        below = jax.scipy.special.betainc(a, b, mid) < q
+        return jnp.where(below, mid, lo), jnp.where(below, hi, mid)
+
+    lo, hi = jax.lax.fori_loop(0, iters, body, (lo, hi))
+    return 0.5 * (lo + hi)
+
+
+@jax.jit
+def jeffreys_interval(count, nobs, alpha):
+    """Jeffreys binomial proportion CI: beta.interval(1-alpha, c+0.5,
+    n-c+0.5) (reference kindel.py:569-574), computed on device."""
+    a = count + 0.5
+    b = nobs - count + 0.5
+    lower = beta_ppf(jnp.full_like(a, alpha / 2), a, b)
+    upper = beta_ppf(jnp.full_like(a, 1 - alpha / 2), a, b)
+    return lower, upper
+
+
+def entropy_rows_host(rel: np.ndarray) -> np.ndarray:
+    return np.asarray(entropy_rows(jnp.asarray(rel)))
+
+
+def jeffreys_interval_host(count: np.ndarray, nobs: np.ndarray,
+                           alpha: float):
+    lower, upper = jeffreys_interval(
+        jnp.asarray(count, jnp.float32),
+        jnp.asarray(nobs, jnp.float32),
+        jnp.float32(alpha),
+    )
+    return np.asarray(lower), np.asarray(upper)

@@ -1,0 +1,8 @@
+"""Cross-cutting utilities: phase timing, profiling, logging."""
+
+from kindel_tpu.utils.profiling import (  # noqa: F401
+    PhaseTimer,
+    enable_profiling,
+    maybe_phase,
+    profile_phases,
+)

@@ -1,0 +1,90 @@
+"""Per-phase wall-time instrumentation + optional XLA profiler traces.
+
+The reference's only runtime observability was two tqdm progress bars
+(/root/reference/kindel/kindel.py:40,390 — SURVEY §5). kindel-tpu replaces
+them with structured phase timing (`--profile` on the CLI prints the table
+to stderr) and, when KINDEL_TPU_TRACE_DIR is set, a JAX profiler trace of
+the device phases viewable in TensorBoard/Perfetto.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from contextlib import contextmanager
+
+
+class PhaseTimer:
+    """Accumulates named phase durations; printable as a report table."""
+
+    def __init__(self):
+        self.phases: list[tuple[str, float]] = []
+        self._trace_dir = os.environ.get("KINDEL_TPU_TRACE_DIR")
+        self._tracing = False
+
+    @contextmanager
+    def phase(self, name: str):
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.phases.append((name, time.perf_counter() - start))
+
+    def start_trace(self):
+        if self._trace_dir and not self._tracing:
+            import jax
+
+            jax.profiler.start_trace(self._trace_dir)
+            self._tracing = True
+
+    def stop_trace(self):
+        if self._tracing:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._tracing = False
+
+    def report(self) -> str:
+        total = sum(d for _, d in self.phases)
+        lines = ["===================== PROFILE ======================"]
+        for name, dur in self.phases:
+            pct = 100.0 * dur / total if total else 0.0
+            lines.append(f"{name:<28s} {dur * 1e3:>10.1f} ms {pct:>5.1f}%")
+        lines.append(f"{'total':<28s} {total * 1e3:>10.1f} ms")
+        if self._trace_dir:
+            lines.append(f"xla trace: {self._trace_dir}")
+        return "\n".join(lines)
+
+    def print_report(self, file=None):
+        print(self.report(), file=file or sys.stderr)
+
+
+_active: PhaseTimer | None = None
+
+
+def profile_phases() -> PhaseTimer | None:
+    """The process-active PhaseTimer, if profiling is enabled."""
+    return _active
+
+
+def enable_profiling() -> PhaseTimer:
+    global _active
+    _active = PhaseTimer()
+    return _active
+
+
+def disable_profiling() -> None:
+    global _active
+    _active = None
+
+
+@contextmanager
+def maybe_phase(name: str):
+    """Record `name` against the active timer (no-op when disabled)."""
+    timer = _active
+    if timer is None:
+        yield
+    else:
+        with timer.phase(name):
+            yield

@@ -90,11 +90,16 @@ def bam_to_consensus(
     API-compatible with the reference (/root/reference/kindel/kindel.py:488-555,
     including its Python-API default min_overlap=9 vs the CLI's 7 — SURVEY §2.1).
     """
+    from kindel_tpu.pileup import build_pileup
+    from kindel_tpu.utils.profiling import maybe_phase
+
     consensuses = []
     refs_changes = {}
     refs_reports = {}
-    ev = extract_events(load_alignment(bam_path))
-    from kindel_tpu.pileup import build_pileup
+    with maybe_phase("decode"):
+        batch = load_alignment(bam_path)
+    with maybe_phase("event extraction"):
+        ev = extract_events(batch)
 
     for rid in ev.present_ref_ids:
         ref_id = ev.ref_names[rid]
@@ -102,34 +107,39 @@ def bam_to_consensus(
             # realign's CDR detection consumes the full clip tensors —
             # tiny event counts, reduced host-side even under the jax
             # backend (SURVEY §5: CDR/patch metadata is host-gathered)
-            pileup = build_pileup(ev, rid)
+            with maybe_phase(f"pileup reduce [{ref_id}]"):
+                pileup = build_pileup(ev, rid)
         else:
             pileup = None
         if realign:
-            cdrps = cdrp_consensuses(
-                pileup,
-                clip_decay_threshold=clip_decay_threshold,
-                mask_ends=mask_ends,
-            )
-            cdr_patches = merge_cdrps(cdrps, min_overlap)
+            with maybe_phase(f"realign CDR [{ref_id}]"):
+                cdrps = cdrp_consensuses(
+                    pileup,
+                    clip_decay_threshold=clip_decay_threshold,
+                    mask_ends=mask_ends,
+                )
+                cdr_patches = merge_cdrps(cdrps, min_overlap)
         else:
             cdr_patches = None
 
         if backend == "jax":
             from kindel_tpu.call_jax import call_consensus_fused
 
-            res, depth_min, depth_max = call_consensus_fused(
-                ev, rid, pileup=pileup, cdr_patches=cdr_patches,
-                trim_ends=trim_ends, min_depth=min_depth, uppercase=uppercase,
-            )
+            with maybe_phase(f"device call+assemble [{ref_id}]"):
+                res, depth_min, depth_max = call_consensus_fused(
+                    ev, rid, pileup=pileup, cdr_patches=cdr_patches,
+                    trim_ends=trim_ends, min_depth=min_depth,
+                    uppercase=uppercase,
+                )
         else:
-            res = call_consensus(
-                pileup,
-                cdr_patches=cdr_patches,
-                trim_ends=trim_ends,
-                min_depth=min_depth,
-                uppercase=uppercase,
-            )
+            with maybe_phase(f"call+assemble [{ref_id}]"):
+                res = call_consensus(
+                    pileup,
+                    cdr_patches=cdr_patches,
+                    trim_ends=trim_ends,
+                    min_depth=min_depth,
+                    uppercase=uppercase,
+                )
             acgt = pileup.acgt_depth
             depth_min = int(acgt.min()) if len(acgt) else 0
             depth_max = int(acgt.max()) if len(acgt) else 0
@@ -186,15 +196,29 @@ def weights(bam_path, relative: bool = False, confidence: bool = True,
     weights_df["consensus"] = consensus_depths.divide(weights_df.depth)
 
     rel = weights_df[nt_cols].divide(weights_df.depth, axis=0).round(4)
-    with np.errstate(divide="ignore", invalid="ignore"):
-        weights_df["shannon"] = _shannon(rel[["A", "C", "G", "T"]].values)
+    acgt_rel = rel[["A", "C", "G", "T"]].values
+    if backend == "jax":
+        from kindel_tpu.stats_jax import entropy_rows_host
+
+        weights_df["shannon"] = entropy_rows_host(acgt_rel)
+    else:
+        with np.errstate(divide="ignore", invalid="ignore"):
+            weights_df["shannon"] = _shannon(acgt_rel)
 
     if confidence:
-        lower, upper = _jeffreys_ci(
-            consensus_depths.values.astype(np.float64),
-            weights_df["depth"].values.astype(np.float64),
-            confidence_alpha,
-        )
+        if backend == "jax":
+            from kindel_tpu.stats_jax import jeffreys_interval_host
+
+            lower, upper = jeffreys_interval_host(
+                consensus_depths.values, weights_df["depth"].values,
+                confidence_alpha,
+            )
+        else:
+            lower, upper = _jeffreys_ci(
+                consensus_depths.values.astype(np.float64),
+                weights_df["depth"].values.astype(np.float64),
+                confidence_alpha,
+            )
         weights_df["lower_ci"] = lower
         weights_df["upper_ci"] = upper
 

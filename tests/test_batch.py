@@ -1,0 +1,23 @@
+"""Batched data-parallel consensus vs the single-file paths."""
+
+import numpy as np
+
+from kindel_tpu.batch import batch_bam_to_consensus
+from kindel_tpu.workloads import bam_to_consensus
+
+
+def test_batch_matches_single(data_root):
+    paths = [
+        data_root / "data_bwa_mem" / f"{i}.1.sub_test.bam" for i in (1, 2, 3)
+    ] + [data_root / "data_minimap2" / "1.1.multi.bam"]
+    batch_out = batch_bam_to_consensus(paths)
+    for path in paths:
+        singles = bam_to_consensus(path).consensuses
+        batched = batch_out[str(path)]
+        assert [s.name for s in singles] == [b.name for b in batched]
+        for s, b in zip(singles, batched):
+            assert s.sequence == b.sequence, path
+
+
+def test_batch_empty():
+    assert batch_bam_to_consensus([]) == {}

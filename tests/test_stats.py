@@ -1,0 +1,47 @@
+"""Device stats kernels vs scipy oracles."""
+
+import numpy as np
+import pytest
+
+scipy_stats = pytest.importorskip("scipy.stats")
+
+
+def test_entropy_matches_scipy():
+    from kindel_tpu.stats_jax import entropy_rows_host
+
+    rng = np.random.default_rng(0)
+    rel = rng.random((500, 4)).astype(np.float64)
+    rel[::17] = 0.0  # all-zero rows → nan, like scipy
+    ours = entropy_rows_host(rel)
+    ref = np.array([scipy_stats.entropy(r) for r in rel])
+    np.testing.assert_allclose(ours, ref, rtol=1e-5, equal_nan=True)
+
+
+def test_jeffreys_ci_matches_scipy():
+    from kindel_tpu.stats_jax import jeffreys_interval_host
+
+    count = np.array([0.0, 1, 5, 50, 499, 500, 22, 13])
+    nobs = np.array([0.0, 2, 10, 100, 500, 500, 22, 500])
+    lo, hi = jeffreys_interval_host(count, nobs, 0.01)
+    ref_lo, ref_hi = scipy_stats.beta.interval(
+        0.99, count + 0.5, nobs - count + 0.5
+    )
+    np.testing.assert_allclose(lo, ref_lo, atol=2e-4)
+    np.testing.assert_allclose(hi, ref_hi, atol=2e-4)
+
+
+def test_weights_workload_jax_close_to_numpy(data_root):
+    from kindel_tpu.workloads import weights
+
+    bam = data_root / "data_minimap2" / "1.1.multi.bam"
+    df_np = weights(bam)
+    df_jx = weights(bam, backend="jax")
+    assert list(df_np.columns) == list(df_jx.columns)
+    for col in ["A", "C", "G", "T", "N", "depth", "insertions", "deletions"]:
+        np.testing.assert_array_equal(df_np[col].values, df_jx[col].values)
+    for col in ["shannon", "lower_ci", "upper_ci", "consensus"]:
+        np.testing.assert_allclose(
+            df_np[col].values.astype(float),
+            df_jx[col].values.astype(float),
+            atol=2e-3, equal_nan=True,
+        )
