@@ -15,73 +15,25 @@ devices with zero collectives (embarrassingly parallel).
 from __future__ import annotations
 
 from concurrent.futures import ThreadPoolExecutor
-from dataclasses import dataclass
+
+from kindel_tpu.utils.jax_cache import ensure_compilation_cache
+
+ensure_compilation_cache()
 
 import jax.numpy as jnp
 import numpy as np
 
 from kindel_tpu.call import _insertion_calls, assemble
 from kindel_tpu.call_jax import (
+    CallUnit,
     batched_call_kernel,
-    compress_match_events,
     masks_from_emit,
     unpack_emit,
 )
 from kindel_tpu.events import extract_events
 from kindel_tpu.io import load_alignment
 from kindel_tpu.io.fasta import Sequence
-from kindel_tpu.pileup import build_insertion_table
 from kindel_tpu.pileup_jax import PAD_POS, _bucket, _pad
-
-
-@dataclass
-class _Unit:
-    """One (sample, reference) calling unit."""
-
-    sample_idx: int
-    ref_id: str
-    L: int
-    op_r_start: np.ndarray
-    op_off: np.ndarray
-    base_packed: np.ndarray
-    n_events: int
-    del_pos: np.ndarray
-    ins_pos: np.ndarray
-    ins_cnt: np.ndarray
-    ins_table: object
-
-
-def _extract_unit(ev, rid, sample_idx) -> _Unit:
-    L = int(ev.ref_lens[rid])
-    match_sel = ev.match_rid == rid
-    op_r_start, op_off, base_packed = compress_match_events(
-        ev.match_pos[match_sel], ev.match_base[match_sel]
-    )
-    dp = ev.del_pos[ev.del_rid == rid]
-    ins_table = build_insertion_table(ev, rid)
-    have_ins = len(ins_table.pos) > 0
-    ins_sel = ins_table.pos < L if have_ins else slice(0, 0)
-    return _Unit(
-        sample_idx=sample_idx,
-        ref_id=ev.ref_names[rid],
-        L=L,
-        op_r_start=op_r_start,
-        op_off=op_off,
-        base_packed=base_packed,
-        n_events=int(match_sel.sum()),
-        del_pos=dp[dp < L].astype(np.int32),
-        ins_pos=(
-            ins_table.pos[ins_sel].astype(np.int32)
-            if have_ins
-            else np.empty(0, np.int32)
-        ),
-        ins_cnt=(
-            ins_table.count[ins_sel].astype(np.int32)
-            if have_ins
-            else np.empty(0, np.int32)
-        ),
-        ins_table=ins_table,
-    )
 
 
 def batch_bam_to_consensus(
@@ -93,17 +45,21 @@ def batch_bam_to_consensus(
 ) -> dict:
     """Consensus for a cohort of alignment files in one device program.
 
-    Returns {path: [Sequence, ...]} in input order. References of different
-    lengths are padded to the cohort maximum (positions past a sample's own
-    reference produce zero counts and are sliced off)."""
-    bam_paths = [str(p) for p in bam_paths]
+    Returns {path: [Sequence, ...]} keyed by the caller's own path objects,
+    in input order. References of different lengths are padded to the cohort
+    maximum (positions past a sample's own reference produce zero counts and
+    are sliced off)."""
+    bam_paths = list(bam_paths)
 
     def load(path_idx):
         idx, path = path_idx
-        ev = extract_events(load_alignment(path))
-        return [
-            _extract_unit(ev, rid, idx) for rid in ev.present_ref_ids
-        ]
+        ev = extract_events(load_alignment(str(path)))
+        units_ = []
+        for rid in ev.present_ref_ids:
+            u = CallUnit(ev, rid, with_ins_table=True)
+            u.sample_idx = idx
+            units_.append(u)
+        return units_
 
     with ThreadPoolExecutor(max_workers=num_workers) as pool:
         per_sample = list(pool.map(load, enumerate(bam_paths)))

@@ -21,6 +21,10 @@ from __future__ import annotations
 
 from functools import partial
 
+from kindel_tpu.utils.jax_cache import ensure_compilation_cache
+
+ensure_compilation_cache()
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -190,20 +194,43 @@ def masks_from_emit(emit: np.ndarray, ins_pos: np.ndarray,
     )
 
 
-def _rid_events(ev: EventSet, rid: int):
-    L = int(ev.ref_lens[rid])
-    sel = ev.match_rid == rid
-    mp = ev.match_pos[sel]
-    mb = ev.match_base[sel]
-    sel = ev.del_rid == rid
-    dp = ev.del_pos[sel]
-    dp = dp[dp < L].astype(np.int32)
-    ipos, icnt = [], []
-    for (r, p, _s), c in ev.insertions.items():
-        if r == rid and p < L:
-            ipos.append(p)
-            icnt.append(c)
-    return L, mp, mb, dp, np.asarray(ipos, np.int32), np.asarray(icnt, np.int32)
+class CallUnit:
+    """One (reference)'s call-ready event tensors: op-span-compressed match
+    events plus deletion/insertion positions, all bounded to ref length.
+    Shared by the single-sample path (device_call) and the cohort batch
+    path (kindel_tpu.batch)."""
+
+    __slots__ = (
+        "ref_id", "L", "op_r_start", "op_off", "base_packed", "n_events",
+        "del_pos", "ins_pos", "ins_cnt", "ins_table", "sample_idx",
+    )
+
+    def __init__(self, ev: EventSet, rid: int, with_ins_table: bool = False):
+        self.ref_id = ev.ref_names[rid]
+        L = self.L = int(ev.ref_lens[rid])
+        sel = ev.match_rid == rid
+        mp = ev.match_pos[sel]
+        self.op_r_start, self.op_off, self.base_packed = (
+            compress_match_events(mp, ev.match_base[sel])
+        )
+        self.n_events = len(mp)
+        dp = ev.del_pos[ev.del_rid == rid]
+        self.del_pos = dp[dp < L].astype(np.int32)
+        self.ins_table = None
+        if with_ins_table:
+            tab = build_insertion_table(ev, rid)
+            self.ins_table = tab
+            sel = tab.pos < L
+            self.ins_pos = tab.pos[sel].astype(np.int32)
+            self.ins_cnt = tab.count[sel].astype(np.int32)
+        else:
+            ipos, icnt = [], []
+            for (r, p, _s), c in ev.insertions.items():
+                if r == rid and p < L:
+                    ipos.append(p)
+                    icnt.append(c)
+            self.ins_pos = np.asarray(ipos, np.int32)
+            self.ins_cnt = np.asarray(icnt, np.int32)
 
 
 def device_call(ev: EventSet, rid: int, min_depth: int = 1,
@@ -212,23 +239,21 @@ def device_call(ev: EventSet, rid: int, min_depth: int = 1,
 
     Returns (emit_codes uint8[L] (0=skip,1..5=ATGCN), CallMasks|None,
     depth_min, depth_max)."""
-    L, mp, mb, dp, ip, ic = _rid_events(ev, rid)
-
-    op_r_start, op_off, base_packed = compress_match_events(mp, mb)
-    n_events = len(mp)
-    O_pad = _bucket(len(op_r_start), 256)
-    B_pad = _bucket(len(base_packed), 1024)
-    D_pad = _bucket(len(dp), 256)
+    u = CallUnit(ev, rid)
+    L, ip = u.L, u.ins_pos
+    O_pad = _bucket(len(u.op_r_start), 256)
+    B_pad = _bucket(len(u.base_packed), 1024)
+    D_pad = _bucket(len(u.del_pos), 256)
     I_pad = _bucket(len(ip), 256)
 
     emit_packed, masks_packed, dmin, dmax = fused_call_kernel(
-        jnp.asarray(_pad(op_r_start, O_pad, PAD_POS)),
-        jnp.asarray(_pad(op_off, O_pad, np.int32(n_events))),
-        jnp.asarray(_pad(base_packed, B_pad, 0)),
-        jnp.asarray(_pad(dp, D_pad, PAD_POS)),
+        jnp.asarray(_pad(u.op_r_start, O_pad, PAD_POS)),
+        jnp.asarray(_pad(u.op_off, O_pad, np.int32(u.n_events))),
+        jnp.asarray(_pad(u.base_packed, B_pad, 0)),
+        jnp.asarray(_pad(u.del_pos, D_pad, PAD_POS)),
         jnp.asarray(_pad(ip, I_pad, PAD_POS)),
-        jnp.asarray(_pad(ic, I_pad, 0)),
-        jnp.int32(n_events),
+        jnp.asarray(_pad(u.ins_cnt, I_pad, 0)),
+        jnp.int32(u.n_events),
         jnp.int32(min_depth),
         length=L,
         want_masks=want_masks,

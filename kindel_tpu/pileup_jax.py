@@ -17,6 +17,10 @@ from __future__ import annotations
 
 from functools import partial
 
+from kindel_tpu.utils.jax_cache import ensure_compilation_cache
+
+ensure_compilation_cache()
+
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -1,0 +1,46 @@
+"""Persistent XLA compilation cache.
+
+The fused call kernel specializes on reference length; compiling the
+6.1 Mb-reference program costs minutes on a tunneled TPU while executing it
+costs ~1 s. The reference never had this problem (interpreted Python), so
+matching its CLI ergonomics requires compiles to be paid once per machine,
+not once per process: every jax-importing module calls
+`ensure_compilation_cache()` before building kernels, pointing XLA's
+persistent cache at a per-user directory.
+
+Env:
+  KINDEL_TPU_COMPILE_CACHE=<dir>  — cache location (default
+                                    ~/.cache/kindel_tpu/xla)
+  KINDEL_TPU_COMPILE_CACHE=off    — disable
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+_done = False
+
+
+def ensure_compilation_cache() -> None:
+    global _done
+    if _done:
+        return
+    _done = True
+    loc = os.environ.get("KINDEL_TPU_COMPILE_CACHE", "")
+    if loc.lower() in {"off", "0", "none"}:
+        return
+    if not loc and os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+        return  # the user configured jax's cache themselves — leave it alone
+    cache_dir = Path(loc) if loc else Path.home() / ".cache" / "kindel_tpu" / "xla"
+    try:
+        import jax
+
+        if not loc and jax.config.jax_compilation_cache_dir is not None:
+            return  # ditto, configured via jax.config.update
+        cache_dir.mkdir(parents=True, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:  # cache is an optimization — never fail the pipeline
+        pass

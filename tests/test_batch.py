@@ -13,7 +13,7 @@ def test_batch_matches_single(data_root):
     batch_out = batch_bam_to_consensus(paths)
     for path in paths:
         singles = bam_to_consensus(path).consensuses
-        batched = batch_out[str(path)]
+        batched = batch_out[path]
         assert [s.name for s in singles] == [b.name for b in batched]
         for s, b in zip(singles, batched):
             assert s.sequence == b.sequence, path

@@ -1,0 +1,38 @@
+"""Persistent XLA compilation cache wiring (kindel_tpu/utils/jax_cache.py)."""
+
+import jax
+
+from kindel_tpu.utils import jax_cache
+
+
+def test_cache_configured(tmp_path, monkeypatch):
+    before = jax.config.jax_compilation_cache_dir
+    monkeypatch.setenv("KINDEL_TPU_COMPILE_CACHE", str(tmp_path / "xla"))
+    monkeypatch.setattr(jax_cache, "_done", False)
+    try:
+        jax_cache.ensure_compilation_cache()
+        assert jax.config.jax_compilation_cache_dir == str(tmp_path / "xla")
+        assert (tmp_path / "xla").is_dir()
+    finally:
+        jax.config.update("jax_compilation_cache_dir", before)
+
+
+def test_cache_respects_user_config(tmp_path, monkeypatch):
+    before = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", str(tmp_path / "mine"))
+    monkeypatch.delenv("KINDEL_TPU_COMPILE_CACHE", raising=False)
+    monkeypatch.setattr(jax_cache, "_done", False)
+    try:
+        jax_cache.ensure_compilation_cache()
+        assert jax.config.jax_compilation_cache_dir == str(tmp_path / "mine")
+    finally:
+        jax.config.update("jax_compilation_cache_dir", before)
+
+
+def test_cache_disable(tmp_path, monkeypatch):
+    before = jax.config.jax_compilation_cache_dir
+    monkeypatch.setenv("KINDEL_TPU_COMPILE_CACHE", "off")
+    monkeypatch.setattr(jax_cache, "_done", False)
+    jax_cache.ensure_compilation_cache()
+    # disabling must not clobber an unrelated existing setting
+    assert jax.config.jax_compilation_cache_dir == before
