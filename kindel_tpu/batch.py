@@ -36,6 +36,24 @@ from kindel_tpu.io.fasta import Sequence
 from kindel_tpu.pileup_jax import PAD_POS, _bucket, _pad
 
 
+def _load_units(bam_paths, pool) -> list:
+    """Decode + event-extract a cohort concurrently → flat CallUnit list
+    (each tagged with its sample index)."""
+
+    def load(path_idx):
+        idx, path = path_idx
+        ev = extract_events(load_alignment(str(path)))
+        units_ = []
+        for rid in ev.present_ref_ids:
+            u = CallUnit(ev, rid, with_ins_table=True)
+            u.sample_idx = idx
+            units_.append(u)
+        return units_
+
+    per_sample = list(pool.map(load, enumerate(bam_paths)))
+    return [u for units_ in per_sample for u in units_]
+
+
 def batch_bam_to_consensus(
     bam_paths,
     min_depth: int = 1,
@@ -51,22 +69,23 @@ def batch_bam_to_consensus(
     are sliced off)."""
     bam_paths = list(bam_paths)
 
-    def load(path_idx):
-        idx, path = path_idx
-        ev = extract_events(load_alignment(str(path)))
-        units_ = []
-        for rid in ev.present_ref_ids:
-            u = CallUnit(ev, rid, with_ins_table=True)
-            u.sample_idx = idx
-            units_.append(u)
-        return units_
-
     with ThreadPoolExecutor(max_workers=num_workers) as pool:
-        per_sample = list(pool.map(load, enumerate(bam_paths)))
-    units = [u for units_ in per_sample for u in units_]
-    if not units:
-        return {p: [] for p in bam_paths}
+        units = _load_units(bam_paths, pool)
+        if not units:
+            return {p: [] for p in bam_paths}
+        sequences = _call_and_assemble(
+            units, min_depth, trim_ends, uppercase, pool
+        )
 
+    out: dict = {p: [] for p in bam_paths}
+    for u, seq in zip(units, sequences):
+        out[bam_paths[u.sample_idx]].append(seq)
+    return out
+
+
+def _dispatch_device_call(units, min_depth: int):
+    """Pad + upload a cohort's units and launch the batched kernel
+    (asynchronously — jax dispatch returns before the TPU finishes)."""
     L = _bucket(max(u.L for u in units), 1024)
     O_pad = _bucket(max(len(u.op_r_start) for u in units), 64)
     B_pad = _bucket(max(len(u.base_packed) for u in units), 256)
@@ -81,7 +100,7 @@ def batch_bam_to_consensus(
             out[i, : len(arr)] = arr
         return out
 
-    emit_packed, ins_flags, dmins, dmaxs = batched_call_kernel(
+    return batched_call_kernel(
         jnp.asarray(stack(lambda u: u.op_r_start, O_pad, PAD_POS)),
         jnp.asarray(
             np.stack(
@@ -96,6 +115,13 @@ def batch_bam_to_consensus(
         jnp.int32(min_depth),
         length=L,
     )
+
+
+def _assemble_outputs(units, device_out, trim_ends, uppercase, min_depth,
+                      pool) -> list:
+    """Download the kernel outputs and splice per-unit sequences (host,
+    thread-parallel). Returns sequences in unit order."""
+    emit_packed, ins_flags, _dmins, _dmaxs = device_out
     emit_packed = np.asarray(emit_packed)
     ins_flags = np.asarray(ins_flags)
 
@@ -110,12 +136,76 @@ def batch_bam_to_consensus(
             masks, ins_calls, None, trim_ends, min_depth, uppercase,
             build_changes=False,
         )
-        return i, Sequence(name=f"{u.ref_id}_cns", sequence=res.sequence)
+        return Sequence(name=f"{u.ref_id}_cns", sequence=res.sequence)
 
-    with ThreadPoolExecutor(max_workers=num_workers) as pool:
-        assembled = dict(pool.map(assemble_unit, enumerate(units)))
+    return list(pool.map(assemble_unit, enumerate(units)))
 
-    out: dict = {p: [] for p in bam_paths}
-    for i, u in enumerate(units):
-        out[bam_paths[u.sample_idx]].append(assembled[i])
-    return out
+
+def _call_and_assemble(units, min_depth, trim_ends, uppercase, pool) -> list:
+    out = _dispatch_device_call(units, min_depth)
+    return _assemble_outputs(units, out, trim_ends, uppercase, min_depth, pool)
+
+
+def stream_bam_to_consensus(
+    bam_paths,
+    chunk_size: int = 64,
+    min_depth: int = 1,
+    trim_ends: bool = False,
+    uppercase: bool = False,
+    num_workers: int = 8,
+):
+    """Overlapped cohort consensus: yields (path, [Sequence, ...]) per input
+    file, in input order, processing `chunk_size` files per device program.
+
+    Three stages run concurrently (SURVEY §7 build-order 6 — "host-side
+    streaming decode overlapped with device reduce"): while the TPU executes
+    chunk k's batched kernel, host threads are already decoding chunk k+1,
+    and chunk k-1's outputs are being spliced/yielded. Bounded memory:
+    at most three chunks of units are alive at once."""
+    bam_paths = list(bam_paths)
+    chunks = [
+        bam_paths[i : i + chunk_size]
+        for i in range(0, len(bam_paths), chunk_size)
+    ]
+
+    # the prefetch wrapper gets its own single thread: submitting it to
+    # `pool` would deadlock at small num_workers (the wrapper blocks on
+    # pool.map tasks that can never be scheduled behind it)
+    with ThreadPoolExecutor(max_workers=num_workers) as pool, \
+            ThreadPoolExecutor(max_workers=1) as prefetcher:
+        next_load = (
+            prefetcher.submit(_load_units, chunks[0], pool) if chunks else None
+        )
+        pending = None  # (chunk_paths, units, in-flight device call)
+        for k in range(len(chunks) + 1):
+            # kick off decode of the following chunk before blocking on the
+            # device — the jax dispatch below is async, so decode(k+1),
+            # device(k), and assemble(k-1) overlap
+            load = next_load
+            next_load = (
+                prefetcher.submit(_load_units, chunks[k + 1], pool)
+                if k + 1 < len(chunks)
+                else None
+            )
+            if pending is not None:
+                paths_prev, units_prev, out_prev = pending
+                seqs = _assemble_outputs(
+                    units_prev, out_prev, trim_ends, uppercase, min_depth,
+                    pool,
+                )
+                grouped: dict[int, list] = {
+                    i: [] for i in range(len(paths_prev))
+                }
+                for u, s in zip(units_prev, seqs):
+                    grouped[u.sample_idx].append(s)
+                for i, p in enumerate(paths_prev):
+                    yield p, grouped[i]
+                pending = None
+            if load is None:
+                break
+            units = load.result()
+            if units:
+                pending = (chunks[k], units, _dispatch_device_call(units, min_depth))
+            else:
+                for p in chunks[k]:
+                    yield p, []

@@ -3,7 +3,7 @@
 Subcommand surface matches the reference CLI (consensus / weights /
 features / plot / version, /root/reference/kindel/cli.py:9-70) plus the
 `variants` subcommand its README promised (README.md:106). Every data
-subcommand takes `--backend {numpy,jax}`. Flag names and defaults replicate
+subcommand takes `--backend {numpy,jax,pallas}`. Flag names and defaults replicate
 the reference — including the CLI default min_overlap=7 vs the Python API's 9
 (/root/reference/kindel/cli.py:13 vs kindel.py:492; SURVEY §2.1).
 """
@@ -21,7 +21,8 @@ def _add_backend(p: argparse.ArgumentParser):
         "--backend",
         choices=workloads.BACKENDS,
         default="numpy",
-        help="compute backend: numpy (host oracle) or jax (TPU/jit)",
+        help="compute backend: numpy (host oracle), jax (TPU/jit), or "
+             "pallas (MXU histogram kernels)",
     )
 
 
@@ -132,6 +133,62 @@ def cmd_plot(args) -> int:
     return 0
 
 
+def cmd_batch(args) -> int:
+    """Cohort consensus: one fused device program per chunk of samples,
+    host decode of chunk k+1 overlapped with device compute of chunk k
+    (kindel_tpu.batch; BASELINE.json config 5)."""
+    import os
+
+    from kindel_tpu.batch import stream_bam_to_consensus
+    from kindel_tpu.io.fasta import format_fasta
+
+    os.makedirs(args.out_dir, exist_ok=True)
+
+    # map inputs to output names up front, disambiguating stem collisions
+    # (a/s1.bam + b/s1.bam → s1.fa, s1-2.fa) so no sample is clobbered
+    out_paths: dict = {}
+    stems_used: dict[str, int] = {}
+    for p in args.bam_paths:
+        stem = os.path.splitext(os.path.basename(str(p)))[0]
+        n = stems_used.get(stem, 0) + 1
+        stems_used[stem] = n
+        name = stem if n == 1 else f"{stem}-{n}"
+        out_paths[p] = os.path.join(args.out_dir, name + ".fa")
+
+    todo = list(args.bam_paths)
+    if args.resume:
+        skipped = [
+            p for p in todo
+            if os.path.exists(out_paths[p]) and os.path.getsize(out_paths[p])
+        ]
+        todo = [p for p in todo if p not in set(skipped)]
+        if skipped:
+            print(
+                f"resume: skipping {len(skipped)} already-written sample(s)",
+                file=sys.stderr,
+            )
+    n_done = 0
+    for path, records in stream_bam_to_consensus(
+        todo,
+        chunk_size=args.chunk_size,
+        min_depth=args.min_depth,
+        trim_ends=args.trim_ends,
+        uppercase=args.uppercase,
+        num_workers=args.workers,
+    ):
+        # atomic publish: a kill mid-write must not leave a truncated .fa
+        # that --resume would later treat as complete
+        dest = out_paths[path]
+        tmp = dest + ".tmp"
+        with open(tmp, "w") as fh:
+            fh.write(format_fasta(records))
+        os.replace(tmp, dest)
+        n_done += 1
+    print(f"wrote {n_done} consensus file(s) to {args.out_dir}",
+          file=sys.stderr)
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="kindel-tpu",
@@ -191,6 +248,42 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("bam_path", help="path to SAM/BAM file")
     _add_backend(p)
 
+    p = sub.add_parser(
+        "batch",
+        help="cohort consensus: many BAMs per fused device program, "
+             "streamed with decode/compute overlap",
+    )
+    p.add_argument("bam_paths", nargs="+", help="SAM/BAM files")
+    p.add_argument(
+        "-o", "--out-dir", default=".",
+        help="directory for per-sample <stem>.fa outputs",
+    )
+    p.add_argument(
+        "--chunk-size", type=int, default=64,
+        help="samples per device program",
+    )
+    p.add_argument(
+        "--min-depth", type=int, default=1,
+        help="substitute Ns at coverage depths beneath this value",
+    )
+    p.add_argument(
+        "-t", "--trim-ends", action="store_true",
+        help="trim ambiguous nucleotides (Ns) from sequence ends",
+    )
+    p.add_argument(
+        "-u", "--uppercase", action="store_true",
+        help="close gaps using uppercase alphabet",
+    )
+    p.add_argument(
+        "--resume", action="store_true",
+        help="skip samples whose output file already exists (checkpointed "
+             "cohort runs survive interruption)",
+    )
+    p.add_argument(
+        "--workers", type=int, default=8,
+        help="host decode/assembly threads",
+    )
+
     sub.add_parser("version", help="show version")
     return parser
 
@@ -206,6 +299,7 @@ def main(argv=None) -> int:
         "features": cmd_features,
         "variants": cmd_variants,
         "plot": cmd_plot,
+        "batch": cmd_batch,
     }[args.command](args)
 
 

@@ -1,0 +1,3 @@
+"""Pallas TPU kernels for the hot device ops."""
+
+from kindel_tpu.ops.pallas_count import count_events_pallas  # noqa: F401

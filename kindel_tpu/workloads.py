@@ -23,7 +23,7 @@ from kindel_tpu.realign import cdrp_consensuses, merge_cdrps
 
 result = namedtuple("result", ["consensuses", "refs_changes", "refs_reports"])
 
-BACKENDS = ("numpy", "jax")
+BACKENDS = ("numpy", "jax", "pallas")
 
 
 def _load_pileups(bam_path, backend: str) -> dict[str, Pileup]:
@@ -32,6 +32,10 @@ def _load_pileups(bam_path, backend: str) -> dict[str, Pileup]:
         from kindel_tpu.pileup_jax import build_pileups_jax
 
         return build_pileups_jax(ev)
+    if backend == "pallas":
+        from kindel_tpu.pileup_jax import build_pileups_pallas
+
+        return build_pileups_pallas(ev)
     return build_pileups(ev)
 
 
@@ -103,12 +107,17 @@ def bam_to_consensus(
 
     for rid in ev.present_ref_ids:
         ref_id = ev.ref_names[rid]
-        if realign or backend == "numpy":
+        if realign or backend != "jax":
             # realign's CDR detection consumes the full clip tensors —
             # tiny event counts, reduced host-side even under the jax
             # backend (SURVEY §5: CDR/patch metadata is host-gathered)
             with maybe_phase(f"pileup reduce [{ref_id}]"):
-                pileup = build_pileup(ev, rid)
+                if backend == "pallas":
+                    from kindel_tpu.pileup_jax import build_pileup_pallas
+
+                    pileup = build_pileup_pallas(ev, rid)
+                else:
+                    pileup = build_pileup(ev, rid)
         else:
             pileup = None
         if realign:

@@ -21,3 +21,69 @@ def test_batch_matches_single(data_root):
 
 def test_batch_empty():
     assert batch_bam_to_consensus([]) == {}
+
+
+def test_stream_matches_batch(data_root):
+    from kindel_tpu.batch import stream_bam_to_consensus
+
+    paths = [
+        data_root / "data_bwa_mem" / f"{i}.1.sub_test.bam"
+        for i in (1, 2, 3, 4)
+    ]
+    # chunk_size=2 → two device programs, exercising the overlap machinery
+    streamed = list(stream_bam_to_consensus(paths, chunk_size=2))
+    assert [p for p, _ in streamed] == paths  # input order preserved
+    whole = batch_bam_to_consensus(paths)
+    for p, records in streamed:
+        assert [(r.name, r.sequence) for r in records] == [
+            (r.name, r.sequence) for r in whole[p]
+        ]
+
+
+def test_stream_single_worker_no_deadlock(data_root):
+    # regression: the prefetch wrapper must not share the decode pool, or
+    # num_workers=1 deadlocks (wrapper blocks on tasks behind itself)
+    from kindel_tpu.batch import stream_bam_to_consensus
+
+    paths = [data_root / "data_bwa_mem" / "1.1.sub_test.bam"]
+    out = list(stream_bam_to_consensus(paths, num_workers=1))
+    assert len(out) == 1 and out[0][1]
+
+
+def test_batch_cli_stem_collision(data_root, tmp_path):
+    from kindel_tpu.cli import main
+
+    src = data_root / "data_bwa_mem" / "1.1.sub_test.bam"
+    a = tmp_path / "runA" / "s.bam"
+    b = tmp_path / "runB" / "s.bam"
+    for dst in (a, b):
+        dst.parent.mkdir()
+        dst.write_bytes(src.read_bytes())
+    out_dir = tmp_path / "out"
+    assert main(["batch", "-o", str(out_dir), str(a), str(b)]) == 0
+    assert (out_dir / "s.fa").exists() and (out_dir / "s-2.fa").exists()
+
+
+def test_batch_cli_resume(data_root, tmp_path, capsys):
+    from kindel_tpu.cli import main
+    from kindel_tpu.io.fasta import read_fasta
+    from kindel_tpu.workloads import bam_to_consensus
+
+    bams = [
+        str(data_root / "data_bwa_mem" / f"{i}.1.sub_test.bam")
+        for i in (1, 2)
+    ]
+    out_dir = str(tmp_path / "cohort")
+    assert main(["batch", "-o", out_dir, *bams]) == 0
+    got = read_fasta(tmp_path / "cohort" / "1.1.sub_test.fa")
+    expect = bam_to_consensus(bams[0]).consensuses
+    assert [(r.name, r.sequence) for r in got] == [
+        (r.name, r.sequence) for r in expect
+    ]
+
+    # resume: both outputs exist → nothing reprocessed
+    capsys.readouterr()
+    assert main(["batch", "-o", out_dir, "--resume", *bams]) == 0
+    err = capsys.readouterr().err
+    assert "skipping 2" in err
+    assert "wrote 0" in err

@@ -1,0 +1,84 @@
+"""MXU histogram kernel (kindel_tpu/ops/pallas_count.py) vs numpy oracle.
+
+Runs the pallas interpreter on the CPU test backend; the same kernel code
+compiles for TPU (exercised by bench/TPU runs).
+"""
+
+import numpy as np
+import pytest
+
+from kindel_tpu.ops import count_events_pallas
+
+
+def _oracle(pos, base, L, n_ch=5):
+    out = np.zeros((L, n_ch), np.int32)
+    np.add.at(out, (pos, base), 1)
+    return out
+
+
+@pytest.mark.parametrize("L", [1, 100, 512, 1000, 4097])
+def test_count_matches_oracle(L):
+    rng = np.random.default_rng(L)
+    E = 5000
+    pos = rng.integers(0, L, E)
+    base = rng.integers(0, 5, E)
+    got = count_events_pallas(pos, base, L, interpret=True)
+    np.testing.assert_array_equal(got, _oracle(pos, base, L))
+
+
+def test_count_empty():
+    got = count_events_pallas(
+        np.empty(0, np.int64), np.empty(0, np.int64), 300, interpret=True
+    )
+    np.testing.assert_array_equal(got, np.zeros((300, 5), np.int32))
+
+
+def test_count_heavy_duplicates():
+    # all events on one position — exercises accumulation across chunks
+    E = 3000
+    pos = np.full(E, 7)
+    base = np.tile(np.arange(5), 600)
+    got = count_events_pallas(pos, base, 64, interpret=True)
+    expect = np.zeros((64, 5), np.int32)
+    expect[7] = 600
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_pallas_backend_consensus_matches_numpy(data_root):
+    from kindel_tpu.workloads import bam_to_consensus
+
+    bam = str(data_root / "data_bwa_mem" / "1.1.sub_test.bam")
+    np_res = bam_to_consensus(bam, backend="numpy")
+    pl_res = bam_to_consensus(bam, backend="pallas")
+    assert [r.sequence for r in np_res.consensuses] == [
+        r.sequence for r in pl_res.consensuses
+    ]
+    assert np_res.refs_reports == pl_res.refs_reports
+
+
+def test_pallas_backend_realign_matches_numpy(data_root):
+    from kindel_tpu.workloads import bam_to_consensus
+
+    bam = str(data_root / "data_bwa_mem" / "1.1.sub_test.bam")
+    np_res = bam_to_consensus(bam, backend="numpy", realign=True)
+    pl_res = bam_to_consensus(bam, backend="pallas", realign=True)
+    assert [r.sequence for r in np_res.consensuses] == [
+        r.sequence for r in pl_res.consensuses
+    ]
+
+
+def test_count_real_events(data_root):
+    from kindel_tpu.events import extract_events
+    from kindel_tpu.io import load_alignment
+    from kindel_tpu.pileup import build_pileup
+
+    bam = data_root / "data_bwa_mem" / "1.1.sub_test.bam"
+    ev = extract_events(load_alignment(str(bam)))
+    rid = ev.present_ref_ids[0]
+    sel = ev.match_rid == rid
+    L = int(ev.ref_lens[rid])
+    got = count_events_pallas(
+        ev.match_pos[sel], ev.match_base[sel], L, interpret=True
+    )
+    expect = build_pileup(ev, rid).weights
+    np.testing.assert_array_equal(got, expect)
