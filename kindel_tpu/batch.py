@@ -187,6 +187,19 @@ def stream_bam_to_consensus(
                 if k + 1 < len(chunks)
                 else None
             )
+            # dispatch chunk k to the device BEFORE splicing chunk k-1's
+            # outputs on the host — jax dispatch is async, so the device
+            # executes k while the host assembles k-1 below
+            next_pending = None
+            empty_paths: list = []
+            if load is not None:
+                units = load.result()
+                if units:
+                    next_pending = (
+                        chunks[k], units, _dispatch_device_call(units, min_depth)
+                    )
+                else:
+                    empty_paths = chunks[k]
             if pending is not None:
                 paths_prev, units_prev, out_prev = pending
                 seqs = _assemble_outputs(
@@ -200,12 +213,8 @@ def stream_bam_to_consensus(
                     grouped[u.sample_idx].append(s)
                 for i, p in enumerate(paths_prev):
                     yield p, grouped[i]
-                pending = None
+            for p in empty_paths:  # after k-1's outputs: preserves input order
+                yield p, []
+            pending = next_pending
             if load is None:
                 break
-            units = load.result()
-            if units:
-                pending = (chunks[k], units, _dispatch_device_call(units, min_depth))
-            else:
-                for p in chunks[k]:
-                    yield p, []

@@ -144,27 +144,38 @@ def cmd_batch(args) -> int:
 
     os.makedirs(args.out_dir, exist_ok=True)
 
+    # the stream yields results keyed by path, so a path listed twice is
+    # both redundant work and an output collision — process it once
+    inputs: list = []
+    seen: set = set()
+    for p in args.bam_paths:
+        if p in seen:
+            print(f"warning: duplicate input {p} ignored", file=sys.stderr)
+            continue
+        seen.add(p)
+        inputs.append(p)
+
     # map inputs to output names up front, disambiguating stem collisions
     # (a/s1.bam + b/s1.bam → s1.fa, s1-2.fa) so no sample is clobbered
     out_paths: dict = {}
     stems_used: dict[str, int] = {}
-    for p in args.bam_paths:
+    for p in inputs:
         stem = os.path.splitext(os.path.basename(str(p)))[0]
         n = stems_used.get(stem, 0) + 1
         stems_used[stem] = n
         name = stem if n == 1 else f"{stem}-{n}"
         out_paths[p] = os.path.join(args.out_dir, name + ".fa")
 
-    todo = list(args.bam_paths)
+    todo = inputs
     if args.resume:
-        skipped = [
-            p for p in todo
-            if os.path.exists(out_paths[p]) and os.path.getsize(out_paths[p])
-        ]
-        todo = [p for p in todo if p not in set(skipped)]
-        if skipped:
+        # existence is completeness: publication below is atomic (tmp +
+        # os.replace), so even a 0-byte .fa (sample with no aligned reads)
+        # is a finished result
+        skip = {p for p in todo if os.path.exists(out_paths[p])}
+        todo = [p for p in todo if p not in skip]
+        if skip:
             print(
-                f"resume: skipping {len(skipped)} already-written sample(s)",
+                f"resume: skipping {len(skip)} already-written sample(s)",
                 file=sys.stderr,
             )
     n_done = 0
