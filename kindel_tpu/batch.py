@@ -189,16 +189,24 @@ def stream_bam_to_consensus(
             )
             # dispatch chunk k to the device BEFORE splicing chunk k-1's
             # outputs on the host — jax dispatch is async, so the device
-            # executes k while the host assembles k-1 below
+            # executes k while the host assembles k-1 below. A decode
+            # failure in chunk k is deferred until k-1's finished results
+            # have been yielded (so the caller keeps them, and --resume
+            # can skip them on retry).
             next_pending = None
             empty_paths: list = []
+            load_err: Exception | None = None
             if load is not None:
-                units = load.result()
+                try:
+                    units = load.result()
+                except Exception as e:
+                    load_err = e
+                    units = None
                 if units:
                     next_pending = (
                         chunks[k], units, _dispatch_device_call(units, min_depth)
                     )
-                else:
+                elif units is not None:
                     empty_paths = chunks[k]
             if pending is not None:
                 paths_prev, units_prev, out_prev = pending
@@ -215,6 +223,8 @@ def stream_bam_to_consensus(
                     yield p, grouped[i]
             for p in empty_paths:  # after k-1's outputs: preserves input order
                 yield p, []
+            if load_err is not None:
+                raise load_err
             pending = next_pending
             if load is None:
                 break

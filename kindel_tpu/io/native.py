@@ -18,10 +18,50 @@ import numpy as np
 
 _LIB_PATH = Path(__file__).parent / "_kindel_native.so"
 _lib = None
+_build_tried = False
+_lock = __import__("threading").Lock()
+
+
+def _try_build() -> None:
+    """Best-effort one-shot build of the shared library from src/native.
+    Never raises — a missing toolchain just leaves the pure-Python path
+    active. Disable with KINDEL_TPU_NO_NATIVE_BUILD=1. The Makefile
+    publishes the .so atomically (tmp + mv), so a concurrent process can
+    only ever load a complete library."""
+    global _build_tried
+    if _build_tried:
+        return
+    _build_tried = True
+    import os
+    import shutil
+    import subprocess
+
+    if os.environ.get("KINDEL_TPU_NO_NATIVE_BUILD"):
+        return
+    src_dir = Path(__file__).resolve().parents[2] / "src" / "native"
+    if not (src_dir / "Makefile").exists() or shutil.which("make") is None:
+        return
+    try:
+        subprocess.run(
+            ["make", "-C", str(src_dir)],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+    except Exception:
+        pass
 
 
 def _load():
     global _lib
+    with _lock:
+        return _load_locked()
+
+
+def _load_locked():
+    global _lib
+    if _lib is None and not _LIB_PATH.exists():
+        _try_build()
     if _lib is None and _LIB_PATH.exists():
         lib = ctypes.CDLL(str(_LIB_PATH))
         i64 = ctypes.c_int64

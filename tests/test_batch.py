@@ -87,3 +87,26 @@ def test_batch_cli_resume(data_root, tmp_path, capsys):
     err = capsys.readouterr().err
     assert "skipping 2" in err
     assert "wrote 0" in err
+
+
+def test_stream_yields_finished_chunks_before_decode_failure(
+    data_root, tmp_path
+):
+    """A corrupt file in chunk k must not discard chunk k-1's finished
+    results: the stream yields them first, then raises."""
+    import pytest
+
+    from kindel_tpu.batch import stream_bam_to_consensus
+
+    good = str(data_root / "data_bwa_mem" / "1.1.sub_test.bam")
+    bad = tmp_path / "corrupt.bam"
+    bad.write_bytes(b"not a bam at all")
+
+    got = []
+    with pytest.raises(Exception):
+        for path, recs in stream_bam_to_consensus(
+            [good, str(bad)], chunk_size=1
+        ):
+            got.append((path, recs))
+    assert [p for p, _ in got] == [good]
+    assert got[0][1], "good sample's consensus records were lost"
