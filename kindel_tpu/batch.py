@@ -200,7 +200,11 @@ def stream_bam_to_consensus(
                 try:
                     units = load.result()
                 except Exception as e:
-                    load_err = e
+                    load_err = RuntimeError(
+                        f"failed to decode a sample in chunk {k} "
+                        f"({', '.join(map(str, chunks[k]))}): {e}"
+                    )
+                    load_err.__cause__ = e
                     units = None
                 if units:
                     next_pending = (
@@ -224,6 +228,8 @@ def stream_bam_to_consensus(
             for p in empty_paths:  # after k-1's outputs: preserves input order
                 yield p, []
             if load_err is not None:
+                if next_load is not None:  # don't stall the raise behind
+                    next_load.cancel()     # chunk k+1's in-flight decode
                 raise load_err
             pending = next_pending
             if load is None:

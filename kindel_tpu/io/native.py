@@ -12,6 +12,7 @@ Build: `make -C src/native`, producing kindel_tpu/io/_kindel_native.so.
 from __future__ import annotations
 
 import ctypes
+import threading
 from pathlib import Path
 
 import numpy as np
@@ -19,7 +20,7 @@ import numpy as np
 _LIB_PATH = Path(__file__).parent / "_kindel_native.so"
 _lib = None
 _build_tried = False
-_lock = __import__("threading").Lock()
+_lock = threading.Lock()
 
 
 def _try_build() -> None:
@@ -53,7 +54,6 @@ def _try_build() -> None:
 
 
 def _load():
-    global _lib
     with _lock:
         return _load_locked()
 

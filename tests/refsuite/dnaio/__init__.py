@@ -1,0 +1,27 @@
+"""Minimal read-only stand-in for the `dnaio` package (not installed in
+this environment) — just enough for the reference test suite, which only
+does `with dnaio.open(path, mode="r") as reader` over FASTA files and
+reads `.name` / `.sequence` off the records
+(/root/reference/tests/test_kindel.py:117-123 and siblings)."""
+
+from kindel_tpu.io.fasta import Sequence, read_fasta  # noqa: F401
+
+
+class _Reader:
+    def __init__(self, path):
+        self._records = read_fasta(path)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def __iter__(self):
+        return iter(self._records)
+
+
+def open(path, mode="r"):  # noqa: A001 - dnaio's public name
+    if "r" not in mode:
+        raise NotImplementedError("refsuite dnaio shim is read-only")
+    return _Reader(path)

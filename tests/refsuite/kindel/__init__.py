@@ -1,0 +1,6 @@
+"""Drop-in `kindel` package alias backed by kindel_tpu (SURVEY §7 step 7).
+
+Placed on PYTHONPATH when running the reference's own test suite so that
+`from kindel import kindel` resolves to the compat surface of this
+framework (/root/reference/tests/test_kindel.py:4).
+"""

@@ -1,0 +1,18 @@
+"""The reference's `kindel.kindel` module surface, re-exported from
+kindel_tpu. Everything the reference test suite imports directly
+(/root/reference/tests/test_kindel.py:4,18-19,26-53,92-111,329-338):
+`parse_bam`, `consensus`, `merge_by_lcs`, `cdrp_consensuses`,
+`bam_to_consensus`, `weights`, `features`."""
+
+from kindel_tpu.call import consensus  # noqa: F401
+from kindel_tpu.compat import alignment, parse_bam  # noqa: F401
+from kindel_tpu.realign import (  # noqa: F401
+    Region,
+    cdrp_consensuses,
+    merge_by_lcs,
+)
+from kindel_tpu.workloads import (  # noqa: F401
+    bam_to_consensus,
+    features,
+    weights,
+)
