@@ -27,8 +27,7 @@ from kindel_tpu.call import _insertion_calls, assemble
 from kindel_tpu.call_jax import (
     CallUnit,
     batched_call_kernel,
-    masks_from_emit,
-    unpack_emit,
+    decode_fast,
 )
 from kindel_tpu.events import extract_events
 from kindel_tpu.io import load_alignment
@@ -121,14 +120,20 @@ def _assemble_outputs(units, device_out, trim_ends, uppercase, min_depth,
                       pool) -> list:
     """Download the kernel outputs and splice per-unit sequences (host,
     thread-parallel). Returns sequences in unit order."""
-    emit_packed, ins_flags, _dmins, _dmaxs = device_out
-    emit_packed = np.asarray(emit_packed)
+    plane_packed, (exc_bits, del_flags, ins_flags), _dmins, _dmaxs = (
+        device_out
+    )
+    plane_packed = np.asarray(plane_packed)
+    exc_bits = np.asarray(exc_bits)
+    del_flags = np.asarray(del_flags)
     ins_flags = np.asarray(ins_flags)
 
     def assemble_unit(i_u):
         i, u = i_u
-        emit = unpack_emit(emit_packed[i], u.L)
-        masks = masks_from_emit(emit, u.ins_pos, ins_flags[i])
+        masks = decode_fast(
+            plane_packed[i], exc_bits[i], del_flags[i], ins_flags[i],
+            u.L, u.del_pos, u.ins_pos,
+        )
         ins_calls = (
             _insertion_calls(u.ins_table) if masks.ins_mask.any() else {}
         )

@@ -6,10 +6,12 @@ Transfer-minimal by design. The host↔device link can be the bottleneck
   * uploads match events as *op spans* — (ref_start, length) per CIGAR
     run (~KBs) plus 4-bit-packed base codes — and reconstructs per-base
     positions on device with a searchsorted over the span offsets;
-  * downloads one 4-bit emission code per position (deletion-skip / base /
-    N), plus bit-packed decision masks and two depth scalars for reports.
+  * downloads, on the fast path, a dense 2-bit ACGT plane plus a 1-bit
+    exception mask (N / deletion-skip, disambiguated by flags gathered at
+    the sparse deletion positions) and two depth scalars — ~L/4 + L/8
+    bytes; the masks path ships 4-bit emission codes + three bitmasks.
 
-For a 6.1 Mb reference that is ~1.3 MB up / ~4 MB down instead of
+For a 6.1 Mb reference that is ~1.3 MB up / ~2.3 MB down instead of
 ~14 MB up / ~146 MB down for naive event upload + count-tensor download.
 
 Only the rare variable-length splices (insertion strings, CDR patches) stay
@@ -84,7 +86,12 @@ def _call_core(
     ).reshape(E_pad).astype(jnp.int32)
 
     k = jnp.arange(E_pad, dtype=jnp.int32)
-    op_id = jnp.searchsorted(op_off, k, side="right") - 1
+    # span-id per event via boundary scatter + prefix sum (a binary search
+    # per event would cost ~log(spans) serialized gather rounds; the scan
+    # is one memory-bound pass). Pad spans all mark n_events, which only
+    # perturbs op_id for the masked-out k >= n_events tail.
+    marks = jnp.zeros(E_pad, jnp.int32).at[op_off].add(1, mode="drop")
+    op_id = jnp.cumsum(marks) - 1
     op_id = jnp.clip(op_id, 0, op_off.shape[0] - 1)
     pos = op_r_start[op_id] + (k - op_off[op_id])
     pos = jnp.where(k < n_events, pos, PAD_POS)
@@ -118,24 +125,45 @@ def _call_core(
         & (ins_totals * 2 > jnp.minimum(acgt_depth, depth_next))
     )
 
-    emit = jnp.where(del_mask, 0, jnp.where(n_mask, N_CHANNELS, base_code))
-    emit = emit.astype(jnp.uint8)
-    if emit.shape[0] % 2:
-        emit = jnp.concatenate([emit, jnp.zeros(1, jnp.uint8)])
-    emit_packed = (emit[0::2] << 4) | emit[1::2]
-
     if want_masks:
+        emit = jnp.where(
+            del_mask, 0, jnp.where(n_mask, N_CHANNELS, base_code)
+        ).astype(jnp.uint8)
+        if emit.shape[0] % 2:
+            emit = jnp.concatenate([emit, jnp.zeros(1, jnp.uint8)])
+        emit_packed = (emit[0::2] << 4) | emit[1::2]
         masks_packed = (
             jnp.packbits(del_mask),
             jnp.packbits(n_mask),
             jnp.packbits(ins_mask),
         )
-    else:
-        # emit codes alone reconstruct the sequence; insertion emission is
-        # only needed at the (rare) positions that observed insertions —
-        # gather the mask there instead of shipping it densely
-        masks_packed = ins_mask[jnp.where(ins_pos < length, ins_pos, 0)]
-    return emit_packed, masks_packed, acgt_depth.min(), acgt_depth.max()
+        return emit_packed, masks_packed, acgt_depth.min(), acgt_depth.max()
+
+    # fast path: minimal wire format. A dense 2-bit ACGT plane carries the
+    # common case; positions that emit something other than their plane
+    # base — deletion skips and Ns (incl. ties and min-depth) — are exactly
+    # the `exc` bitmask, and which of the two they are reconstructs from
+    # the deletion flags gathered at the (sparse, already-known) del_pos.
+    # Insertion emission likewise gathers at ins_pos. ~L/4 + L/8 bytes
+    # shipped instead of L/2.
+    exc = del_mask | n_mask | (base_code == N_CHANNELS)  # ties emit N too
+    plane = ((base_code - 1) & 3).astype(jnp.uint8)
+    pad4 = (-plane.shape[0]) % 4
+    if pad4:
+        plane = jnp.concatenate([plane, jnp.zeros(pad4, jnp.uint8)])
+    plane_packed = (
+        (plane[0::4] << 6) | (plane[1::4] << 4)
+        | (plane[2::4] << 2) | plane[3::4]
+    )
+    exc_bits = jnp.packbits(exc)
+    del_flags = del_mask[jnp.where(del_pos < length, del_pos, 0)]
+    ins_flags = ins_mask[jnp.where(ins_pos < length, ins_pos, 0)]
+    return (
+        plane_packed,
+        (exc_bits, del_flags, ins_flags),
+        acgt_depth.min(),
+        acgt_depth.max(),
+    )
 
 
 @partial(jax.jit, static_argnames=("length", "want_masks"))
@@ -155,7 +183,8 @@ def batched_call_kernel(op_r_start, op_off, base_packed, del_pos, ins_pos,
 
     Data-parallel by construction: under a mesh with the batch axis sharded
     ('dp'), XLA partitions this embarrassingly-parallel program with no
-    collectives. Returns per-sample (emit_packed, ins_flags, dmin, dmax).
+    collectives. Returns per-sample fast-path outputs
+    (plane_packed, (exc_bits, del_flags, ins_flags), dmin, dmax).
     """
 
     def one(ors, oo, bp, dp, ip, ic, ne):
@@ -176,19 +205,35 @@ def unpack_emit(emit_packed: np.ndarray, L: int) -> np.ndarray:
     return emit[:L]
 
 
-def masks_from_emit(emit: np.ndarray, ins_pos: np.ndarray,
-                    ins_flags: np.ndarray) -> CallMasks:
-    """Reconstruct assembler inputs from emission codes alone: emit already
-    folds the N substitutions in, so only the deletion skips and the sparse
-    insertion emissions need rebuilding."""
-    L = len(emit)
+def decode_fast(plane_packed: np.ndarray, exc_bits: np.ndarray,
+                del_flags: np.ndarray, ins_flags: np.ndarray, L: int,
+                del_pos: np.ndarray, ins_pos: np.ndarray) -> CallMasks:
+    """Rebuild assembler inputs from the fast-path wire format: the 2-bit
+    ACGT plane, the exception bitmask (N or deletion-skip), and the
+    deletion/insertion flags gathered at their sparse event positions."""
+    plane = np.empty(plane_packed.shape[0] * 4, dtype=np.uint8)
+    plane[0::4] = plane_packed >> 6
+    plane[1::4] = (plane_packed >> 4) & 3
+    plane[2::4] = (plane_packed >> 2) & 3
+    plane[3::4] = plane_packed & 3
+    base_char = EMIT_ASCII[1:5][plane[:L]]
+
+    exc = np.unpackbits(np.asarray(exc_bits))[:L].astype(bool)
+    base_char = np.where(exc, EMIT_ASCII[N_CHANNELS], base_char)
+
+    del_mask = np.zeros(L, dtype=bool)
+    if len(del_pos):
+        flags = np.asarray(del_flags)[: len(del_pos)]
+        valid = del_pos < L
+        del_mask[del_pos[valid & flags]] = True
     ins_mask = np.zeros(L, dtype=bool)
     if len(ins_pos):
         flags = np.asarray(ins_flags)[: len(ins_pos)]
-        ins_mask[ins_pos[flags]] = True
+        valid = ins_pos < L
+        ins_mask[ins_pos[valid & flags]] = True
     return CallMasks(
-        base_char=EMIT_ASCII[np.where(emit == 0, N_CHANNELS, emit)],
-        del_mask=emit == 0,
+        base_char=base_char,
+        del_mask=del_mask,
         n_mask=np.zeros(L, dtype=bool),
         ins_mask=ins_mask,
     )
@@ -237,8 +282,10 @@ def device_call(ev: EventSet, rid: int, min_depth: int = 1,
                 want_masks: bool = True):
     """Run the fused kernel for one reference.
 
-    Returns (emit_codes uint8[L] (0=skip,1..5=ATGCN), CallMasks|None,
-    depth_min, depth_max)."""
+    Returns (emit_codes, masks, depth_min, depth_max). With want_masks,
+    emit_codes is uint8[L] (0=skip, 1..5=ATGCN) and masks carries the
+    dense decision masks; on the fast path emit_codes is None and masks
+    is rebuilt from the 2-bit wire format (see decode_fast)."""
     u = CallUnit(ev, rid)
     L, ip = u.L, u.ins_pos
     O_pad = _bucket(len(u.op_r_start), 256)
@@ -246,7 +293,7 @@ def device_call(ev: EventSet, rid: int, min_depth: int = 1,
     D_pad = _bucket(len(u.del_pos), 256)
     I_pad = _bucket(len(ip), 256)
 
-    emit_packed, masks_packed, dmin, dmax = fused_call_kernel(
+    main_out, masks_packed, dmin, dmax = fused_call_kernel(
         jnp.asarray(_pad(u.op_r_start, O_pad, PAD_POS)),
         jnp.asarray(_pad(u.op_off, O_pad, np.int32(u.n_events))),
         jnp.asarray(_pad(u.base_packed, B_pad, 0)),
@@ -258,9 +305,9 @@ def device_call(ev: EventSet, rid: int, min_depth: int = 1,
         length=L,
         want_masks=want_masks,
     )
-    emit = unpack_emit(np.asarray(emit_packed), L)
 
     if want_masks:
+        emit = unpack_emit(np.asarray(main_out), L)
         db, nb, ib = (np.asarray(x) for x in masks_packed)
         masks = CallMasks(
             base_char=EMIT_ASCII[np.where(emit == 0, N_CHANNELS, emit)],
@@ -268,9 +315,14 @@ def device_call(ev: EventSet, rid: int, min_depth: int = 1,
             n_mask=np.unpackbits(nb)[:L].astype(bool),
             ins_mask=np.unpackbits(ib)[:L].astype(bool),
         )
-    else:
-        masks = masks_from_emit(emit, ip, np.asarray(masks_packed))
-    return emit, masks, int(dmin), int(dmax)
+        return emit, masks, int(dmin), int(dmax)
+
+    exc_bits, del_flags, ins_flags = masks_packed
+    masks = decode_fast(
+        np.asarray(main_out), np.asarray(exc_bits), np.asarray(del_flags),
+        np.asarray(ins_flags), L, u.del_pos, ip,
+    )
+    return None, masks, int(dmin), int(dmax)
 
 
 def call_consensus_fused(
@@ -288,8 +340,9 @@ def call_consensus_fused(
 
     Returns (CallResult, depth_min, depth_max) — the depth scalars feed the
     per-reference report without any count-tensor download. When the caller
-    does not need per-position change markers, the dense decision masks are
-    not shipped at all — the sequence reconstructs from emission codes."""
+    does not need per-position change markers, neither emission codes nor
+    dense decision masks are shipped — the sequence reconstructs from the
+    2-bit plane + exception bitmask wire format (decode_fast)."""
     _emit, masks, dmin, dmax = device_call(
         ev, rid, min_depth, want_masks=build_changes
     )
