@@ -15,8 +15,12 @@ Accumulator semantics replicated exactly from the reference
   * M/=/X      count read base at r_pos into weights; advance both (:49-54)
   * I          whole inserted string counted at (unadvanced) r_pos (:55-58)
   * D          deletions[r_pos+k] += 1 for k<len; advance ref (:59-62)
-  * N          *ignored entirely* — no coordinate advance (no branch exists;
-               quirk documented in SURVEY.md §2.1, consciously replicated)
+  * N          advances the reference coordinate, emits nothing — a
+               conscious DIVERGENCE: the reference has no N branch at all,
+               so a ref-skip silently corrupts every later position of the
+               read (SURVEY.md §2.1). Spliced alignments (RNA-seq) are
+               handled correctly here instead; never exercised by the
+               golden corpus, pinned by tests/test_pileup.py.
   * S at i==0  clip_ends[r_pos] += 1; clipped bases projected leftwards into
                clip_end_weights[r_pos-len+gap_i] for gap_i with index >= 0;
                query advances (:63-73)
@@ -46,6 +50,7 @@ from kindel_tpu.io.records import (
     OP_M,
     OP_I,
     OP_D,
+    OP_N,
     OP_S,
     OP_EQ,
     OP_X,
@@ -107,7 +112,9 @@ def _advances(op_code, op_len, op_i):
     unclamped; reads needing the clamp are routed to the exact path)."""
     is_m = (op_code == OP_M) | (op_code == OP_EQ) | (op_code == OP_X)
     is_ts = (op_code == OP_S) & (op_i > 0)
-    ref_adv = np.where(is_m | (op_code == OP_D) | is_ts, op_len, 0)
+    ref_adv = np.where(
+        is_m | (op_code == OP_D) | (op_code == OP_N) | is_ts, op_len, 0
+    )
     qry_adv = np.where(
         is_m | (op_code == OP_I) | (op_code == OP_S), op_len, 0
     )
@@ -344,6 +351,8 @@ def _exact_read_events(out, insertions, batch, read_idx):
                 if 0 <= p <= L:
                     del_p.append(p)
             r += ln
+        elif code == OP_N:
+            r += ln  # ref-skip: spliced-out span, no events
         elif code == OP_S:
             if i == 0:
                 p = r if r >= 0 else r + L + 1
@@ -367,7 +376,7 @@ def _exact_read_events(out, insertions, batch, read_idx):
                             csw_b.append(BASE_CODE[seq[q]])
                         r += 1
                         q += 1
-        # N/H/P: ignored, no advance (reference has no branch for them)
+        # H/P: ignored, no advance (matches the reference; N handled above)
     for key, plist, blist in (
         ("match", match_p, match_b),
         ("csw", csw_p, csw_b),

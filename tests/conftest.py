@@ -87,13 +87,11 @@ def _restore_real_stdio() -> None:
         if len(saved) == 2:
             break
     # pytest saves stdout before stderr, so the lower fd is stdout. If
-    # only one qualifies (the other stream was sent to /dev/null), we
-    # cannot tell which save survived — bind both fds to it so the child's
-    # output is at least visible somewhere rather than lost in the temp.
-    if len(saved) == 1:
-        os.dup2(saved[0], 1)
-        os.dup2(saved[0], 2)
-    elif len(saved) == 2:
+    # only one save qualifies (the other stream was sent to /dev/null) we
+    # cannot tell WHICH survived; restoring it to the wrong fd would
+    # reroute a stream the user explicitly silenced, so restore nothing —
+    # the exit code still propagates, only the output stays captured.
+    if len(saved) == 2:
         os.dup2(saved[0], 1)
         os.dup2(saved[1], 2)
 

@@ -67,3 +67,46 @@ def test_compat_parse_bam(data_root):
     assert aln.weights[0]["A"] == 22
     assert aln.weights[23]["A"] == 57
     assert aln.clip_starts[525] == 16
+
+
+def test_refskip_advances_reference():
+    """CIGAR N (spliced ref-skip) advances the reference coordinate and
+    emits nothing — conscious divergence from the reference, which has no
+    N branch and silently corrupts all later positions of the read
+    (SURVEY.md §2.1). Pinned on both the vectorized fast path and the
+    sequential exact path."""
+    from collections import Counter
+
+    import numpy as np
+
+    from kindel_tpu.events import _exact_read_events
+    from kindel_tpu.io.sam import parse_sam_bytes
+
+    sam = (
+        b"@HD\tVN:1.6\n"
+        b"@SQ\tSN:ref1\tLN:300\n"
+        b"r1\t0\tref1\t11\t60\t5M100N5M\t*\t0\t0\tAAAAACCCCC\t*\n"
+    )
+    batch = parse_sam_bytes(sam)
+    ev = extract_events(batch)
+    p = next(iter(build_pileups(ev).values()))
+
+    assert all(p.weights[pos, A] == 1 for pos in range(10, 15))
+    # the spliced-out span and the positions the reference would
+    # (wrongly) hit stay empty
+    assert p.weights[15:115].sum() == 0
+    assert all(p.weights[pos, C] == 1 for pos in range(115, 120))
+    assert p.deletions.sum() == 0
+
+    # exact path agrees with the fast path
+    out = {
+        "match": ([], [], []),
+        "del": ([], []),
+        "cs": ([], []),
+        "ce": ([], []),
+        "csw": ([], [], []),
+        "cew": ([], [], []),
+    }
+    _exact_read_events(out, Counter(), batch, 0)
+    exact_pos = np.concatenate([np.asarray(x) for x in out["match"][1]])
+    assert sorted(exact_pos) == sorted(ev.match_pos.tolist())
