@@ -7,3 +7,7 @@ from kindel_tpu.parallel.mesh import (  # noqa: F401
     sharded_call,
     batched_sharded_call,
 )
+from kindel_tpu.parallel.distributed import (  # noqa: F401
+    initialize_distributed,
+    make_global_mesh,
+)

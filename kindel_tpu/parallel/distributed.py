@@ -1,0 +1,126 @@
+"""Multi-host scale-out: process-group init and DCN×ICI mesh construction.
+
+The reference has no distributed backend at all (SURVEY §2.2/§5 — no
+NCCL/MPI/Gloo anywhere); kindel-tpu's communication backend is XLA
+collectives, which ride ICI within a slice and DCN across hosts once the
+JAX process group is up. This module is the thin host-topology layer on
+top:
+
+  * `initialize_distributed()` — bring up (or no-op) the JAX process
+    group from explicit args or the standard cluster env vars.
+  * `make_global_mesh()` — a Mesh over *all* processes' devices, laying
+    the data-parallel axis across hosts (sample cohorts never talk to
+    each other → their traffic may cross slower DCN) and the
+    sequence-parallel axis within a host's slice (halo exchanges stay on
+    ICI). This is the scaling-book recipe: outer axis = DCN, inner = ICI.
+
+Single-process behavior is identical to `make_mesh` — every function
+degrades gracefully so the same driver script runs on a laptop, one
+tunneled chip, or a multi-host pod.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from kindel_tpu.parallel.mesh import make_mesh
+
+__all__ = ["initialize_distributed", "make_global_mesh"]
+
+
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+    local_device_ids=None,
+    auto_detect: bool = False,
+) -> bool:
+    """Initialize the JAX process group for multi-host execution.
+
+    Returns True when a multi-process group is (already) up, False when
+    running single-process. Arguments default to the standard cluster env
+    vars (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID).
+    On a TPU pod whose launcher exports none of these, pass
+    `auto_detect=True` to let jax.distributed.initialize() probe the
+    cluster metadata itself (not the default: the probe can fail or stall
+    on plain CPU hosts and single tunneled chips). Safe to call twice: a
+    second call with a live group is a no-op."""
+    if jax.distributed.is_initialized():
+        return jax.process_count() > 1
+
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and "JAX_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+
+    if coordinator_address is None and num_processes is None:
+        if not auto_detect:
+            # no cluster context advertised anywhere → single process
+            return False
+        jax.distributed.initialize()  # cluster auto-detection
+        return jax.process_count() > 1
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+    return jax.process_count() > 1
+
+
+def make_global_mesh(
+    axes: dict[str, int] | None = None,
+    dcn_axis: str = "dp",
+) -> Mesh:
+    """Mesh over every device in the (possibly multi-host) process group.
+
+    `axes` maps axis name → size exactly as in `make_mesh`; their product
+    must not exceed the global device count. When the group spans several
+    hosts, `dcn_axis` (default the data-parallel axis, whose shards never
+    exchange tensors during the reduction) is laid out across hosts so
+    all other axes — in particular the position axis with its ppermute
+    halo — stay within a host's ICI domain. Single-host behaves exactly
+    like `make_mesh`; multi-host with a factorization that does not tile
+    the hosts raises (a silent local-only mesh would shard wrongly)."""
+    n_hosts = jax.process_count()
+    if axes is None or n_hosts <= 1 or dcn_axis not in axes:
+        return make_mesh(axes)
+
+    dcn = axes[dcn_axis]
+    per_host = len(jax.local_devices())
+    inner = 1
+    for name, size in axes.items():
+        if name != dcn_axis:
+            inner *= size
+    if dcn % n_hosts != 0 or (dcn // n_hosts) * inner != per_host:
+        raise ValueError(
+            f"axes {axes} do not tile {n_hosts} hosts x {per_host} "
+            f"devices/host: need {dcn_axis} % n_hosts == 0 and "
+            f"({dcn_axis}/n_hosts) * (product of other axes) == "
+            "devices/host"
+        )
+
+    from jax.experimental import mesh_utils
+
+    dev = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape=(dcn // n_hosts, inner),
+        dcn_mesh_shape=(n_hosts, 1),
+        devices=jax.devices(),
+    )
+    # hybrid mesh comes back (dcn, inner); split inner into the remaining
+    # axes (declared order) and move dcn into its declared position
+    rest = [n for n in axes if n != dcn_axis]
+    dev = np.asarray(dev).reshape(
+        (axes[dcn_axis],) + tuple(axes[n] for n in rest)
+    )
+    dev = np.moveaxis(dev, 0, list(axes).index(dcn_axis))
+    return Mesh(dev, tuple(axes.keys()))

@@ -1,0 +1,66 @@
+"""Multi-host topology layer (kindel_tpu.parallel.distributed) — exercised
+single-process on the virtual 8-device CPU mesh, the same no-cluster
+degradation every laptop/driver run takes."""
+
+import numpy as np
+
+import jax
+
+from kindel_tpu.parallel import (
+    batched_sharded_call,
+    initialize_distributed,
+    make_global_mesh,
+)
+
+
+def test_initialize_distributed_single_process_noop(monkeypatch):
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("JAX_NUM_PROCESSES", raising=False)
+    assert initialize_distributed() is False
+    assert jax.process_count() == 1
+
+
+def test_make_global_mesh_single_host_layout():
+    mesh = make_global_mesh({"dp": 2, "sp": 4})
+    assert mesh.devices.shape == (2, 4)
+    assert mesh.axis_names == ("dp", "sp")
+    # degenerate args behave like make_mesh
+    assert make_global_mesh().devices.shape == (len(jax.devices()),)
+
+
+def test_make_global_mesh_rejects_bad_multihost_tiling(monkeypatch):
+    """Multi-host with a factorization that can't tile the hosts must
+    raise — a silent local-only mesh would shard the cohort wrongly."""
+    import pytest
+
+    from kindel_tpu.parallel import distributed as d
+
+    monkeypatch.setattr(d.jax, "process_count", lambda: 2)
+    monkeypatch.setattr(
+        d.jax, "local_devices", lambda: jax.devices()[:4]
+    )
+    with pytest.raises(ValueError, match="do not tile"):
+        d.make_global_mesh({"dp": 2, "sp": 2})  # 1*2 != 4 devices/host
+    with pytest.raises(ValueError, match="do not tile"):
+        d.make_global_mesh({"dp": 3, "sp": 4})  # 3 % 2 != 0
+
+
+def test_global_mesh_runs_batched_step():
+    mesh = make_global_mesh({"dp": 2, "sp": 4})
+    rng = np.random.default_rng(0)
+    ref_len = 512
+    samples = []
+    for _ in range(2):
+        pos = rng.integers(0, ref_len, size=64)
+        samples.append(
+            {
+                "match_pos": pos.astype(np.int64),
+                "match_base": rng.integers(0, 4, size=64).astype(np.int64),
+                "del_pos": np.asarray([3], np.int64),
+                "ins_pos": np.asarray([5], np.int64),
+                "ins_cnt": np.asarray([1], np.int64),
+            }
+        )
+    w, bc, dm, nm, im = batched_sharded_call(samples, ref_len, mesh)
+    assert w.shape == (2, ref_len, 5)
+    assert int(w.sum()) == 2 * 64
