@@ -1,0 +1,94 @@
+"""Phase-level micro-profile of the fused consensus path on the current
+JAX default device (TPU when the tunnel is up, CPU otherwise).
+
+Usage: python benchmarks/microprof.py [bam_path]
+
+Breaks the benchmark pipeline into decode / extract / unit-build / upload /
+device-compute / download / host-assemble and prints a per-phase table,
+three trials. This is the tool for attributing wall time between the
+tunnel wire (upload+download), the XLA program, and host work — see
+BASELINE.md for the end-to-end target.
+"""
+
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import numpy as np
+
+
+def main() -> None:
+    bam = Path(
+        sys.argv[1]
+        if len(sys.argv) > 1
+        else "/root/reference/tests/data_minimap2_bact/bact.tiny.bam"
+    )
+    import jax
+
+    from kindel_tpu.call import _insertion_calls, assemble
+    from kindel_tpu.call_jax import (
+        CallUnit,
+        decode_fast,
+        fused_call_kernel,
+        kernel_args,
+    )
+    from kindel_tpu.events import extract_events
+    from kindel_tpu.io import load_alignment
+    from kindel_tpu.pileup import build_insertion_table
+
+    print(f"device: {jax.devices()[0]}", flush=True)
+
+    batch = load_alignment(bam)
+    ev = extract_events(batch)
+    rid = ev.present_ref_ids[0]
+
+    # warmup / compile
+    u = CallUnit(ev, rid)
+    args = kernel_args(u)
+    jax.block_until_ready(args)
+    out = fused_call_kernel(*args, length=u.L, want_masks=False)
+    jax.block_until_ready(out)
+
+    for trial in range(3):
+        t0 = time.perf_counter()
+        batch = load_alignment(bam)
+        t1 = time.perf_counter()
+        ev = extract_events(batch)
+        t2 = time.perf_counter()
+        u = CallUnit(ev, rid)
+        t3 = time.perf_counter()
+        args = kernel_args(u)
+        jax.block_until_ready(args)
+        t4 = time.perf_counter()
+        out = fused_call_kernel(*args, length=u.L, want_masks=False)
+        jax.block_until_ready(out)
+        t5 = time.perf_counter()
+        plane = np.asarray(out[0])
+        exc_bits, del_flags, ins_flags = (np.asarray(x) for x in out[1])
+        t6 = time.perf_counter()
+        masks = decode_fast(
+            plane, exc_bits, del_flags, ins_flags, u.L, u.del_pos, u.ins_pos
+        )
+        # match the production path: resolve insertion strings when any emit
+        ins_calls = (
+            _insertion_calls(build_insertion_table(ev, rid))
+            if masks.ins_mask.any()
+            else {}
+        )
+        res = assemble(masks, ins_calls, None, False, 1, False, False)
+        t7 = time.perf_counter()
+        assert len(res.sequence) > 0
+        print(
+            f"trial{trial}: decode={t1-t0:.3f} extract={t2-t1:.3f} "
+            f"unit={t3-t2:.3f} upload={t4-t3:.3f} compute={t5-t4:.3f} "
+            f"download={t6-t5:.3f} assemble={t7-t6:.3f} "
+            f"total={t7-t0:.3f}",
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -278,6 +278,26 @@ class CallUnit:
             self.ins_cnt = np.asarray(icnt, np.int32)
 
 
+def kernel_args(u: "CallUnit", min_depth: int = 1):
+    """Pad + upload one unit's arrays in fused_call_kernel argument order.
+    Single source of truth for bucket sizes and pad fills — shared by
+    device_call and benchmarks/microprof.py."""
+    O_pad = _bucket(len(u.op_r_start), 256)
+    B_pad = _bucket(len(u.base_packed), 1024)
+    D_pad = _bucket(len(u.del_pos), 256)
+    I_pad = _bucket(len(u.ins_pos), 256)
+    return (
+        jnp.asarray(_pad(u.op_r_start, O_pad, PAD_POS)),
+        jnp.asarray(_pad(u.op_off, O_pad, np.int32(u.n_events))),
+        jnp.asarray(_pad(u.base_packed, B_pad, 0)),
+        jnp.asarray(_pad(u.del_pos, D_pad, PAD_POS)),
+        jnp.asarray(_pad(u.ins_pos, I_pad, PAD_POS)),
+        jnp.asarray(_pad(u.ins_cnt, I_pad, 0)),
+        jnp.int32(u.n_events),
+        jnp.int32(min_depth),
+    )
+
+
 def device_call(ev: EventSet, rid: int, min_depth: int = 1,
                 want_masks: bool = True):
     """Run the fused kernel for one reference.
@@ -288,22 +308,8 @@ def device_call(ev: EventSet, rid: int, min_depth: int = 1,
     is rebuilt from the 2-bit wire format (see decode_fast)."""
     u = CallUnit(ev, rid)
     L, ip = u.L, u.ins_pos
-    O_pad = _bucket(len(u.op_r_start), 256)
-    B_pad = _bucket(len(u.base_packed), 1024)
-    D_pad = _bucket(len(u.del_pos), 256)
-    I_pad = _bucket(len(ip), 256)
-
     main_out, masks_packed, dmin, dmax = fused_call_kernel(
-        jnp.asarray(_pad(u.op_r_start, O_pad, PAD_POS)),
-        jnp.asarray(_pad(u.op_off, O_pad, np.int32(u.n_events))),
-        jnp.asarray(_pad(u.base_packed, B_pad, 0)),
-        jnp.asarray(_pad(u.del_pos, D_pad, PAD_POS)),
-        jnp.asarray(_pad(ip, I_pad, PAD_POS)),
-        jnp.asarray(_pad(u.ins_cnt, I_pad, 0)),
-        jnp.int32(u.n_events),
-        jnp.int32(min_depth),
-        length=L,
-        want_masks=want_masks,
+        *kernel_args(u, min_depth), length=L, want_masks=want_masks
     )
 
     if want_masks:
