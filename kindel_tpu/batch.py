@@ -82,15 +82,46 @@ def batch_bam_to_consensus(
     return out
 
 
+def _dp_sharding(n_rows: int):
+    """A NamedSharding over all devices for batch-leading arrays, or None
+    single-device. The batch axis is embarrassingly parallel, so laying
+    rows across a dp mesh makes XLA partition the vmapped kernel with
+    zero collectives."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n_dev = len(jax.devices())
+    if n_dev <= 1:
+        return None, 1
+    from kindel_tpu.parallel import make_mesh
+
+    dp = min(n_dev, n_rows) if n_rows else 1
+    if dp <= 1:
+        return None, 1
+    mesh = make_mesh({"dp": dp})
+    return (
+        lambda ndim: NamedSharding(mesh, P("dp", *([None] * (ndim - 1)))),
+        dp,
+    )
+
+
 def _dispatch_device_call(units, min_depth: int):
     """Pad + upload a cohort's units and launch the batched kernel
-    (asynchronously — jax dispatch returns before the TPU finishes)."""
+    (asynchronously — jax dispatch returns before the TPU finishes).
+    With multiple visible devices, rows are sharded over a dp mesh."""
+    import jax
+
     L = _bucket(max(u.L for u in units), 1024)
     O_pad = _bucket(max(len(u.op_r_start) for u in units), 64)
     B_pad = _bucket(max(len(u.base_packed) for u in units), 256)
     D_pad = _bucket(max((len(u.del_pos) for u in units), default=1), 64)
     I_pad = _bucket(max((len(u.ins_pos) for u in units), default=1), 64)
-    B = len(units)
+
+    sharding, dp = _dp_sharding(len(units))
+    # pad the row count to a dp multiple with empty dummy units (n_events
+    # 0 → all-PAD scatter → all-N rows, discarded by the caller which
+    # only reads the first len(units) rows)
+    B = -(-len(units) // dp) * dp
 
     def stack(getter, pad_size, fill, dtype=np.int32):
         out = np.full((B, pad_size), fill, dtype=dtype)
@@ -99,21 +130,26 @@ def _dispatch_device_call(units, min_depth: int):
             out[i, : len(arr)] = arr
         return out
 
-    return batched_call_kernel(
-        jnp.asarray(stack(lambda u: u.op_r_start, O_pad, PAD_POS)),
-        jnp.asarray(
-            np.stack(
-                [_pad(u.op_off, O_pad, np.int32(u.n_events)) for u in units]
-            )
-        ),
-        jnp.asarray(stack(lambda u: u.base_packed, B_pad, 0, np.uint8)),
-        jnp.asarray(stack(lambda u: u.del_pos, D_pad, PAD_POS)),
-        jnp.asarray(stack(lambda u: u.ins_pos, I_pad, PAD_POS)),
-        jnp.asarray(stack(lambda u: u.ins_cnt, I_pad, 0)),
-        jnp.asarray(np.array([u.n_events for u in units], dtype=np.int32)),
-        jnp.int32(min_depth),
-        length=L,
+    n_events = np.zeros(B, dtype=np.int32)
+    n_events[: len(units)] = [u.n_events for u in units]
+
+    arrays = (
+        stack(lambda u: u.op_r_start, O_pad, PAD_POS),
+        # per-row pad sentinel is that row's n_events; dummy rows get 0
+        stack(lambda u: _pad(u.op_off, O_pad, np.int32(u.n_events)), O_pad, 0),
+        stack(lambda u: u.base_packed, B_pad, 0, np.uint8),
+        stack(lambda u: u.del_pos, D_pad, PAD_POS),
+        stack(lambda u: u.ins_pos, I_pad, PAD_POS),
+        stack(lambda u: u.ins_cnt, I_pad, 0),
+        n_events,
     )
+    if sharding is None:
+        dev_arrays = tuple(jnp.asarray(a) for a in arrays)
+    else:
+        dev_arrays = tuple(
+            jax.device_put(a, sharding(a.ndim)) for a in arrays
+        )
+    return batched_call_kernel(*dev_arrays, jnp.int32(min_depth), length=L)
 
 
 def _assemble_outputs(units, device_out, trim_ends, uppercase, min_depth,

@@ -110,3 +110,42 @@ def test_stream_yields_finished_chunks_before_decode_failure(
             got.append((path, recs))
     assert [p for p, _ in got] == [good]
     assert got[0][1], "good sample's consensus records were lost"
+
+
+def test_batch_dispatch_shards_rows():
+    """Under a multi-device mesh the cohort rows must actually lay out
+    across the dp axis (guards the sharded dispatch from silently
+    regressing to device 0)."""
+    import jax
+
+    from kindel_tpu.batch import _dp_sharding
+
+    sharding, dp = _dp_sharding(6)
+    if len(jax.devices()) <= 1:
+        assert sharding is None and dp == 1
+    else:
+        assert dp == min(len(jax.devices()), 6)
+        spec = sharding(2).spec
+        assert spec[0] == "dp"
+
+
+def test_batch_uneven_cohort_pads_dummy_rows(data_root):
+    """More units than devices and not a dp multiple: rows are padded with
+    empty dummy units that must not perturb real samples."""
+    import jax
+
+    if len(jax.devices()) <= 1:
+        import pytest
+
+        pytest.skip("needs a multi-device mesh")
+    # 6 bwa refs + 3 multi-BAM contigs = 9 units over 8 devices → B=16
+    paths = [
+        data_root / "data_bwa_mem" / f"{i}.1.sub_test.bam"
+        for i in (1, 2, 3, 4, 5, 6)
+    ] + [data_root / "data_minimap2" / "1.1.multi.bam"]
+    batch_out = batch_bam_to_consensus(paths)
+    for path in paths:
+        singles = bam_to_consensus(path).consensuses
+        assert [s.sequence for s in singles] == [
+            b.sequence for b in batch_out[path]
+        ], path
