@@ -98,6 +98,46 @@ def cmd_consensus(args) -> int:
     return 0
 
 
+def _write_tsv(df, fh) -> None:
+    """TSV out through pyarrow's C++ CSV writer when available — pandas'
+    per-value float formatting dominates to_csv wall time on megabase
+    tables (~20 s for a 6.1 Mb genome vs ~1 s via arrow). Falls back to
+    pandas with identical column content; float rendering may differ in
+    trailing-zero style between the two paths (values are pre-rounded in
+    the workloads, so no information differs). NaN renders as the empty
+    field either way."""
+    try:
+        import pyarrow as pa
+        import pyarrow.csv as pacsv
+
+        table = pa.Table.from_pandas(df, preserve_index=False)
+        buf = pa.BufferOutputStream()
+        pacsv.write_csv(
+            table,
+            buf,
+            # header written by hand: arrow quotes header cells regardless
+            # of the data quoting style
+            pacsv.WriteOptions(
+                delimiter="\t", quoting_style="none", include_header=False
+            ),
+        )
+    except Exception:
+        # pyarrow absent, or too old for quoting_style (<8) — the slow
+        # path is always correct
+        df.to_csv(fh, sep="\t", index=False)
+        return
+    data = (
+        "\t".join(map(str, df.columns)).encode()
+        + b"\n"
+        + buf.getvalue().to_pybytes()
+    )
+    out = fh.buffer if hasattr(fh, "buffer") else fh
+    try:
+        out.write(data)
+    except TypeError:  # text-mode StringIO and friends
+        fh.write(data.decode())
+
+
 def cmd_weights(args) -> int:
     df = workloads.weights(
         args.bam_path,
@@ -106,13 +146,13 @@ def cmd_weights(args) -> int:
         confidence_alpha=args.confidence_alpha,
         backend=args.backend,
     )
-    df.to_csv(sys.stdout, sep="\t", index=False)
+    _write_tsv(df, sys.stdout)
     return 0
 
 
 def cmd_features(args) -> int:
     df = workloads.features(args.bam_path, backend=args.backend)
-    df.to_csv(sys.stdout, sep="\t", index=False)
+    _write_tsv(df, sys.stdout)
     return 0
 
 
@@ -124,7 +164,7 @@ def cmd_variants(args) -> int:
         indels=not args.no_indels,
         backend=args.backend,
     )
-    df.to_csv(sys.stdout, sep="\t", index=False)
+    _write_tsv(df, sys.stdout)
     return 0
 
 

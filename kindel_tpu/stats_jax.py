@@ -70,6 +70,9 @@ def entropy_rows_host(rel: np.ndarray) -> np.ndarray:
 
 def jeffreys_interval_host(count: np.ndarray, nobs: np.ndarray,
                            alpha: float):
+    """float32 betainc bisection: agrees with scipy to ~1e-4; a value
+    sitting exactly on a 3-decimal rounding boundary can therefore print
+    one ulp-at-3dp away from the numpy oracle's table."""
     lower, upper = jeffreys_interval(
         jnp.asarray(count, jnp.float32),
         jnp.asarray(nobs, jnp.float32),

@@ -175,69 +175,103 @@ def weights(bam_path, relative: bool = False, confidence: bool = True,
     """
     import pandas as pd
 
-    rows = []
+    # All derivation happens on flat numpy arrays; pandas only receives
+    # finished columns (a 6.1 Mb genome otherwise spends tens of seconds
+    # in DataFrame broadcast/divide/round overhead).
+    per_ref = []
     for chrom, p in _load_pileups(bam_path, backend).items():
         L = p.ref_len
-        df = pd.DataFrame(
-            {
-                "chrom": chrom,
-                "pos": np.arange(1, L + 1),
-                "A": p.weights[:, 0],
-                "C": p.weights[:, 3],
-                "G": p.weights[:, 2],
-                "T": p.weights[:, 1],
-                "N": p.weights[:, 4],
-                "insertions": p.ins.totals[:L].astype(np.int64),
-                "deletions": p.deletions[:L].astype(np.int64),
-                "clip_starts": p.clip_starts[:L].astype(np.int64),
-                "clip_ends": p.clip_ends[:L].astype(np.int64),
-            }
+        counts = np.stack(
+            [
+                p.weights[:, 0],  # A
+                p.weights[:, 3],  # C
+                p.weights[:, 2],  # G
+                p.weights[:, 1],  # T
+                p.weights[:, 4],  # N
+                p.deletions[:L],
+            ],
+            axis=1,
+        ).astype(np.int64)
+        per_ref.append(
+            (
+                chrom,
+                counts,
+                p.ins.totals[:L].astype(np.int64),
+                p.clip_starts[:L].astype(np.int64),
+                p.clip_ends[:L].astype(np.int64),
+            )
         )
-        rows.append(df)
-    weights_df = (
-        pd.concat(rows, ignore_index=True)
-        if rows
-        else __empty_weights_df()
-    )
-    nt_cols = ["A", "C", "G", "T", "N", "deletions"]
-    weights_df["depth"] = weights_df[nt_cols].sum(axis=1)
-    consensus_depths = weights_df[nt_cols].max(axis=1)
-    weights_df["consensus"] = consensus_depths.divide(weights_df.depth)
+    if not per_ref:
+        empty = __empty_weights_df()
+        for col in ["depth", "consensus", "shannon"] + (
+            ["lower_ci", "upper_ci"] if confidence else []
+        ):
+            empty[col] = np.empty(0)
+        return empty
 
-    rel = weights_df[nt_cols].divide(weights_df.depth, axis=0).round(4)
-    acgt_rel = rel[["A", "C", "G", "T"]].values
+    counts = np.concatenate([r[1] for r in per_ref])
+    depth = counts.sum(axis=1)
+    consensus_depths = counts.max(axis=1)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        consensus_frac = consensus_depths / depth
+        rel = np.round(counts / depth[:, None], 4)
+
+    acgt_rel = rel[:, :4]
     if backend == "jax":
         from kindel_tpu.stats_jax import entropy_rows_host
 
-        weights_df["shannon"] = entropy_rows_host(acgt_rel)
+        shannon = entropy_rows_host(acgt_rel)
     else:
         with np.errstate(divide="ignore", invalid="ignore"):
-            weights_df["shannon"] = _shannon(acgt_rel)
+            shannon = _shannon(acgt_rel)
+
+    lens = [len(r[1]) for r in per_ref]
+    cols: dict = {
+        # from_codes: no 6M-element python-string array is ever built
+        "chrom": pd.Categorical.from_codes(
+            np.repeat(np.arange(len(per_ref), dtype=np.int32), lens),
+            categories=[r[0] for r in per_ref],
+        ),
+        "pos": np.concatenate(
+            [np.arange(1, n + 1, dtype=np.int32) for n in lens]
+        ),
+    }
+    # int32 count columns: halves the bytes pandas copies when it stacks
+    # same-dtype columns into blocks (and the TSV writer reads back)
+    base = rel[:, :5] if relative else counts[:, :5].astype(np.int32)
+    for i, nt in enumerate(["A", "C", "G", "T", "N"]):
+        cols[nt] = base[:, i]
+    cols["insertions"] = np.concatenate(
+        [r[2] for r in per_ref]
+    ).astype(np.int32)
+    cols["deletions"] = counts[:, 5].astype(np.int32)
+    cols["clip_starts"] = np.concatenate(
+        [r[3] for r in per_ref]
+    ).astype(np.int32)
+    cols["clip_ends"] = np.concatenate(
+        [r[4] for r in per_ref]
+    ).astype(np.int32)
+    cols["depth"] = depth.astype(np.int32)
+    cols["consensus"] = np.round(consensus_frac, 3)
+    cols["shannon"] = np.round(shannon, 3)
 
     if confidence:
         if backend == "jax":
             from kindel_tpu.stats_jax import jeffreys_interval_host
 
             lower, upper = jeffreys_interval_host(
-                consensus_depths.values, weights_df["depth"].values,
-                confidence_alpha,
+                consensus_depths, depth, confidence_alpha
             )
         else:
             lower, upper = _jeffreys_ci(
-                consensus_depths.values.astype(np.float64),
-                weights_df["depth"].values.astype(np.float64),
+                consensus_depths.astype(np.float64),
+                depth.astype(np.float64),
                 confidence_alpha,
             )
-        weights_df["lower_ci"] = lower
-        weights_df["upper_ci"] = upper
+        cols["lower_ci"] = np.round(lower, 3)
+        cols["upper_ci"] = np.round(upper, 3)
 
-    if relative:
-        for nt in ["A", "C", "G", "T", "N"]:
-            weights_df[nt] = rel[nt]
-
-    return weights_df.round(
-        dict(consensus=3, lower_ci=3, upper_ci=3, shannon=3)
-    )
+    return pd.DataFrame(cols)
 
 
 def __empty_weights_df():
@@ -251,26 +285,39 @@ def __empty_weights_df():
 
 def _shannon(rel: np.ndarray) -> np.ndarray:
     """Shannon entropy rows of a relative-frequency matrix, matching
-    scipy.stats.entropy semantics (normalizes rows; 0·log0 = 0)."""
-    totals = rel.sum(axis=1, keepdims=True)
-    with np.errstate(divide="ignore", invalid="ignore"):
-        pk = rel / totals
-        terms = np.where(pk > 0, -pk * np.log(pk), 0.0)
-        out = terms.sum(axis=1)
-        out = np.where(np.isnan(rel).any(axis=1) | (totals[:, 0] == 0),
-                       np.nan, out)
+    scipy.stats.entropy semantics (normalizes rows; 0·log0 = 0). Rows
+    with zero total (or NaN inputs) are NaN — typically the uncovered
+    majority of a sparse genome, so the log only runs on covered rows."""
+    totals = rel.sum(axis=1)
+    covered = np.flatnonzero(~np.isnan(totals) & (totals > 0))
+    out = np.full(rel.shape[0], np.nan)
+    if len(covered):
+        sub = rel[covered]
+        with np.errstate(divide="ignore", invalid="ignore"):
+            pk = sub / totals[covered, None]
+            terms = np.where(pk > 0, -pk * np.log(pk), 0.0)
+        out[covered] = terms.sum(axis=1)
     return out
 
 
 def _jeffreys_ci(count, nobs, alpha):
     """Jeffreys binomial proportion CI — beta.interval(1-alpha, c+0.5,
-    n-c+0.5) (reference kindel.py:569-574)."""
+    n-c+0.5) (reference kindel.py:569-574). betaincinv costs ~µs/site, so
+    evaluate once per unique (count, nobs) pair — read depths are small
+    ints, collapsing a megabase genome to a few hundred evaluations."""
     import scipy.stats
 
-    lower, upper = scipy.stats.beta.interval(
-        1 - alpha, count + 0.5, nobs - count + 0.5
+    c = np.asarray(count).astype(np.int64)
+    n = np.asarray(nobs).astype(np.int64)
+    stride = n.max() + 1 if len(n) else 1
+    key = c * stride + n  # c <= n, both small ints: collision-free
+    uniq, inverse = np.unique(key, return_inverse=True)
+    lower_u, upper_u = scipy.stats.beta.interval(
+        1 - alpha,
+        uniq // stride + 0.5,
+        uniq % stride - uniq // stride + 0.5,
     )
-    return lower, upper
+    return lower_u[inverse], upper_u[inverse]
 
 
 def features(bam_path, backend: str = "numpy"):
@@ -284,37 +331,51 @@ def features(bam_path, backend: str = "numpy"):
     """
     import pandas as pd
 
-    rows = []
+    per_ref = []
     for chrom, p in _load_pileups(bam_path, backend).items():
         L = p.ref_len
-        df = pd.DataFrame(
-            {
-                "chrom": chrom,
-                "pos": np.arange(1, L + 1),
-                "A": p.weights[:, 0].astype(np.float64),
-                "C": p.weights[:, 3].astype(np.float64),
-                "G": p.weights[:, 2].astype(np.float64),
-                "T": p.weights[:, 1].astype(np.float64),
-                "N": p.weights[:, 4].astype(np.float64),
-                "i": p.ins.totals[:L].astype(np.float64),
-                "d": p.deletions[:L].astype(np.float64),
-            }
-        )
-        rows.append(df)
-    if not rows:
+        counts = np.stack(
+            [
+                p.weights[:, 0],  # A
+                p.weights[:, 3],  # C
+                p.weights[:, 2],  # G
+                p.weights[:, 1],  # T
+                p.weights[:, 4],  # N
+                p.ins.totals[:L],  # i
+                p.deletions[:L],  # d
+            ],
+            axis=1,
+        ).astype(np.float64)
+        per_ref.append((chrom, counts))
+    if not per_ref:
         return pd.DataFrame(
             columns=["chrom", "pos", "A", "C", "G", "T", "N", "i", "d",
                      "depth", "consensus", "shannon"]
         )
-    df = pd.concat(rows, ignore_index=True)
-    nt_cols = ["A", "C", "G", "T", "N", "d"]
-    df["depth"] = df[nt_cols].sum(axis=1)
-    df["consensus"] = df[["A", "C", "G", "T", "N"]].max(axis=1).divide(df.depth)
-    for nt in ["A", "C", "G", "T", "N", "i", "d"]:
-        df[nt] = df[nt].divide(df.depth, axis=0)
+    counts = np.concatenate([r[1] for r in per_ref])
+    # depth counts deletions but not insertions (reference kindel.py:650-652)
+    depth = counts[:, :5].sum(axis=1) + counts[:, 6]
     with np.errstate(divide="ignore", invalid="ignore"):
-        df["shannon"] = _shannon(df[["A", "C", "G", "T", "i", "d"]].values)
-    return df.round(3)
+        consensus_frac = counts[:, :5].max(axis=1) / depth
+        rel = counts / depth[:, None]
+    shannon = _shannon(rel[:, [0, 1, 2, 3, 5, 6]])
+
+    lens = [len(r[1]) for r in per_ref]
+    cols: dict = {
+        "chrom": pd.Categorical.from_codes(
+            np.repeat(np.arange(len(per_ref), dtype=np.int32), lens),
+            categories=[r[0] for r in per_ref],
+        ),
+        "pos": np.concatenate(
+            [np.arange(1, n + 1, dtype=np.int32) for n in lens]
+        ),
+    }
+    for i, name in enumerate(["A", "C", "G", "T", "N", "i", "d"]):
+        cols[name] = np.round(rel[:, i], 3)
+    cols["depth"] = depth
+    cols["consensus"] = np.round(consensus_frac, 3)
+    cols["shannon"] = np.round(shannon, 3)
+    return pd.DataFrame(cols)
 
 
 def variants(bam_path, min_count: int = 1, min_frequency: float = 0.0,
