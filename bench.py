@@ -6,7 +6,15 @@ reference implementation measures 0.069 Mbases/s end-to-end on one CPU core
 (88.3 s); vs_baseline is the speedup over that.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "backend": ...}
+
+Hermeticity contract (round-1 postmortem, VERDICT.md "Next round" item 1):
+this parent process NEVER imports jax. The measured run happens in a
+watchdog-timed child; if the tunneled TPU relay is dead or its backend
+fails to initialize, the benchmark reruns in a CPU child with the
+accelerator hook scrubbed and the JSON line is labeled
+``"backend": "cpu-fallback"`` with the TPU error attached — one environment
+flap must never void the round's perf evidence.
 """
 
 from __future__ import annotations
@@ -27,6 +35,10 @@ BACT_BAM = Path(
     )
 )
 BASELINE_MBASES_PER_S = 0.069  # reference end-to-end, 1 CPU core (SURVEY §6)
+
+TPU_ATTEMPT_TIMEOUT_S = 420.0  # first compile ~20-40s + tunneled transfers
+CPU_ATTEMPT_TIMEOUT_S = 300.0
+RELAY_WAIT_S = 30.0
 
 
 def _synthesize_bam(path: Path, ref_len: int = 6_097_032,
@@ -68,17 +80,21 @@ def _synthesize_bam(path: Path, ref_len: int = 6_097_032,
     path.write_bytes(gzip.compress(raw, 1))
 
 
-def main():
+def _run_benchmark() -> dict:
+    """The measured pipeline. Runs only in a child process (jax imported
+    here, never in the parent)."""
     bam = BACT_BAM
     if not bam.exists():
         bam = Path("/tmp/kindel_tpu_synth.bam")
         if not bam.exists():
             _synthesize_bam(bam)
 
+    import jax
+
     from kindel_tpu.events import extract_events
     from kindel_tpu.io import load_alignment
     from kindel_tpu.call_jax import call_consensus_fused
-    from kindel_tpu.pileup import build_pileup
+    from kindel_tpu.pileup import build_pileup  # noqa: F401 (import check)
 
     # warmup: trigger jit compilation with the real shapes
     batch = load_alignment(bam)
@@ -99,17 +115,103 @@ def main():
     elapsed = time.perf_counter() - t0
 
     mbases_per_s = total_bases / elapsed / 1e6
+    return {
+        "metric": "consensus_throughput_bacterial",
+        "value": round(mbases_per_s, 3),
+        "unit": "Mbases/s",
+        "vs_baseline": round(mbases_per_s / BASELINE_MBASES_PER_S, 1),
+        "backend": jax.default_backend(),
+    }
+
+
+def _parse_child_json(stdout: str) -> dict | None:
+    for line in reversed(stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def _tail(text: str, n: int = 800) -> str:
+    return text[-n:] if text else ""
+
+
+def main() -> None:
+    import _hermetic as hz
+
+    errors: list[str] = []
+    argv = [sys.executable, str(REPO / "bench.py")]
+    child_marker = {"KINDEL_TPU_BENCH_CHILD": "1"}
+
+    # Attempt 1: the tunneled accelerator, but only if its relay answers.
+    if hz.pool_advertised():
+        if hz.wait_for_relay(RELAY_WAIT_S):
+            env = hz.accelerator_env()
+            env.update(child_marker)
+            proc = hz.run_child(argv, env, TPU_ATTEMPT_TIMEOUT_S)
+            result = _parse_child_json(proc.stdout)
+            if (
+                proc.returncode == 0
+                and result is not None
+                and result.get("backend") != "cpu"
+            ):
+                print(json.dumps(result))
+                return
+            if result is not None and result.get("backend") == "cpu":
+                # JAX_PLATFORMS pinning should make this impossible, but
+                # never report a hook-tainted CPU run as the accelerator.
+                errors.append("tpu attempt silently ran on cpu backend")
+            else:
+                errors.append(
+                    f"tpu attempt rc={proc.returncode}: "
+                    f"{_tail(proc.stderr, 400)}"
+                )
+            print(errors[-1], file=sys.stderr)
+        else:
+            errors.append(
+                f"accelerator relay dead (no listener on "
+                f"{hz.RELAY_PORTS} after {RELAY_WAIT_S:.0f}s)"
+            )
+            print(errors[-1], file=sys.stderr)
+
+    # Attempt 2: CPU with the accelerator hook scrubbed — always possible.
+    env = hz.scrubbed_cpu_env()
+    env.update(child_marker)
+    proc = hz.run_child(argv, env, CPU_ATTEMPT_TIMEOUT_S)
+    result = _parse_child_json(proc.stdout)
+    if proc.returncode == 0 and result is not None:
+        if errors:
+            result["backend"] = "cpu-fallback"
+            result["note"] = "; ".join(errors)
+        print(json.dumps(result))
+        return
+    errors.append(
+        f"cpu attempt rc={proc.returncode}: {_tail(proc.stderr, 400)}"
+    )
+    print(errors[-1], file=sys.stderr)
+
+    # Hard failure: still emit a parseable line so the round records the
+    # error itself rather than a traceback.
     print(
         json.dumps(
             {
                 "metric": "consensus_throughput_bacterial",
-                "value": round(mbases_per_s, 3),
+                "value": 0.0,
                 "unit": "Mbases/s",
-                "vs_baseline": round(mbases_per_s / BASELINE_MBASES_PER_S, 1),
+                "vs_baseline": 0.0,
+                "backend": "failed",
+                "note": "; ".join(errors),
             }
         )
     )
+    sys.exit(1)
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("KINDEL_TPU_BENCH_CHILD"):
+        print(json.dumps(_run_benchmark()))
+    else:
+        main()

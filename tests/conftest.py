@@ -11,30 +11,24 @@ import sys
 from pathlib import Path
 
 
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+import _hermetic  # noqa: E402  (stdlib-only; shared relay-probe logic)
+
+
 def _axon_relay_dead() -> bool:
     """True when the container advertises a tunneled accelerator pool but
     its local relay is not accepting connections. In that state *importing
     jax hangs* (the registered plugin retries the dead endpoint), so the
     suite must restart itself with the pool hook disabled — CPU tests need
-    no accelerator anyway."""
-    if not os.environ.get("PALLAS_AXON_POOL_IPS"):
-        return False
-    import socket
+    no accelerator anyway.
 
-    # NB: port liveness, not protocol identity — fine in this sandboxed
-    # container where 808x is reserved for the relay; a foreign listener
-    # there would defeat the guard.
-    for port in (8082, 8083, 8087):  # relay listens on all or none
-        s = socket.socket()
-        s.settimeout(1.0)
-        try:
-            s.connect(("127.0.0.1", port))
-            return False
-        except OSError:
-            continue
-        finally:
-            s.close()
-    return True
+    NB: port liveness, not protocol identity (see _hermetic.relay_alive) —
+    fine in this sandboxed container where 808x is reserved for the relay;
+    a foreign listener there would defeat the guard, which is why the
+    jax import below additionally runs under a SIGALRM watchdog."""
+    return _hermetic.pool_advertised() and not _hermetic.relay_alive()
 
 
 def _restore_real_stdio() -> None:
@@ -141,13 +135,36 @@ if "xla_force_host_platform_device_count" not in _flags:
 # state early — override through the config API before any backend
 # initializes. NB the first *full* `import jax` in this process is the one
 # below; with a dead relay it would hang, which is exactly why the
-# re-exec guard above must run before this line.
-import jax  # noqa: E402
+# re-exec guard above must run before this line. The port probe cannot
+# rule out a foreign listener or a half-dead relay, so the import itself
+# runs under a SIGALRM watchdog that turns an indefinite hang into a loud
+# failure with re-run instructions (ADVICE.md round 1, conftest finding).
+import signal  # noqa: E402
+
+_JAX_IMPORT_TIMEOUT_S = 120  # first import may genuinely compile/probe
+
+
+def _jax_import_watchdog(signum, frame):
+    raise RuntimeError(
+        "`import jax` did not complete within "
+        f"{_JAX_IMPORT_TIMEOUT_S}s — the accelerator plugin is likely "
+        "retrying a dead relay behind an open port. Re-run with "
+        "PALLAS_AXON_POOL_IPS unset and JAX_PLATFORMS=cpu."
+    )
+
+
+_can_alarm = hasattr(signal, "SIGALRM")
+if _can_alarm:
+    _prev_handler = signal.signal(signal.SIGALRM, _jax_import_watchdog)
+    signal.alarm(_JAX_IMPORT_TIMEOUT_S)
+try:
+    import jax  # noqa: E402
+finally:
+    if _can_alarm:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, _prev_handler)
 
 jax.config.update("jax_platforms", "cpu")
-
-REPO_ROOT = Path(__file__).resolve().parent.parent
-sys.path.insert(0, str(REPO_ROOT))
 
 import pytest  # noqa: E402
 
