@@ -11,3 +11,8 @@ from kindel_tpu.parallel.distributed import (  # noqa: F401
     initialize_distributed,
     make_global_mesh,
 )
+from kindel_tpu.parallel.product import (  # noqa: F401
+    ShardedRef,
+    sharded_consensus,
+    split_match_spans,
+)

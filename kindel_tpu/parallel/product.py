@@ -1,0 +1,514 @@
+"""Position-sharded product path: full consensus (+realign) over a Mesh.
+
+This is the end-to-end sequence-parallel pipeline behind
+``bam_to_consensus(backend="jax")`` when more than one device is visible —
+the scaling axis SURVEY §5 identifies as the reference's cost driver
+(/root/reference/kindel/kindel.py:29-39,83-96,390-424: runtime scales with
+reference *positions*). Every pileup channel the product needs — aligned
+weights, clip-start/clip-end projections (kindel.py:63-81), deletions,
+insertion totals — reduces shard-locally on its device; the per-position
+call runs on device with a single one-element ppermute halo for the
+``aligned_depth_next`` lookahead (kindel.py:406-408); depth report scalars
+reduce across shards on device.
+
+Transfer discipline (the tunneled-TPU budget of call_jax.py applies):
+
+  upload    match events as op spans *split at block boundaries*
+            (~0.5 B/aligned base + ~12 B/span piece); clip/deletion/
+            insertion events raw-bucketed (rare);
+  download  per-position decisions as a 2-bit base plane + four packed
+            bitmasks (~0.75 B/position), two depth scalars, and — under
+            --realign — two integer-exact trigger bitmasks (L/8 B each).
+            The CDR decay walk and clip-consensus windows then download
+            on demand, a few KB per (rare) clip-dominant region, via
+            jitted dynamic-slice chunk fetches from the device-resident
+            sharded tensors. Dense [L,5] tensors never cross the wire.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+from kindel_tpu.utils.jax_cache import ensure_compilation_cache
+
+ensure_compilation_cache()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from kindel_tpu.call import CallMasks, CallResult, _insertion_calls, assemble
+from kindel_tpu.call_jax import EMIT_ASCII
+from kindel_tpu.events import EventSet, N_CHANNELS
+from kindel_tpu.io.records import (
+    ragged_indices,
+    ragged_local_offsets,
+    segment_exclusive_cumsum,
+)
+from kindel_tpu.parallel.mesh import bucket_events_by_position, make_mesh
+from kindel_tpu.pileup import build_insertion_table
+from kindel_tpu.pileup_jax import PAD_POS, _bucket
+from kindel_tpu.realign import (
+    cdr_end_consensuses_lazy,
+    cdr_start_consensuses_lazy,
+    merge_cdrps,
+    pair_regions,
+)
+
+_I32_MAX = np.int32(2**31 - 1)
+
+
+def split_match_spans(mp: np.ndarray, mb: np.ndarray, n_shards: int,
+                      block: int):
+    """Split the op-span-compressed match stream at block boundaries.
+
+    match_pos is a concatenation of ascending unit-stride runs (one per
+    M/=/X op — see call_jax.compress_match_events); each run is cut at
+    multiples of `block` so every piece lands wholly in one shard. Reads
+    are ~100s of bp and blocks ~100k+, so a span crosses at most one
+    boundary in practice (the math handles any number).
+
+    Returns (op_start [n,Omax] block-local int32, op_off [n,Omax] exclusive
+    local event offsets, base_packed [n,Emax//2] 4-bit pairs, n_ev [n]).
+    """
+    E = len(mp)
+    if E == 0:
+        Omax, Emax = 64, 256
+        return (
+            np.full((n_shards, Omax), PAD_POS, np.int32),
+            np.zeros((n_shards, Omax), np.int32),
+            np.zeros((n_shards, Emax // 2), np.uint8),
+            np.zeros(n_shards, np.int32),
+        )
+    boundary = np.r_[True, np.diff(mp) != 1]
+    sidx = np.flatnonzero(boundary)  # event index of each span start
+    slen = np.diff(np.r_[sidx, E])
+    sstart = mp[sidx]
+    send = sstart + slen  # exclusive end position
+
+    first = sstart // block
+    npieces = (send - 1) // block - first + 1
+    pspan = np.repeat(np.arange(len(sidx)), npieces)
+    pshard = first[pspan] + ragged_local_offsets(npieces)
+    plo = np.maximum(sstart[pspan], pshard * block)
+    phi = np.minimum(send[pspan], (pshard + 1) * block)
+    plen = phi - plo
+    pev = sidx[pspan] + (plo - sstart[pspan])  # global event idx of piece
+
+    order = np.argsort(pshard, kind="stable")
+    pshard, plo, plen, pev = (
+        pshard[order], plo[order], plen[order], pev[order]
+    )
+    piece_counts = np.bincount(pshard, minlength=n_shards)[:n_shards]
+    ev_counts = np.bincount(
+        pshard, weights=plen, minlength=n_shards
+    )[:n_shards].astype(np.int64)
+    piece_off = np.cumsum(piece_counts) - piece_counts
+    ev_off = np.cumsum(ev_counts) - ev_counts
+
+    # exclusive event offsets restarting per shard (empty shards excluded:
+    # their segment start would index one past the end)
+    nz = piece_counts > 0
+    local_off = segment_exclusive_cumsum(
+        plen, piece_off[nz], piece_counts[nz]
+    )
+    # bases regrouped by shard (pieces are contiguous global event ranges)
+    bases = mb[ragged_indices(pev, plen)].astype(np.uint8)
+
+    Omax = _bucket(int(piece_counts.max()), 64)
+    Emax = _bucket(int(ev_counts.max()), 256)
+    op_start = np.full((n_shards, Omax), PAD_POS, np.int32)
+    op_off = np.empty((n_shards, Omax), np.int32)
+    base_packed = np.zeros((n_shards, Emax // 2), np.uint8)
+    n_ev = ev_counts.astype(np.int32)
+    op_off[:] = n_ev[:, None]  # pad marks one-past-last event (see _call_core)
+    for s in range(n_shards):
+        a, c = piece_off[s], piece_counts[s]
+        op_start[s, :c] = plo[a : a + c] - s * block
+        op_off[s, :c] = local_off[a : a + c]
+        eb = bases[ev_off[s] : ev_off[s] + ev_counts[s]]
+        if len(eb) % 2:
+            eb = np.r_[eb, np.uint8(0)]
+        base_packed[s, : len(eb) // 2] = (eb[0::2] << 4) | eb[1::2]
+    return op_start, op_off, base_packed, n_ev
+
+
+def _reduce_and_call_local(
+    op_start, op_off, base_packed, n_ev,
+    del_pos, ins_pos, ins_cnt,
+    csw_pos, csw_base, cew_pos, cew_base,
+    min_depth,
+    *, block: int, L: int, axis: str, realign: bool,
+):
+    """One shard's slice: scatter-reduce all channels, call every position.
+
+    Runs under shard_map; inputs carry a leading length-1 shard dim.
+    """
+    op_start, op_off, base_packed = op_start[0], op_off[0], base_packed[0]
+    n_ev = n_ev[0]
+    del_pos, ins_pos, ins_cnt = del_pos[0], ins_pos[0], ins_cnt[0]
+    csw_pos, csw_base = csw_pos[0], csw_base[0]
+    cew_pos, cew_base = cew_pos[0], cew_base[0]
+
+    # --- reconstruct match events from spans (call_jax._call_core scheme) ---
+    E_pad = base_packed.shape[0] * 2
+    base = jnp.stack(
+        [base_packed >> 4, base_packed & 0xF], axis=1
+    ).reshape(E_pad).astype(jnp.int32)
+    k = jnp.arange(E_pad, dtype=jnp.int32)
+    marks = jnp.zeros(E_pad, jnp.int32).at[op_off].add(1, mode="drop")
+    op_id = jnp.clip(jnp.cumsum(marks) - 1, 0, op_off.shape[0] - 1)
+    pos = op_start[op_id] + (k - op_off[op_id])
+    pos = jnp.where(k < n_ev, pos, PAD_POS)
+
+    # --- shard-local scatters ---
+    def weighted(p, b):
+        return (
+            jnp.zeros(block * N_CHANNELS, jnp.int32)
+            .at[p * N_CHANNELS + b]
+            .add(1, mode="drop")
+            .reshape(block, N_CHANNELS)
+        )
+
+    weights = weighted(pos, base)
+    deletions = jnp.zeros(block, jnp.int32).at[del_pos].add(1, mode="drop")
+    ins_totals = (
+        jnp.zeros(block, jnp.int32).at[ins_pos].add(ins_cnt, mode="drop")
+    )
+
+    acgt = weights[:, :4].sum(axis=1)
+    w_sum = weights.sum(axis=1)
+
+    # --- halo: aligned_depth_next lookahead (kindel.py:406-408) ---
+    n = jax.lax.axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+    recv = jax.lax.ppermute(
+        acgt[:1], axis, [((i + 1) % n, i) for i in range(n)]
+    )
+    recv = jnp.where(idx == n - 1, 0, recv)
+    depth_next = jnp.concatenate([acgt[1:], recv])
+
+    # --- per-position call (exact _call_core semantics) ---
+    freq = weights.max(axis=1)
+    base_idx = jnp.argmax(weights, axis=1)  # first max wins, order A,T,G,C,N
+    tie = (freq > 0) & ((weights == freq[:, None]).sum(axis=1) > 1)
+    base_idx = jnp.where(w_sum == 0, N_CHANNELS - 1, base_idx)
+    base_code = jnp.where(tie, N_CHANNELS - 1, base_idx) + 1  # 1..5
+
+    del_mask = deletions * 2 > acgt
+    n_mask = ~del_mask & (acgt < min_depth)
+    ins_mask = (
+        ~del_mask
+        & ~n_mask
+        & (ins_totals * 2 > jnp.minimum(acgt, depth_next))
+    )
+    nchar = base_code == N_CHANNELS  # base emits 'N' (tie/zero-depth/argmax-N)
+
+    plane = ((base_code - 1) & 3).astype(jnp.uint8)
+    plane_packed = (
+        (plane[0::4] << 6) | (plane[1::4] << 4)
+        | (plane[2::4] << 2) | plane[3::4]
+    )
+
+    # --- depth report scalars over valid positions only ---
+    gpos = idx * block + jnp.arange(block, dtype=jnp.int32)
+    valid = gpos < L
+    dmin = jnp.where(valid, acgt, _I32_MAX).min()[None]
+    dmax = jnp.where(valid, acgt, -1).max()[None]
+
+    wire = (
+        plane_packed[None],
+        jnp.packbits(nchar)[None],
+        jnp.packbits(del_mask)[None],
+        jnp.packbits(n_mask)[None],
+        jnp.packbits(ins_mask)[None],
+        dmin, dmax,
+    )
+    dense = (weights[None], deletions[None], ins_totals[None])
+
+    if not realign:
+        return wire + dense
+
+    csw = weighted(csw_pos, csw_base)
+    cew = weighted(cew_pos, cew_base)
+    csd = csw[:, :4].sum(axis=1)
+    ced = cew[:, :4].sum(axis=1)
+    # integer-exact dominance trigger: c/(w+d+1) > 0.5 ⟺ 2c > w+d+1
+    # (kindel.py:182-185,229-238); w counts all 5 channels (aligned_depth)
+    denom = w_sum + deletions + 1
+    trig_fwd = (2 * csd > denom) & valid
+    trig_rev = (2 * ced > denom) & valid
+    return wire + dense + (
+        jnp.packbits(trig_fwd)[None],
+        jnp.packbits(trig_rev)[None],
+        csw[None],
+        cew[None],
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("mesh", "block", "L", "axis", "realign"),
+)
+def _product_jit(
+    op_start, op_off, base_packed, n_ev,
+    del_pos, ins_pos, ins_cnt,
+    csw_pos, csw_base, cew_pos, cew_base,
+    min_depth,
+    *, mesh: Mesh, block: int, L: int, axis: str, realign: bool,
+):
+    fn = partial(
+        _reduce_and_call_local, block=block, L=L, axis=axis, realign=realign
+    )
+    row = P(axis, None)
+    wire_specs = (row,) * 5 + (P(axis), P(axis))
+    dense_specs = (P(axis, None, None), row, row)
+    out_specs = wire_specs + dense_specs
+    if realign:
+        out_specs = out_specs + (
+            row, row, P(axis, None, None), P(axis, None, None)
+        )
+    mapped = jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(row,) * 3 + (P(axis),) + (row,) * 7 + (P(),),
+        out_specs=out_specs,
+    )
+    outs = mapped(
+        op_start, op_off, base_packed, n_ev,
+        del_pos, ins_pos, ins_cnt,
+        csw_pos, csw_base, cew_pos, cew_base,
+        min_depth,
+    )
+    n = mesh.shape[axis]
+    Lp = n * block
+    (plane, nchar_b, del_b, n_b, ins_b, dmin, dmax,
+     weights, deletions, ins_totals, *rest) = outs
+    flat = {
+        "plane": plane.reshape(Lp // 4),
+        "nchar_bits": nchar_b.reshape(Lp // 8),
+        "del_bits": del_b.reshape(Lp // 8),
+        "n_bits": n_b.reshape(Lp // 8),
+        "ins_bits": ins_b.reshape(Lp // 8),
+        "dmin": dmin.min(),
+        "dmax": dmax.max(),
+        "weights": weights.reshape(Lp, N_CHANNELS),
+        "deletions": deletions.reshape(Lp),
+        "ins_totals": ins_totals.reshape(Lp),
+    }
+    if realign:
+        trig_f, trig_r, csw, cew = rest
+        flat["trig_fwd_bits"] = trig_f.reshape(Lp // 8)
+        flat["trig_rev_bits"] = trig_r.reshape(Lp // 8)
+        flat["csw"] = csw.reshape(Lp, N_CHANNELS)
+        flat["cew"] = cew.reshape(Lp, N_CHANNELS)
+    return flat
+
+
+@partial(jax.jit, static_argnames=("chunk",))
+def _fetch1d(arr, start, *, chunk: int):
+    return jax.lax.dynamic_slice(arr, (start,), (chunk,))
+
+
+@partial(jax.jit, static_argnames=("chunk",))
+def _fetch2d(arr, start, *, chunk: int):
+    return jax.lax.dynamic_slice(arr, (start, 0), (chunk, arr.shape[1]))
+
+
+class ShardedRef:
+    """Device-resident sharded pileup + call for one reference.
+
+    Construction uploads the bucketed event streams and runs the single
+    fused reduce+call jit; the dense channel tensors stay sharded on
+    device, reachable only through chunked window fetches.
+    """
+
+    def __init__(self, ev: EventSet, rid: int, mesh: Mesh,
+                 min_depth: int = 1, realign: bool = False,
+                 axis: str = "sp"):
+        self.L = L = int(ev.ref_lens[rid])
+        self.ref_id = ev.ref_names[rid]
+        n = self.n_shards = mesh.shape[axis]
+        # block: ceil(L/n) rounded up to a multiple of 8 so the per-shard
+        # packbits/plane lanes stay byte-aligned
+        block = -(-L // n)
+        self.block = block = -(-block // 8) * 8
+        self.Lp = n * block
+        self.realign = realign
+
+        sel = ev.match_rid == rid
+        op_start, op_off, base_packed, n_ev = split_match_spans(
+            ev.match_pos[sel], ev.match_base[sel], n, block
+        )
+
+        dpos = ev.del_pos[ev.del_rid == rid]
+        del_b, _ = bucket_events_by_position(dpos[dpos < L], [], n, block)
+
+        self.ins_table = build_insertion_table(ev, rid)
+        isel = self.ins_table.pos < L
+        ins_b, (icnt_b,) = bucket_events_by_position(
+            self.ins_table.pos[isel],
+            [self.ins_table.count[isel].astype(np.int64)],
+            n, block,
+        )
+
+        def weighted_buckets(rsel, pos, base):
+            s = rsel == rid
+            p, b = pos[s], base[s].astype(np.int64)
+            pb, (bb,) = bucket_events_by_position(p, [b], n, block)
+            return pb, bb
+
+        if realign:
+            csw_b, cswb_b = weighted_buckets(
+                ev.csw_rid, ev.csw_pos, ev.csw_base
+            )
+            cew_b, cewb_b = weighted_buckets(
+                ev.cew_rid, ev.cew_pos, ev.cew_base
+            )
+        else:
+            empty = np.full((n, 16), PAD_POS, np.int32)
+            csw_b = cew_b = empty
+            cswb_b = cewb_b = np.zeros((n, 16), np.int32)
+
+        with mesh:
+            self._out = _product_jit(
+                jnp.asarray(op_start), jnp.asarray(op_off),
+                jnp.asarray(base_packed), jnp.asarray(n_ev),
+                jnp.asarray(del_b),
+                jnp.asarray(ins_b), jnp.asarray(icnt_b),
+                jnp.asarray(csw_b), jnp.asarray(cswb_b),
+                jnp.asarray(cew_b), jnp.asarray(cewb_b),
+                jnp.int32(min_depth),
+                mesh=mesh, block=block, L=L, axis=axis, realign=realign,
+            )
+        self._chunk = min(4096, self.Lp)
+
+    # ---- wire-format decode ------------------------------------------------
+
+    def _bits(self, key: str) -> np.ndarray:
+        return np.unpackbits(np.asarray(self._out[key]))[: self.L].astype(bool)
+
+    def call_masks(self) -> CallMasks:
+        plane = np.asarray(self._out["plane"])
+        lanes = np.empty(plane.shape[0] * 4, dtype=np.uint8)
+        lanes[0::4] = plane >> 6
+        lanes[1::4] = (plane >> 4) & 3
+        lanes[2::4] = (plane >> 2) & 3
+        lanes[3::4] = plane & 3
+        base_char = EMIT_ASCII[1:5][lanes[: self.L]]
+        nchar = self._bits("nchar_bits")
+        base_char = np.where(nchar, EMIT_ASCII[N_CHANNELS], base_char)
+        return CallMasks(
+            base_char=base_char,
+            del_mask=self._bits("del_bits"),
+            n_mask=self._bits("n_bits"),
+            ins_mask=self._bits("ins_bits"),
+        )
+
+    def depth_scalars(self) -> tuple[int, int]:
+        return int(self._out["dmin"]), int(self._out["dmax"])
+
+    # ---- realign sparse access --------------------------------------------
+
+    def trigger_positions(self) -> tuple[np.ndarray, np.ndarray]:
+        return (
+            np.flatnonzero(self._bits("trig_fwd_bits")),
+            np.flatnonzero(self._bits("trig_rev_bits")),
+        )
+
+    def _window(self, key: str, a: int, b: int) -> np.ndarray:
+        """Download [a,b) of a device-resident channel via fixed-size
+        jitted dynamic-slice fetches (compile-once per shape)."""
+        arr = self._out[key]
+        chunk = self._chunk
+        fetch = _fetch2d if arr.ndim == 2 else _fetch1d
+        parts = []
+        s = a
+        while s < b:
+            # dynamic_slice clamps the start so the window stays in range
+            start = min(s, self.Lp - chunk)
+            win = np.asarray(fetch(arr, jnp.int32(start), chunk=chunk))
+            e = min(b, start + chunk)
+            parts.append(win[s - start : e - start])
+            s = e
+        return (
+            np.concatenate(parts)
+            if parts
+            else np.empty((0,) + arr.shape[1:], np.int32)
+        )
+
+    def _cond_fetch(self, clip_key: str, threshold: float):
+        """Decay condition csd > (w+d)·threshold over a window, evaluated
+        host-side in float64 from integer windows — bit-identical to the
+        eager path (realign.py cdr_*_consensuses)."""
+
+        def fetch(a: int, b: int) -> np.ndarray:
+            clip = self._window(clip_key, a, b)[:, :4].sum(axis=1)
+            w = self._window("weights", a, b).sum(axis=1)
+            d = self._window("deletions", a, b)
+            return clip.astype(np.float64) > (
+                w.astype(np.float64) + d.astype(np.float64)
+            ) * threshold
+
+        return fetch
+
+    def cdr_patches(self, clip_decay_threshold: float, mask_ends: int,
+                    min_overlap: int):
+        """Full CDR pipeline through the sharded tensors: sparse candidate
+        discovery → lazy decay walks → pairing → LCS merge (host)."""
+        trig_f, trig_r = self.trigger_positions()
+        fwd = cdr_start_consensuses_lazy(
+            self.L, trig_f,
+            self._cond_fetch("csw", clip_decay_threshold),
+            lambda a, b: self._window("csw", a, b),
+            mask_ends,
+        )
+        rev = cdr_end_consensuses_lazy(
+            self.L, trig_r[::-1],
+            self._cond_fetch("cew", clip_decay_threshold),
+            lambda a, b: self._window("cew", a, b),
+            mask_ends,
+        )
+        return merge_cdrps(pair_regions(fwd, rev), min_overlap)
+
+
+def sharded_consensus(
+    ev: EventSet,
+    rid: int,
+    mesh: Mesh | None = None,
+    realign: bool = False,
+    min_depth: int = 1,
+    min_overlap: int = 9,
+    clip_decay_threshold: float = 0.1,
+    mask_ends: int = 50,
+    trim_ends: bool = False,
+    uppercase: bool = False,
+    build_changes: bool = True,
+    axis: str = "sp",
+):
+    """Position-sharded equivalent of call_jax.call_consensus_fused +
+    the optional realign pipeline.
+
+    Returns (CallResult, depth_min, depth_max, cdr_patches).
+    """
+    if mesh is None:
+        mesh = make_mesh()
+    sr = ShardedRef(
+        ev, rid, mesh, min_depth=min_depth, realign=realign, axis=axis
+    )
+    cdr_patches = (
+        sr.cdr_patches(clip_decay_threshold, mask_ends, min_overlap)
+        if realign
+        else None
+    )
+    masks = sr.call_masks()
+    ins_calls = (
+        _insertion_calls(sr.ins_table) if masks.ins_mask.any() else {}
+    )
+    res: CallResult = assemble(
+        masks, ins_calls, cdr_patches, trim_ends, min_depth, uppercase,
+        build_changes,
+    )
+    dmin, dmax = sr.depth_scalars()
+    return res, dmin, dmax, cdr_patches

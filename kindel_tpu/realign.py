@@ -48,41 +48,141 @@ def _in_claimed(pos: int, claimed: list[tuple[int, int]]) -> bool:
     return any(s <= pos < e for s, e in claimed)
 
 
-def cdr_start_consensuses(pileup: Pileup, clip_decay_threshold: float,
-                          mask_ends: int) -> list[Region]:
-    """Rightward ('→') clip consensuses (reference kindel.py:156-213)."""
-    L = pileup.ref_len
+# ---------------------------------------------------------------------------
+# Lazy CDR core — shared by the eager (whole-pileup-in-RAM) path and the
+# position-sharded device path (kindel_tpu.parallel.product). The core walks
+# the decay condition and reads clip-weight windows through fetch callables,
+# so the sharded backend only downloads the few KB around each candidate
+# instead of dense [L,5] tensors.
+# ---------------------------------------------------------------------------
+
+_WALK_CHUNK = 4096
+
+
+def _leading_true_run(cond_fetch, start: int, stop: int) -> tuple[int, bool]:
+    """Length of the leading all-True run of cond over [start, stop) and
+    whether a False terminated it (vs the range being exhausted)."""
+    n, a = 0, start
+    while a < stop:
+        b = min(a + _WALK_CHUNK, stop)
+        c = cond_fetch(a, b)
+        fail = np.flatnonzero(~c)
+        if len(fail):
+            return n + int(fail[0]), True
+        n += b - a
+        a = b
+    return n, False
+
+
+def _leading_true_run_rev(cond_fetch, pos: int) -> tuple[int, bool]:
+    """Like _leading_true_run but over the reversed head
+    [cond[pos-1], cond[pos-2], ..., cond[0]]."""
+    n, b = 0, pos
+    while b > 0:
+        a = max(0, b - _WALK_CHUNK)
+        c = cond_fetch(a, b)[::-1]
+        fail = np.flatnonzero(~c)
+        if len(fail):
+            return n + int(fail[0]), True
+        n += b - a
+        b = a
+    return n, False
+
+
+def cdr_start_consensuses_lazy(L: int, trigger_pos, cond_fetch,
+                               clip_block_fetch,
+                               mask_ends: int) -> list[Region]:
+    """Rightward ('→') scan over pre-computed trigger candidates.
+
+    trigger_pos: ascending positions where clip-start depth dominates
+    (reference kindel.py:182-185; integer-exact: csd/(w+d+1) > 0.5 ⟺
+    2·csd > w+d+1). cond_fetch(a,b) -> bool[b-a] is the decay condition
+    csd > (w+d)·threshold over [a,b); clip_block_fetch(a,b) -> int[k,5]
+    reads the clip_start_weights window."""
     regions: list[Region] = []
     if _masked_all(mask_ends, L):
         return regions
-    csd = pileup.clip_start_depth.astype(np.float64)
-    w_sum = pileup.aligned_depth.astype(np.float64)
-    d = pileup.deletions[:L].astype(np.float64)
-    trigger = csd / (w_sum + d + 1.0) > 0.5
-    trigger[:mask_ends] = False
-    trigger[L - mask_ends :] = False
-    # decay condition: csd > (aligned incl. N + deletions) * threshold; the
-    # reference's sum(w_.values(), d_) feeds deletions via sum()'s start arg
-    # (kindel.py:202; SURVEY §2.1)
-    cond = csd > (w_sum + d) * clip_decay_threshold
     claimed: list[tuple[int, int]] = []
-    for pos in np.flatnonzero(trigger):
+    for pos in trigger_pos:
         pos = int(pos)
+        if pos < mask_ends or pos >= L - mask_ends:
+            continue
         if _in_claimed(pos, claimed):
             continue
-        tail = cond[pos:]
-        fail = np.flatnonzero(~tail)
-        if len(fail):
-            ext = int(fail[0])
-            end_pos = pos + ext  # failing position (kindel.py:198)
-        else:
-            ext = L - pos
-            end_pos = L - 1  # loop exhausted without break
-        seq = _span_consensus(pileup.clip_start_weights[pos : pos + ext])
+        ext, found = _leading_true_run(cond_fetch, pos, L)
+        # found: end is the failing position (kindel.py:198); otherwise the
+        # loop exhausted without break and the end clamps to L-1
+        end_pos = pos + ext if found else L - 1
+        seq = _span_consensus(clip_block_fetch(pos, pos + ext))
         regions.append(Region(pos, end_pos, seq, "→"))
         claimed.append((pos, end_pos))
         logging.debug(regions[-1])
     return regions
+
+
+def cdr_end_consensuses_lazy(L: int, trigger_pos_desc, cond_fetch,
+                             clip_block_fetch,
+                             mask_ends: int) -> list[Region]:
+    """Leftward ('←') scan (reference kindel.py:216-275), descending over
+    trigger candidates; fetches mirror cdr_start_consensuses_lazy but read
+    clip-end channels."""
+    regions: list[Region] = []
+    if _masked_all(mask_ends, L):
+        return regions
+    claimed: list[tuple[int, int]] = []
+    for pos in trigger_pos_desc:
+        pos = int(pos)
+        if pos < mask_ends or pos >= L - mask_ends:
+            continue
+        if _in_claimed(pos, claimed):
+            continue
+        end_pos = pos + 1
+        # extension walks pos-1, pos-2, ... 0; find first failing index
+        n_acc, found = _leading_true_run_rev(cond_fetch, pos)
+        if found:
+            start_pos = pos - 1 - n_acc  # failing position (kindel.py:252)
+        else:
+            start_pos = 0 if pos else pos  # exhausted (or no iterations)
+        if n_acc:
+            # accepted span ascends pos-n_acc .. pos-1, plus the one-base lag
+            # compensation at pos (kindel.py:257-261), reversed to ascending:
+            seq = _span_consensus(clip_block_fetch(pos - n_acc, pos + 1))
+        else:
+            seq = ""
+        regions.append(Region(start_pos, end_pos, seq, "←"))
+        claimed.append((start_pos, end_pos))
+        logging.debug(regions[-1])
+    return regions
+
+
+def _eager_trigger(clip_depth, w_sum, d, L, mask_ends):
+    """Dominance trigger over full arrays (reference kindel.py:182-185)."""
+    trigger = clip_depth / (w_sum + d + 1.0) > 0.5
+    trigger[:mask_ends] = False
+    trigger[L - mask_ends :] = False
+    return np.flatnonzero(trigger)
+
+
+def cdr_start_consensuses(pileup: Pileup, clip_decay_threshold: float,
+                          mask_ends: int) -> list[Region]:
+    """Rightward ('→') clip consensuses (reference kindel.py:156-213)."""
+    L = pileup.ref_len
+    if _masked_all(mask_ends, L):
+        return []
+    csd = pileup.clip_start_depth.astype(np.float64)
+    w_sum = pileup.aligned_depth.astype(np.float64)
+    d = pileup.deletions[:L].astype(np.float64)
+    # decay condition: csd > (aligned incl. N + deletions) * threshold; the
+    # reference's sum(w_.values(), d_) feeds deletions via sum()'s start arg
+    # (kindel.py:202; SURVEY §2.1)
+    cond = csd > (w_sum + d) * clip_decay_threshold
+    return cdr_start_consensuses_lazy(
+        L,
+        _eager_trigger(csd, w_sum, d, L, mask_ends),
+        lambda a, b: cond[a:b],
+        lambda a, b: pileup.clip_start_weights[a:b],
+        mask_ends,
+    )
 
 
 def cdr_end_consensuses(pileup: Pileup, clip_decay_threshold: float,
@@ -90,43 +190,19 @@ def cdr_end_consensuses(pileup: Pileup, clip_decay_threshold: float,
     """Leftward ('←') clip consensuses from a reverse scan
     (reference kindel.py:216-275)."""
     L = pileup.ref_len
-    regions: list[Region] = []
     if _masked_all(mask_ends, L):
-        return regions
+        return []
     ced = pileup.clip_end_depth.astype(np.float64)
     w_sum = pileup.aligned_depth.astype(np.float64)
     d = pileup.deletions[:L].astype(np.float64)
-    trigger = ced / (w_sum + d + 1.0) > 0.5
-    trigger[:mask_ends] = False
-    trigger[L - mask_ends :] = False
     cond = ced > (w_sum + d) * clip_decay_threshold
-    claimed: list[tuple[int, int]] = []
-    for pos in np.flatnonzero(trigger)[::-1]:
-        pos = int(pos)
-        if _in_claimed(pos, claimed):
-            continue
-        end_pos = pos + 1
-        # extension walks pos-1, pos-2, ... 0; find first failing index
-        head = cond[:pos][::-1]  # cond at pos-1, pos-2, ...
-        fail = np.flatnonzero(~head)
-        if len(fail):
-            n_acc = int(fail[0])  # accepted count
-            start_pos = pos - 1 - n_acc  # failing position (kindel.py:252)
-        else:
-            n_acc = pos
-            start_pos = 0 if pos else pos  # exhausted (or no iterations)
-        if n_acc:
-            # accepted span ascends pos-n_acc .. pos-1, plus the one-base lag
-            # compensation at pos (kindel.py:257-261), reversed to ascending:
-            seq = _span_consensus(
-                pileup.clip_end_weights[pos - n_acc : pos + 1]
-            )
-        else:
-            seq = ""
-        regions.append(Region(start_pos, end_pos, seq, "←"))
-        claimed.append((start_pos, end_pos))
-        logging.debug(regions[-1])
-    return regions
+    return cdr_end_consensuses_lazy(
+        L,
+        _eager_trigger(ced, w_sum, d, L, mask_ends)[::-1],
+        lambda a, b: cond[a:b],
+        lambda a, b: pileup.clip_end_weights[a:b],
+        mask_ends,
+    )
 
 
 def cdrp_consensuses(pileup_or_weights, deletions=None, clip_start_weights=None,
@@ -148,6 +224,13 @@ def cdrp_consensuses(pileup_or_weights, deletions=None, clip_start_weights=None,
         )
     fwd = cdr_start_consensuses(pileup, clip_decay_threshold, mask_ends)
     rev = cdr_end_consensuses(pileup, clip_decay_threshold, mask_ends)
+    return pair_regions(fwd, rev)
+
+
+def pair_regions(fwd: list[Region],
+                 rev: list[Region]) -> list[tuple[Region, Region]]:
+    """Each '→' region pairs with the first '←' region whose span
+    intersects it (reference kindel.py:310-316)."""
     pairs: list[tuple[Region, Region]] = []
     for f in fwd:
         for r in rev:

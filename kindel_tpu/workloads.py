@@ -26,6 +26,18 @@ result = namedtuple("result", ["consensuses", "refs_changes", "refs_reports"])
 BACKENDS = ("numpy", "jax", "pallas")
 
 
+def _shardable_device_count() -> int:
+    """Visible jax devices for auto-sharding the position axis; 0 disables
+    (KINDEL_TPU_FORCE_FUSED=1 keeps the single-device fused kernel)."""
+    import os
+
+    if os.environ.get("KINDEL_TPU_FORCE_FUSED"):
+        return 0
+    import jax
+
+    return len(jax.devices())
+
+
 def _load_pileups(bam_path, backend: str) -> dict[str, Pileup]:
     ev = extract_events(load_alignment(bam_path))
     if backend == "jax":
@@ -105,8 +117,34 @@ def bam_to_consensus(
     with maybe_phase("event extraction"):
         ev = extract_events(batch)
 
+    n_dev = _shardable_device_count() if backend == "jax" else 0
     for rid in ev.present_ref_ids:
         ref_id = ev.ref_names[rid]
+        if n_dev > 1 and int(ev.ref_lens[rid]) >= n_dev:
+            # Position-sharded product path: every channel reduces on its
+            # shard's device, the call runs on device with a ppermute halo,
+            # and realign walks the device-resident clip tensors sparsely
+            # (kindel_tpu.parallel.product; SURVEY §5's headline axis).
+            from kindel_tpu.parallel.product import sharded_consensus
+
+            with maybe_phase(f"sharded call+assemble [{ref_id}]"):
+                res, depth_min, depth_max, cdr_patches = sharded_consensus(
+                    ev, rid, realign=realign, min_depth=min_depth,
+                    min_overlap=min_overlap,
+                    clip_decay_threshold=clip_decay_threshold,
+                    mask_ends=mask_ends, trim_ends=trim_ends,
+                    uppercase=uppercase,
+                )
+            refs_reports[ref_id] = build_report(
+                ref_id, depth_min, depth_max, res.changes, cdr_patches,
+                bam_path, realign, min_depth, min_overlap,
+                clip_decay_threshold, trim_ends, uppercase,
+            )
+            refs_changes[ref_id] = res.changes
+            consensuses.append(
+                Sequence(name=f"{ref_id}_cns", sequence=res.sequence)
+            )
+            continue
         if realign or backend != "jax":
             # realign's CDR detection consumes the full clip tensors —
             # tiny event counts, reduced host-side even under the jax

@@ -1,0 +1,181 @@
+"""Position-sharded product path (kindel_tpu.parallel.product).
+
+The contract (VERDICT r1, next-round item 3): non-realign AND realign
+consensus must be byte-identical through the sharded path on the 8-device
+CPU mesh — sequence, changes, and report text — against the numpy oracle,
+which itself is pinned to the reference by the golden and differential
+suites.
+"""
+
+import os
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+
+from kindel_tpu.events import extract_events
+from kindel_tpu.io import load_alignment
+from kindel_tpu.parallel import make_mesh, sharded_consensus, split_match_spans
+from kindel_tpu.parallel.product import ShardedRef
+from kindel_tpu.workloads import bam_to_consensus
+
+
+# NB: not imported from conftest — importing `tests.conftest` would execute
+# the module body a second time under a new name (relay probe, re-exec
+# guard, jax-import watchdog).
+_DATA_ROOT = Path(
+    os.environ.get("KINDEL_TPU_TEST_DATA", "/root/reference/tests")
+)
+
+
+def require_data(*rel) -> Path:
+    path = _DATA_ROOT.joinpath(*rel)
+    if not path.exists():
+        pytest.skip(f"golden corpus not available: {path}")
+    return path
+
+
+def _events(path):
+    return extract_events(load_alignment(path))
+
+
+# ---------------------------------------------------------------------------
+# split_match_spans unit behavior
+# ---------------------------------------------------------------------------
+
+
+def test_split_match_spans_reconstructs_counts():
+    rng = np.random.default_rng(7)
+    L, n, block = 1000, 4, 256  # Lp=1024
+    # spans of varying length, some crossing block boundaries
+    starts = rng.integers(0, L - 60, size=50)
+    lens = rng.integers(1, 60, size=50)
+    mp = np.concatenate([np.arange(s, s + l) for s, l in zip(starts, lens)])
+    mb = rng.integers(0, 5, size=len(mp)).astype(np.uint8)
+
+    op_start, op_off, base_packed, n_ev = split_match_spans(mp, mb, n, block)
+    assert int(n_ev.sum()) == len(mp)
+
+    # reconstruct (pos, base) multiset per shard and compare to a direct
+    # host bincount of the same events
+    expect = np.zeros((n * block, 5), np.int64)
+    np.add.at(expect, (mp, mb.astype(np.int64)), 1)
+    got = np.zeros((n * block, 5), np.int64)
+    for s in range(n):
+        E = int(n_ev[s])
+        bases = np.empty(base_packed.shape[1] * 2, np.uint8)
+        bases[0::2] = base_packed[s] >> 4
+        bases[1::2] = base_packed[s] & 0xF
+        offs = op_off[s]
+        for j in range(op_start.shape[1]):
+            if op_start[s, j] >= block:  # padding (PAD_POS)
+                continue
+            end = min(offs[j + 1] if j + 1 < len(offs) else E, E)
+            for i in range(offs[j], end):
+                pos = s * block + op_start[s, j] + (i - offs[j])
+                got[pos, bases[i]] += 1
+    assert np.array_equal(got, expect)
+
+
+def test_split_match_spans_empty():
+    op_start, op_off, base_packed, n_ev = split_match_spans(
+        np.empty(0, np.int64), np.empty(0, np.uint8), 4, 64
+    )
+    assert n_ev.sum() == 0
+    assert op_start.shape[0] == 4
+
+
+# ---------------------------------------------------------------------------
+# ShardedRef counts equal the host pileup
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_counts_match_host_pileup():
+    from kindel_tpu.pileup import build_pileup
+
+    bam = require_data("data_bwa_mem", "1.1.sub_test.bam")
+    ev = _events(bam)
+    rid = ev.present_ref_ids[0]
+    host = build_pileup(ev, rid)
+    mesh = make_mesh()
+    sr = ShardedRef(ev, rid, mesh, realign=True)
+    L = sr.L
+    assert np.array_equal(sr._window("weights", 0, L), host.weights)
+    assert np.array_equal(sr._window("deletions", 0, L), host.deletions[:L])
+    assert np.array_equal(sr._window("csw", 0, L), host.clip_start_weights)
+    assert np.array_equal(sr._window("cew", 0, L), host.clip_end_weights)
+    assert np.array_equal(
+        sr._window("ins_totals", 0, L), host.ins.totals[:L].astype(np.int32)
+    )
+    dmin, dmax = sr.depth_scalars()
+    acgt = host.acgt_depth
+    assert (dmin, dmax) == (int(acgt.min()), int(acgt.max()))
+
+
+# ---------------------------------------------------------------------------
+# end-to-end byte identity vs the numpy oracle
+# ---------------------------------------------------------------------------
+
+BWA = ["1.1", "2.1", "3.1", "4.1", "5.1", "6.1"]
+
+
+def _assert_products_equal(a, b):
+    assert [s.sequence for s in a.consensuses] == [
+        s.sequence for s in b.consensuses
+    ]
+    assert a.refs_changes == b.refs_changes
+    assert a.refs_reports == b.refs_reports
+
+
+@pytest.mark.parametrize("sample", BWA)
+@pytest.mark.parametrize("realign", [False, True])
+def test_sharded_matches_numpy_bwa(sample, realign):
+    bam = require_data("data_bwa_mem", f"{sample}.sub_test.bam")
+    assert len(jax.devices()) == 8  # the virtual CPU mesh must be active
+    got = bam_to_consensus(bam, realign=realign, backend="jax")
+    want = bam_to_consensus(bam, realign=realign, backend="numpy")
+    _assert_products_equal(got, want)
+
+
+@pytest.mark.parametrize("realign", [False, True])
+def test_sharded_matches_numpy_multicontig(realign):
+    bam = require_data("data_minimap2", "1.1.multi.bam")
+    got = bam_to_consensus(bam, realign=realign, backend="jax")
+    want = bam_to_consensus(bam, realign=realign, backend="numpy")
+    _assert_products_equal(got, want)
+
+
+def test_sharded_matches_numpy_ext_sam():
+    sam = require_data("data_ext", "1.issue23.debug.sam")
+    got = bam_to_consensus(sam, realign=True, backend="jax")
+    want = bam_to_consensus(sam, realign=True, backend="numpy")
+    _assert_products_equal(got, want)
+
+
+def test_sharded_direct_small_ref():
+    """Direct sharded_consensus on a tiny reference (L barely >= devices):
+    blocks are minimal and mostly padding."""
+    bam = require_data("data_minimap2", "1.1.multi.bam")
+    ev = _events(bam)
+    mesh = make_mesh()
+    for rid in ev.present_ref_ids:
+        from kindel_tpu.call import call_consensus
+        from kindel_tpu.pileup import build_pileup
+
+        res, dmin, dmax, _ = sharded_consensus(ev, rid, mesh)
+        want = call_consensus(build_pileup(ev, rid))
+        assert res.sequence == want.sequence
+        assert res.changes == want.changes
+
+
+def test_sharded_mask_ends_zero_disables_realign_regions():
+    """mask_ends=0 masks every position (reference kindel.py:168 quirk) —
+    the sharded realign path must produce no patches."""
+    bam = require_data("data_bwa_mem", "1.1.sub_test.bam")
+    ev = _events(bam)
+    rid = ev.present_ref_ids[0]
+    mesh = make_mesh()
+    sr = ShardedRef(ev, rid, mesh, realign=True)
+    assert sr.cdr_patches(0.1, 0, 7) == []
