@@ -1,0 +1,152 @@
+"""Flat-RSS demonstration for the streamed single-file decode.
+
+Synthesizes a large BAM (vectorized — fixed-length reads, BGZF-compatible
+gzip members), then measures peak RSS and wall time for the slurped vs the
+streamed consensus path in separate child processes.
+
+    python benchmarks/rss_stream.py [--gb 1.0] [--chunk-mb 64]
+
+Prints one JSON line per mode: {"mode", "max_rss_mb", "wall_s", "mbases"}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import gzip
+import json
+import os
+import struct
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+READ_LEN = 140
+REC_BYTES = 252  # 4 block_size + 32 fixed + 2 name + 4 cigar + 70 seq + 140 qual
+
+
+def synthesize(path: Path, target_bytes: int, ref_len: int = 6_097_032,
+               seed: int = 0) -> int:
+    """Write a gzip-member-chunked BAM of ~target_bytes decompressed size.
+    Returns the read count. All reads are 140M (fixed CIGAR) with random
+    positions/sequences — the same shape as the bacterial benchmark."""
+    n_reads = max(target_bytes // REC_BYTES, 1)
+    rng = np.random.default_rng(seed)
+
+    name = b"SYNTH1\x00"
+    header_text = f"@SQ\tSN:SYNTH1\tLN:{ref_len}\n".encode()
+    hdr = b"BAM\x01" + struct.pack("<i", len(header_text)) + header_text
+    hdr += struct.pack("<i", 1)
+    hdr += struct.pack("<i", len(name)) + name + struct.pack("<i", ref_len)
+
+    fixed = np.zeros((1, REC_BYTES), dtype=np.uint8)
+    fixed[0, 0:4] = np.frombuffer(
+        struct.pack("<i", REC_BYTES - 4), dtype=np.uint8
+    )
+    # refID=0, pos filled later, l_read_name=2, mapq=60, bin=0, n_cigar=1,
+    # flag=0, l_seq, next_refID=-1, next_pos=-1, tlen=0
+    body = struct.pack(
+        "<iiBBHHHiiii", 0, 0, 2, 60, 0, 1, 0, READ_LEN, -1, -1, 0
+    )
+    fixed[0, 4:36] = np.frombuffer(body, dtype=np.uint8)
+    fixed[0, 36:38] = np.frombuffer(b"r\x00", dtype=np.uint8)
+    fixed[0, 38:42] = np.frombuffer(
+        struct.pack("<I", (READ_LEN << 4) | 0), dtype=np.uint8
+    )
+    fixed[0, 112:252] = 0xFF  # qual
+
+    nib_codes = np.array([1, 2, 4, 8], dtype=np.uint8)  # A C G T
+
+    with open(path, "wb") as fh:
+        fh.write(gzip.compress(hdr, 1))
+        batch = 200_000
+        done = 0
+        while done < n_reads:
+            b = min(batch, n_reads - done)
+            out = np.repeat(fixed, b, axis=0)
+            pos = rng.integers(
+                0, ref_len - READ_LEN, size=b, dtype=np.int32
+            )
+            out[:, 8:12] = pos.view(np.uint8).reshape(b, 4)
+            nibs = nib_codes[
+                rng.integers(0, 4, size=(b, READ_LEN), dtype=np.int8)
+            ]
+            out[:, 42:112] = (nibs[:, 0::2] << 4) | nibs[:, 1::2]
+            fh.write(gzip.compress(out.tobytes(), 1))
+            done += b
+    return int(n_reads)
+
+
+_CHILD = r"""
+import json, resource, sys, time
+sys.path.insert(0, {repo!r})
+from kindel_tpu.workloads import bam_to_consensus
+t0 = time.perf_counter()
+res = bam_to_consensus({bam!r}, backend={backend!r},
+                       stream_chunk_mb={chunk!r})
+wall = time.perf_counter() - t0
+rss_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
+print(json.dumps({{"mode": {mode!r}, "max_rss_mb": round(rss_mb, 1),
+                  "wall_s": round(wall, 2),
+                  "mbases": round(len(res.consensuses[0].sequence) / 1e6, 2)}}))
+"""
+
+
+def measure(bam: Path, mode: str, backend: str, chunk_mb) -> dict:
+    code = _CHILD.format(
+        repo=str(REPO), bam=str(bam), backend=backend, chunk=chunk_mb,
+        mode=mode,
+    )
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    # keep autostream out of the slurp arm
+    env["KINDEL_TPU_STREAM_THRESHOLD_MB"] = "1000000"
+    out = subprocess.run(
+        [sys.executable, "-c", code], env=env, capture_output=True,
+        text=True, check=True,
+    )
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    print(json.dumps(rec))
+    return rec
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--gb", type=float, default=1.0,
+                    help="decompressed size of the synthetic BAM")
+    ap.add_argument("--chunk-mb", type=float, default=64.0)
+    ap.add_argument("--backend", default="jax")
+    ap.add_argument("--keep", action="store_true")
+    args = ap.parse_args()
+
+    bam = Path("/tmp/kindel_tpu_rss_synth.bam")
+    target = int(args.gb * (1 << 30))
+    if not bam.exists() or abs(bam.stat().st_size * 3 - target) > target:
+        t0 = time.perf_counter()
+        n = synthesize(bam, target)
+        print(
+            f"# synthesized {n} reads, {bam.stat().st_size / 1e6:.0f} MB "
+            f"compressed in {time.perf_counter() - t0:.1f}s",
+            file=sys.stderr,
+        )
+
+    slurp = measure(bam, "slurp", args.backend, None)
+    stream = measure(bam, "stream", args.backend, args.chunk_mb)
+    ratio = slurp["max_rss_mb"] / max(stream["max_rss_mb"], 1)
+    print(
+        f"# rss {slurp['max_rss_mb']:.0f} -> {stream['max_rss_mb']:.0f} MB "
+        f"({ratio:.1f}x), wall {slurp['wall_s']} -> {stream['wall_s']} s",
+        file=sys.stderr,
+    )
+    if not args.keep:
+        bam.unlink()
+
+
+if __name__ == "__main__":
+    main()

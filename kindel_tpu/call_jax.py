@@ -106,7 +106,19 @@ def _call_core(
     ins_totals = (
         jnp.zeros(length, jnp.int32).at[ins_pos].add(ins_cnt, mode="drop")
     )
+    return _decide(
+        weights, deletions, ins_totals, del_pos, ins_pos, min_depth,
+        want_masks,
+    )
 
+
+def _decide(weights, deletions, ins_totals, del_pos, ins_pos, min_depth,
+            want_masks: bool):
+    """Per-position call decisions + wire-format packing over count
+    tensors — the second half of _call_core, shared with the streamed
+    counts-input kernel (counts_call_kernel). del_pos/ins_pos feed the
+    fast path's sparse flag gathers only (unused when want_masks)."""
+    length = weights.shape[0]
     acgt_depth = weights[:, :4].sum(axis=1)
     depth_next = jnp.concatenate([acgt_depth[1:], jnp.zeros(1, jnp.int32)])
 
@@ -176,6 +188,19 @@ def fused_call_kernel(op_r_start, op_off, base_packed, del_pos, ins_pos,
     )
 
 
+@jax.jit
+def counts_call_kernel(weights, deletions, ins_totals, min_depth):
+    """Call decisions straight from device-resident count tensors — the
+    closing step of the streamed-accumulation path (kindel_tpu.streaming),
+    where the scatters already happened chunk-by-chunk. Always the masks
+    wire format (emit codes + three bitmasks; no sparse positions needed)."""
+    empty = jnp.zeros(0, jnp.int32)
+    return _decide(
+        weights, deletions, ins_totals, empty, empty, min_depth,
+        want_masks=True,
+    )
+
+
 @partial(jax.jit, static_argnames=("length",))
 def batched_call_kernel(op_r_start, op_off, base_packed, del_pos, ins_pos,
                         ins_cnt, n_events, min_depth, *, length: int):
@@ -203,6 +228,21 @@ def unpack_emit(emit_packed: np.ndarray, L: int) -> np.ndarray:
     emit[0::2] = emit_packed >> 4
     emit[1::2] = emit_packed & 0xF
     return emit[:L]
+
+
+def masks_from_wire(emit_packed, masks_packed, L: int):
+    """Decode the masks wire format (4-bit emit codes + three packed
+    bitmasks) into (emit_codes, CallMasks) — shared by device_call and
+    the streamed counts path (kindel_tpu.streaming)."""
+    emit = unpack_emit(np.asarray(emit_packed), L)
+    db, nb, ib = (np.asarray(x) for x in masks_packed)
+    masks = CallMasks(
+        base_char=EMIT_ASCII[np.where(emit == 0, N_CHANNELS, emit)],
+        del_mask=np.unpackbits(db)[:L].astype(bool),
+        n_mask=np.unpackbits(nb)[:L].astype(bool),
+        ins_mask=np.unpackbits(ib)[:L].astype(bool),
+    )
+    return emit, masks
 
 
 def decode_fast(plane_packed: np.ndarray, exc_bits: np.ndarray,
@@ -313,14 +353,7 @@ def device_call(ev: EventSet, rid: int, min_depth: int = 1,
     )
 
     if want_masks:
-        emit = unpack_emit(np.asarray(main_out), L)
-        db, nb, ib = (np.asarray(x) for x in masks_packed)
-        masks = CallMasks(
-            base_char=EMIT_ASCII[np.where(emit == 0, N_CHANNELS, emit)],
-            del_mask=np.unpackbits(db)[:L].astype(bool),
-            n_mask=np.unpackbits(nb)[:L].astype(bool),
-            ins_mask=np.unpackbits(ib)[:L].astype(bool),
-        )
+        emit, masks = masks_from_wire(main_out, masks_packed, L)
         return emit, masks, int(dmin), int(dmax)
 
     exc_bits, del_flags, ins_flags = masks_packed

@@ -64,6 +64,13 @@ def _consensus_parser(sub):
         help="print per-phase wall-time report to stderr "
              "(set KINDEL_TPU_TRACE_DIR for an XLA profiler trace)",
     )
+    p.add_argument(
+        "--stream-chunk-mb", type=float, default=None, metavar="MB",
+        help="stream the decode in chunks of this many (decompressed) MB, "
+             "bounding host memory at O(chunk + reference length); files "
+             "over $KINDEL_TPU_STREAM_THRESHOLD_MB (default 512) stream "
+             "automatically",
+    )
     _add_backend(p)
 
 
@@ -85,6 +92,7 @@ def cmd_consensus(args) -> int:
             trim_ends=args.trim_ends,
             uppercase=args.uppercase,
             backend=args.backend,
+            stream_chunk_mb=args.stream_chunk_mb,
         )
     finally:
         if timer is not None:

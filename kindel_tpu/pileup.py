@@ -118,11 +118,19 @@ def _scalar_counts(rid, pos, sel_rid, L1) -> np.ndarray:
 
 def build_insertion_table(ev: EventSet, rid: int) -> InsertionTable:
     """Dictionary-encoded insertion observations for one reference."""
-    L = int(ev.ref_lens[rid])
+    return insertion_table_from_counter(
+        ev.insertions, rid, int(ev.ref_lens[rid])
+    )
+
+
+def insertion_table_from_counter(counter, rid: int, L: int) -> InsertionTable:
+    """InsertionTable from a (rid, pos, string) -> count mapping — shared
+    by the eager EventSet path and the streamed accumulator
+    (kindel_tpu.streaming), whose Counter merges across chunks."""
     ins = InsertionTable.empty(L)
     string_ids: dict[bytes, int] = {}
     ipos, iid, icnt = [], [], []
-    for (r, p, s), c in ev.insertions.items():
+    for (r, p, s), c in counter.items():
         if r != rid:
             continue
         sid = string_ids.setdefault(s, len(string_ids))

@@ -1,0 +1,300 @@
+"""Streamed single-file reduction: bounded-RSS consensus and pileups.
+
+Closes SURVEY §7 step 6 for ONE large file (round 1 only pipelined across
+files, kindel_tpu.batch): the decode never materializes the whole BAM —
+kindel_tpu.io.stream yields ~chunk-sized ReadBatches, each chunk's events
+extract and reduce additively into per-reference count state, and the
+final call runs over the finished tensors. Host RSS stays
+O(chunk + reference length) where the reference implementation (and a
+slurped decode) is O(file) (/root/reference/kindel/kindel.py:143-148).
+
+Backends:
+
+  numpy  per-chunk bincounts summed into host arrays (oracle semantics)
+  jax    per-chunk scatter-adds into donated device buffers — jax's async
+         dispatch overlaps the device reduce of chunk k with the host
+         decode of chunk k+1 (the double-buffering SURVEY §7 prescribes);
+         the closing per-position call runs on device from the accumulated
+         tensors (call_jax.counts_call_kernel), so no count tensor is
+         downloaded unless --realign needs the clip channels
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from functools import partial
+
+import numpy as np
+
+from kindel_tpu.events import N_CHANNELS, extract_events
+from kindel_tpu.io.stream import DEFAULT_CHUNK_BYTES, stream_alignment
+from kindel_tpu.pileup import (
+    Pileup,
+    insertion_table_from_counter,
+)
+
+#: hard framework-wide limit of the int32 flat-index scatter scheme
+#: (jax's default x64-off mode): L·N_CHANNELS must stay addressable
+_MAX_FLAT = 2**31 - 2
+
+
+class _RefState:
+    """Accumulating count state for one reference (host or device)."""
+
+    __slots__ = ("L", "w", "csw", "cew", "cs", "ce", "d")
+
+    def __init__(self, L: int, device: bool, full: bool):
+        self.L = L
+        if device and L * N_CHANNELS > _MAX_FLAT:
+            raise ValueError(
+                f"reference length {L} exceeds the int32 flat-index limit "
+                f"of the device scatter scheme ({_MAX_FLAT // N_CHANNELS} bp)"
+            )
+
+        def zeros(n):
+            if device:
+                import jax.numpy as jnp
+
+                return jnp.zeros(n, jnp.int32)
+            return np.zeros(n, np.int64)
+
+        self.w = zeros(L * N_CHANNELS)
+        self.d = zeros(L + 1)
+        # clip channels only materialize when realign / full pileups need
+        # them — the plain consensus path never touches them
+        self.csw = zeros(L * N_CHANNELS) if full else None
+        self.cew = zeros(L * N_CHANNELS) if full else None
+        self.cs = zeros(L + 1) if full else None
+        self.ce = zeros(L + 1) if full else None
+
+
+def _host_add(state, idx, size, cnt=None):
+    weights = cnt if cnt is not None else None
+    return state + np.bincount(
+        idx, weights=weights, minlength=size
+    ).astype(np.int64)
+
+
+_DEV_OPS = None
+
+
+def _dev_ops():
+    """Lazily-built donated-buffer scatter jits (jax import deferred so the
+    numpy oracle path never touches jax)."""
+    global _DEV_OPS
+    if _DEV_OPS is None:
+        import jax
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def add1(state, idx):
+            return state.at[idx].add(1, mode="drop")
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def addc(state, idx, cnt):
+            return state.at[idx].add(cnt, mode="drop")
+
+        _DEV_OPS = (add1, addc)
+    return _DEV_OPS
+
+
+class StreamAccumulator:
+    """Order-independent additive reduction over streamed ReadBatches."""
+
+    def __init__(self, backend: str = "numpy", full: bool = False):
+        self.device = backend in ("jax", "pallas")
+        self.full = full
+        self.ref_names: list[str] = []
+        self.ref_lens = None
+        self.states: dict[int, _RefState] = {}
+        self.present: list[int] = []  # first-appearance order
+        self.insertions: Counter = Counter()
+
+    # -- helpers -----------------------------------------------------------
+
+    def _dev_scatter(self, state, idx, cnt=None):
+        import jax.numpy as jnp
+
+        from kindel_tpu.pileup_jax import _bucket, _pad
+
+        add1, addc = _dev_ops()
+        size = _bucket(len(idx), 1024)
+        # pad sentinel = one past the state's end: out of range for THIS
+        # array whatever its length (a fixed 2^30-style constant would be a
+        # valid index for references past ~215 Mbp), dropped by mode="drop"
+        pad_idx = np.int32(state.shape[0])
+        idx_p = jnp.asarray(_pad(idx.astype(np.int32), size, pad_idx))
+        if cnt is None:
+            return add1(state, idx_p)
+        cnt_p = jnp.asarray(_pad(cnt.astype(np.int32), size, 0))
+        return addc(state, idx_p, cnt_p)
+
+    def _add(self, state, idx, size, cnt=None):
+        if self.device:
+            return self._dev_scatter(state, idx, cnt)
+        return _host_add(state, idx, size, cnt)
+
+    # -- per-chunk reduction -----------------------------------------------
+
+    def add_batch(self, batch) -> None:
+        if self.ref_lens is None:
+            self.ref_names = batch.ref_names
+            self.ref_lens = np.asarray(batch.ref_lens, dtype=np.int64)
+        ev = extract_events(batch)
+        self.insertions.update(ev.insertions)
+        for rid in ev.present_ref_ids:
+            if rid not in self.states:
+                self.states[rid] = _RefState(
+                    int(self.ref_lens[rid]), self.device, self.full
+                )
+                self.present.append(rid)
+            st = self.states[rid]
+            L = st.L
+
+            def stream(rids, pos, base=None):
+                sel = rids == rid
+                p = pos[sel]
+                if base is None:
+                    return p
+                return p * N_CHANNELS + base[sel].astype(np.int64)
+
+            st.w = self._add(
+                st.w, stream(ev.match_rid, ev.match_pos, ev.match_base),
+                L * N_CHANNELS,
+            )
+            st.d = self._add(st.d, stream(ev.del_rid, ev.del_pos), L + 1)
+            if self.full:
+                st.csw = self._add(
+                    st.csw, stream(ev.csw_rid, ev.csw_pos, ev.csw_base),
+                    L * N_CHANNELS,
+                )
+                st.cew = self._add(
+                    st.cew, stream(ev.cew_rid, ev.cew_pos, ev.cew_base),
+                    L * N_CHANNELS,
+                )
+                st.cs = self._add(st.cs, stream(ev.cs_rid, ev.cs_pos), L + 1)
+                st.ce = self._add(st.ce, stream(ev.ce_rid, ev.ce_pos), L + 1)
+
+    # -- materialization ---------------------------------------------------
+
+    def pileup(self, rid: int) -> Pileup:
+        """Host Pileup for one reference (downloads device state)."""
+        if not self.full:
+            raise ValueError("accumulator built without clip channels")
+        st = self.states[rid]
+        tab = insertion_table_from_counter(self.insertions, rid, st.L)
+
+        def host(a, shape=None):
+            out = np.asarray(a)
+            return out.reshape(shape) if shape else out
+
+        L = st.L
+        return Pileup(
+            ref_id=self.ref_names[rid],
+            ref_len=L,
+            weights=host(st.w, (L, N_CHANNELS)).astype(np.int32),
+            clip_start_weights=host(st.csw, (L, N_CHANNELS)).astype(np.int32),
+            clip_end_weights=host(st.cew, (L, N_CHANNELS)).astype(np.int32),
+            clip_starts=host(st.cs).astype(np.int32),
+            clip_ends=host(st.ce).astype(np.int32),
+            deletions=host(st.d).astype(np.int32),
+            ins=tab,
+        )
+
+
+def stream_pileups(
+    path,
+    chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+    backend: str = "numpy",
+) -> dict[str, Pileup]:
+    """Bounded-RSS replacement for build_pileups(extract_events(load…)):
+    same output, O(chunk + L) host memory."""
+    acc = StreamAccumulator(backend=backend, full=True)
+    for batch in stream_alignment(path, chunk_bytes):
+        acc.add_batch(batch)
+    return {acc.ref_names[rid]: acc.pileup(rid) for rid in acc.present}
+
+
+def streamed_consensus(
+    bam_path,
+    realign: bool = False,
+    min_depth: int = 1,
+    min_overlap: int = 9,
+    clip_decay_threshold: float = 0.1,
+    mask_ends: int = 50,
+    trim_ends: bool = False,
+    uppercase: bool = False,
+    backend: str = "numpy",
+    chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+):
+    """bam_to_consensus over a streamed decode — identical output, host
+    RSS bounded by O(chunk + reference length).
+
+    Returns the same result namedtuple as workloads.bam_to_consensus.
+    """
+    from kindel_tpu.call import _insertion_calls, assemble, call_consensus
+    from kindel_tpu.io.fasta import Sequence
+    from kindel_tpu.realign import cdrp_consensuses, merge_cdrps
+    from kindel_tpu.workloads import build_report, result
+
+    # realign (or the numpy oracle) consumes host pileups; the plain jax
+    # path keeps everything on device until the packed wire download
+    full = realign or backend not in ("jax", "pallas")
+    acc = StreamAccumulator(backend=backend, full=full)
+    for batch in stream_alignment(bam_path, chunk_bytes):
+        acc.add_batch(batch)
+
+    consensuses, refs_changes, refs_reports = [], {}, {}
+    for rid in acc.present:
+        ref_id = acc.ref_names[rid]
+        cdr_patches = None
+        if full:
+            pileup = acc.pileup(rid)
+            if realign:
+                cdr_patches = merge_cdrps(
+                    cdrp_consensuses(
+                        pileup,
+                        clip_decay_threshold=clip_decay_threshold,
+                        mask_ends=mask_ends,
+                    ),
+                    min_overlap,
+                )
+            res = call_consensus(
+                pileup, cdr_patches=cdr_patches, trim_ends=trim_ends,
+                min_depth=min_depth, uppercase=uppercase,
+            )
+            acgt = pileup.acgt_depth
+            depth_min = int(acgt.min()) if len(acgt) else 0
+            depth_max = int(acgt.max()) if len(acgt) else 0
+        else:
+            import jax.numpy as jnp
+
+            from kindel_tpu.call_jax import counts_call_kernel, masks_from_wire
+
+            st = acc.states[rid]
+            tab = insertion_table_from_counter(acc.insertions, rid, st.L)
+            L = st.L
+            emit_packed, masks_packed, dmin, dmax = counts_call_kernel(
+                st.w.reshape(L, N_CHANNELS),
+                st.d[:L],
+                jnp.asarray(tab.totals[:L].astype(np.int32)),
+                jnp.int32(min_depth),
+            )
+            _emit, masks = masks_from_wire(emit_packed, masks_packed, L)
+            ins_calls = (
+                _insertion_calls(tab) if masks.ins_mask.any() else {}
+            )
+            res = assemble(
+                masks, ins_calls, None, trim_ends, min_depth, uppercase,
+            )
+            depth_min, depth_max = int(dmin), int(dmax)
+
+        refs_reports[ref_id] = build_report(
+            ref_id, depth_min, depth_max, res.changes, cdr_patches,
+            bam_path, realign, min_depth, min_overlap,
+            clip_decay_threshold, trim_ends, uppercase,
+        )
+        refs_changes[ref_id] = res.changes
+        consensuses.append(
+            Sequence(name=f"{ref_id}_cns", sequence=res.sequence)
+        )
+    return result(consensuses, refs_changes, refs_reports)

@@ -38,7 +38,47 @@ def _shardable_device_count() -> int:
     return len(jax.devices())
 
 
-def _load_pileups(bam_path, backend: str) -> dict[str, Pileup]:
+def _resolve_stream_chunk(bam_path, stream_chunk_mb,
+                          backend: str = "numpy") -> float | None:
+    """Decide whether to stream: explicit arg > env chunk size > automatic
+    for files past the size threshold (default 512 MB).
+
+    Auto-streaming stands down when the multi-device sharded product path
+    would engage (backend=jax, >1 device): streamed accumulation is
+    currently single-device, and silently trading the mesh for bounded RSS
+    on exactly the large inputs sharding targets would regress the
+    headline benchmark. An explicit chunk size still wins — the caller
+    asked for bounded memory."""
+    import os
+
+    if stream_chunk_mb is not None:
+        return float(stream_chunk_mb) or None
+    env = os.environ.get("KINDEL_TPU_STREAM_CHUNK_MB")
+    if env:
+        return float(env) or None
+    if backend == "jax" and _shardable_device_count() > 1:
+        return None
+    try:
+        size = os.path.getsize(bam_path)
+    except OSError:
+        return None
+    threshold = float(
+        os.environ.get("KINDEL_TPU_STREAM_THRESHOLD_MB", "512")
+    )
+    if size > threshold * (1 << 20):
+        return 64.0
+    return None
+
+
+def _load_pileups(bam_path, backend: str,
+                  stream_chunk_mb: float | None = None) -> dict[str, Pileup]:
+    chunk_mb = _resolve_stream_chunk(bam_path, stream_chunk_mb, backend)
+    if chunk_mb is not None:
+        from kindel_tpu.streaming import stream_pileups
+
+        return stream_pileups(
+            bam_path, chunk_bytes=int(chunk_mb * (1 << 20)), backend=backend
+        )
     ev = extract_events(load_alignment(bam_path))
     if backend == "jax":
         from kindel_tpu.pileup_jax import build_pileups_jax
@@ -100,14 +140,33 @@ def bam_to_consensus(
     trim_ends: bool = False,
     uppercase: bool = False,
     backend: str = "numpy",
+    stream_chunk_mb: float | None = None,
 ):
     """Infer consensus for every reference with aligned reads.
 
     API-compatible with the reference (/root/reference/kindel/kindel.py:488-555,
     including its Python-API default min_overlap=9 vs the CLI's 7 — SURVEY §2.1).
+
+    stream_chunk_mb switches to the bounded-RSS streamed decode
+    (kindel_tpu.streaming): the file is never materialized whole — chunks
+    reduce additively, host memory stays O(chunk + reference length).
+    Defaults from $KINDEL_TPU_STREAM_CHUNK_MB; files larger than
+    $KINDEL_TPU_STREAM_THRESHOLD_MB (default 512) stream automatically.
     """
     from kindel_tpu.pileup import build_pileup
     from kindel_tpu.utils.profiling import maybe_phase
+
+    chunk_mb = _resolve_stream_chunk(bam_path, stream_chunk_mb, backend)
+    if chunk_mb is not None:
+        from kindel_tpu.streaming import streamed_consensus
+
+        return streamed_consensus(
+            bam_path, realign=realign, min_depth=min_depth,
+            min_overlap=min_overlap,
+            clip_decay_threshold=clip_decay_threshold, mask_ends=mask_ends,
+            trim_ends=trim_ends, uppercase=uppercase, backend=backend,
+            chunk_bytes=int(chunk_mb * (1 << 20)),
+        )
 
     consensuses = []
     refs_changes = {}

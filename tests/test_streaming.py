@@ -1,0 +1,191 @@
+"""Streamed single-file ingest (kindel_tpu.io.stream + kindel_tpu.streaming).
+
+Contract (VERDICT r1, next-round item 4): chunked decode + additive
+reduction must reproduce the slurped pipeline exactly — consensus
+sequences, changes, reports, and pileup tensors — while touching only
+O(chunk) of the file at a time. Chunk sizes here are tiny (KBs) so every
+corpus file exercises many chunk boundaries.
+"""
+
+import os
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from kindel_tpu.io import load_alignment
+from kindel_tpu.io.stream import stream_alignment
+from kindel_tpu.pileup import build_pileups
+from kindel_tpu.events import extract_events
+from kindel_tpu.streaming import stream_pileups, streamed_consensus
+from kindel_tpu.workloads import bam_to_consensus
+
+_DATA_ROOT = Path(
+    os.environ.get("KINDEL_TPU_TEST_DATA", "/root/reference/tests")
+)
+
+
+def require_data(*rel) -> Path:
+    path = _DATA_ROOT.joinpath(*rel)
+    if not path.exists():
+        pytest.skip(f"golden corpus not available: {path}")
+    return path
+
+
+TINY_CHUNK = 64 << 10  # 64 KB — forces many chunk boundaries on the corpus
+
+
+# ---------------------------------------------------------------------------
+# stream_alignment: chunked decode equals slurped decode
+# ---------------------------------------------------------------------------
+
+
+def _concat_batches(batches):
+    reads = []
+    for b in batches:
+        for i in range(b.n_reads):
+            reads.append(
+                (
+                    int(b.ref_id[i]),
+                    int(b.pos[i]),
+                    int(b.flag[i]),
+                    b.seq[b.seq_off[i] : b.seq_off[i + 1]].tobytes(),
+                    b.cig_op[b.cig_off[i] : b.cig_off[i + 1]].tobytes(),
+                    tuple(b.cig_len[b.cig_off[i] : b.cig_off[i + 1]]),
+                )
+            )
+    return reads
+
+
+@pytest.mark.parametrize(
+    "rel",
+    [
+        ("data_bwa_mem", "1.1.sub_test.bam"),
+        ("data_minimap2", "1.1.multi.bam"),
+        ("data_ext", "1.issue23.debug.sam"),
+    ],
+)
+def test_stream_equals_slurp_decode(rel):
+    path = require_data(*rel)
+    slurped = load_alignment(path)
+    batches = list(stream_alignment(path, chunk_bytes=TINY_CHUNK))
+    assert len(batches) >= 1
+    assert batches[0].ref_names == slurped.ref_names
+    got = _concat_batches(batches)
+    want = _concat_batches([slurped])
+    assert got == want
+
+
+def test_stream_chunking_actually_chunks():
+    path = require_data("data_bwa_mem", "1.1.sub_test.bam")
+    batches = list(stream_alignment(path, chunk_bytes=TINY_CHUNK))
+    assert len(batches) > 3  # ~2 MB decompressed / 64 KB
+
+
+# ---------------------------------------------------------------------------
+# stream_pileups: accumulated counts equal the slurped pileups
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("backend", ["numpy", "jax"])
+def test_stream_pileups_match(backend):
+    path = require_data("data_bwa_mem", "1.1.sub_test.bam")
+    want = build_pileups(extract_events(load_alignment(path)))
+    got = stream_pileups(path, chunk_bytes=TINY_CHUNK, backend=backend)
+    assert list(got) == list(want)
+    for chrom in want:
+        g, w = got[chrom], want[chrom]
+        assert np.array_equal(g.weights, w.weights)
+        assert np.array_equal(g.deletions, w.deletions)
+        assert np.array_equal(g.clip_start_weights, w.clip_start_weights)
+        assert np.array_equal(g.clip_end_weights, w.clip_end_weights)
+        assert np.array_equal(g.clip_starts, w.clip_starts)
+        assert np.array_equal(g.clip_ends, w.clip_ends)
+        assert np.array_equal(g.ins.totals, w.ins.totals)
+        assert g.ins.at(1) == w.ins.at(1)
+
+
+# ---------------------------------------------------------------------------
+# streamed_consensus: byte-identical product output
+# ---------------------------------------------------------------------------
+
+
+def _assert_same(a, b):
+    assert [s.sequence for s in a.consensuses] == [
+        s.sequence for s in b.consensuses
+    ]
+    assert a.refs_changes == b.refs_changes
+    assert a.refs_reports == b.refs_reports
+
+
+@pytest.mark.parametrize("backend", ["numpy", "jax"])
+@pytest.mark.parametrize("realign", [False, True])
+def test_streamed_consensus_matches(backend, realign):
+    path = require_data("data_bwa_mem", "1.1.sub_test.bam")
+    want = bam_to_consensus(path, realign=realign, backend="numpy")
+    got = streamed_consensus(
+        path, realign=realign, backend=backend, chunk_bytes=TINY_CHUNK
+    )
+    _assert_same(got, want)
+
+
+def test_streamed_consensus_multicontig():
+    path = require_data("data_minimap2", "1.1.multi.bam")
+    want = bam_to_consensus(path, backend="numpy")
+    got = streamed_consensus(path, chunk_bytes=TINY_CHUNK)
+    _assert_same(got, want)
+
+
+def test_streamed_consensus_sam_text():
+    path = require_data("data_ext", "1.issue23.debug.sam")
+    want = bam_to_consensus(path, realign=True, backend="numpy")
+    got = streamed_consensus(path, realign=True, chunk_bytes=TINY_CHUNK)
+    _assert_same(got, want)
+
+
+def test_bam_to_consensus_stream_param_routes():
+    path = require_data("data_bwa_mem", "1.1.sub_test.bam")
+    want = bam_to_consensus(path, backend="numpy")
+    got = bam_to_consensus(
+        path, backend="numpy", stream_chunk_mb=TINY_CHUNK / (1 << 20)
+    )
+    _assert_same(got, want)
+
+
+def test_stream_gzip_with_foreign_fextra(tmp_path):
+    """A conforming gzip member whose FEXTRA holds a non-BC subfield wider
+    than the 18-byte BGZF header probe must fall back to generic inflate,
+    not crash."""
+    import struct
+    import zlib
+
+    src = require_data("data_bwa_mem", "1.1.sub_test.bam")
+    from kindel_tpu.io import bgzf
+
+    raw = bgzf.decompress(src.read_bytes())
+    extra = struct.pack("<BBH", ord("Z"), ord("Q"), 8) + b"\x00" * 8
+    co = zlib.compressobj(1, zlib.DEFLATED, -15)
+    deflated = co.compress(raw) + co.flush()
+    member = (
+        b"\x1f\x8b\x08\x04\x00\x00\x00\x00\x00\xff"
+        + struct.pack("<H", len(extra)) + extra
+        + deflated
+        + struct.pack("<II", zlib.crc32(raw), len(raw) & 0xFFFFFFFF)
+    )
+    path = tmp_path / "fextra.bam"
+    path.write_bytes(member)
+    batches = list(stream_alignment(path, chunk_bytes=TINY_CHUNK))
+    assert sum(b.n_reads for b in batches) == load_alignment(src).n_reads
+
+
+def test_stream_truncated_bam_raises(tmp_path):
+    src = require_data("data_bwa_mem", "1.1.sub_test.bam")
+    import gzip
+
+    from kindel_tpu.io import bgzf
+
+    raw = bgzf.decompress(src.read_bytes())
+    cut = tmp_path / "trunc.bam"
+    cut.write_bytes(gzip.compress(raw[: len(raw) - 37], 1))
+    with pytest.raises(ValueError, match="truncated"):
+        list(stream_alignment(cut, chunk_bytes=TINY_CHUNK))
