@@ -10,11 +10,19 @@ sample; host threads assemble the per-sample FASTA.
 One device dispatch per cohort amortizes the host↔device latency that
 dominates single-file runs — on a mesh, XLA partitions the batch across
 devices with zero collectives (embarrassingly parallel).
+
+The cohort contract matches the single-file one
+(/root/reference/kindel/kindel.py:488-555): per-sample results can carry
+reports, per-position change lists, and --realign CDR patching — a batch
+run of one file equals a `consensus` run of that file exactly
+(tests/test_batch.py). The plain Sequence-only entry points remain as thin
+wrappers for callers that only want FASTA.
 """
 
 from __future__ import annotations
 
 from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
 
 from kindel_tpu.utils.jax_cache import ensure_compilation_cache
 
@@ -28,6 +36,7 @@ from kindel_tpu.call_jax import (
     CallUnit,
     batched_call_kernel,
     decode_fast,
+    masks_from_wire,
 )
 from kindel_tpu.events import extract_events
 from kindel_tpu.io import load_alignment
@@ -35,9 +44,43 @@ from kindel_tpu.io.fasta import Sequence
 from kindel_tpu.pileup_jax import PAD_POS, _bucket, _pad
 
 
-def _load_units(bam_paths, pool) -> list:
+@dataclass
+class BatchOptions:
+    """Per-cohort call/assembly options (reference kindel.py:488-497
+    signature, plus the report/changes switches)."""
+
+    realign: bool = False
+    min_depth: int = 1
+    min_overlap: int = 9
+    clip_decay_threshold: float = 0.1
+    mask_ends: int = 50
+    trim_ends: bool = False
+    uppercase: bool = False
+    build_reports: bool = False
+    build_changes: bool = False
+
+    @property
+    def want_masks(self) -> bool:
+        """Reports need change-site lists; change lists need the dense
+        mask wire format. The 2-bit fast path can't carry either."""
+        return self.build_reports or self.build_changes
+
+
+@dataclass
+class SampleResult:
+    """One sample's cohort output — same fields as the single-file
+    workloads.result, per sample."""
+
+    consensuses: list = field(default_factory=list)
+    refs_changes: dict = field(default_factory=dict)
+    refs_reports: dict = field(default_factory=dict)
+
+
+def _load_units(bam_paths, pool, opts: BatchOptions) -> list:
     """Decode + event-extract a cohort concurrently → flat CallUnit list
-    (each tagged with its sample index)."""
+    (each tagged with its sample index). Under --realign, each unit's CDR
+    patches are computed here from a transient host pileup (CDR metadata
+    is tiny; the pileup is dropped immediately)."""
 
     def load(path_idx):
         idx, path = path_idx
@@ -46,11 +89,74 @@ def _load_units(bam_paths, pool) -> list:
         for rid in ev.present_ref_ids:
             u = CallUnit(ev, rid, with_ins_table=True)
             u.sample_idx = idx
+            if opts.realign:
+                from kindel_tpu.pileup import build_pileup
+                from kindel_tpu.realign import cdrp_consensuses, merge_cdrps
+
+                pileup = build_pileup(ev, rid)
+                u.cdr_patches = merge_cdrps(
+                    cdrp_consensuses(
+                        pileup,
+                        clip_decay_threshold=opts.clip_decay_threshold,
+                        mask_ends=opts.mask_ends,
+                    ),
+                    opts.min_overlap,
+                )
             units_.append(u)
         return units_
 
     per_sample = list(pool.map(load, enumerate(bam_paths)))
     return [u for units_ in per_sample for u in units_]
+
+
+def batch_bam_to_results(
+    bam_paths,
+    realign: bool = False,
+    min_depth: int = 1,
+    min_overlap: int = 9,
+    clip_decay_threshold: float = 0.1,
+    mask_ends: int = 50,
+    trim_ends: bool = False,
+    uppercase: bool = False,
+    build_reports: bool = True,
+    build_changes: bool = True,
+    num_workers: int = 8,
+) -> dict:
+    """Cohort consensus with full per-sample results.
+
+    Returns {path: SampleResult} keyed by the caller's own path objects,
+    in input order. References of different lengths are padded to the
+    cohort maximum (positions past a sample's own reference produce zero
+    counts and are sliced off)."""
+    opts = BatchOptions(
+        realign=realign, min_depth=min_depth, min_overlap=min_overlap,
+        clip_decay_threshold=clip_decay_threshold, mask_ends=mask_ends,
+        trim_ends=trim_ends, uppercase=uppercase,
+        build_reports=build_reports, build_changes=build_changes,
+    )
+    bam_paths = list(bam_paths)
+    with ThreadPoolExecutor(max_workers=num_workers) as pool:
+        units = _load_units(bam_paths, pool, opts)
+        if not units:
+            return {p: SampleResult() for p in bam_paths}
+        outputs = _call_and_assemble(units, opts, pool, bam_paths)
+
+    grouped = _fold_results(units, outputs, len(bam_paths))
+    return {p: grouped[i] for i, p in enumerate(bam_paths)}
+
+
+def _fold_results(units, outputs, n_samples: int) -> dict:
+    """Fold per-unit (seq, changes, report) outputs into one SampleResult
+    per sample index — shared by the whole-cohort and streamed paths."""
+    grouped = {i: SampleResult() for i in range(n_samples)}
+    for u, (seq, changes, report) in zip(units, outputs):
+        res = grouped[u.sample_idx]
+        res.consensuses.append(seq)
+        if changes is not None:
+            res.refs_changes[u.ref_id] = changes
+        if report is not None:
+            res.refs_reports[u.ref_id] = report
+    return grouped
 
 
 def batch_bam_to_consensus(
@@ -60,26 +166,13 @@ def batch_bam_to_consensus(
     uppercase: bool = False,
     num_workers: int = 8,
 ) -> dict:
-    """Consensus for a cohort of alignment files in one device program.
-
-    Returns {path: [Sequence, ...]} keyed by the caller's own path objects,
-    in input order. References of different lengths are padded to the cohort
-    maximum (positions past a sample's own reference produce zero counts and
-    are sliced off)."""
-    bam_paths = list(bam_paths)
-
-    with ThreadPoolExecutor(max_workers=num_workers) as pool:
-        units = _load_units(bam_paths, pool)
-        if not units:
-            return {p: [] for p in bam_paths}
-        sequences = _call_and_assemble(
-            units, min_depth, trim_ends, uppercase, pool
-        )
-
-    out: dict = {p: [] for p in bam_paths}
-    for u, seq in zip(units, sequences):
-        out[bam_paths[u.sample_idx]].append(seq)
-    return out
+    """FASTA-only cohort consensus: {path: [Sequence, ...]}."""
+    rich = batch_bam_to_results(
+        bam_paths, min_depth=min_depth, trim_ends=trim_ends,
+        uppercase=uppercase, build_reports=False, build_changes=False,
+        num_workers=num_workers,
+    )
+    return {p: r.consensuses for p, r in rich.items()}
 
 
 def _dp_sharding(n_rows: int):
@@ -105,7 +198,7 @@ def _dp_sharding(n_rows: int):
     )
 
 
-def _dispatch_device_call(units, min_depth: int):
+def _dispatch_device_call(units, opts: BatchOptions):
     """Pad + upload a cohort's units and launch the batched kernel
     (asynchronously — jax dispatch returns before the TPU finishes).
     With multiple visible devices, rows are sharded over a dp mesh."""
@@ -132,6 +225,8 @@ def _dispatch_device_call(units, min_depth: int):
 
     n_events = np.zeros(B, dtype=np.int32)
     n_events[: len(units)] = [u.n_events for u in units]
+    ref_lens = np.zeros(B, dtype=np.int32)
+    ref_lens[: len(units)] = [u.L for u in units]
 
     arrays = (
         stack(lambda u: u.op_r_start, O_pad, PAD_POS),
@@ -142,6 +237,7 @@ def _dispatch_device_call(units, min_depth: int):
         stack(lambda u: u.ins_pos, I_pad, PAD_POS),
         stack(lambda u: u.ins_cnt, I_pad, 0),
         n_events,
+        ref_lens,
     )
     if sharding is None:
         dev_arrays = tuple(jnp.asarray(a) for a in arrays)
@@ -149,60 +245,82 @@ def _dispatch_device_call(units, min_depth: int):
         dev_arrays = tuple(
             jax.device_put(a, sharding(a.ndim)) for a in arrays
         )
-    return batched_call_kernel(*dev_arrays, jnp.int32(min_depth), length=L)
-
-
-def _assemble_outputs(units, device_out, trim_ends, uppercase, min_depth,
-                      pool) -> list:
-    """Download the kernel outputs and splice per-unit sequences (host,
-    thread-parallel). Returns sequences in unit order."""
-    plane_packed, (exc_bits, del_flags, ins_flags), _dmins, _dmaxs = (
-        device_out
+    return batched_call_kernel(
+        *dev_arrays, jnp.int32(opts.min_depth), length=L,
+        want_masks=opts.want_masks,
     )
-    plane_packed = np.asarray(plane_packed)
-    exc_bits = np.asarray(exc_bits)
-    del_flags = np.asarray(del_flags)
-    ins_flags = np.asarray(ins_flags)
+
+
+def _assemble_outputs(units, device_out, opts: BatchOptions, pool,
+                      paths=None) -> list:
+    """Download the kernel outputs and splice per-unit sequences (host,
+    thread-parallel). Returns (Sequence, changes|None, report|None) per
+    unit, in unit order. `paths` maps sample_idx → input path for the
+    report header (required when build_reports)."""
+    main_out, extra, dmins, dmaxs = device_out
+    main_out = np.asarray(main_out)
+    extra = tuple(np.asarray(x) for x in extra)
+    if opts.build_reports:
+        dmins = np.asarray(dmins)
+        dmaxs = np.asarray(dmaxs)
 
     def assemble_unit(i_u):
         i, u = i_u
-        masks = decode_fast(
-            plane_packed[i], exc_bits[i], del_flags[i], ins_flags[i],
-            u.L, u.del_pos, u.ins_pos,
-        )
+        if opts.want_masks:
+            _emit, masks = masks_from_wire(
+                main_out[i], (extra[0][i], extra[1][i], extra[2][i]), u.L
+            )
+        else:
+            masks = decode_fast(
+                main_out[i], extra[0][i], extra[1][i], extra[2][i],
+                u.L, u.del_pos, u.ins_pos,
+            )
         ins_calls = (
             _insertion_calls(u.ins_table) if masks.ins_mask.any() else {}
         )
         res = assemble(
-            masks, ins_calls, None, trim_ends, min_depth, uppercase,
-            build_changes=False,
+            masks, ins_calls, u.cdr_patches, opts.trim_ends,
+            opts.min_depth, opts.uppercase,
+            build_changes=opts.want_masks,
         )
-        return Sequence(name=f"{u.ref_id}_cns", sequence=res.sequence)
+        seq = Sequence(name=f"{u.ref_id}_cns", sequence=res.sequence)
+        changes = res.changes if opts.build_changes else None
+        report = None
+        if opts.build_reports:
+            from kindel_tpu.workloads import build_report
+
+            report = build_report(
+                u.ref_id, int(dmins[i]), int(dmaxs[i]), res.changes,
+                u.cdr_patches, paths[u.sample_idx], opts.realign,
+                opts.min_depth, opts.min_overlap,
+                opts.clip_decay_threshold, opts.trim_ends, opts.uppercase,
+            )
+        return seq, changes, report
 
     return list(pool.map(assemble_unit, enumerate(units)))
 
 
-def _call_and_assemble(units, min_depth, trim_ends, uppercase, pool) -> list:
-    out = _dispatch_device_call(units, min_depth)
-    return _assemble_outputs(units, out, trim_ends, uppercase, min_depth, pool)
+def _call_and_assemble(units, opts: BatchOptions, pool, paths=None) -> list:
+    out = _dispatch_device_call(units, opts)
+    return _assemble_outputs(units, out, opts, pool, paths)
 
 
-def stream_bam_to_consensus(
+def stream_bam_to_results(
     bam_paths,
     chunk_size: int = 64,
-    min_depth: int = 1,
-    trim_ends: bool = False,
-    uppercase: bool = False,
     num_workers: int = 8,
+    **opt_kwargs,
 ):
-    """Overlapped cohort consensus: yields (path, [Sequence, ...]) per input
-    file, in input order, processing `chunk_size` files per device program.
+    """Overlapped cohort consensus with full per-sample results: yields
+    (path, SampleResult) per input file, in input order, processing
+    `chunk_size` files per device program.
 
     Three stages run concurrently (SURVEY §7 build-order 6 — "host-side
     streaming decode overlapped with device reduce"): while the TPU executes
     chunk k's batched kernel, host threads are already decoding chunk k+1,
     and chunk k-1's outputs are being spliced/yielded. Bounded memory:
     at most three chunks of units are alive at once."""
+    opts = BatchOptions(**opt_kwargs)
     bam_paths = list(bam_paths)
     chunks = [
         bam_paths[i : i + chunk_size]
@@ -215,7 +333,8 @@ def stream_bam_to_consensus(
     with ThreadPoolExecutor(max_workers=num_workers) as pool, \
             ThreadPoolExecutor(max_workers=1) as prefetcher:
         next_load = (
-            prefetcher.submit(_load_units, chunks[0], pool) if chunks else None
+            prefetcher.submit(_load_units, chunks[0], pool, opts)
+            if chunks else None
         )
         pending = None  # (chunk_paths, units, in-flight device call)
         for k in range(len(chunks) + 1):
@@ -224,7 +343,7 @@ def stream_bam_to_consensus(
             # device(k), and assemble(k-1) overlap
             load = next_load
             next_load = (
-                prefetcher.submit(_load_units, chunks[k + 1], pool)
+                prefetcher.submit(_load_units, chunks[k + 1], pool, opts)
                 if k + 1 < len(chunks)
                 else None
             )
@@ -249,25 +368,20 @@ def stream_bam_to_consensus(
                     units = None
                 if units:
                     next_pending = (
-                        chunks[k], units, _dispatch_device_call(units, min_depth)
+                        chunks[k], units, _dispatch_device_call(units, opts)
                     )
                 elif units is not None:
                     empty_paths = chunks[k]
             if pending is not None:
                 paths_prev, units_prev, out_prev = pending
-                seqs = _assemble_outputs(
-                    units_prev, out_prev, trim_ends, uppercase, min_depth,
-                    pool,
+                outputs = _assemble_outputs(
+                    units_prev, out_prev, opts, pool, paths_prev
                 )
-                grouped: dict[int, list] = {
-                    i: [] for i in range(len(paths_prev))
-                }
-                for u, s in zip(units_prev, seqs):
-                    grouped[u.sample_idx].append(s)
+                grouped = _fold_results(units_prev, outputs, len(paths_prev))
                 for i, p in enumerate(paths_prev):
                     yield p, grouped[i]
             for p in empty_paths:  # after k-1's outputs: preserves input order
-                yield p, []
+                yield p, SampleResult()
             if load_err is not None:
                 if next_load is not None:  # don't stall the raise behind
                     next_load.cancel()     # chunk k+1's in-flight decode
@@ -275,3 +389,20 @@ def stream_bam_to_consensus(
             pending = next_pending
             if load is None:
                 break
+
+
+def stream_bam_to_consensus(
+    bam_paths,
+    chunk_size: int = 64,
+    min_depth: int = 1,
+    trim_ends: bool = False,
+    uppercase: bool = False,
+    num_workers: int = 8,
+):
+    """FASTA-only overlapped cohort consensus: yields (path, [Sequence,…])
+    per input file, in input order."""
+    for path, res in stream_bam_to_results(
+        bam_paths, chunk_size=chunk_size, num_workers=num_workers,
+        min_depth=min_depth, trim_ends=trim_ends, uppercase=uppercase,
+    ):
+        yield path, res.consensuses

@@ -74,6 +74,7 @@ def _call_core(
     min_depth,  # int32 scalar
     length: int,
     want_masks: bool,
+    valid_len=None,  # optional int32 scalar: row's true ref length
 ):
     """Reconstruct match events, scatter counts, call every position.
 
@@ -108,19 +109,29 @@ def _call_core(
     )
     return _decide(
         weights, deletions, ins_totals, del_pos, ins_pos, min_depth,
-        want_masks,
+        want_masks, valid_len,
     )
 
 
 def _decide(weights, deletions, ins_totals, del_pos, ins_pos, min_depth,
-            want_masks: bool):
+            want_masks: bool, valid_len=None):
     """Per-position call decisions + wire-format packing over count
     tensors — the second half of _call_core, shared with the streamed
     counts-input kernel (counts_call_kernel). del_pos/ins_pos feed the
-    fast path's sparse flag gathers only (unused when want_masks)."""
+    fast path's sparse flag gathers only (unused when want_masks).
+    valid_len (traced scalar) masks the depth-report min/max to a row's
+    true reference length when the position axis is padded to a batch
+    maximum (kindel_tpu.batch)."""
     length = weights.shape[0]
     acgt_depth = weights[:, :4].sum(axis=1)
     depth_next = jnp.concatenate([acgt_depth[1:], jnp.zeros(1, jnp.int32)])
+
+    if valid_len is None:
+        dmin, dmax = acgt_depth.min(), acgt_depth.max()
+    else:
+        in_ref = jnp.arange(length, dtype=jnp.int32) < valid_len
+        dmin = jnp.where(in_ref, acgt_depth, np.int32(2**31 - 1)).min()
+        dmax = jnp.where(in_ref, acgt_depth, -1).max()
 
     freq = weights.max(axis=1)
     base_idx = jnp.argmax(weights, axis=1)  # first max wins, order A,T,G,C,N
@@ -149,7 +160,7 @@ def _decide(weights, deletions, ins_totals, del_pos, ins_pos, min_depth,
             jnp.packbits(n_mask),
             jnp.packbits(ins_mask),
         )
-        return emit_packed, masks_packed, acgt_depth.min(), acgt_depth.max()
+        return emit_packed, masks_packed, dmin, dmax
 
     # fast path: minimal wire format. A dense 2-bit ACGT plane carries the
     # common case; positions that emit something other than their plane
@@ -173,8 +184,8 @@ def _decide(weights, deletions, ins_totals, del_pos, ins_pos, min_depth,
     return (
         plane_packed,
         (exc_bits, del_flags, ins_flags),
-        acgt_depth.min(),
-        acgt_depth.max(),
+        dmin,
+        dmax,
     )
 
 
@@ -201,24 +212,31 @@ def counts_call_kernel(weights, deletions, ins_totals, min_depth):
     )
 
 
-@partial(jax.jit, static_argnames=("length",))
+@partial(jax.jit, static_argnames=("length", "want_masks"))
 def batched_call_kernel(op_r_start, op_off, base_packed, del_pos, ins_pos,
-                        ins_cnt, n_events, min_depth, *, length: int):
+                        ins_cnt, n_events, ref_lens, min_depth, *,
+                        length: int, want_masks: bool = False):
     """vmapped fused call over a batch of samples (leading axis B).
 
     Data-parallel by construction: under a mesh with the batch axis sharded
     ('dp'), XLA partitions this embarrassingly-parallel program with no
-    collectives. Returns per-sample fast-path outputs
-    (plane_packed, (exc_bits, del_flags, ins_flags), dmin, dmax).
+    collectives. ref_lens[B] masks each row's depth-report scalars to its
+    own reference length (rows are padded to the cohort maximum). Returns
+    per-sample fast-path outputs (plane_packed, (exc_bits, del_flags,
+    ins_flags), dmin, dmax), or the masks wire format when want_masks
+    (emit codes + del/n/ins bitmasks — needed for per-sample change lists
+    and reports).
     """
 
-    def one(ors, oo, bp, dp, ip, ic, ne):
+    def one(ors, oo, bp, dp, ip, ic, ne, rl):
         return _call_core(
-            ors, oo, bp, dp, ip, ic, ne, min_depth, length, False
+            ors, oo, bp, dp, ip, ic, ne, min_depth, length, want_masks,
+            valid_len=rl,
         )
 
     return jax.vmap(one)(
-        op_r_start, op_off, base_packed, del_pos, ins_pos, ins_cnt, n_events
+        op_r_start, op_off, base_packed, del_pos, ins_pos, ins_cnt,
+        n_events, ref_lens,
     )
 
 
@@ -288,9 +306,11 @@ class CallUnit:
     __slots__ = (
         "ref_id", "L", "op_r_start", "op_off", "base_packed", "n_events",
         "del_pos", "ins_pos", "ins_cnt", "ins_table", "sample_idx",
+        "cdr_patches",
     )
 
     def __init__(self, ev: EventSet, rid: int, with_ins_table: bool = False):
+        self.cdr_patches = None  # set by the cohort loader under --realign
         self.ref_id = ev.ref_names[rid]
         L = self.L = int(ev.ref_lens[rid])
         sel = ev.match_rid == rid

@@ -187,7 +187,7 @@ def cmd_batch(args) -> int:
     (kindel_tpu.batch; BASELINE.json config 5)."""
     import os
 
-    from kindel_tpu.batch import stream_bam_to_consensus
+    from kindel_tpu.batch import stream_bam_to_results
     from kindel_tpu.io.fasta import format_fasta
 
     os.makedirs(args.out_dir, exist_ok=True)
@@ -219,7 +219,17 @@ def cmd_batch(args) -> int:
         # existence is completeness: publication below is atomic (tmp +
         # os.replace), so even a 0-byte .fa (sample with no aligned reads)
         # is a finished result
-        skip = {p for p in todo if os.path.exists(out_paths[p])}
+        def complete(p) -> bool:
+            if not os.path.exists(out_paths[p]):
+                return False
+            if args.reports:
+                rep = os.path.splitext(out_paths[p])[0] + ".report.txt"
+                # a 0-byte .fa (no aligned reads) legitimately has no report
+                if os.path.getsize(out_paths[p]) and not os.path.exists(rep):
+                    return False
+            return True
+
+        skip = {p for p in todo if complete(p)}
         todo = [p for p in todo if p not in skip]
         if skip:
             print(
@@ -227,21 +237,31 @@ def cmd_batch(args) -> int:
                 file=sys.stderr,
             )
     n_done = 0
-    for path, records in stream_bam_to_consensus(
+    for path, res in stream_bam_to_results(
         todo,
         chunk_size=args.chunk_size,
+        num_workers=args.workers,
+        realign=args.realign,
         min_depth=args.min_depth,
+        min_overlap=args.min_overlap,
+        clip_decay_threshold=args.clip_decay_threshold,
+        mask_ends=args.mask_ends,
         trim_ends=args.trim_ends,
         uppercase=args.uppercase,
-        num_workers=args.workers,
+        build_reports=args.reports,
     ):
         # atomic publish: a kill mid-write must not leave a truncated .fa
         # that --resume would later treat as complete
         dest = out_paths[path]
         tmp = dest + ".tmp"
         with open(tmp, "w") as fh:
-            fh.write(format_fasta(records))
+            fh.write(format_fasta(res.consensuses))
         os.replace(tmp, dest)
+        if args.reports and res.refs_reports:
+            rep = os.path.splitext(dest)[0] + ".report.txt"
+            with open(rep + ".tmp", "w") as fh:
+                fh.write("\n".join(res.refs_reports.values()))
+            os.replace(rep + ".tmp", rep)
         n_done += 1
     print(f"wrote {n_done} consensus file(s) to {args.out_dir}",
           file=sys.stderr)
@@ -332,6 +352,27 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "-u", "--uppercase", action="store_true",
         help="close gaps using uppercase alphabet",
+    )
+    p.add_argument(
+        "-r", "--realign", action="store_true",
+        help="attempt to reconstruct reference around soft-clip boundaries",
+    )
+    p.add_argument(
+        "--min-overlap", type=int, default=7,
+        help="match length required to close soft-clipped gaps",
+    )
+    p.add_argument(
+        "-c", "--clip-decay-threshold", type=float, default=0.1,
+        help="read depth fraction at which to cease clip extension",
+    )
+    p.add_argument(
+        "--mask-ends", type=int, default=50,
+        help="ignore clip dominant positions within n positions of termini",
+    )
+    p.add_argument(
+        "--reports", action="store_true",
+        help="also write a per-sample <stem>.report.txt (the same text the "
+             "consensus subcommand prints to stderr)",
     )
     p.add_argument(
         "--resume", action="store_true",
