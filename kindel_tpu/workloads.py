@@ -488,52 +488,64 @@ def variants(bam_path, min_count: int = 1, min_frequency: float = 0.0,
     """
     import pandas as pd
 
-    recs = []
-    base_cols = ["A", "T", "G", "C", "N"]
+    base_cols = np.array(["A", "T", "G", "C", "N"], dtype=object)
+    thr = max(min_count, 1)
+    parts = []  # one dict of flat column arrays per record block
+
+    def block(chrom, pos_idx, cons_idx, alt, count, depth):
+        """Fully vectorized record block — no per-site Python."""
+        parts.append(
+            {
+                "chrom": np.full(len(pos_idx), chrom, dtype=object),
+                "pos": pos_idx.astype(np.int64) + 1,
+                "consensus": base_cols[cons_idx[pos_idx]],
+                "alt": alt
+                if isinstance(alt, np.ndarray)
+                else np.full(len(pos_idx), alt, dtype=object),
+                "count": count.astype(np.int64),
+                "depth": depth[pos_idx].astype(np.int64),
+                "frequency": np.round(count / depth[pos_idx], 4),
+            }
+        )
+
     for chrom, p in _load_pileups(bam_path, backend).items():
         L = p.ref_len
         w = p.weights
-        depth = w.sum(axis=1) + p.deletions[:L]
+        dels = p.deletions[:L]
+        depth = w.sum(axis=1).astype(np.int64) + dels
         cons_idx = w.argmax(axis=1)
-        for ch in range(5):
-            count = w[:, ch]
-            sel = (
-                (count >= max(min_count, 1))
-                & (cons_idx != ch)
-                & (depth > 0)
-                & (count / np.maximum(depth, 1) >= min_frequency)
-            )
-            for pos in np.flatnonzero(sel):
-                recs.append(
-                    (chrom, int(pos) + 1, base_cols[cons_idx[pos]],
-                     base_cols[ch], int(count[pos]), int(depth[pos]),
-                     round(float(count[pos] / depth[pos]), 4))
-                )
+        covered = depth > 0
+        safe_depth = np.maximum(depth, 1)
+
+        sel2d = (
+            (w >= thr)
+            & (np.arange(5)[None, :] != cons_idx[:, None])
+            & covered[:, None]
+            & (w / safe_depth[:, None] >= min_frequency)
+        )
+        pos_idx, ch_idx = np.nonzero(sel2d)
+        block(
+            chrom, pos_idx, cons_idx, base_cols[ch_idx],
+            w[pos_idx, ch_idx], depth,
+        )
         if indels:
-            dels = p.deletions[:L]
-            sel = (dels >= max(min_count, 1)) & (depth > 0) & (
-                dels / np.maximum(depth, 1) >= min_frequency
-            )
-            for pos in np.flatnonzero(sel):
-                recs.append(
-                    (chrom, int(pos) + 1, base_cols[cons_idx[pos]], "DEL",
-                     int(dels[pos]), int(depth[pos]),
-                     round(float(dels[pos] / depth[pos]), 4))
+            for alt, counts in (
+                ("DEL", dels),
+                ("INS", p.ins.totals[:L]),
+            ):
+                sel = (
+                    (counts >= thr)
+                    & covered
+                    & (counts / safe_depth >= min_frequency)
                 )
-            ins_tot = p.ins.totals[:L]
-            sel = (ins_tot >= max(min_count, 1)) & (depth > 0) & (
-                ins_tot / np.maximum(depth, 1) >= min_frequency
-            )
-            for pos in np.flatnonzero(sel):
-                recs.append(
-                    (chrom, int(pos) + 1, base_cols[cons_idx[pos]], "INS",
-                     int(ins_tot[pos]), int(depth[pos]),
-                     round(float(ins_tot[pos] / depth[pos]), 4))
-                )
+                pos_idx = np.flatnonzero(sel)
+                block(chrom, pos_idx, cons_idx, alt, counts[pos_idx], depth)
+
+    cols = ["chrom", "pos", "consensus", "alt", "count", "depth", "frequency"]
     df = pd.DataFrame(
-        recs,
-        columns=["chrom", "pos", "consensus", "alt", "count", "depth",
-                 "frequency"],
+        {c: np.concatenate([b[c] for b in parts]) for c in cols}
+        if parts
+        else {c: [] for c in cols}
     )
     return df.sort_values(["chrom", "pos", "alt"]).reset_index(drop=True)
 
