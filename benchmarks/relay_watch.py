@@ -1,0 +1,102 @@
+"""TPU relay watcher: convert any tunnel-uptime window into committed evidence.
+
+The accelerator relay (ports 8082/8083/8087) flaps between sessions; two
+rounds of BENCH artifacts were cpu-fallback because `bench.py` probes once
+and gives up. This watcher runs for the whole round: every PERIOD seconds it
+probes the relay ports, appends one JSON line per probe to RELAY_LOG.jsonl
+(so a dead-all-round relay is *provably* environmental), and whenever the
+relay is up and no TPU bench has succeeded in the last REBENCH_S seconds it
+runs `bench.py` and appends the result to BENCH_ATTEMPTS.jsonl.
+
+Usage: python benchmarks/relay_watch.py [--once]
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+PROBE_LOG = REPO / "RELAY_LOG.jsonl"
+BENCH_LOG = REPO / "BENCH_ATTEMPTS.jsonl"
+PORTS = (8082, 8083, 8087)
+PERIOD = 180  # seconds between probes
+REBENCH_S = 3600  # re-run bench at most hourly while the relay stays up
+
+
+def probe() -> dict[int, bool]:
+    out = {}
+    for port in PORTS:
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=3):
+                out[port] = True
+        except OSError:
+            out[port] = False
+    return out
+
+
+def append(path: Path, obj: dict) -> None:
+    with path.open("a") as fh:
+        fh.write(json.dumps(obj) + "\n")
+
+
+def run_bench() -> dict:
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "bench.py")],
+            capture_output=True,
+            text=True,
+            timeout=900,
+            cwd=REPO,
+        )
+        line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
+        try:
+            result = json.loads(line)
+        except (json.JSONDecodeError, IndexError):
+            result = {"error": "unparseable", "stdout_tail": line[:500]}
+        result["rc"] = proc.returncode
+    except subprocess.TimeoutExpired:
+        result = {"error": "timeout", "rc": -1}
+    result["bench_wall_s"] = round(time.time() - t0, 1)
+    return result
+
+
+def main() -> None:
+    once = "--once" in sys.argv
+    last_tpu_bench = 0.0
+    # resume: find the last successful tpu bench so restarts don't re-bench
+    if BENCH_LOG.exists():
+        for raw in BENCH_LOG.read_text().splitlines():
+            try:
+                rec = json.loads(raw)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("backend") == "tpu" and rec.get("rc") == 0:
+                last_tpu_bench = rec.get("ts", 0.0)
+    while True:
+        now = time.time()
+        ports = probe()
+        up = all(ports.values())
+        append(
+            PROBE_LOG,
+            {"ts": round(now, 1), "iso": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(now)), "ports": {str(k): v for k, v in ports.items()}, "relay_up": up},
+        )
+        if up and now - last_tpu_bench > REBENCH_S:
+            result = run_bench()
+            result["ts"] = round(now, 1)
+            result["iso"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(now))
+            append(BENCH_LOG, result)
+            if result.get("backend") == "tpu" and result.get("rc") == 0:
+                last_tpu_bench = now
+        if once:
+            break
+        time.sleep(PERIOD)
+
+
+if __name__ == "__main__":
+    main()
