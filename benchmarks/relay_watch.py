@@ -25,7 +25,8 @@ PROBE_LOG = REPO / "RELAY_LOG.jsonl"
 BENCH_LOG = REPO / "BENCH_ATTEMPTS.jsonl"
 PORTS = (8082, 8083, 8087)
 PERIOD = 180  # seconds between probes
-REBENCH_S = 3600  # re-run bench at most hourly while the relay stays up
+REBENCH_S = 3600  # re-run bench at most hourly once a TPU result exists
+FAIL_RETRY_S = 1800  # min gap between attempts that didn't yield a TPU result
 
 
 def probe() -> dict[int, bool]:
@@ -69,13 +70,15 @@ def run_bench() -> dict:
 def main() -> None:
     once = "--once" in sys.argv
     last_tpu_bench = 0.0
-    # resume: find the last successful tpu bench so restarts don't re-bench
+    last_attempt = 0.0
+    # resume: find prior attempts so restarts don't immediately re-bench
     if BENCH_LOG.exists():
         for raw in BENCH_LOG.read_text().splitlines():
             try:
                 rec = json.loads(raw)
             except json.JSONDecodeError:
                 continue
+            last_attempt = rec.get("ts", 0.0)
             if rec.get("backend") == "tpu" and rec.get("rc") == 0:
                 last_tpu_bench = rec.get("ts", 0.0)
     while True:
@@ -86,7 +89,15 @@ def main() -> None:
             PROBE_LOG,
             {"ts": round(now, 1), "iso": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(now)), "ports": {str(k): v for k, v in ports.items()}, "relay_up": up},
         )
-        if up and now - last_tpu_bench > REBENCH_S:
+        # throttle: ports-up-but-cpu-fallback must not re-run the multi-
+        # minute bench every probe cycle — any attempt counts for
+        # FAIL_RETRY_S, a real TPU result for REBENCH_S
+        if (
+            up
+            and now - last_tpu_bench > REBENCH_S
+            and now - last_attempt > FAIL_RETRY_S
+        ):
+            last_attempt = now
             result = run_bench()
             result["ts"] = round(now, 1)
             result["iso"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(now))

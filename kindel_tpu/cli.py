@@ -3,7 +3,7 @@
 Subcommand surface matches the reference CLI (consensus / weights /
 features / plot / version, /root/reference/kindel/cli.py:9-70) plus the
 `variants` subcommand its README promised (README.md:106). Every data
-subcommand takes `--backend {numpy,jax,pallas}`. Flag names and defaults replicate
+subcommand takes `--backend {numpy,jax}`. Flag names and defaults replicate
 the reference — including the CLI default min_overlap=7 vs the Python API's 9
 (/root/reference/kindel/cli.py:13 vs kindel.py:492; SURVEY §2.1).
 """
@@ -21,8 +21,7 @@ def _add_backend(p: argparse.ArgumentParser):
         "--backend",
         choices=workloads.BACKENDS,
         default="numpy",
-        help="compute backend: numpy (host oracle), jax (TPU/jit), or "
-             "pallas (MXU histogram kernels)",
+        help="compute backend: numpy (host oracle) or jax (TPU/jit)",
     )
 
 

@@ -125,43 +125,7 @@ def build_pileups_jax(ev: EventSet) -> dict[str, Pileup]:
     }
 
 
-def build_pileup_pallas(ev: EventSet, rid: int) -> Pileup:
-    """Pileup via the MXU histogram kernel (kindel_tpu.ops.pallas_count)
-    instead of XLA scatter-adds — the same dense tensors, reduced on the
-    matrix unit (~2× faster device-side than the scatter on v5e; host-side
-    event bucketing adds an O(E log E) sort, so the fused scatter path
-    remains the default jax backend)."""
-    from kindel_tpu.ops import count_events_pallas
-
-    L = int(ev.ref_lens[rid])
-
-    def weighted(rid_arr, pos_arr, base_arr, length):
-        sel = rid_arr == rid
-        return count_events_pallas(pos_arr[sel], base_arr[sel], length)
-
-    def scalar(rid_arr, pos_arr, length):
-        sel = rid_arr == rid
-        p = pos_arr[sel]
-        return count_events_pallas(
-            p, np.zeros(len(p), np.int64), length, n_ch=1
-        )[:, 0]
-
-    ins = build_insertion_table(ev, rid)
-    return Pileup(
-        ref_id=ev.ref_names[rid],
-        ref_len=L,
-        weights=weighted(ev.match_rid, ev.match_pos, ev.match_base, L),
-        clip_start_weights=weighted(ev.csw_rid, ev.csw_pos, ev.csw_base, L),
-        clip_end_weights=weighted(ev.cew_rid, ev.cew_pos, ev.cew_base, L),
-        clip_starts=scalar(ev.cs_rid, ev.cs_pos, L + 1),
-        clip_ends=scalar(ev.ce_rid, ev.ce_pos, L + 1),
-        deletions=scalar(ev.del_rid, ev.del_pos, L + 1),
-        ins=ins,
-    )
-
-
-def build_pileups_pallas(ev: EventSet) -> dict[str, Pileup]:
-    return {
-        ev.ref_names[rid]: build_pileup_pallas(ev, rid)
-        for rid in ev.present_ref_ids
-    }
+# A Pallas MXU histogram backend (`--backend pallas`) existed through
+# round 2 and was retired after losing its on-silicon A/B against these
+# scatter-adds by ~200× device-side — measurement table in BASELINE.md
+# ("Pallas MXU histogram vs XLA scatter").

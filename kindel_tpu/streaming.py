@@ -101,7 +101,7 @@ class StreamAccumulator:
     """Order-independent additive reduction over streamed ReadBatches."""
 
     def __init__(self, backend: str = "numpy", full: bool = False):
-        self.device = backend in ("jax", "pallas")
+        self.device = backend == "jax"
         self.full = full
         self.ref_names: list[str] = []
         self.ref_lens = None
@@ -238,7 +238,7 @@ def streamed_consensus(
 
     # realign (or the numpy oracle) consumes host pileups; the plain jax
     # path keeps everything on device until the packed wire download
-    full = realign or backend not in ("jax", "pallas")
+    full = realign or backend != "jax"
     acc = StreamAccumulator(backend=backend, full=full)
     for batch in stream_alignment(bam_path, chunk_bytes):
         acc.add_batch(batch)

@@ -23,7 +23,7 @@ from kindel_tpu.realign import cdrp_consensuses, merge_cdrps
 
 result = namedtuple("result", ["consensuses", "refs_changes", "refs_reports"])
 
-BACKENDS = ("numpy", "jax", "pallas")
+BACKENDS = ("numpy", "jax")
 
 
 def _shardable_device_count() -> int:
@@ -70,8 +70,17 @@ def _resolve_stream_chunk(bam_path, stream_chunk_mb,
     return None
 
 
+def _check_backend(backend: str) -> None:
+    if backend not in BACKENDS:
+        raise ValueError(
+            f"unknown backend {backend!r}; choose from {BACKENDS} "
+            "(the 'pallas' backend was retired in round 3 — BASELINE.md)"
+        )
+
+
 def _load_pileups(bam_path, backend: str,
                   stream_chunk_mb: float | None = None) -> dict[str, Pileup]:
+    _check_backend(backend)
     chunk_mb = _resolve_stream_chunk(bam_path, stream_chunk_mb, backend)
     if chunk_mb is not None:
         from kindel_tpu.streaming import stream_pileups
@@ -84,10 +93,6 @@ def _load_pileups(bam_path, backend: str,
         from kindel_tpu.pileup_jax import build_pileups_jax
 
         return build_pileups_jax(ev)
-    if backend == "pallas":
-        from kindel_tpu.pileup_jax import build_pileups_pallas
-
-        return build_pileups_pallas(ev)
     return build_pileups(ev)
 
 
@@ -156,6 +161,7 @@ def bam_to_consensus(
     from kindel_tpu.pileup import build_pileup
     from kindel_tpu.utils.profiling import maybe_phase
 
+    _check_backend(backend)
     chunk_mb = _resolve_stream_chunk(bam_path, stream_chunk_mb, backend)
     if chunk_mb is not None:
         from kindel_tpu.streaming import streamed_consensus
@@ -209,12 +215,7 @@ def bam_to_consensus(
             # tiny event counts, reduced host-side even under the jax
             # backend (SURVEY §5: CDR/patch metadata is host-gathered)
             with maybe_phase(f"pileup reduce [{ref_id}]"):
-                if backend == "pallas":
-                    from kindel_tpu.pileup_jax import build_pileup_pallas
-
-                    pileup = build_pileup_pallas(ev, rid)
-                else:
-                    pileup = build_pileup(ev, rid)
+                pileup = build_pileup(ev, rid)
         else:
             pileup = None
         if realign:
