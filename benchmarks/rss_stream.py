@@ -84,6 +84,8 @@ def synthesize(path: Path, target_bytes: int, ref_len: int = 6_097_032,
 
 _CHILD = r"""
 import json, resource, sys, time
+import jax
+jax.config.update("jax_platforms", "cpu")
 sys.path.insert(0, {repo!r})
 from kindel_tpu.workloads import bam_to_consensus
 t0 = time.perf_counter()
@@ -91,20 +93,33 @@ res = bam_to_consensus({bam!r}, backend={backend!r},
                        stream_chunk_mb={chunk!r})
 wall = time.perf_counter() - t0
 rss_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
+sharded = False
+if {mesh!r}:
+    import kindel_tpu.parallel.stream_product as sp
+    sharded = sp._zeros_sharded._cache_size() > 0  # jit ran => mesh engaged
+seq = res.consensuses[0].sequence
 print(json.dumps({{"mode": {mode!r}, "max_rss_mb": round(rss_mb, 1),
-                  "wall_s": round(wall, 2),
-                  "mbases": round(len(res.consensuses[0].sequence) / 1e6, 2)}}))
+                  "wall_s": round(wall, 2), "n_devices": len(jax.devices()),
+                  "sharded": sharded,
+                  "digest": __import__("hashlib").sha256(seq.encode()).hexdigest()[:16],
+                  "mbases": round(len(seq) / 1e6, 2)}}))
 """
 
 
-def measure(bam: Path, mode: str, backend: str, chunk_mb) -> dict:
+def measure(bam: Path, mode: str, backend: str, chunk_mb,
+            mesh: int = 0) -> dict:
     code = _CHILD.format(
         repo=str(REPO), bam=str(bam), backend=backend, chunk=chunk_mb,
-        mode=mode,
+        mode=mode, mesh=mesh,
     )
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
+    if mesh:
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={mesh}"
+        ).strip()
     # keep autostream out of the slurp arm
     env["KINDEL_TPU_STREAM_THRESHOLD_MB"] = "1000000"
     out = subprocess.run(
@@ -122,6 +137,10 @@ def main():
                     help="decompressed size of the synthetic BAM")
     ap.add_argument("--chunk-mb", type=float, default=64.0)
     ap.add_argument("--backend", default="jax")
+    ap.add_argument("--mesh", type=int, default=8, metavar="N",
+                    help="also run the streamed path on an N-device "
+                         "virtual CPU mesh and assert sharded execution + "
+                         "identical output (0 disables)")
     ap.add_argument("--keep", action="store_true")
     args = ap.parse_args()
 
@@ -144,6 +163,20 @@ def main():
         f"({ratio:.1f}x), wall {slurp['wall_s']} -> {stream['wall_s']} s",
         file=sys.stderr,
     )
+    if args.mesh:
+        meshed = measure(
+            bam, f"stream+mesh{args.mesh}", args.backend, args.chunk_mb,
+            mesh=args.mesh,
+        )
+        same = meshed["digest"] == stream["digest"] == slurp["digest"]
+        print(
+            f"# mesh{args.mesh}: rss {meshed['max_rss_mb']:.0f} MB, "
+            f"wall {meshed['wall_s']} s, sharded={meshed['sharded']}, "
+            f"output identical={same}",
+            file=sys.stderr,
+        )
+        if not (same and meshed["sharded"]):
+            sys.exit(1)
     if not args.keep:
         bam.unlink()
 

@@ -176,7 +176,23 @@ def _reduce_and_call_local(
     ins_totals = (
         jnp.zeros(block, jnp.int32).at[ins_pos].add(ins_cnt, mode="drop")
     )
+    csw = weighted(csw_pos, csw_base) if realign else None
+    cew = weighted(cew_pos, cew_base) if realign else None
+    return _call_from_channels(
+        weights, deletions, ins_totals, csw, cew, min_depth,
+        block=block, L=L, axis=axis, realign=realign,
+    )
 
+
+def _call_from_channels(
+    weights, deletions, ins_totals, csw, cew, min_depth,
+    *, block: int, L: int, axis: str, realign: bool,
+):
+    """Per-position call over one shard's finished channel tensors —
+    shared by the event-reduce path above and the streamed-accumulate
+    path (counts arrive already reduced on device). Channel tensors are
+    shard-local [block, C] / [block]; semantics are exactly
+    call_jax._call_core's."""
     acgt = weights[:, :4].sum(axis=1)
     w_sum = weights.sum(axis=1)
 
@@ -230,8 +246,6 @@ def _reduce_and_call_local(
     if not realign:
         return wire + dense
 
-    csw = weighted(csw_pos, csw_base)
-    cew = weighted(cew_pos, cew_base)
     csd = csw[:, :4].sum(axis=1)
     ced = cew[:, :4].sum(axis=1)
     # integer-exact dominance trigger: c/(w+d+1) > 0.5 ⟺ 2c > w+d+1
@@ -262,18 +276,11 @@ def _product_jit(
         _reduce_and_call_local, block=block, L=L, axis=axis, realign=realign
     )
     row = P(axis, None)
-    wire_specs = (row,) * 5 + (P(axis), P(axis))
-    dense_specs = (P(axis, None, None), row, row)
-    out_specs = wire_specs + dense_specs
-    if realign:
-        out_specs = out_specs + (
-            row, row, P(axis, None, None), P(axis, None, None)
-        )
     mapped = jax.shard_map(
         fn,
         mesh=mesh,
         in_specs=(row,) * 3 + (P(axis),) + (row,) * 7 + (P(),),
-        out_specs=out_specs,
+        out_specs=_out_specs(axis, realign),
     )
     outs = mapped(
         op_start, op_off, base_packed, n_ev,
@@ -281,7 +288,18 @@ def _product_jit(
         csw_pos, csw_base, cew_pos, cew_base,
         min_depth,
     )
-    n = mesh.shape[axis]
+    return _package_outs(outs, mesh.shape[axis], block, realign)
+
+
+def _out_specs(axis: str, realign: bool):
+    row = P(axis, None)
+    specs = (row,) * 5 + (P(axis), P(axis)) + (P(axis, None, None), row, row)
+    if realign:
+        specs = specs + (row, row, P(axis, None, None), P(axis, None, None))
+    return specs
+
+
+def _package_outs(outs, n: int, block: int, realign: bool):
     Lp = n * block
     (plane, nchar_b, del_b, n_b, ins_b, dmin, dmax,
      weights, deletions, ins_totals, *rest) = outs
@@ -304,6 +322,50 @@ def _product_jit(
         flat["csw"] = csw.reshape(Lp, N_CHANNELS)
         flat["cew"] = cew.reshape(Lp, N_CHANNELS)
     return flat
+
+
+def _counts_call_local(
+    w_flat, d, ins_pos, ins_cnt, csw_flat, cew_flat, min_depth,
+    *, block: int, L: int, axis: str, realign: bool,
+):
+    """Call over one shard's *accumulated* channel tensors (streamed
+    path): the reduction already happened chunk-by-chunk on this device;
+    only the tiny insertion-totals scatter remains."""
+    weights = w_flat[0].reshape(block, N_CHANNELS)
+    deletions = d[0]
+    ins_totals = (
+        jnp.zeros(block, jnp.int32)
+        .at[ins_pos[0]]
+        .add(ins_cnt[0], mode="drop")
+    )
+    csw = csw_flat[0].reshape(block, N_CHANNELS) if realign else None
+    cew = cew_flat[0].reshape(block, N_CHANNELS) if realign else None
+    return _call_from_channels(
+        weights, deletions, ins_totals, csw, cew, min_depth,
+        block=block, L=L, axis=axis, realign=realign,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("mesh", "block", "L", "axis", "realign"),
+)
+def _counts_product_jit(
+    w_flat, d, ins_pos, ins_cnt, csw_flat, cew_flat, min_depth,
+    *, mesh: Mesh, block: int, L: int, axis: str, realign: bool,
+):
+    fn = partial(
+        _counts_call_local, block=block, L=L, axis=axis, realign=realign
+    )
+    row = P(axis, None)
+    mapped = jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(row,) * 6 + (P(),),
+        out_specs=_out_specs(axis, realign),
+    )
+    outs = mapped(w_flat, d, ins_pos, ins_cnt, csw_flat, cew_flat, min_depth)
+    return _package_outs(outs, mesh.shape[axis], block, realign)
 
 
 @partial(jax.jit, static_argnames=("chunk",))
@@ -383,6 +445,44 @@ class ShardedRef:
                 mesh=mesh, block=block, L=L, axis=axis, realign=realign,
             )
         self._chunk = min(4096, self.Lp)
+
+    @classmethod
+    def from_counts(
+        cls, *, ref_id: str, L: int, block: int, mesh: Mesh,
+        w_flat, d, csw_flat, cew_flat, ins_table,
+        min_depth: int = 1, realign: bool = False, axis: str = "sp",
+    ):
+        """Build from already-accumulated sharded count state (the
+        streamed-ingest path): w/csw/cew are device-resident
+        [n, block·C] int32 shards, d is [n, block]; only the tiny
+        insertion table still rides up from host. The call kernel and
+        every downstream accessor (wire decode, lazy CDR windows) are
+        identical to the event-built instance."""
+        self = cls.__new__(cls)
+        self.L = L
+        self.ref_id = ref_id
+        n = self.n_shards = mesh.shape[axis]
+        self.block = block
+        self.Lp = n * block
+        self.realign = realign
+        self.ins_table = ins_table
+
+        isel = ins_table.pos < L
+        ins_b, (icnt_b,) = bucket_events_by_position(
+            ins_table.pos[isel],
+            [ins_table.count[isel].astype(np.int64)],
+            n, block,
+        )
+        if csw_flat is None:
+            csw_flat = cew_flat = jnp.zeros((n, 8), jnp.int32)
+        with mesh:
+            self._out = _counts_product_jit(
+                w_flat, d, jnp.asarray(ins_b), jnp.asarray(icnt_b),
+                csw_flat, cew_flat, jnp.int32(min_depth),
+                mesh=mesh, block=block, L=L, axis=axis, realign=realign,
+            )
+        self._chunk = min(4096, self.Lp)
+        return self
 
     # ---- wire-format decode ------------------------------------------------
 

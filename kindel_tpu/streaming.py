@@ -97,17 +97,40 @@ def _dev_ops():
     return _DEV_OPS
 
 
-class StreamAccumulator:
+class StreamAccumulatorBase:
+    """Shared per-batch bookkeeping for streamed accumulation: header
+    latch from the first batch, insertion-counter update, first-appearance
+    reference registration. Subclasses define `_new_state(rid)` and
+    `_reduce(state, ev, rid)` (single-device host/device state here;
+    position-sharded mesh state in parallel.stream_product)."""
+
+    def __init__(self):
+        self.ref_names: list[str] = []
+        self.ref_lens = None
+        self.states: dict = {}
+        self.present: list[int] = []  # first-appearance order
+        self.insertions: Counter = Counter()
+
+    def add_batch(self, batch) -> None:
+        if self.ref_lens is None:
+            self.ref_names = batch.ref_names
+            self.ref_lens = np.asarray(batch.ref_lens, dtype=np.int64)
+        ev = extract_events(batch)
+        self.insertions.update(ev.insertions)
+        for rid in ev.present_ref_ids:
+            if rid not in self.states:
+                self.states[rid] = self._new_state(rid)
+                self.present.append(rid)
+            self._reduce(self.states[rid], ev, rid)
+
+
+class StreamAccumulator(StreamAccumulatorBase):
     """Order-independent additive reduction over streamed ReadBatches."""
 
     def __init__(self, backend: str = "numpy", full: bool = False):
+        super().__init__()
         self.device = backend == "jax"
         self.full = full
-        self.ref_names: list[str] = []
-        self.ref_lens = None
-        self.states: dict[int, _RefState] = {}
-        self.present: list[int] = []  # first-appearance order
-        self.insertions: Counter = Counter()
 
     # -- helpers -----------------------------------------------------------
 
@@ -135,44 +158,35 @@ class StreamAccumulator:
 
     # -- per-chunk reduction -----------------------------------------------
 
-    def add_batch(self, batch) -> None:
-        if self.ref_lens is None:
-            self.ref_names = batch.ref_names
-            self.ref_lens = np.asarray(batch.ref_lens, dtype=np.int64)
-        ev = extract_events(batch)
-        self.insertions.update(ev.insertions)
-        for rid in ev.present_ref_ids:
-            if rid not in self.states:
-                self.states[rid] = _RefState(
-                    int(self.ref_lens[rid]), self.device, self.full
-                )
-                self.present.append(rid)
-            st = self.states[rid]
-            L = st.L
+    def _new_state(self, rid: int) -> _RefState:
+        return _RefState(int(self.ref_lens[rid]), self.device, self.full)
 
-            def stream(rids, pos, base=None):
-                sel = rids == rid
-                p = pos[sel]
-                if base is None:
-                    return p
-                return p * N_CHANNELS + base[sel].astype(np.int64)
+    def _reduce(self, st: _RefState, ev, rid: int) -> None:
+        L = st.L
 
-            st.w = self._add(
-                st.w, stream(ev.match_rid, ev.match_pos, ev.match_base),
+        def stream(rids, pos, base=None):
+            sel = rids == rid
+            p = pos[sel]
+            if base is None:
+                return p
+            return p * N_CHANNELS + base[sel].astype(np.int64)
+
+        st.w = self._add(
+            st.w, stream(ev.match_rid, ev.match_pos, ev.match_base),
+            L * N_CHANNELS,
+        )
+        st.d = self._add(st.d, stream(ev.del_rid, ev.del_pos), L + 1)
+        if self.full:
+            st.csw = self._add(
+                st.csw, stream(ev.csw_rid, ev.csw_pos, ev.csw_base),
                 L * N_CHANNELS,
             )
-            st.d = self._add(st.d, stream(ev.del_rid, ev.del_pos), L + 1)
-            if self.full:
-                st.csw = self._add(
-                    st.csw, stream(ev.csw_rid, ev.csw_pos, ev.csw_base),
-                    L * N_CHANNELS,
-                )
-                st.cew = self._add(
-                    st.cew, stream(ev.cew_rid, ev.cew_pos, ev.cew_base),
-                    L * N_CHANNELS,
-                )
-                st.cs = self._add(st.cs, stream(ev.cs_rid, ev.cs_pos), L + 1)
-                st.ce = self._add(st.ce, stream(ev.ce_rid, ev.ce_pos), L + 1)
+            st.cew = self._add(
+                st.cew, stream(ev.cew_rid, ev.cew_pos, ev.cew_base),
+                L * N_CHANNELS,
+            )
+            st.cs = self._add(st.cs, stream(ev.cs_rid, ev.cs_pos), L + 1)
+            st.ce = self._add(st.ce, stream(ev.ce_rid, ev.ce_pos), L + 1)
 
     # -- materialization ---------------------------------------------------
 
@@ -234,7 +248,17 @@ def streamed_consensus(
     from kindel_tpu.call import _insertion_calls, assemble, call_consensus
     from kindel_tpu.io.fasta import Sequence
     from kindel_tpu.realign import cdrp_consensuses, merge_cdrps
-    from kindel_tpu.workloads import build_report, result
+    from kindel_tpu.workloads import _shardable_device_count, build_report, result
+
+    if backend == "jax" and _shardable_device_count() > 1:
+        # streamed × sharded: chunks reduce into position-sharded device
+        # state, the close runs the product kernel — bounded RSS *and*
+        # sequence parallelism together (kindel_tpu.parallel.stream_product)
+        return _streamed_sharded_consensus(
+            bam_path, realign, min_depth, min_overlap,
+            clip_decay_threshold, mask_ends, trim_ends, uppercase,
+            chunk_bytes,
+        )
 
     # realign (or the numpy oracle) consumes host pileups; the plain jax
     # path keeps everything on device until the packed wire download
@@ -288,6 +312,51 @@ def streamed_consensus(
             )
             depth_min, depth_max = int(dmin), int(dmax)
 
+        refs_reports[ref_id] = build_report(
+            ref_id, depth_min, depth_max, res.changes, cdr_patches,
+            bam_path, realign, min_depth, min_overlap,
+            clip_decay_threshold, trim_ends, uppercase,
+        )
+        refs_changes[ref_id] = res.changes
+        consensuses.append(
+            Sequence(name=f"{ref_id}_cns", sequence=res.sequence)
+        )
+    return result(consensuses, refs_changes, refs_reports)
+
+
+def _streamed_sharded_consensus(
+    bam_path, realign, min_depth, min_overlap, clip_decay_threshold,
+    mask_ends, trim_ends, uppercase, chunk_bytes,
+):
+    """Streamed decode reduced into position-sharded device state; the
+    closing call + (optional) lazy CDR walk run through the product
+    kernel. Output byte-identical to every other path."""
+    from kindel_tpu.call import _insertion_calls, assemble
+    from kindel_tpu.io.fasta import Sequence
+    from kindel_tpu.parallel.stream_product import ShardedStreamAccumulator
+    from kindel_tpu.workloads import build_report, result
+
+    acc = ShardedStreamAccumulator(full=realign)
+    for batch in stream_alignment(bam_path, chunk_bytes):
+        acc.add_batch(batch)
+
+    consensuses, refs_changes, refs_reports = [], {}, {}
+    for rid in acc.present:
+        ref_id = acc.ref_names[rid]
+        sr = acc.finish(rid, min_depth=min_depth, realign=realign)
+        cdr_patches = (
+            sr.cdr_patches(clip_decay_threshold, mask_ends, min_overlap)
+            if realign
+            else None
+        )
+        masks = sr.call_masks()
+        ins_calls = (
+            _insertion_calls(sr.ins_table) if masks.ins_mask.any() else {}
+        )
+        res = assemble(
+            masks, ins_calls, cdr_patches, trim_ends, min_depth, uppercase,
+        )
+        depth_min, depth_max = sr.depth_scalars()
         refs_reports[ref_id] = build_report(
             ref_id, depth_min, depth_max, res.changes, cdr_patches,
             bam_path, realign, min_depth, min_overlap,

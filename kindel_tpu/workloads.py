@@ -43,12 +43,10 @@ def _resolve_stream_chunk(bam_path, stream_chunk_mb,
     """Decide whether to stream: explicit arg > env chunk size > automatic
     for files past the size threshold (default 512 MB).
 
-    Auto-streaming stands down when the multi-device sharded product path
-    would engage (backend=jax, >1 device): streamed accumulation is
-    currently single-device, and silently trading the mesh for bounded RSS
-    on exactly the large inputs sharding targets would regress the
-    headline benchmark. An explicit chunk size still wins — the caller
-    asked for bounded memory."""
+    Streaming composes with the multi-device sharded product path (round
+    3): chunks reduce into position-sharded device state
+    (kindel_tpu.parallel.stream_product), so a large file on a mesh gets
+    bounded RSS *and* sequence parallelism together."""
     import os
 
     if stream_chunk_mb is not None:
@@ -56,8 +54,6 @@ def _resolve_stream_chunk(bam_path, stream_chunk_mb,
     env = os.environ.get("KINDEL_TPU_STREAM_CHUNK_MB")
     if env:
         return float(env) or None
-    if backend == "jax" and _shardable_device_count() > 1:
-        return None
     try:
         size = os.path.getsize(bam_path)
     except OSError:
