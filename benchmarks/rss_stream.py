@@ -163,6 +163,9 @@ def main():
         f"({ratio:.1f}x), wall {slurp['wall_s']} -> {stream['wall_s']} s",
         file=sys.stderr,
     )
+    if args.mesh and args.backend != "jax":
+        print("# mesh arm skipped: requires --backend jax", file=sys.stderr)
+        args.mesh = 0
     if args.mesh:
         meshed = measure(
             bam, f"stream+mesh{args.mesh}", args.backend, args.chunk_mb,

@@ -23,11 +23,13 @@ from __future__ import annotations
 
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
+from functools import partial
 
 from kindel_tpu.utils.jax_cache import ensure_compilation_cache
 
 ensure_compilation_cache()
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -35,13 +37,15 @@ from kindel_tpu.call import _insertion_calls, assemble
 from kindel_tpu.call_jax import (
     CallUnit,
     batched_call_kernel,
+    batched_realign_call_kernel,
     decode_fast,
     masks_from_wire,
 )
 from kindel_tpu.events import extract_events
 from kindel_tpu.io import load_alignment
 from kindel_tpu.io.fasta import Sequence
-from kindel_tpu.pileup_jax import PAD_POS, _bucket, _pad
+from kindel_tpu.pileup_jax import PAD_POS, _bucket, _pad, check_pad_safe_block
+from kindel_tpu.realign import LazyCdrWindows
 
 
 @dataclass
@@ -78,30 +82,19 @@ class SampleResult:
 
 def _load_units(bam_paths, pool, opts: BatchOptions) -> list:
     """Decode + event-extract a cohort concurrently → flat CallUnit list
-    (each tagged with its sample index). Under --realign, each unit's CDR
-    patches are computed here from a transient host pileup (CDR metadata
-    is tiny; the pileup is dropped immediately)."""
+    (each tagged with its sample index). Under --realign the units carry
+    their clip-projection events; CDR triggers and clip channels reduce
+    on device in the batched kernel and the patches are computed at
+    assembly via lazy window fetches — no host pileup is ever built
+    (VERDICT r2 item 3)."""
 
     def load(path_idx):
         idx, path = path_idx
         ev = extract_events(load_alignment(str(path)))
         units_ = []
         for rid in ev.present_ref_ids:
-            u = CallUnit(ev, rid, with_ins_table=True)
+            u = CallUnit(ev, rid, with_ins_table=True, realign=opts.realign)
             u.sample_idx = idx
-            if opts.realign:
-                from kindel_tpu.pileup import build_pileup
-                from kindel_tpu.realign import cdrp_consensuses, merge_cdrps
-
-                pileup = build_pileup(ev, rid)
-                u.cdr_patches = merge_cdrps(
-                    cdrp_consensuses(
-                        pileup,
-                        clip_decay_threshold=opts.clip_decay_threshold,
-                        mask_ends=opts.mask_ends,
-                    ),
-                    opts.min_overlap,
-                )
             units_.append(u)
         return units_
 
@@ -205,6 +198,8 @@ def _dispatch_device_call(units, opts: BatchOptions):
     import jax
 
     L = _bucket(max(u.L for u in units), 1024)
+    # the bucketed (power-of-two) length is the actual scatter target
+    check_pad_safe_block(L, "cohort-padded reference")
     O_pad = _bucket(max(len(u.op_r_start) for u in units), 64)
     B_pad = _bucket(max(len(u.base_packed) for u in units), 256)
     D_pad = _bucket(max((len(u.del_pos) for u in units), default=1), 64)
@@ -239,16 +234,76 @@ def _dispatch_device_call(units, opts: BatchOptions):
         n_events,
         ref_lens,
     )
+    if opts.realign:
+        C_pad = _bucket(
+            max(
+                (max(len(u.csw_pos), len(u.cew_pos)) for u in units),
+                default=1,
+            ),
+            64,
+        )
+        arrays = arrays + (
+            stack(lambda u: u.csw_pos, C_pad, PAD_POS),
+            stack(lambda u: u.csw_base, C_pad, 0),
+            stack(lambda u: u.cew_pos, C_pad, PAD_POS),
+            stack(lambda u: u.cew_base, C_pad, 0),
+        )
     if sharding is None:
         dev_arrays = tuple(jnp.asarray(a) for a in arrays)
     else:
         dev_arrays = tuple(
             jax.device_put(a, sharding(a.ndim)) for a in arrays
         )
-    return batched_call_kernel(
+    kernel = (
+        batched_realign_call_kernel if opts.realign else batched_call_kernel
+    )
+    return kernel(
         *dev_arrays, jnp.int32(opts.min_depth), length=L,
         want_masks=opts.want_masks,
     )
+
+
+@partial(jax.jit, static_argnames=("chunk",))
+def _fetch_row2d(arr, i, start, *, chunk: int):
+    return jax.lax.dynamic_slice(
+        arr, (i, start, 0), (1, chunk, arr.shape[2])
+    )[0]
+
+
+@partial(jax.jit, static_argnames=("chunk",))
+def _fetch_row1d(arr, i, start, *, chunk: int):
+    return jax.lax.dynamic_slice(arr, (i, start), (1, chunk))[0]
+
+
+class _RowCdrFetcher(LazyCdrWindows):
+    """Lazy window access into one sample's row of the batched
+    device-resident channel tensors — the cohort instantiation of
+    realign.LazyCdrWindows. Downloads a few KB per clip-dominant region
+    instead of one dense pileup per sample."""
+
+    def __init__(self, dense, row: int, L: int):
+        weights, deletions, csw, cew = dense
+        self._arrs = {
+            "weights": weights, "deletions": deletions,
+            "csw": csw, "cew": cew,
+        }
+        self.row = row
+        self.L = L
+        self.Lp = int(weights.shape[1])
+        self._chunk = min(4096, self.Lp)
+
+    def _fetch(self, key: str, start: int) -> np.ndarray:
+        arr = self._arrs[key]
+        fetch = _fetch_row2d if arr.ndim == 3 else _fetch_row1d
+        return np.asarray(
+            fetch(arr, jnp.int32(self.row), jnp.int32(start),
+                  chunk=self._chunk)
+        )
+
+    def _empty(self, key: str) -> np.ndarray:
+        return np.empty(
+            (0,) + self._arrs[key].shape[2:], np.int32
+        )
 
 
 def _assemble_outputs(units, device_out, opts: BatchOptions, pool,
@@ -257,7 +312,14 @@ def _assemble_outputs(units, device_out, opts: BatchOptions, pool,
     thread-parallel). Returns (Sequence, changes|None, report|None) per
     unit, in unit order. `paths` maps sample_idx → input path for the
     report header (required when build_reports)."""
-    main_out, extra, dmins, dmaxs = device_out
+    if opts.realign:
+        (main_out, extra, dmins, dmaxs,
+         trig_f_bits, trig_r_bits, *dense) = device_out
+        trig_f_bits = np.asarray(trig_f_bits)
+        trig_r_bits = np.asarray(trig_r_bits)
+    else:
+        main_out, extra, dmins, dmaxs = device_out
+        trig_f_bits = trig_r_bits = dense = None
     main_out = np.asarray(main_out)
     extra = tuple(np.asarray(x) for x in extra)
     if opts.build_reports:
@@ -266,6 +328,19 @@ def _assemble_outputs(units, device_out, opts: BatchOptions, pool,
 
     def assemble_unit(i_u):
         i, u = i_u
+        if opts.realign:
+            trig_f = np.flatnonzero(
+                np.unpackbits(trig_f_bits[i])[: u.L]
+            )
+            trig_r = np.flatnonzero(
+                np.unpackbits(trig_r_bits[i])[: u.L]
+            )
+            u.cdr_patches = _RowCdrFetcher(
+                dense, i, u.L
+            ).cdr_patches_from_triggers(
+                trig_f, trig_r, opts.clip_decay_threshold,
+                opts.mask_ends, opts.min_overlap,
+            )
         if opts.want_masks:
             _emit, masks = masks_from_wire(
                 main_out[i], (extra[0][i], extra[1][i], extra[2][i]), u.L

@@ -34,7 +34,7 @@ import numpy as np
 from kindel_tpu.call import CallMasks, CallResult, _insertion_calls, assemble
 from kindel_tpu.events import BASES, EventSet, N_CHANNELS
 from kindel_tpu.pileup import build_insertion_table
-from kindel_tpu.pileup_jax import PAD_POS, _bucket, _pad
+from kindel_tpu.pileup_jax import PAD_POS, _bucket, _pad, check_pad_safe_block
 
 #: emission encoding: 0 = emit nothing (deletion call), 1..5 = A,T,G,C,N
 EMIT_ASCII = np.frombuffer(b"\x00" + BASES, dtype=np.uint8)
@@ -75,10 +75,14 @@ def _call_core(
     length: int,
     want_masks: bool,
     valid_len=None,  # optional int32 scalar: row's true ref length
+    keep_dense: bool = False,
 ):
     """Reconstruct match events, scatter counts, call every position.
 
-    Returns (emit_packed, masks, depth_min, depth_max).
+    Returns (emit_packed, masks, depth_min, depth_max); with keep_dense
+    the scattered weights/deletions tensors are appended (the cohort
+    realign path needs them device-resident for trigger denominators and
+    lazy CDR window fetches).
     """
     E_pad = base_packed.shape[0] * 2
     # unpack 4-bit base codes
@@ -107,10 +111,13 @@ def _call_core(
     ins_totals = (
         jnp.zeros(length, jnp.int32).at[ins_pos].add(ins_cnt, mode="drop")
     )
-    return _decide(
+    out = _decide(
         weights, deletions, ins_totals, del_pos, ins_pos, min_depth,
         want_masks, valid_len,
     )
+    if keep_dense:
+        return out + (weights, deletions)
+    return out
 
 
 def _decide(weights, deletions, ins_totals, del_pos, ins_pos, min_depth,
@@ -240,6 +247,53 @@ def batched_call_kernel(op_r_start, op_off, base_packed, del_pos, ins_pos,
     )
 
 
+@partial(jax.jit, static_argnames=("length", "want_masks"))
+def batched_realign_call_kernel(
+    op_r_start, op_off, base_packed, del_pos, ins_pos, ins_cnt,
+    n_events, ref_lens, csw_pos, csw_base, cew_pos, cew_base, min_depth,
+    *, length: int, want_masks: bool = False,
+):
+    """Batched call + on-device CDR trigger computation (cohort --realign).
+
+    Beyond batched_call_kernel, each sample's clip-projection events
+    scatter into [length, 5] clip-weight tensors, and the two
+    clip-dominance trigger bitmasks (2·csd > w+d+1, integer-exact —
+    reference kindel.py:182-185,229-238) are computed per position.
+    Returns (main, extra, dmin, dmax, trig_fwd_bits, trig_rev_bits,
+    weights, deletions, csw, cew): the four dense tensors stay
+    device-resident for the host walk's lazy window fetches — only the
+    ~L/8-byte trigger bitmasks are meant to cross the wire. This replaces
+    one dense host pileup per sample (VERDICT r2 item 3)."""
+
+    def one(ors, oo, bp, dp, ip, ic, ne, rl, cswp, cswb, cewp, cewb):
+        out = _call_core(
+            ors, oo, bp, dp, ip, ic, ne, min_depth, length, want_masks,
+            valid_len=rl, keep_dense=True,
+        )
+        *wire, weights, deletions = out
+
+        def clip_scatter(p, b):
+            return (
+                jnp.zeros(length * N_CHANNELS, jnp.int32)
+                .at[p * N_CHANNELS + b]
+                .add(1, mode="drop")
+                .reshape(length, N_CHANNELS)
+            )
+
+        csw = clip_scatter(cswp, cswb)
+        cew = clip_scatter(cewp, cewb)
+        valid = jnp.arange(length, dtype=jnp.int32) < rl
+        denom = weights.sum(axis=1) + deletions + 1
+        trig_f = jnp.packbits((2 * csw[:, :4].sum(axis=1) > denom) & valid)
+        trig_r = jnp.packbits((2 * cew[:, :4].sum(axis=1) > denom) & valid)
+        return tuple(wire) + (trig_f, trig_r, weights, deletions, csw, cew)
+
+    return jax.vmap(one)(
+        op_r_start, op_off, base_packed, del_pos, ins_pos, ins_cnt,
+        n_events, ref_lens, csw_pos, csw_base, cew_pos, cew_base,
+    )
+
+
 def unpack_emit(emit_packed: np.ndarray, L: int) -> np.ndarray:
     """4-bit emission codes → uint8[L] (0=deletion-skip, 1..5=A,T,G,C,N)."""
     emit = np.empty(emit_packed.shape[0] * 2, dtype=np.uint8)
@@ -306,13 +360,15 @@ class CallUnit:
     __slots__ = (
         "ref_id", "L", "op_r_start", "op_off", "base_packed", "n_events",
         "del_pos", "ins_pos", "ins_cnt", "ins_table", "sample_idx",
-        "cdr_patches",
+        "cdr_patches", "csw_pos", "csw_base", "cew_pos", "cew_base",
     )
 
-    def __init__(self, ev: EventSet, rid: int, with_ins_table: bool = False):
-        self.cdr_patches = None  # set by the cohort loader under --realign
+    def __init__(self, ev: EventSet, rid: int, with_ins_table: bool = False,
+                 realign: bool = False):
+        self.cdr_patches = None  # set by the cohort pipeline under --realign
         self.ref_id = ev.ref_names[rid]
         L = self.L = int(ev.ref_lens[rid])
+        check_pad_safe_block(L)
         sel = ev.match_rid == rid
         mp = ev.match_pos[sel]
         self.op_r_start, self.op_off, self.base_packed = (
@@ -321,6 +377,18 @@ class CallUnit:
         self.n_events = len(mp)
         dp = ev.del_pos[ev.del_rid == rid]
         self.del_pos = dp[dp < L].astype(np.int32)
+        if realign:
+            # clip-projection events feed the on-device CDR trigger
+            # computation + lazy windows (batch realign; VERDICT r2 item 3)
+            s = ev.csw_rid == rid
+            self.csw_pos = ev.csw_pos[s].astype(np.int32)
+            self.csw_base = ev.csw_base[s].astype(np.int32)
+            s = ev.cew_rid == rid
+            self.cew_pos = ev.cew_pos[s].astype(np.int32)
+            self.cew_base = ev.cew_base[s].astype(np.int32)
+        else:
+            self.csw_pos = self.csw_base = None
+            self.cew_pos = self.cew_base = None
         self.ins_table = None
         if with_ins_table:
             tab = build_insertion_table(ev, rid)

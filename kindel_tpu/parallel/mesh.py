@@ -32,7 +32,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from kindel_tpu.events import N_CHANNELS, BASES
-from kindel_tpu.pileup_jax import PAD_POS, _bucket, _pad
+from kindel_tpu.pileup_jax import PAD_POS, _bucket, _pad, check_pad_safe_block
 
 BASE_ASCII_J = jnp.asarray(np.frombuffer(BASES, dtype=np.uint8))
 _N = np.uint8(ord("N"))
@@ -165,6 +165,7 @@ def sharded_call(ev, rid: int, mesh: Mesh, min_depth: int = 1,
     n = mesh.shape[axis]
     L = int(ev.ref_lens[rid])
     block = -(-L // n)  # ceil; padded positions produce zero counts
+    check_pad_safe_block(block, "per-shard block")
 
     sel = ev.match_rid == rid
     mp, mb = ev.match_pos[sel], ev.match_base[sel].astype(np.int64)

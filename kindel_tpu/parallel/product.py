@@ -48,13 +48,8 @@ from kindel_tpu.io.records import (
 )
 from kindel_tpu.parallel.mesh import bucket_events_by_position, make_mesh
 from kindel_tpu.pileup import build_insertion_table
-from kindel_tpu.pileup_jax import PAD_POS, _bucket
-from kindel_tpu.realign import (
-    cdr_end_consensuses_lazy,
-    cdr_start_consensuses_lazy,
-    merge_cdrps,
-    pair_regions,
-)
+from kindel_tpu.pileup_jax import PAD_POS, _bucket, check_pad_safe_block
+from kindel_tpu.realign import LazyCdrWindows
 
 _I32_MAX = np.int32(2**31 - 1)
 
@@ -349,6 +344,9 @@ def _counts_call_local(
 @partial(
     jax.jit,
     static_argnames=("mesh", "block", "L", "axis", "realign"),
+    # the accumulated stream state is dead after the closing call —
+    # donate it so finish() does not double device memory
+    donate_argnums=(0, 1, 4, 5),
 )
 def _counts_product_jit(
     w_flat, d, ins_pos, ins_cnt, csw_flat, cew_flat, min_depth,
@@ -378,7 +376,7 @@ def _fetch2d(arr, start, *, chunk: int):
     return jax.lax.dynamic_slice(arr, (start, 0), (chunk, arr.shape[1]))
 
 
-class ShardedRef:
+class ShardedRef(LazyCdrWindows):
     """Device-resident sharded pileup + call for one reference.
 
     Construction uploads the bucketed event streams and runs the single
@@ -396,6 +394,7 @@ class ShardedRef:
         # packbits/plane lanes stay byte-aligned
         block = -(-L // n)
         self.block = block = -(-block // 8) * 8
+        check_pad_safe_block(block, "per-shard block")
         self.Lp = n * block
         self.realign = realign
 
@@ -474,7 +473,9 @@ class ShardedRef:
             n, block,
         )
         if csw_flat is None:
-            csw_flat = cew_flat = jnp.zeros((n, 8), jnp.int32)
+            # two distinct buffers: both are donated into the call
+            csw_flat = jnp.zeros((n, 8), jnp.int32)
+            cew_flat = jnp.zeros((n, 8), jnp.int32)
         with mesh:
             self._out = _counts_product_jit(
                 w_flat, d, jnp.asarray(ins_b), jnp.asarray(icnt_b),
@@ -517,60 +518,24 @@ class ShardedRef:
             np.flatnonzero(self._bits("trig_rev_bits")),
         )
 
-    def _window(self, key: str, a: int, b: int) -> np.ndarray:
-        """Download [a,b) of a device-resident channel via fixed-size
-        jitted dynamic-slice fetches (compile-once per shape)."""
+    def _fetch(self, key: str, start: int) -> np.ndarray:
+        """One fixed-size jitted dynamic-slice download (LazyCdrWindows
+        contract; compile-once per shape)."""
         arr = self._out[key]
-        chunk = self._chunk
         fetch = _fetch2d if arr.ndim == 2 else _fetch1d
-        parts = []
-        s = a
-        while s < b:
-            # dynamic_slice clamps the start so the window stays in range
-            start = min(s, self.Lp - chunk)
-            win = np.asarray(fetch(arr, jnp.int32(start), chunk=chunk))
-            e = min(b, start + chunk)
-            parts.append(win[s - start : e - start])
-            s = e
-        return (
-            np.concatenate(parts)
-            if parts
-            else np.empty((0,) + arr.shape[1:], np.int32)
-        )
+        return np.asarray(fetch(arr, jnp.int32(start), chunk=self._chunk))
 
-    def _cond_fetch(self, clip_key: str, threshold: float):
-        """Decay condition csd > (w+d)·threshold over a window, evaluated
-        host-side in float64 from integer windows — bit-identical to the
-        eager path (realign.py cdr_*_consensuses)."""
-
-        def fetch(a: int, b: int) -> np.ndarray:
-            clip = self._window(clip_key, a, b)[:, :4].sum(axis=1)
-            w = self._window("weights", a, b).sum(axis=1)
-            d = self._window("deletions", a, b)
-            return clip.astype(np.float64) > (
-                w.astype(np.float64) + d.astype(np.float64)
-            ) * threshold
-
-        return fetch
+    def _empty(self, key: str) -> np.ndarray:
+        return np.empty((0,) + self._out[key].shape[1:], np.int32)
 
     def cdr_patches(self, clip_decay_threshold: float, mask_ends: int,
                     min_overlap: int):
         """Full CDR pipeline through the sharded tensors: sparse candidate
         discovery → lazy decay walks → pairing → LCS merge (host)."""
         trig_f, trig_r = self.trigger_positions()
-        fwd = cdr_start_consensuses_lazy(
-            self.L, trig_f,
-            self._cond_fetch("csw", clip_decay_threshold),
-            lambda a, b: self._window("csw", a, b),
-            mask_ends,
+        return self.cdr_patches_from_triggers(
+            trig_f, trig_r, clip_decay_threshold, mask_ends, min_overlap
         )
-        rev = cdr_end_consensuses_lazy(
-            self.L, trig_r[::-1],
-            self._cond_fetch("cew", clip_decay_threshold),
-            lambda a, b: self._window("cew", a, b),
-            mask_ends,
-        )
-        return merge_cdrps(pair_regions(fwd, rev), min_overlap)
 
 
 def sharded_consensus(
@@ -597,6 +562,31 @@ def sharded_consensus(
     sr = ShardedRef(
         ev, rid, mesh, min_depth=min_depth, realign=realign, axis=axis
     )
+    return close_sharded_ref(
+        sr, realign=realign, min_depth=min_depth, min_overlap=min_overlap,
+        clip_decay_threshold=clip_decay_threshold, mask_ends=mask_ends,
+        trim_ends=trim_ends, uppercase=uppercase,
+        build_changes=build_changes,
+    )
+
+
+def close_sharded_ref(
+    sr: ShardedRef,
+    *,
+    realign: bool,
+    min_depth: int,
+    min_overlap: int,
+    clip_decay_threshold: float,
+    mask_ends: int,
+    trim_ends: bool,
+    uppercase: bool,
+    build_changes: bool = True,
+):
+    """Close one ShardedRef: (optional) lazy CDR walk → wire decode →
+    host assembly. Shared by the event-built path above and the streamed
+    close (streaming._streamed_sharded_consensus).
+
+    Returns (CallResult, depth_min, depth_max, cdr_patches)."""
     cdr_patches = (
         sr.cdr_patches(clip_decay_threshold, mask_ends, min_overlap)
         if realign

@@ -85,10 +85,13 @@ class _ShardState:
     __slots__ = ("L", "block", "w", "d", "csw", "cew")
 
     def __init__(self, L: int, n: int, mesh: Mesh, axis: str, full: bool):
+        from kindel_tpu.pileup_jax import check_pad_safe_block
+
         # same block geometry as ShardedRef.__init__: ceil(L/n) rounded to
         # a multiple of 8 keeps the packbits/plane lanes byte-aligned
         block = -(-L // n)
         self.block = block = -(-block // 8) * 8
+        check_pad_safe_block(block, "per-shard block")
         self.L = L
         z = partial(_zeros_sharded, mesh=mesh, axis=axis, n=n)
         self.w = z(m=block * N_CHANNELS)
@@ -150,12 +153,14 @@ class ShardedStreamAccumulator(StreamAccumulatorBase):
     def finish(self, rid: int, min_depth: int = 1,
                realign: bool = False) -> ShardedRef:
         """Close one reference's accumulation: run the sharded call kernel
-        over the finished channels and hand back the ShardedRef."""
+        over the finished channels and hand back the ShardedRef. The
+        accumulated state is consumed (popped + donated into the call) —
+        one finish per reference."""
         from kindel_tpu.pileup import insertion_table_from_counter
 
         if realign and not self.full:
             raise ValueError("accumulator built without clip channels")
-        st = self.states[rid]
+        st = self.states.pop(rid)
         tab = insertion_table_from_counter(self.insertions, rid, st.L)
         sr = ShardedRef.from_counts(
             ref_id=self.ref_names[rid], L=st.L, block=st.block,

@@ -31,6 +31,24 @@ from kindel_tpu.pileup import Pileup, build_insertion_table
 #: padding sentinel — out of range for every target array, dropped by scatter
 PAD_POS = np.int32(2**30)
 
+#: largest position count a PAD_POS-padded *flat* (pos·N_CHANNELS + base)
+#: scatter may cover: int32(PAD_POS·N_CHANNELS) two's-complement-wraps to
+#: exactly 2**30 (positive!), so a target with length·N_CHANNELS > 2**30
+#: would bring the pad sentinel back in range and every pad slot would
+#: silently corrupt one position instead of dropping
+MAX_PAD_SAFE_BLOCK = 2**30 // N_CHANNELS
+
+
+def check_pad_safe_block(n_positions: int, what: str = "reference") -> None:
+    """Raise before any PAD_POS flat scatter whose target is large enough
+    for the wrapped sentinel to land in range (~214.7 Mbp per shard)."""
+    if n_positions > MAX_PAD_SAFE_BLOCK:
+        raise ValueError(
+            f"{what} spans {n_positions} positions, past the "
+            f"{MAX_PAD_SAFE_BLOCK} bp limit of the PAD_POS flat-scatter "
+            "scheme — shard the position axis over more devices"
+        )
+
 
 def _bucket(n: int, minimum: int = 1024) -> int:
     """Next power-of-two padding size (bounds jit recompilations)."""
@@ -78,6 +96,7 @@ def build_pileup_jax(ev: EventSet, rid: int) -> Pileup:
     all-device path for benchmarks lives in kindel_tpu.call_jax.
     """
     L = int(ev.ref_lens[rid])
+    check_pad_safe_block(L)
 
     def weighted(rid_arr, pos_arr, base_arr, length):
         sel = rid_arr == rid

@@ -227,6 +227,81 @@ def cdrp_consensuses(pileup_or_weights, deletions=None, clip_start_weights=None,
     return pair_regions(fwd, rev)
 
 
+class LazyCdrWindows:
+    """Chunked window access to device-resident channel tensors for the
+    CDR walk — shared by the position-sharded product path (ShardedRef)
+    and the cohort batch path (_RowCdrFetcher). Subclasses define
+    `L` (reference length), `Lp` (padded tensor length), `_chunk`
+    (fetch granularity), `_fetch(key, start) -> np[chunk, ...]`
+    (a jitted dynamic-slice download of one fixed-size window), and
+    `_empty(key)`. Channel keys: "weights" [·,5], "deletions" [·],
+    "csw"/"cew" [·,5]."""
+
+    def window(self, key: str, a: int, b: int) -> np.ndarray:
+        """Download [a,b) of a channel via fixed-size fetches
+        (compile-once per shape; starts clamp so windows stay in range)."""
+        chunk = self._chunk
+        parts = []
+        s = a
+        while s < b:
+            start = min(s, self.Lp - chunk)
+            win = self._fetch(key, start)
+            e = min(b, start + chunk)
+            parts.append(win[s - start : e - start])
+            s = e
+        return np.concatenate(parts) if parts else self._empty(key)
+
+    def cond(self, clip_key: str, threshold: float):
+        """Decay condition csd > (w+d)·threshold over a window, evaluated
+        host-side in float64 from integer windows — bit-identical to the
+        eager path (cdr_*_consensuses)."""
+
+        def fetch(a: int, b: int) -> np.ndarray:
+            clip = self.window(clip_key, a, b)[:, :4].sum(axis=1)
+            w = self.window("weights", a, b).sum(axis=1)
+            d = self.window("deletions", a, b)
+            return clip.astype(np.float64) > (
+                w.astype(np.float64) + d.astype(np.float64)
+            ) * threshold
+
+        return fetch
+
+    def cdr_patches_from_triggers(
+        self, trig_fwd, trig_rev, clip_decay_threshold: float,
+        mask_ends: int, min_overlap: int,
+    ) -> list["Region"]:
+        return lazy_cdr_patches(
+            self.L, trig_fwd, trig_rev,
+            self.cond("csw", clip_decay_threshold),
+            self.cond("cew", clip_decay_threshold),
+            lambda a, b: self.window("csw", a, b),
+            lambda a, b: self.window("cew", a, b),
+            mask_ends, min_overlap,
+        )
+
+
+def lazy_cdr_patches(
+    L: int,
+    trig_fwd: np.ndarray,
+    trig_rev: np.ndarray,
+    cond_csw,
+    cond_cew,
+    win_csw,
+    win_cew,
+    mask_ends: int,
+    min_overlap: int,
+) -> list[Region]:
+    """Full CDR pipeline over device-resident clip tensors: trigger
+    positions (pre-computed on device, integer-exact) → lazy decay walks
+    via the fetch callables → pairing → LCS merge (host). Shared by the
+    position-sharded product path and the cohort batch path."""
+    fwd = cdr_start_consensuses_lazy(L, trig_fwd, cond_csw, win_csw,
+                                     mask_ends)
+    rev = cdr_end_consensuses_lazy(L, trig_rev[::-1], cond_cew, win_cew,
+                                   mask_ends)
+    return merge_cdrps(pair_regions(fwd, rev), min_overlap)
+
+
 def pair_regions(fwd: list[Region],
                  rev: list[Region]) -> list[tuple[Region, Region]]:
     """Each '→' region pairs with the first '←' region whose span

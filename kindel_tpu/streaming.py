@@ -250,14 +250,22 @@ def streamed_consensus(
     from kindel_tpu.realign import cdrp_consensuses, merge_cdrps
     from kindel_tpu.workloads import _shardable_device_count, build_report, result
 
-    if backend == "jax" and _shardable_device_count() > 1:
+    n_dev = _shardable_device_count() if backend == "jax" else 0
+    if backend == "jax" and (n_dev > 1 or realign):
         # streamed × sharded: chunks reduce into position-sharded device
         # state, the close runs the product kernel — bounded RSS *and*
-        # sequence parallelism together (kindel_tpu.parallel.stream_product)
+        # sequence parallelism together (kindel_tpu.parallel.stream_product).
+        # Realign takes this route even single-device (1-shard mesh): clip
+        # channels reduce on device, no dense host pileup (VERDICT r2 item 3).
+        mesh = None
+        if n_dev <= 1:
+            from kindel_tpu.parallel.mesh import make_mesh
+
+            mesh = make_mesh({"sp": 1})
         return _streamed_sharded_consensus(
             bam_path, realign, min_depth, min_overlap,
             clip_decay_threshold, mask_ends, trim_ends, uppercase,
-            chunk_bytes,
+            chunk_bytes, mesh,
         )
 
     # realign (or the numpy oracle) consumes host pileups; the plain jax
@@ -326,17 +334,17 @@ def streamed_consensus(
 
 def _streamed_sharded_consensus(
     bam_path, realign, min_depth, min_overlap, clip_decay_threshold,
-    mask_ends, trim_ends, uppercase, chunk_bytes,
+    mask_ends, trim_ends, uppercase, chunk_bytes, mesh=None,
 ):
     """Streamed decode reduced into position-sharded device state; the
     closing call + (optional) lazy CDR walk run through the product
     kernel. Output byte-identical to every other path."""
-    from kindel_tpu.call import _insertion_calls, assemble
     from kindel_tpu.io.fasta import Sequence
+    from kindel_tpu.parallel.product import close_sharded_ref
     from kindel_tpu.parallel.stream_product import ShardedStreamAccumulator
     from kindel_tpu.workloads import build_report, result
 
-    acc = ShardedStreamAccumulator(full=realign)
+    acc = ShardedStreamAccumulator(mesh=mesh, full=realign)
     for batch in stream_alignment(bam_path, chunk_bytes):
         acc.add_batch(batch)
 
@@ -344,19 +352,12 @@ def _streamed_sharded_consensus(
     for rid in acc.present:
         ref_id = acc.ref_names[rid]
         sr = acc.finish(rid, min_depth=min_depth, realign=realign)
-        cdr_patches = (
-            sr.cdr_patches(clip_decay_threshold, mask_ends, min_overlap)
-            if realign
-            else None
+        res, depth_min, depth_max, cdr_patches = close_sharded_ref(
+            sr, realign=realign, min_depth=min_depth,
+            min_overlap=min_overlap,
+            clip_decay_threshold=clip_decay_threshold,
+            mask_ends=mask_ends, trim_ends=trim_ends, uppercase=uppercase,
         )
-        masks = sr.call_masks()
-        ins_calls = (
-            _insertion_calls(sr.ins_table) if masks.ins_mask.any() else {}
-        )
-        res = assemble(
-            masks, ins_calls, cdr_patches, trim_ends, min_depth, uppercase,
-        )
-        depth_min, depth_max = sr.depth_scalars()
         refs_reports[ref_id] = build_report(
             ref_id, depth_min, depth_max, res.changes, cdr_patches,
             bam_path, realign, min_depth, min_overlap,

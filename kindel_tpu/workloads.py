@@ -181,17 +181,23 @@ def bam_to_consensus(
     n_dev = _shardable_device_count() if backend == "jax" else 0
     for rid in ev.present_ref_ids:
         ref_id = ev.ref_names[rid]
-        if n_dev > 1 and int(ev.ref_lens[rid]) >= n_dev:
+        shard_ok = n_dev > 1 and int(ev.ref_lens[rid]) >= n_dev
+        if backend == "jax" and (shard_ok or realign):
             # Position-sharded product path: every channel reduces on its
             # shard's device, the call runs on device with a ppermute halo,
             # and realign walks the device-resident clip tensors sparsely
             # (kindel_tpu.parallel.product; SURVEY §5's headline axis).
+            # Under --realign this path engages even single-device (a
+            # 1-shard mesh): the clip channels then reduce on device
+            # instead of via a dense host pileup (VERDICT r2 item 3).
+            from kindel_tpu.parallel.mesh import make_mesh
             from kindel_tpu.parallel.product import sharded_consensus
 
+            mesh = None if shard_ok else make_mesh({"sp": 1})
             with maybe_phase(f"sharded call+assemble [{ref_id}]"):
                 res, depth_min, depth_max, cdr_patches = sharded_consensus(
-                    ev, rid, realign=realign, min_depth=min_depth,
-                    min_overlap=min_overlap,
+                    ev, rid, mesh=mesh, realign=realign,
+                    min_depth=min_depth, min_overlap=min_overlap,
                     clip_decay_threshold=clip_decay_threshold,
                     mask_ends=mask_ends, trim_ends=trim_ends,
                     uppercase=uppercase,
@@ -206,35 +212,30 @@ def bam_to_consensus(
                 Sequence(name=f"{ref_id}_cns", sequence=res.sequence)
             )
             continue
-        if realign or backend != "jax":
-            # realign's CDR detection consumes the full clip tensors —
-            # tiny event counts, reduced host-side even under the jax
-            # backend (SURVEY §5: CDR/patch metadata is host-gathered)
-            with maybe_phase(f"pileup reduce [{ref_id}]"):
-                pileup = build_pileup(ev, rid)
-        else:
-            pileup = None
-        if realign:
-            with maybe_phase(f"realign CDR [{ref_id}]"):
-                cdrps = cdrp_consensuses(
-                    pileup,
-                    clip_decay_threshold=clip_decay_threshold,
-                    mask_ends=mask_ends,
-                )
-                cdr_patches = merge_cdrps(cdrps, min_overlap)
-        else:
-            cdr_patches = None
 
         if backend == "jax":
             from kindel_tpu.call_jax import call_consensus_fused
 
+            cdr_patches = None  # realign routed through the product path
             with maybe_phase(f"device call+assemble [{ref_id}]"):
                 res, depth_min, depth_max = call_consensus_fused(
-                    ev, rid, pileup=pileup, cdr_patches=cdr_patches,
+                    ev, rid, cdr_patches=None,
                     trim_ends=trim_ends, min_depth=min_depth,
                     uppercase=uppercase,
                 )
         else:
+            with maybe_phase(f"pileup reduce [{ref_id}]"):
+                pileup = build_pileup(ev, rid)
+            if realign:
+                with maybe_phase(f"realign CDR [{ref_id}]"):
+                    cdrps = cdrp_consensuses(
+                        pileup,
+                        clip_decay_threshold=clip_decay_threshold,
+                        mask_ends=mask_ends,
+                    )
+                    cdr_patches = merge_cdrps(cdrps, min_overlap)
+            else:
+                cdr_patches = None
             with maybe_phase(f"call+assemble [{ref_id}]"):
                 res = call_consensus(
                     pileup,

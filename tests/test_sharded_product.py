@@ -102,12 +102,12 @@ def test_sharded_counts_match_host_pileup():
     mesh = make_mesh()
     sr = ShardedRef(ev, rid, mesh, realign=True)
     L = sr.L
-    assert np.array_equal(sr._window("weights", 0, L), host.weights)
-    assert np.array_equal(sr._window("deletions", 0, L), host.deletions[:L])
-    assert np.array_equal(sr._window("csw", 0, L), host.clip_start_weights)
-    assert np.array_equal(sr._window("cew", 0, L), host.clip_end_weights)
+    assert np.array_equal(sr.window("weights", 0, L), host.weights)
+    assert np.array_equal(sr.window("deletions", 0, L), host.deletions[:L])
+    assert np.array_equal(sr.window("csw", 0, L), host.clip_start_weights)
+    assert np.array_equal(sr.window("cew", 0, L), host.clip_end_weights)
     assert np.array_equal(
-        sr._window("ins_totals", 0, L), host.ins.totals[:L].astype(np.int32)
+        sr.window("ins_totals", 0, L), host.ins.totals[:L].astype(np.int32)
     )
     dmin, dmax = sr.depth_scalars()
     acgt = host.acgt_depth

@@ -112,3 +112,23 @@ def test_explicit_chunk_still_shards():
     got = bam_to_consensus(bam, backend="jax", realign=True, min_overlap=7,
                            stream_chunk_mb=0.0625)
     _assert_identical(got, ref)
+
+
+def test_pad_safe_block_guard():
+    """PAD_POS flat-scatter wraparound guard: int32(2^30·5) wraps to a
+    positive in-range index for blocks past 2^30/5 positions, so the
+    guard must reject them (review r3 finding)."""
+    from kindel_tpu.pileup_jax import MAX_PAD_SAFE_BLOCK, check_pad_safe_block
+
+    check_pad_safe_block(MAX_PAD_SAFE_BLOCK)  # at the limit: fine
+    with pytest.raises(ValueError, match="PAD_POS"):
+        check_pad_safe_block(MAX_PAD_SAFE_BLOCK + 1)
+    # the wrap itself: the sentinel's two's-complement flat index must be
+    # out of range for every legal block size
+    from kindel_tpu.events import N_CHANNELS
+    from kindel_tpu.pileup_jax import PAD_POS
+
+    wrapped = int(PAD_POS) * N_CHANNELS & 0xFFFFFFFF
+    if wrapped >= 2**31:
+        wrapped -= 2**32
+    assert wrapped < 0 or wrapped >= MAX_PAD_SAFE_BLOCK * N_CHANNELS
