@@ -61,12 +61,37 @@ def initialize_distributed(
     if process_id is None and "JAX_PROCESS_ID" in os.environ:
         process_id = int(os.environ["JAX_PROCESS_ID"])
 
-    if coordinator_address is None and num_processes is None:
+    if (
+        coordinator_address is None
+        and num_processes is None
+        and process_id is None
+    ):
         if not auto_detect:
             # no cluster context advertised anywhere → single process
             return False
         jax.distributed.initialize()  # cluster auto-detection
         return jax.process_count() > 1
+
+    # partially-specified cluster config must fail loudly here, not
+    # stall or misconfigure inside jax.distributed.initialize
+    # (round-1 advisor finding): explicit init needs all three of
+    # coordinator/num_processes/process_id
+    missing = [
+        name
+        for name, val in (
+            ("coordinator_address", coordinator_address),
+            ("num_processes", num_processes),
+            ("process_id", process_id),
+        )
+        if val is None
+    ]
+    if missing:
+        raise ValueError(
+            "partially-specified cluster config: "
+            f"{', '.join(missing)} unset (set the JAX_COORDINATOR_ADDRESS/"
+            "JAX_NUM_PROCESSES/JAX_PROCESS_ID env vars or pass them "
+            "explicitly; or set none of them for single-process)"
+        )
 
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
@@ -115,6 +140,9 @@ def make_global_mesh(
         mesh_shape=(dcn // n_hosts, inner),
         dcn_mesh_shape=(n_hosts, 1),
         devices=jax.devices(),
+        # granule = process: matches the per-host tiling math above (and
+        # CPU/virtual devices carry no TPU slice_index at all)
+        process_is_granule=True,
     )
     # hybrid mesh comes back (dcn, inner); split inner into the remaining
     # axes (declared order) and move dcn into its declared position

@@ -34,7 +34,11 @@ from jax.sharding import Mesh, PartitionSpec as P
 from kindel_tpu.events import N_CHANNELS, BASES
 from kindel_tpu.pileup_jax import PAD_POS, _bucket, _pad, check_pad_safe_block
 
-BASE_ASCII_J = jnp.asarray(np.frombuffer(BASES, dtype=np.uint8))
+# numpy at module scope: a module-level jnp.asarray would initialize the
+# XLA backend at import, which forbids the standard multi-host pattern
+# (import the package, THEN jax.distributed.initialize). The device
+# constant materializes inside the traced function instead.
+_BASE_ASCII = np.frombuffer(BASES, dtype=np.uint8)
 _N = np.uint8(ord("N"))
 
 
@@ -111,7 +115,7 @@ def _local_call(match_pos, match_base, del_pos, ins_pos, ins_cnt, min_depth,
     base_idx = jnp.argmax(weights, axis=1)
     tie = (freq > 0) & ((weights == freq[:, None]).sum(axis=1) > 1)
     base_idx = jnp.where(weights.sum(axis=1) == 0, N_CHANNELS - 1, base_idx)
-    base_char = jnp.where(tie, _N, BASE_ASCII_J[base_idx])
+    base_char = jnp.where(tie, _N, jnp.asarray(_BASE_ASCII)[base_idx])
 
     del_mask = deletions * 2 > acgt_depth
     n_mask = ~del_mask & (acgt_depth < min_depth)
@@ -272,12 +276,23 @@ def batched_sharded_call(event_batches, ref_len: int, mesh: Mesh,
             jnp.asarray(ip), jnp.asarray(ic), jnp.int32(min_depth),
             mesh=mesh, block=block,
         )
+
+    if jax.process_count() > 1:
+        # outputs span non-addressable devices on a multi-host mesh;
+        # all-gather the global value to every process (tiny wire format)
+        from jax.experimental import multihost_utils
+
+        def host(x):
+            return multihost_utils.process_allgather(x, tiled=True)
+    else:
+        host = np.asarray
+
     L = ref_len
     n = block * n_sp
     return (
-        np.asarray(w).reshape(B, n, N_CHANNELS)[:, :L],
-        np.asarray(bc).reshape(B, n)[:, :L],
-        np.asarray(dm).reshape(B, n)[:, :L],
-        np.asarray(nm).reshape(B, n)[:, :L],
-        np.asarray(im).reshape(B, n)[:, :L],
+        host(w).reshape(B, n, N_CHANNELS)[:, :L],
+        host(bc).reshape(B, n)[:, :L],
+        host(dm).reshape(B, n)[:, :L],
+        host(nm).reshape(B, n)[:, :L],
+        host(im).reshape(B, n)[:, :L],
     )

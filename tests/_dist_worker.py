@@ -1,0 +1,55 @@
+"""Worker for the 2-process distributed test: join the localhost process
+group (4 virtual CPU devices per process → 8 global), build the hybrid
+dp×sp mesh, run the batched dp×sp step, print the output digest.
+
+Usage: python tests/_dist_worker.py <process_id> <coordinator_port>
+(underscore prefix: not collected by pytest)."""
+
+import os
+import sys
+
+proc_id = int(sys.argv[1])
+port = int(sys.argv[2])
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_here))
+sys.path.insert(0, _here)
+
+import distfixture  # noqa: E402
+
+from kindel_tpu.parallel import (  # noqa: E402
+    batched_sharded_call,
+    initialize_distributed,
+    make_global_mesh,
+)
+
+assert (
+    initialize_distributed(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=2,
+        process_id=proc_id,
+    )
+    is True
+), "process group did not come up"
+assert jax.process_count() == 2, jax.process_count()
+assert jax.device_count() == 8, jax.device_count()
+assert len(jax.local_devices()) == 4
+
+mesh = make_global_mesh(dict(distfixture.AXES))
+assert mesh.devices.shape == (2, 4)
+# the dcn (dp) axis must be laid across processes so the sp halo stays
+# within one process's devices (the ICI analogue)
+for row in range(2):
+    procs = {d.process_index for d in mesh.devices[row].flat}
+    assert len(procs) == 1, f"sp row {row} spans processes {procs}"
+
+outs = batched_sharded_call(
+    distfixture.make_samples(), distfixture.REF_LEN, mesh
+)
+print("DIGEST:" + distfixture.digest(outs), flush=True)

@@ -1,0 +1,34 @@
+"""Deterministic synthetic cohort + output digest shared between the
+2-process distributed test's workers and its single-process reference
+(tests/test_distributed.py, tests/_dist_worker.py)."""
+
+import hashlib
+
+import numpy as np
+
+REF_LEN = 512
+AXES = {"dp": 2, "sp": 4}
+
+
+def make_samples(n: int = 4, seed: int = 7) -> list[dict]:
+    rng = np.random.default_rng(seed)
+    samples = []
+    for _ in range(n):
+        e = 256
+        samples.append(
+            {
+                "match_pos": rng.integers(0, REF_LEN, size=e).astype(np.int64),
+                "match_base": rng.integers(0, 4, size=e).astype(np.int64),
+                "del_pos": rng.integers(0, REF_LEN, size=5).astype(np.int64),
+                "ins_pos": rng.integers(0, REF_LEN, size=3).astype(np.int64),
+                "ins_cnt": rng.integers(1, 4, size=3).astype(np.int64),
+            }
+        )
+    return samples
+
+
+def digest(outs) -> str:
+    h = hashlib.sha256()
+    for o in outs:
+        h.update(np.ascontiguousarray(o).tobytes())
+    return h.hexdigest()
