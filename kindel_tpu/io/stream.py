@@ -145,6 +145,11 @@ class _Prefetcher:
         self._buf.clear()
         return out
 
+    def peek(self, n: int) -> bytes:
+        """First n buffered bytes (fewer at EOF) without consuming."""
+        self.ensure(n)
+        return bytes(self._buf[:n])
+
     @property
     def exhausted(self) -> bool:
         return self._eof and not self._buf
@@ -213,6 +218,12 @@ def stream_alignment(
             yield from _stream_sam(fh, chunk_bytes)
             return
         pf = _Prefetcher(_inflate_stream(fh))
+        if compressed and pf.peek(4) != b"BAM\x01":
+            # gzip-compressed SAM text (the eager loader decompresses
+            # then sniffs, ADVICE r2): feed the inflated stream through
+            # the SAM line-chunking path
+            yield from _stream_sam(_PrefetchReader(pf), chunk_bytes)
+            return
         ref_names, ref_lens = _read_bam_header(pf)
         carry = b""
         while True:
@@ -235,6 +246,19 @@ def stream_alignment(
                 f"{path}: truncated BAM record at end of stream "
                 f"({len(carry)} trailing bytes)"
             )
+
+
+class _PrefetchReader:
+    """read(n) adapter over a _Prefetcher, so the SAM line-chunker can
+    consume an inflated (.sam.gz) stream like a plain file handle. May
+    return more than n bytes per call (whole inflate chunks) — the SAM
+    chunker treats sizes as advisory."""
+
+    def __init__(self, pf: _Prefetcher):
+        self._pf = pf
+
+    def read(self, n: int) -> bytes:
+        return self._pf.fill_to(n)
 
 
 def _stream_sam(fh, chunk_bytes: int) -> Iterator[ReadBatch]:

@@ -75,7 +75,9 @@ def initialize_distributed(
     # partially-specified cluster config must fail loudly here, not
     # stall or misconfigure inside jax.distributed.initialize
     # (round-1 advisor finding): explicit init needs all three of
-    # coordinator/num_processes/process_id
+    # coordinator/num_processes/process_id. auto_detect opts out — the
+    # cluster plugins (SLURM/GKE/...) may legitimately resolve the
+    # missing fields from cluster metadata.
     missing = [
         name
         for name, val in (
@@ -85,12 +87,14 @@ def initialize_distributed(
         )
         if val is None
     ]
-    if missing:
+    if missing and not auto_detect:
         raise ValueError(
             "partially-specified cluster config: "
             f"{', '.join(missing)} unset (set the JAX_COORDINATOR_ADDRESS/"
             "JAX_NUM_PROCESSES/JAX_PROCESS_ID env vars or pass them "
-            "explicitly; or set none of them for single-process)"
+            "explicitly; pass auto_detect=True to let jax's cluster "
+            "plugins fill the gaps; or set none of them for "
+            "single-process)"
         )
 
     jax.distributed.initialize(

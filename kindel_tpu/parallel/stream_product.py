@@ -175,8 +175,7 @@ class ShardedStreamAccumulator(StreamAccumulatorBase):
         # positions (dmax stays positive as long as any position is
         # normally covered)
         if int(sr._out["dmin"]) < 0:
-            raise OverflowError(
-                f"{self.ref_names[rid]}: per-position depth exceeded the "
-                "int32 accumulation ceiling (2^31-1)"
-            )
+            from kindel_tpu.streaming import _depth_ceiling_error
+
+            raise _depth_ceiling_error(self.ref_names[rid])
         return sr

@@ -37,6 +37,25 @@ from kindel_tpu.pileup import (
 #: (jax's default x64-off mode): L·N_CHANNELS must stay addressable
 _MAX_FLAT = 2**31 - 2
 
+#: depth ceiling of the DEVICE accumulator: counts are int32 (the scatter
+#: dtype), so per-position per-channel depth beyond 2^31-1 wraps — unlike
+#: the numpy backend's int64 state. ~2.1 G reads over one position is far
+#: past any real pileup; materialization checks for the wrap anyway
+#: (negative counts) and raises instead of returning a silently wrong
+#: consensus (ADVICE r2).
+
+
+def _depth_ceiling_error(what: str) -> OverflowError:
+    return OverflowError(
+        f"{what}: accumulated depth exceeded the int32 ceiling "
+        "(2^31-1) of the device accumulator"
+    )
+
+
+def _check_depth_ceiling(arr, what: str) -> None:
+    if len(arr) and int(arr.min()) < 0:
+        raise _depth_ceiling_error(what)
+
 
 class _RefState:
     """Accumulating count state for one reference (host or device)."""
@@ -199,6 +218,8 @@ class StreamAccumulator(StreamAccumulatorBase):
 
         def host(a, shape=None):
             out = np.asarray(a)
+            if self.device:
+                _check_depth_ceiling(out, self.ref_names[rid])
             return out.reshape(shape) if shape else out
 
         L = st.L
@@ -319,6 +340,8 @@ def streamed_consensus(
                 masks, ins_calls, None, trim_ends, min_depth, uppercase,
             )
             depth_min, depth_max = int(dmin), int(dmax)
+            if depth_min < 0:  # int32 accumulator wrap (module docstring)
+                raise _depth_ceiling_error(ref_id)
 
         refs_reports[ref_id] = build_report(
             ref_id, depth_min, depth_max, res.changes, cdr_patches,
