@@ -33,6 +33,10 @@ from kindel_tpu.io.sam import parse_sam_bytes
 
 _SLAB = 8 << 20  # compressed-side read size
 DEFAULT_CHUNK_BYTES = 64 << 20  # decompressed bytes per yielded batch
+#: inflate output cap per yielded chunk on the generic-gzip path — text
+#: SAM compresses 100-1000×, so an uncapped decompress of one slab could
+#: materialize GBs and break the O(chunk) RSS bound
+_MAX_INFLATE = 32 << 20
 
 
 def _inflate_stream(fh) -> Iterator[bytes]:
@@ -60,9 +64,13 @@ def _inflate_stream(fh) -> Iterator[bytes]:
                         yield out
                     return
                 buf = bytearray(more)
-            out = dobj.decompress(bytes(buf))
+            out = dobj.decompress(bytes(buf), _MAX_INFLATE)
             if out:
                 yield out
+            while dobj.unconsumed_tail and not dobj.eof:
+                out = dobj.decompress(dobj.unconsumed_tail, _MAX_INFLATE)
+                if out:
+                    yield out
             if dobj.eof:
                 buf = bytearray(dobj.unused_data)
                 dobj = None
@@ -215,14 +223,15 @@ def stream_alignment(
         fh.seek(0)
         compressed = bgzf.is_gzipped(head)
         if not compressed and head[:4] != b"BAM\x01":
-            yield from _stream_sam(fh, chunk_bytes)
+            yield from _stream_sam(fh, chunk_bytes, label=path)
             return
         pf = _Prefetcher(_inflate_stream(fh))
         if compressed and pf.peek(4) != b"BAM\x01":
             # gzip-compressed SAM text (the eager loader decompresses
             # then sniffs, ADVICE r2): feed the inflated stream through
             # the SAM line-chunking path
-            yield from _stream_sam(_PrefetchReader(pf), chunk_bytes)
+            yield from _stream_sam(_PrefetchReader(pf), chunk_bytes,
+                                   label=path)
             return
         ref_names, ref_lens = _read_bam_header(pf)
         carry = b""
@@ -261,13 +270,23 @@ class _PrefetchReader:
         return self._pf.fill_to(n)
 
 
-def _stream_sam(fh, chunk_bytes: int) -> Iterator[ReadBatch]:
+def _stream_sam(fh, chunk_bytes: int, label=None) -> Iterator[ReadBatch]:
     """SAM text: capture the header once, then parse record-line chunks
     with the header prepended so every batch shares the reference
-    dictionary."""
+    dictionary. A stream with neither header references nor records
+    raises like the eager loader (io.load_alignment)."""
     header_lines = []
     carry = b""
     header_done = False
+    saw_content = False
+
+    def emit(data: bytes):
+        nonlocal saw_content
+        batch = parse_sam_bytes(data)
+        if batch.ref_names or batch.n_reads:
+            saw_content = True
+        return batch
+
     while True:
         block = fh.read(chunk_bytes)
         if not block:
@@ -294,6 +313,10 @@ def _stream_sam(fh, chunk_bytes: int) -> Iterator[ReadBatch]:
                 continue
             header_done = True
         if complete:
-            yield parse_sam_bytes(b"".join(header_lines) + complete)
+            yield emit(b"".join(header_lines) + complete)
     if carry:
-        yield parse_sam_bytes(b"".join(header_lines) + carry + b"\n")
+        yield emit(b"".join(header_lines) + carry + b"\n")
+    if not saw_content:
+        # empty / record-free garbage: the eager loader raises here too
+        # (io.load_alignment: no refs and no reads)
+        raise ValueError(f"{label}: not a recognizable SAM/BAM file")

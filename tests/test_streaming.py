@@ -212,3 +212,25 @@ def test_stream_gzipped_sam(tmp_path):
     plain = _concat_batches(stream_alignment(src, 16 << 10))
     gzed = _concat_batches(stream_alignment(gz, 16 << 10))
     assert plain == gzed
+
+
+def test_stream_empty_gzip_raises_like_eager(tmp_path):
+    """Empty / record-free gzipped content must error like the eager
+    loader, not silently stream zero batches (review r3)."""
+    import gzip
+
+    from kindel_tpu.io import load_alignment
+
+    for name, payload in (
+        ("empty.sam.gz", b""),
+        ("empty.sam", b""),
+        ("blank.sam.gz", b"\n\n"),
+    ):
+        f = tmp_path / name
+        f.write_bytes(
+            gzip.compress(payload) if name.endswith(".gz") else payload
+        )
+        with pytest.raises(ValueError, match="not a recognizable"):
+            list(stream_alignment(f, 16 << 10))
+        with pytest.raises(ValueError):
+            load_alignment(f)
