@@ -135,6 +135,10 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--gb", type=float, default=1.0,
                     help="decompressed size of the synthetic BAM")
+    ap.add_argument("--ref-len", type=int, default=6_097_032,
+                    help="reference length of the synthetic BAM (the "
+                         "position axis is the cost driver; 1e8 for the "
+                         "scale-headroom proof)")
     ap.add_argument("--chunk-mb", type=float, default=64.0)
     ap.add_argument("--backend", default="jax")
     ap.add_argument("--mesh", type=int, default=8, metavar="N",
@@ -144,11 +148,11 @@ def main():
     ap.add_argument("--keep", action="store_true")
     args = ap.parse_args()
 
-    bam = Path("/tmp/kindel_tpu_rss_synth.bam")
+    bam = Path(f"/tmp/kindel_tpu_rss_synth_{args.ref_len}.bam")
     target = int(args.gb * (1 << 30))
     if not bam.exists() or abs(bam.stat().st_size * 3 - target) > target:
         t0 = time.perf_counter()
-        n = synthesize(bam, target)
+        n = synthesize(bam, target, ref_len=args.ref_len)
         print(
             f"# synthesized {n} reads, {bam.stat().st_size / 1e6:.0f} MB "
             f"compressed in {time.perf_counter() - t0:.1f}s",

@@ -78,13 +78,29 @@ def _load_pileups(bam_path, backend: str,
                   stream_chunk_mb: float | None = None) -> dict[str, Pileup]:
     _check_backend(backend)
     chunk_mb = _resolve_stream_chunk(bam_path, stream_chunk_mb, backend)
+    sharded = backend == "jax" and _shardable_device_count() > 1
     if chunk_mb is not None:
+        if sharded:
+            # per-base channels reduce on the position-sharded mesh,
+            # bounded host ingest (stats counterpart of the product path)
+            from kindel_tpu.parallel.stream_product import (
+                sharded_stream_pileups,
+            )
+
+            return sharded_stream_pileups(
+                bam_path, chunk_bytes=int(chunk_mb * (1 << 20))
+            )
         from kindel_tpu.streaming import stream_pileups
 
         return stream_pileups(
             bam_path, chunk_bytes=int(chunk_mb * (1 << 20)), backend=backend
         )
-    ev = extract_events(load_alignment(bam_path))
+    batch = load_alignment(bam_path)
+    if sharded:
+        from kindel_tpu.parallel.stream_product import sharded_pileups
+
+        return sharded_pileups(batch)
+    ev = extract_events(batch)
     if backend == "jax":
         from kindel_tpu.pileup_jax import build_pileups_jax
 

@@ -1,0 +1,66 @@
+"""Scale headroom past the 6.1 Mb corpus (VERDICT r2 item 8): a 100 Mb
+reference through the streamed × sharded path.
+
+Pins the int32 flat-index arithmetic (L·N_CHANNELS ≈ 5·10⁸, inside the
+guard but far past any corpus file), the block/packbits alignment math
+of the product path at 12.5 M-position shards, and bounded host memory.
+Cross-path correctness: the 8-shard mesh run must equal the
+single-device streamed run (independently computed reductions).
+
+Slow (~minutes): gated behind KINDEL_TPU_RUN_SLOW=1 so the default
+suite stays fast; `benchmarks/rss_stream.py --ref-len 100000000` is the
+measured counterpart recorded in BASELINE.md.
+"""
+
+import hashlib
+import importlib.util
+import os
+from pathlib import Path
+
+import pytest
+
+pytestmark = [
+    pytest.mark.slow,
+    pytest.mark.skipif(
+        not os.environ.get("KINDEL_TPU_RUN_SLOW"),
+        reason="100 Mb scale test: set KINDEL_TPU_RUN_SLOW=1",
+    ),
+]
+
+REF_LEN = 100_000_000
+
+
+def _synthesize(bam: Path, target_bytes: int) -> None:
+    spec = importlib.util.spec_from_file_location(
+        "rss_stream",
+        Path(__file__).resolve().parent.parent / "benchmarks" / "rss_stream.py",
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.synthesize(bam, target_bytes, ref_len=REF_LEN)
+
+
+def test_100mb_streamed_sharded_matches_single_device(tmp_path, monkeypatch):
+    import jax
+
+    from kindel_tpu.streaming import streamed_consensus
+
+    assert len(jax.devices()) >= 2, "virtual mesh missing"
+    # the meshed leg must actually shard — a shell-exported FORCE_FUSED
+    # would silently make both legs single-device (test vacuity)
+    monkeypatch.delenv("KINDEL_TPU_FORCE_FUSED", raising=False)
+    bam = tmp_path / "synth100mb.bam"
+    _synthesize(bam, 48 << 20)  # ~200k reads x 140 bp over 100 Mb
+
+    meshed = streamed_consensus(bam, backend="jax", chunk_bytes=32 << 20)
+    seq_m = meshed.consensuses[0].sequence
+    assert len(seq_m) == REF_LEN
+
+    monkeypatch.setenv("KINDEL_TPU_FORCE_FUSED", "1")
+    single = streamed_consensus(bam, backend="jax", chunk_bytes=32 << 20)
+    seq_s = single.consensuses[0].sequence
+
+    hm = hashlib.sha256(seq_m.encode()).hexdigest()
+    hs = hashlib.sha256(seq_s.encode()).hexdigest()
+    assert hm == hs, "sharded 100 Mb output diverged from single-device"
+    assert meshed.refs_reports == single.refs_reports
