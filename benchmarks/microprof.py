@@ -32,8 +32,9 @@ def main() -> None:
     from kindel_tpu.call_jax import (
         CallUnit,
         decode_fast,
-        fused_call_kernel,
+        fused_call_kernel_wire,
         kernel_args,
+        unpack_wire,
     )
     from kindel_tpu.events import extract_events
     from kindel_tpu.io import load_alignment
@@ -49,7 +50,7 @@ def main() -> None:
     u = CallUnit(ev, rid)
     args = kernel_args(u)
     jax.block_until_ready(args)
-    out = fused_call_kernel(*args, length=u.L, want_masks=False)
+    out = fused_call_kernel_wire(*args, length=u.L, want_masks=False)
     jax.block_until_ready(out)
 
     for trial in range(3):
@@ -62,12 +63,18 @@ def main() -> None:
         t3 = time.perf_counter()
         args = kernel_args(u)
         jax.block_until_ready(args)
+        d_pad, i_pad = args[3].shape[0], args[4].shape[0]
         t4 = time.perf_counter()
-        out = fused_call_kernel(*args, length=u.L, want_masks=False)
+        out = fused_call_kernel_wire(*args, length=u.L, want_masks=False)
         jax.block_until_ready(out)
         t5 = time.perf_counter()
-        plane = np.asarray(out[0])
-        exc_bits, del_flags, ins_flags = (np.asarray(x) for x in out[1])
+        # ONE packed buffer, one d2h transfer (round-3 wire packing)
+        plane, parts, _dmin, _dmax = unpack_wire(
+            np.asarray(out), u.L, d_pad, i_pad, want_masks=False
+        )
+        exc_bits, del_bits, ins_bits = parts
+        del_flags = np.unpackbits(del_bits)[: len(u.del_pos)].astype(bool)
+        ins_flags = np.unpackbits(ins_bits)[: len(u.ins_pos)].astype(bool)
         t6 = time.perf_counter()
         masks = decode_fast(
             plane, exc_bits, del_flags, ins_flags, u.L, u.del_pos, u.ins_pos

@@ -206,6 +206,60 @@ def fused_call_kernel(op_r_start, op_off, base_packed, del_pos, ins_pos,
     )
 
 
+def _pack_wire(main, parts, dmin, dmax):
+    """Concatenate every wire output into ONE uint8 buffer. On a
+    tunneled TPU each host fetch pays a round trip; seven small arrays
+    cost seven RTTs where one ~L/2.5-byte buffer costs one."""
+    segs = [main]
+    for p in parts:
+        segs.append(p if p.dtype == jnp.uint8 else jnp.packbits(p))
+    scalars = jax.lax.bitcast_convert_type(
+        jnp.stack([dmin, dmax]), jnp.uint8
+    ).reshape(8)
+    segs.append(scalars)
+    return jnp.concatenate(segs)
+
+
+@partial(jax.jit, static_argnames=("length", "want_masks"))
+def fused_call_kernel_wire(op_r_start, op_off, base_packed, del_pos,
+                           ins_pos, ins_cnt, n_events, min_depth, *,
+                           length: int, want_masks: bool):
+    """fused_call_kernel with all outputs packed into one uint8 buffer
+    (single d2h transfer). Layout — masks path:
+    [emit ⌈L/2⌉ | del ⌈L/8⌉ | n ⌈L/8⌉ | ins ⌈L/8⌉ | dmin,dmax 8B];
+    fast path:
+    [plane ⌈L/4⌉ | exc ⌈L/8⌉ | del_flags ⌈D/8⌉ | ins_flags ⌈I/8⌉ | 8B]
+    where D/I are the padded sparse-event widths (see _wire_sizes, the
+    single source of truth for these offsets)."""
+    main, parts, dmin, dmax = _call_core(
+        op_r_start, op_off, base_packed, del_pos, ins_pos, ins_cnt,
+        n_events, min_depth, length, want_masks,
+    )
+    return _pack_wire(main, parts, dmin, dmax)
+
+
+def _wire_sizes(length: int, d_pad: int, i_pad: int, want_masks: bool):
+    l8 = -(-length // 8)
+    if want_masks:
+        return [-(-length // 2), l8, l8, l8]
+    return [-(-length // 4), l8, -(-d_pad // 8), -(-i_pad // 8)]
+
+
+def unpack_wire(buf: np.ndarray, length: int, d_pad: int, i_pad: int,
+                want_masks: bool):
+    """Split the packed wire buffer back into (main, parts, dmin, dmax).
+    Bool flag segments come back bit-packed; decode_fast/masks_from_wire
+    accept the packed forms via np.unpackbits below."""
+    buf = np.asarray(buf)
+    sizes = _wire_sizes(length, d_pad, i_pad, want_masks)
+    offs = np.cumsum([0] + sizes)
+    segs = [buf[offs[i]: offs[i + 1]] for i in range(len(sizes))]
+    dmin, dmax = np.frombuffer(
+        buf[offs[-1]: offs[-1] + 8].tobytes(), np.int32
+    ).tolist()
+    return segs[0], tuple(segs[1:]), dmin, dmax
+
+
 @jax.jit
 def counts_call_kernel(weights, deletions, ins_totals, min_depth):
     """Call decisions straight from device-resident count tensors — the
@@ -436,20 +490,24 @@ def device_call(ev: EventSet, rid: int, min_depth: int = 1,
     is rebuilt from the 2-bit wire format (see decode_fast)."""
     u = CallUnit(ev, rid)
     L, ip = u.L, u.ins_pos
-    main_out, masks_packed, dmin, dmax = fused_call_kernel(
-        *kernel_args(u, min_depth), length=L, want_masks=want_masks
+    args = kernel_args(u, min_depth)
+    d_pad, i_pad = args[3].shape[0], args[4].shape[0]
+    buf = fused_call_kernel_wire(*args, length=L, want_masks=want_masks)
+    main_out, parts, dmin, dmax = unpack_wire(
+        buf, L, d_pad, i_pad, want_masks
     )
 
     if want_masks:
-        emit, masks = masks_from_wire(main_out, masks_packed, L)
-        return emit, masks, int(dmin), int(dmax)
+        emit, masks = masks_from_wire(main_out, parts, L)
+        return emit, masks, dmin, dmax
 
-    exc_bits, del_flags, ins_flags = masks_packed
+    exc_bits, del_bits, ins_bits = parts
+    del_flags = np.unpackbits(del_bits)[: len(u.del_pos)].astype(bool)
+    ins_flags = np.unpackbits(ins_bits)[: len(ip)].astype(bool)
     masks = decode_fast(
-        np.asarray(main_out), np.asarray(exc_bits), np.asarray(del_flags),
-        np.asarray(ins_flags), L, u.del_pos, ip,
+        main_out, exc_bits, del_flags, ins_flags, L, u.del_pos, ip,
     )
-    return None, masks, int(dmin), int(dmax)
+    return None, masks, dmin, dmax
 
 
 def call_consensus_fused(

@@ -185,7 +185,7 @@ class ShardedStreamAccumulator(StreamAccumulatorBase):
         # ACGT depth goes negative, which surfaces in the min over valid
         # positions (dmax stays positive as long as any position is
         # normally covered)
-        if int(sr._out["dmin"]) < 0:
+        if sr.depth_scalars()[0] < 0:
             from kindel_tpu.streaming import _depth_ceiling_error
 
             raise _depth_ceiling_error(self.ref_names[rid])
@@ -218,6 +218,14 @@ class ShardedStatsAccumulator(ShardedStreamAccumulator):
             k: np.zeros(L1, np.int64) for k in ("cs", "ce", "d")
         }
         return st
+
+    def finish(self, rid: int, min_depth: int = 1,
+               realign: bool = False) -> ShardedRef:
+        raise TypeError(
+            "ShardedStatsAccumulator reduces deletions on host (no device "
+            "tensor) and cannot close into a ShardedRef — use pileup(rid) "
+            "for stats, or ShardedStreamAccumulator for the consensus path"
+        )
 
     def _reduce(self, st: _ShardState, ev, rid: int) -> None:
         super()._reduce(st, ev, rid)
