@@ -206,6 +206,23 @@ def fused_call_kernel(op_r_start, op_off, base_packed, del_pos, ins_pos,
     )
 
 
+def pack_depth_scalars(dmin, dmax):
+    """Two int32 depth scalars → 8 wire bytes (single encoding shared by
+    every packed-wire producer; inverse below)."""
+    return jax.lax.bitcast_convert_type(
+        jnp.stack([dmin, dmax]), jnp.uint8
+    ).reshape(8)
+
+
+def unpack_depth_scalars(buf8) -> tuple[int, int]:
+    """Inverse of pack_depth_scalars. tobytes(): the 8-byte slice may sit
+    at an arbitrary (unaligned) offset of the packed buffer."""
+    dmin, dmax = np.frombuffer(
+        np.asarray(buf8).tobytes(), np.int32
+    ).tolist()
+    return dmin, dmax
+
+
 def _pack_wire(main, parts, dmin, dmax):
     """Concatenate every wire output into ONE uint8 buffer. On a
     tunneled TPU each host fetch pays a round trip; seven small arrays
@@ -213,10 +230,7 @@ def _pack_wire(main, parts, dmin, dmax):
     segs = [main]
     for p in parts:
         segs.append(p if p.dtype == jnp.uint8 else jnp.packbits(p))
-    scalars = jax.lax.bitcast_convert_type(
-        jnp.stack([dmin, dmax]), jnp.uint8
-    ).reshape(8)
-    segs.append(scalars)
+    segs.append(pack_depth_scalars(dmin, dmax))
     return jnp.concatenate(segs)
 
 
@@ -254,9 +268,7 @@ def unpack_wire(buf: np.ndarray, length: int, d_pad: int, i_pad: int,
     sizes = _wire_sizes(length, d_pad, i_pad, want_masks)
     offs = np.cumsum([0] + sizes)
     segs = [buf[offs[i]: offs[i + 1]] for i in range(len(sizes))]
-    dmin, dmax = np.frombuffer(
-        buf[offs[-1]: offs[-1] + 8].tobytes(), np.int32
-    ).tolist()
+    dmin, dmax = unpack_depth_scalars(buf[offs[-1]: offs[-1] + 8])
     return segs[0], tuple(segs[1:]), dmin, dmax
 
 

@@ -39,7 +39,11 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from kindel_tpu.call import CallMasks, CallResult, _insertion_calls, assemble
-from kindel_tpu.call_jax import EMIT_ASCII
+from kindel_tpu.call_jax import (
+    EMIT_ASCII,
+    pack_depth_scalars,
+    unpack_depth_scalars,
+)
 from kindel_tpu.events import EventSet, N_CHANNELS
 from kindel_tpu.io.records import (
     ragged_indices,
@@ -334,10 +338,9 @@ def _package_outs(outs, n: int, block: int, realign: bool):
         segs += [trig_f.reshape(Lp // 8), trig_r.reshape(Lp // 8)]
         flat["csw"] = csw.reshape(Lp, N_CHANNELS)
         flat["cew"] = cew.reshape(Lp, N_CHANNELS)
-    scal = jax.lax.bitcast_convert_type(
-        jnp.stack([dmin.min(), dmax.max()]), jnp.uint8
-    ).reshape(8)
-    flat["wire"] = jnp.concatenate(segs + [scal])
+    flat["wire"] = jnp.concatenate(
+        segs + [pack_depth_scalars(dmin.min(), dmax.max())]
+    )
     return flat
 
 
@@ -543,12 +546,7 @@ class ShardedRef(LazyCdrWindows):
         )
 
     def depth_scalars(self) -> tuple[int, int]:
-        # tobytes: the 8-byte slice sits at an arbitrary (possibly
-        # unaligned) offset in the packed buffer
-        dmin, dmax = np.frombuffer(
-            self._seg("scalars").tobytes(), np.int32
-        ).tolist()
-        return dmin, dmax
+        return unpack_depth_scalars(self._seg("scalars"))
 
     # ---- realign sparse access --------------------------------------------
 

@@ -147,6 +147,71 @@ def build_report(ref_id, depth_min, depth_max, changes, cdr_patches, bam_path,
     return report
 
 
+#: device bytes the weights scatters of one contig batch may occupy —
+#: rows pad to the group's bucketed max length, so the footprint is
+#: n_contigs · Lb · 5 · 4 B; groups exceeding this run separately
+_BATCH_SCATTER_BUDGET = 512 << 20
+
+
+def _fused_batch_groups(ev, rids) -> list[list[int]]:
+    """Partition contigs into batches whose padded scatter footprint
+    stays within budget. Ascending length order keeps each group's
+    bucketed maximum tight (a 6 Mb chromosome never inflates the
+    plasmids' padding); contigs too long for the PAD_POS scheme or the
+    budget become singletons (caller runs those per-contig)."""
+    from kindel_tpu.events import N_CHANNELS
+    from kindel_tpu.pileup_jax import MAX_PAD_SAFE_BLOCK, _bucket
+
+    groups: list[list[int]] = []
+    cur: list[int] = []
+    for rid in sorted(rids, key=lambda r: int(ev.ref_lens[r])):
+        Lb = _bucket(int(ev.ref_lens[rid]), 1024)
+        if Lb > MAX_PAD_SAFE_BLOCK:
+            if cur:
+                groups.append(cur)
+                cur = []
+            groups.append([rid])
+            continue
+        if (
+            cur
+            and (len(cur) + 1) * Lb * N_CHANNELS * 4
+            > _BATCH_SCATTER_BUDGET
+        ):
+            groups.append(cur)
+            cur = []
+        cur.append(rid)
+    if cur:
+        groups.append(cur)
+    return groups
+
+
+def _fused_contig_batch(ev, rids, bam_path, min_depth, min_overlap,
+                        clip_decay_threshold, mask_ends, trim_ends,
+                        uppercase) -> dict:
+    """One batched device dispatch for several contigs of one file.
+    Returns {rid: (Sequence, changes, report)} via the cohort machinery
+    (kindel_tpu.batch), which is byte-identical to per-contig calls."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from kindel_tpu.batch import BatchOptions, _call_and_assemble
+    from kindel_tpu.call_jax import CallUnit
+
+    units = []
+    for rid in rids:
+        u = CallUnit(ev, rid, with_ins_table=True)
+        u.sample_idx = 0
+        units.append(u)
+    opts = BatchOptions(
+        realign=False, min_depth=min_depth, min_overlap=min_overlap,
+        clip_decay_threshold=clip_decay_threshold, mask_ends=mask_ends,
+        trim_ends=trim_ends, uppercase=uppercase,
+        build_reports=True, build_changes=True,
+    )
+    with ThreadPoolExecutor(max_workers=4) as pool:
+        outputs = _call_and_assemble(units, opts, pool, [bam_path])
+    return dict(zip(rids, outputs))
+
+
 def bam_to_consensus(
     bam_path,
     realign: bool = False,
@@ -195,9 +260,42 @@ def bam_to_consensus(
         ev = extract_events(batch)
 
     n_dev = _shardable_device_count() if backend == "jax" else 0
+
+    def _shard_ok(rid):
+        return n_dev > 1 and int(ev.ref_lens[rid]) >= n_dev
+
+    # multi-contig fused batching: contigs that would take the
+    # single-device fused path go up in batched dispatches (one padded
+    # device program + one packed download per group) instead of one
+    # round trip per contig — same kernels as the cohort path, so the
+    # per-contig outputs are byte-identical (tests/test_batch.py parity).
+    # Grouping is footprint-aware: rows pad to the group's bucketed
+    # maximum, so mixing a chromosome with 50 plasmids must not allocate
+    # 50 chromosome-sized scatter targets (see _fused_batch_groups).
+    batched_out: dict = {}
+    if backend == "jax" and not realign:
+        fused_rids = [
+            rid for rid in ev.present_ref_ids if not _shard_ok(rid)
+        ]
+        for group in _fused_batch_groups(ev, fused_rids):
+            if len(group) > 1:
+                batched_out.update(
+                    _fused_contig_batch(
+                        ev, group, bam_path, min_depth, min_overlap,
+                        clip_decay_threshold, mask_ends, trim_ends,
+                        uppercase,
+                    )
+                )
+
     for rid in ev.present_ref_ids:
         ref_id = ev.ref_names[rid]
-        shard_ok = n_dev > 1 and int(ev.ref_lens[rid]) >= n_dev
+        if rid in batched_out:
+            seq, changes, report = batched_out[rid]
+            refs_reports[ref_id] = report
+            refs_changes[ref_id] = changes
+            consensuses.append(seq)
+            continue
+        shard_ok = _shard_ok(rid)
         if backend == "jax" and (shard_ok or realign):
             # Position-sharded product path: every channel reduces on its
             # shard's device, the call runs on device with a ppermute halo,

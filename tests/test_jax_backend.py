@@ -212,3 +212,64 @@ def test_batch_realign_no_host_pileup(data_root, monkeypatch):
         c.sequence for c in expected.consensuses
     ]
     assert got.refs_reports == expected.refs_reports
+
+
+def test_multi_contig_fused_batched_identity(data_root, monkeypatch):
+    """Multi-contig files on the single-device fused path run ONE
+    batched dispatch for all contigs; output (sequences, changes,
+    reports) must equal numpy exactly. FORCE_FUSED pins the fused route
+    on the virtual mesh, where sharding would otherwise take over."""
+    import kindel_tpu.workloads as w
+
+    monkeypatch.setenv("KINDEL_TPU_FORCE_FUSED", "1")
+    calls = []
+    orig = w._fused_contig_batch
+
+    def spy(*a, **k):
+        out = orig(*a, **k)
+        calls.append(len(out))
+        return out
+
+    monkeypatch.setattr(w, "_fused_contig_batch", spy)
+    for rel in (("data_minimap2", "1.1.multi.bam"),):
+        bam = data_root.joinpath(*rel)
+        ref = bam_to_consensus(bam, backend="numpy")
+        got = bam_to_consensus(bam, backend="jax")
+        assert calls and calls[-1] > 1, "batched contig dispatch not taken"
+        assert [c.sequence for c in got.consensuses] == [
+            c.sequence for c in ref.consensuses
+        ]
+        assert got.refs_changes == ref.refs_changes
+        assert got.refs_reports == ref.refs_reports
+
+
+def test_fused_batch_groups_footprint():
+    """Grouping must not let one long contig inflate every row's
+    padding (review r3): a 6 Mb chromosome + tiny plasmids yields
+    separate groups, and an over-limit contig becomes a singleton."""
+    from types import SimpleNamespace
+
+    import kindel_tpu.workloads as w
+    from kindel_tpu.pileup_jax import MAX_PAD_SAFE_BLOCK
+
+    ev = SimpleNamespace(ref_lens=[6_000_000] + [5_000] * 50)
+    groups = w._fused_batch_groups(ev, list(range(51)))
+    by_rid = {rid: g for g in groups for rid in g}
+    assert len(by_rid) == 51
+    # the chromosome does not share a group with 50 plasmids at its pad
+    assert len(by_rid[0]) < 50
+    assert all(rid in by_rid for rid in range(51))
+    # footprint bound holds for every group
+    from kindel_tpu.events import N_CHANNELS
+    from kindel_tpu.pileup_jax import _bucket
+
+    for g in groups:
+        Lb = _bucket(max(int(ev.ref_lens[r]) for r in g), 1024)
+        assert (
+            len(g) == 1
+            or len(g) * Lb * N_CHANNELS * 4 <= w._BATCH_SCATTER_BUDGET
+        )
+    # a contig past the PAD_POS limit is always a singleton
+    ev2 = SimpleNamespace(ref_lens=[MAX_PAD_SAFE_BLOCK + 10, 1000, 2000])
+    groups2 = w._fused_batch_groups(ev2, [0, 1, 2])
+    assert [0] in groups2
