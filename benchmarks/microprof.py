@@ -32,8 +32,8 @@ def main() -> None:
     from kindel_tpu.call_jax import (
         CallUnit,
         decode_fast,
-        fused_call_kernel_wire,
-        kernel_args,
+        fused_call_kernel_packed,
+        pack_kernel_args,
         unpack_wire,
     )
     from kindel_tpu.events import extract_events
@@ -48,9 +48,13 @@ def main() -> None:
 
     # warmup / compile
     u = CallUnit(ev, rid)
-    args = kernel_args(u)
-    jax.block_until_ready(args)
-    out = fused_call_kernel_wire(*args, length=u.L, want_masks=False)
+    up, (o_pad, b_pad, d_pad, i_pad) = pack_kernel_args(u)
+    buf = jax.device_put(up)
+    jax.block_until_ready(buf)
+    out = fused_call_kernel_packed(
+        buf, o_pad=o_pad, b_pad=b_pad, d_pad=d_pad, i_pad=i_pad,
+        length=u.L, want_masks=False,
+    )
     jax.block_until_ready(out)
 
     for trial in range(3):
@@ -61,11 +65,14 @@ def main() -> None:
         t2 = time.perf_counter()
         u = CallUnit(ev, rid)
         t3 = time.perf_counter()
-        args = kernel_args(u)
-        jax.block_until_ready(args)
-        d_pad, i_pad = args[3].shape[0], args[4].shape[0]
+        up, (o_pad, b_pad, d_pad, i_pad) = pack_kernel_args(u)
+        buf = jax.device_put(up)  # ONE h2d transfer (round-3 packing)
+        jax.block_until_ready(buf)
         t4 = time.perf_counter()
-        out = fused_call_kernel_wire(*args, length=u.L, want_masks=False)
+        out = fused_call_kernel_packed(
+            buf, o_pad=o_pad, b_pad=b_pad, d_pad=d_pad, i_pad=i_pad,
+            length=u.L, want_masks=False,
+        )
         jax.block_until_ready(out)
         t5 = time.perf_counter()
         # ONE packed buffer, one d2h transfer (round-3 wire packing)

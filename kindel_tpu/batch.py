@@ -173,9 +173,15 @@ def _dp_sharding(n_rows: int):
     single-device. The batch axis is embarrassingly parallel, so laying
     rows across a dp mesh makes XLA partition the vmapped kernel with
     zero collectives."""
+    import os
+
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    # the documented single-device pin (README: "benchmark one chip in
+    # isolation") must hold on this path too
+    if os.environ.get("KINDEL_TPU_FORCE_FUSED"):
+        return None, 1
     n_dev = len(jax.devices())
     if n_dev <= 1:
         return None, 1

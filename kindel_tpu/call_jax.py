@@ -234,20 +234,73 @@ def _pack_wire(main, parts, dmin, dmax):
     return jnp.concatenate(segs)
 
 
-@partial(jax.jit, static_argnames=("length", "want_masks"))
-def fused_call_kernel_wire(op_r_start, op_off, base_packed, del_pos,
-                           ins_pos, ins_cnt, n_events, min_depth, *,
-                           length: int, want_masks: bool):
-    """fused_call_kernel with all outputs packed into one uint8 buffer
-    (single d2h transfer). Layout — masks path:
+def pack_kernel_args(u: "CallUnit", min_depth: int = 1):
+    """Pad + pack one unit's six event arrays AND the two scalars into a
+    single uint8 upload buffer (one h2d round trip instead of eight).
+    Layout (little-endian int32 unless noted):
+    [op_r_start 4·O | op_off 4·O | base_packed B (uint8) |
+     del_pos 4·D | ins_pos 4·I | ins_cnt 4·I | n_events 4 | min_depth 4]
+    Returns (buf, (o_pad, b_pad, d_pad, i_pad)) — the pad geometry is
+    static (bucketed) and keys the kernel's compile cache exactly like
+    the unpacked path."""
+    O_pad = _bucket(len(u.op_r_start), 256)
+    B_pad = _bucket(len(u.base_packed), 1024)
+    D_pad = _bucket(len(u.del_pos), 256)
+    I_pad = _bucket(len(u.ins_pos), 256)
+    segs = [
+        _pad(u.op_r_start, O_pad, PAD_POS).view(np.uint8),
+        _pad(u.op_off, O_pad, np.int32(u.n_events)).view(np.uint8),
+        # astype, not view: _pad of an EMPTY array defaults to int32
+        _pad(u.base_packed, B_pad, 0).astype(np.uint8, copy=False),
+        _pad(u.del_pos, D_pad, PAD_POS).view(np.uint8),
+        _pad(u.ins_pos, I_pad, PAD_POS).view(np.uint8),
+        _pad(u.ins_cnt, I_pad, 0).view(np.uint8),
+        np.asarray([u.n_events, min_depth], np.int32).view(np.uint8),
+    ]
+    return np.concatenate(segs), (O_pad, B_pad, D_pad, I_pad)
+
+
+def _unpack_kernel_args(buf, o_pad: int, b_pad: int, d_pad: int,
+                        i_pad: int):
+    """Device-side inverse of pack_kernel_args (traced; bitcasts only)."""
+
+    def i32(seg):
+        return jax.lax.bitcast_convert_type(
+            seg.reshape(-1, 4), jnp.int32
+        )
+
+    offs = np.cumsum(
+        [0, 4 * o_pad, 4 * o_pad, b_pad, 4 * d_pad, 4 * i_pad, 4 * i_pad]
+    )
+    op_r_start = i32(buf[offs[0]: offs[1]])
+    op_off = i32(buf[offs[1]: offs[2]])
+    base_packed = buf[offs[2]: offs[3]]
+    del_pos = i32(buf[offs[3]: offs[4]])
+    ins_pos = i32(buf[offs[4]: offs[5]])
+    ins_cnt = i32(buf[offs[5]: offs[6]])
+    scalars = i32(buf[offs[6]: offs[6] + 8])
+    return (op_r_start, op_off, base_packed, del_pos, ins_pos, ins_cnt,
+            scalars[0], scalars[1])
+
+
+@partial(
+    jax.jit,
+    static_argnames=("o_pad", "b_pad", "d_pad", "i_pad", "length",
+                     "want_masks"),
+)
+def fused_call_kernel_packed(buf, *, o_pad: int, b_pad: int, d_pad: int,
+                             i_pad: int, length: int, want_masks: bool):
+    """Single-buffer-in, single-buffer-out fused call: unpack the
+    uint8 upload (pack_kernel_args), run _call_core, pack the wire.
+    Result layout — masks path:
     [emit ⌈L/2⌉ | del ⌈L/8⌉ | n ⌈L/8⌉ | ins ⌈L/8⌉ | dmin,dmax 8B];
     fast path:
     [plane ⌈L/4⌉ | exc ⌈L/8⌉ | del_flags ⌈D/8⌉ | ins_flags ⌈I/8⌉ | 8B]
-    where D/I are the padded sparse-event widths (see _wire_sizes, the
-    single source of truth for these offsets)."""
+    with D/I the padded sparse-event widths (_wire_sizes is the single
+    source of truth for these offsets; unpack_wire decodes)."""
+    args = _unpack_kernel_args(buf, o_pad, b_pad, d_pad, i_pad)
     main, parts, dmin, dmax = _call_core(
-        op_r_start, op_off, base_packed, del_pos, ins_pos, ins_cnt,
-        n_events, min_depth, length, want_masks,
+        *args, length, want_masks,
     )
     return _pack_wire(main, parts, dmin, dmax)
 
@@ -472,26 +525,6 @@ class CallUnit:
             self.ins_cnt = np.asarray(icnt, np.int32)
 
 
-def kernel_args(u: "CallUnit", min_depth: int = 1):
-    """Pad + upload one unit's arrays in fused_call_kernel argument order.
-    Single source of truth for bucket sizes and pad fills — shared by
-    device_call and benchmarks/microprof.py."""
-    O_pad = _bucket(len(u.op_r_start), 256)
-    B_pad = _bucket(len(u.base_packed), 1024)
-    D_pad = _bucket(len(u.del_pos), 256)
-    I_pad = _bucket(len(u.ins_pos), 256)
-    return (
-        jnp.asarray(_pad(u.op_r_start, O_pad, PAD_POS)),
-        jnp.asarray(_pad(u.op_off, O_pad, np.int32(u.n_events))),
-        jnp.asarray(_pad(u.base_packed, B_pad, 0)),
-        jnp.asarray(_pad(u.del_pos, D_pad, PAD_POS)),
-        jnp.asarray(_pad(u.ins_pos, I_pad, PAD_POS)),
-        jnp.asarray(_pad(u.ins_cnt, I_pad, 0)),
-        jnp.int32(u.n_events),
-        jnp.int32(min_depth),
-    )
-
-
 def device_call(ev: EventSet, rid: int, min_depth: int = 1,
                 want_masks: bool = True):
     """Run the fused kernel for one reference.
@@ -502,9 +535,11 @@ def device_call(ev: EventSet, rid: int, min_depth: int = 1,
     is rebuilt from the 2-bit wire format (see decode_fast)."""
     u = CallUnit(ev, rid)
     L, ip = u.L, u.ins_pos
-    args = kernel_args(u, min_depth)
-    d_pad, i_pad = args[3].shape[0], args[4].shape[0]
-    buf = fused_call_kernel_wire(*args, length=L, want_masks=want_masks)
+    up, (o_pad, b_pad, d_pad, i_pad) = pack_kernel_args(u, min_depth)
+    buf = fused_call_kernel_packed(
+        jnp.asarray(up), o_pad=o_pad, b_pad=b_pad, d_pad=d_pad,
+        i_pad=i_pad, length=L, want_masks=want_masks,
+    )
     main_out, parts, dmin, dmax = unpack_wire(
         buf, L, d_pad, i_pad, want_masks
     )

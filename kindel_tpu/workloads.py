@@ -196,18 +196,21 @@ def _fused_contig_batch(ev, rids, bam_path, min_depth, min_overlap,
     from kindel_tpu.batch import BatchOptions, _call_and_assemble
     from kindel_tpu.call_jax import CallUnit
 
-    units = []
-    for rid in rids:
-        u = CallUnit(ev, rid, with_ins_table=True)
-        u.sample_idx = 0
-        units.append(u)
     opts = BatchOptions(
         realign=False, min_depth=min_depth, min_overlap=min_overlap,
         clip_decay_threshold=clip_decay_threshold, mask_ends=mask_ends,
         trim_ends=trim_ends, uppercase=uppercase,
         build_reports=True, build_changes=True,
     )
+
+    def unit(rid):
+        u = CallUnit(ev, rid, with_ins_table=True)
+        u.sample_idx = 0
+        return u
+
     with ThreadPoolExecutor(max_workers=4) as pool:
+        # per-contig event slicing + insertion tables build concurrently
+        units = list(pool.map(unit, rids))
         outputs = _call_and_assemble(units, opts, pool, [bam_path])
     return dict(zip(rids, outputs))
 
