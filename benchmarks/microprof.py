@@ -80,11 +80,9 @@ def main() -> None:
             np.asarray(out), u.L, d_pad, i_pad, want_masks=False
         )
         exc_bits, del_bits, ins_bits = parts
-        del_flags = np.unpackbits(del_bits)[: len(u.del_pos)].astype(bool)
-        ins_flags = np.unpackbits(ins_bits)[: len(u.ins_pos)].astype(bool)
         t6 = time.perf_counter()
         masks = decode_fast(
-            plane, exc_bits, del_flags, ins_flags, u.L, u.del_pos, u.ins_pos
+            plane, exc_bits, del_bits, ins_bits, u.L, u.del_pos, u.ins_pos
         )
         # match the production path: resolve insertion strings when any emit
         ins_calls = (

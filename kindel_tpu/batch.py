@@ -36,10 +36,12 @@ import numpy as np
 from kindel_tpu.call import _insertion_calls, assemble
 from kindel_tpu.call_jax import (
     CallUnit,
+    _wire_sizes,
     batched_call_kernel,
     batched_realign_call_kernel,
     decode_fast,
     masks_from_wire,
+    unpack_depth_scalars,
 )
 from kindel_tpu.events import extract_events
 from kindel_tpu.io import load_alignment
@@ -263,10 +265,12 @@ def _dispatch_device_call(units, opts: BatchOptions):
     kernel = (
         batched_realign_call_kernel if opts.realign else batched_call_kernel
     )
-    return kernel(
+    out = kernel(
         *dev_arrays, jnp.int32(opts.min_depth), length=L,
         want_masks=opts.want_masks,
     )
+    # meta the host decoder needs to slice each row's packed wire
+    return out, (L, D_pad, I_pad)
 
 
 @partial(jax.jit, static_argnames=("chunk",))
@@ -318,29 +322,30 @@ def _assemble_outputs(units, device_out, opts: BatchOptions, pool,
     thread-parallel). Returns (Sequence, changes|None, report|None) per
     unit, in unit order. `paths` maps sample_idx → input path for the
     report header (required when build_reports)."""
+    out, (L_pad, d_pad, i_pad) = device_out
     if opts.realign:
-        (main_out, extra, dmins, dmaxs,
-         trig_f_bits, trig_r_bits, *dense) = device_out
-        trig_f_bits = np.asarray(trig_f_bits)
-        trig_r_bits = np.asarray(trig_r_bits)
+        wire, *dense = out
     else:
-        main_out, extra, dmins, dmaxs = device_out
-        trig_f_bits = trig_r_bits = dense = None
-    main_out = np.asarray(main_out)
-    extra = tuple(np.asarray(x) for x in extra)
-    if opts.build_reports:
-        dmins = np.asarray(dmins)
-        dmaxs = np.asarray(dmaxs)
+        wire, dense = out, None
+    # ONE d2h transfer for the whole chunk's call wire
+    wire = np.asarray(wire)
+    sizes = _wire_sizes(
+        L_pad, d_pad, i_pad, opts.want_masks,
+        extra_bitmasks=2 if opts.realign else 0,  # CDR trigger planes
+    )
+    offs = np.cumsum([0] + sizes)
+
+    def row_segs(i):
+        segs = [wire[i, offs[k]: offs[k + 1]] for k in range(len(sizes))]
+        dmin, dmax = unpack_depth_scalars(wire[i, offs[-1]: offs[-1] + 8])
+        return segs, dmin, dmax
 
     def assemble_unit(i_u):
         i, u = i_u
+        segs, dmin, dmax = row_segs(i)
         if opts.realign:
-            trig_f = np.flatnonzero(
-                np.unpackbits(trig_f_bits[i])[: u.L]
-            )
-            trig_r = np.flatnonzero(
-                np.unpackbits(trig_r_bits[i])[: u.L]
-            )
+            trig_f = np.flatnonzero(np.unpackbits(segs[-2])[: u.L])
+            trig_r = np.flatnonzero(np.unpackbits(segs[-1])[: u.L])
             u.cdr_patches = _RowCdrFetcher(
                 dense, i, u.L
             ).cdr_patches_from_triggers(
@@ -349,11 +354,11 @@ def _assemble_outputs(units, device_out, opts: BatchOptions, pool,
             )
         if opts.want_masks:
             _emit, masks = masks_from_wire(
-                main_out[i], (extra[0][i], extra[1][i], extra[2][i]), u.L
+                segs[0], (segs[1], segs[2], segs[3]), u.L
             )
         else:
             masks = decode_fast(
-                main_out[i], extra[0][i], extra[1][i], extra[2][i],
+                segs[0], segs[1], segs[2], segs[3],
                 u.L, u.del_pos, u.ins_pos,
             )
         ins_calls = (
@@ -371,7 +376,7 @@ def _assemble_outputs(units, device_out, opts: BatchOptions, pool,
             from kindel_tpu.workloads import build_report
 
             report = build_report(
-                u.ref_id, int(dmins[i]), int(dmaxs[i]), res.changes,
+                u.ref_id, dmin, dmax, res.changes,
                 u.cdr_patches, paths[u.sample_idx], opts.realign,
                 opts.min_depth, opts.min_overlap,
                 opts.clip_decay_threshold, opts.trim_ends, opts.uppercase,

@@ -305,11 +305,18 @@ def fused_call_kernel_packed(buf, *, o_pad: int, b_pad: int, d_pad: int,
     return _pack_wire(main, parts, dmin, dmax)
 
 
-def _wire_sizes(length: int, d_pad: int, i_pad: int, want_masks: bool):
+def _wire_sizes(length: int, d_pad: int, i_pad: int, want_masks: bool,
+                extra_bitmasks: int = 0):
+    """Byte sizes of each packed-wire segment, in producer order — the
+    single source of truth for every decoder. extra_bitmasks appends
+    that many ⌈L/8⌉ segments (the batched realign kernel's two CDR
+    trigger planes)."""
     l8 = -(-length // 8)
     if want_masks:
-        return [-(-length // 2), l8, l8, l8]
-    return [-(-length // 4), l8, -(-d_pad // 8), -(-i_pad // 8)]
+        sizes = [-(-length // 2), l8, l8, l8]
+    else:
+        sizes = [-(-length // 4), l8, -(-d_pad // 8), -(-i_pad // 8)]
+    return sizes + [l8] * extra_bitmasks
 
 
 def unpack_wire(buf: np.ndarray, length: int, d_pad: int, i_pad: int,
@@ -355,10 +362,11 @@ def batched_call_kernel(op_r_start, op_off, base_packed, del_pos, ins_pos,
     """
 
     def one(ors, oo, bp, dp, ip, ic, ne, rl):
-        return _call_core(
+        main, parts, dmin, dmax = _call_core(
             ors, oo, bp, dp, ip, ic, ne, min_depth, length, want_masks,
             valid_len=rl,
         )
+        return _pack_wire(main, parts, dmin, dmax)
 
     return jax.vmap(one)(
         op_r_start, op_off, base_packed, del_pos, ins_pos, ins_cnt,
@@ -378,18 +386,18 @@ def batched_realign_call_kernel(
     scatter into [length, 5] clip-weight tensors, and the two
     clip-dominance trigger bitmasks (2·csd > w+d+1, integer-exact —
     reference kindel.py:182-185,229-238) are computed per position.
-    Returns (main, extra, dmin, dmax, trig_fwd_bits, trig_rev_bits,
-    weights, deletions, csw, cew): the four dense tensors stay
-    device-resident for the host walk's lazy window fetches — only the
-    ~L/8-byte trigger bitmasks are meant to cross the wire. This replaces
-    one dense host pileup per sample (VERDICT r2 item 3)."""
+    Returns (wire [B, W] packed uint8 — per-row call wire + the two
+    trigger bitmasks + depth scalars, one d2h transfer — plus weights,
+    deletions, csw, cew): the four dense tensors stay device-resident
+    for the host walk's lazy window fetches. This replaces one dense
+    host pileup per sample (VERDICT r2 item 3)."""
 
-    def one(ors, oo, bp, dp, ip, ic, ne, rl, cswp, cswb, cewp, cewb):
+    def one_full(ors, oo, bp, dp, ip, ic, ne, rl, cswp, cswb, cewp, cewb):
         out = _call_core(
             ors, oo, bp, dp, ip, ic, ne, min_depth, length, want_masks,
             valid_len=rl, keep_dense=True,
         )
-        *wire, weights, deletions = out
+        (main, parts, dmin, dmax), (weights, deletions) = out[:4], out[4:]
 
         def clip_scatter(p, b):
             return (
@@ -405,9 +413,12 @@ def batched_realign_call_kernel(
         denom = weights.sum(axis=1) + deletions + 1
         trig_f = jnp.packbits((2 * csw[:, :4].sum(axis=1) > denom) & valid)
         trig_r = jnp.packbits((2 * cew[:, :4].sum(axis=1) > denom) & valid)
-        return tuple(wire) + (trig_f, trig_r, weights, deletions, csw, cew)
+        wire = _pack_wire(
+            main, tuple(parts) + (trig_f, trig_r), dmin, dmax
+        )
+        return wire, weights, deletions, csw, cew
 
-    return jax.vmap(one)(
+    return jax.vmap(one_full)(
         op_r_start, op_off, base_packed, del_pos, ins_pos, ins_cnt,
         n_events, ref_lens, csw_pos, csw_base, cew_pos, cew_base,
     )
@@ -437,11 +448,19 @@ def masks_from_wire(emit_packed, masks_packed, L: int):
 
 
 def decode_fast(plane_packed: np.ndarray, exc_bits: np.ndarray,
-                del_flags: np.ndarray, ins_flags: np.ndarray, L: int,
-                del_pos: np.ndarray, ins_pos: np.ndarray) -> CallMasks:
+                del_flag_bits: np.ndarray, ins_flag_bits: np.ndarray,
+                L: int, del_pos: np.ndarray,
+                ins_pos: np.ndarray) -> CallMasks:
     """Rebuild assembler inputs from the fast-path wire format: the 2-bit
     ACGT plane, the exception bitmask (N or deletion-skip), and the
-    deletion/insertion flags gathered at their sparse event positions."""
+    BIT-PACKED deletion/insertion flags gathered at their sparse event
+    positions (unpacked here — one decoder, no per-caller dance)."""
+    del_flags = np.unpackbits(
+        np.asarray(del_flag_bits)
+    )[: len(del_pos)].astype(bool)
+    ins_flags = np.unpackbits(
+        np.asarray(ins_flag_bits)
+    )[: len(ins_pos)].astype(bool)
     plane = np.empty(plane_packed.shape[0] * 4, dtype=np.uint8)
     plane[0::4] = plane_packed >> 6
     plane[1::4] = (plane_packed >> 4) & 3
@@ -549,10 +568,8 @@ def device_call(ev: EventSet, rid: int, min_depth: int = 1,
         return emit, masks, dmin, dmax
 
     exc_bits, del_bits, ins_bits = parts
-    del_flags = np.unpackbits(del_bits)[: len(u.del_pos)].astype(bool)
-    ins_flags = np.unpackbits(ins_bits)[: len(ip)].astype(bool)
     masks = decode_fast(
-        main_out, exc_bits, del_flags, ins_flags, L, u.del_pos, ip,
+        main_out, exc_bits, del_bits, ins_bits, L, u.del_pos, ip,
     )
     return None, masks, dmin, dmax
 
