@@ -45,6 +45,27 @@ def append(path: Path, obj: dict) -> None:
         fh.write(json.dumps(obj) + "\n")
 
 
+MICROPROF_LOG = REPO / "MICROPROF_TPU.log"
+
+
+def run_microprof(ts_iso: str) -> None:
+    """After a successful TPU bench, capture one per-phase attribution
+    (now measuring the packed single-transfer wire) for BASELINE."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "benchmarks" / "microprof.py")],
+            capture_output=True, text=True, timeout=300, cwd=REPO,
+        )
+        with MICROPROF_LOG.open("a") as fh:
+            fh.write(f"=== {ts_iso} rc={proc.returncode}\n")
+            fh.write(proc.stdout[-2000:] + "\n")
+            if proc.returncode != 0:  # keep the traceback as evidence too
+                fh.write(proc.stderr[-2000:] + "\n")
+    except Exception as e:  # evidence capture must never kill the watcher
+        with MICROPROF_LOG.open("a") as fh:
+            fh.write(f"=== {ts_iso} microprof failed: {e}\n")
+
+
 def run_bench() -> dict:
     t0 = time.time()
     try:
@@ -104,6 +125,22 @@ def main() -> None:
             append(BENCH_LOG, result)
             if result.get("backend") == "tpu" and result.get("rc") == 0:
                 last_tpu_bench = now
+                # re-probe before the (up to 300 s) microprof run so the
+                # uptime log has no hole exactly around the TPU-up window
+                now2 = time.time()
+                ports2 = probe()
+                append(
+                    PROBE_LOG,
+                    {
+                        "ts": round(now2, 1),
+                        "iso": time.strftime(
+                            "%Y-%m-%dT%H:%M:%SZ", time.gmtime(now2)
+                        ),
+                        "ports": {str(k): v for k, v in ports2.items()},
+                        "relay_up": all(ports2.values()),
+                    },
+                )
+                run_microprof(result["iso"])
         if once:
             break
         time.sleep(PERIOD)

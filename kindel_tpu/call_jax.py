@@ -473,14 +473,10 @@ def decode_fast(plane_packed: np.ndarray, exc_bits: np.ndarray,
 
     del_mask = np.zeros(L, dtype=bool)
     if len(del_pos):
-        flags = np.asarray(del_flags)[: len(del_pos)]
-        valid = del_pos < L
-        del_mask[del_pos[valid & flags]] = True
+        del_mask[del_pos[(del_pos < L) & del_flags]] = True
     ins_mask = np.zeros(L, dtype=bool)
     if len(ins_pos):
-        flags = np.asarray(ins_flags)[: len(ins_pos)]
-        valid = ins_pos < L
-        ins_mask[ins_pos[valid & flags]] = True
+        ins_mask[ins_pos[(ins_pos < L) & ins_flags]] = True
     return CallMasks(
         base_char=base_char,
         del_mask=del_mask,

@@ -255,3 +255,74 @@ def test_consensus_matches_reference(seed, realign, tmp_path):
     ours = res.consensuses[0].sequence
     assert ours == ref_seq, f"seed={seed} realign={realign}"
     assert res.refs_changes["ref1"] == ref_changes
+
+
+def cdr_heavy_alignment(seed: int):
+    """Alignment engineered to trigger the realign pipeline: a coverage
+    GAP (bp1, bp2) that only soft-clip projections span — left-anchored
+    reads match up to bp1 then clip rightward across the gap,
+    right-anchored reads clip leftward across it then match from bp2.
+    Inside the gap csd ≫ w, so the dominance trigger fires; the clips
+    share the gap sequence, so pairing + LCS merge run (gap < min_overlap
+    exercises the merge-failure → unpatched fallback too)."""
+    rng = random.Random(seed + 7_000_000)
+    ref_len = rng.randint(90, 220)
+    gap = rng.randint(4, 18)  # straddles min_overlap=7: merges + failures
+    bp1 = rng.randint(20, ref_len - 30 - gap)
+    bp2 = bp1 + gap
+    gap_seq = "".join(rng.choice(BASES4) for _ in range(gap))
+    flank_l = "".join(rng.choice(BASES4) for _ in range(25))
+    flank_r = "".join(rng.choice(BASES4) for _ in range(25))
+    reads = []
+    depth = rng.randint(4, 9)
+    for _ in range(depth):
+        # → side: match the left flank up to bp1, clip across the gap and
+        # a few bases into the right flank
+        m = rng.randint(8, 20)
+        k = rng.randint(0, 6)
+        clip = gap_seq + flank_r[:k]
+        seq = flank_l[-m:] + clip
+        reads.append(
+            FakeRecord(bp1 - m + 1, seq, [(m, "M"), (len(clip), "S")])
+        )
+    for _ in range(depth):
+        # ← side: clip out of the left flank + gap, match from bp2+1 on
+        m = rng.randint(8, 20)
+        k = rng.randint(0, 6)
+        clip = flank_l[-k:] + gap_seq if k else gap_seq
+        seq = clip + flank_r[:m]
+        reads.append(
+            FakeRecord(bp2 + 1, seq, [(len(clip), "S"), (m, "M")])
+        )
+    return ref_len, reads
+
+
+@pytest.mark.parametrize("seed", range(30))
+def test_cdr_heavy_realign_matches_reference(seed, tmp_path):
+    """Targeted CDR fuzz: detection, pairing, decay extension, and the
+    LCS merge (including min_overlap failures → unpatched fallback) must
+    match the reference on clip-dominant inputs."""
+    ref_len, reads = cdr_heavy_alignment(seed)
+    aln = REF.parse_records("ref1", ref_len, reads)
+    cdrps = REF.cdrp_consensuses(
+        aln.weights, aln.deletions, aln.clip_start_weights,
+        aln.clip_end_weights, aln.clip_start_depth, aln.clip_end_depth,
+        0.1, 10,
+    )
+    cdr_patches = REF.merge_cdrps(cdrps, 7)
+    assert cdr_patches, "generator failed to trigger a CDR (vacuous test)"
+    ref_seq, ref_changes = REF.consensus_sequence(
+        aln.weights, aln.insertions, aln.deletions, cdr_patches,
+        trim_ends=False, min_depth=1, uppercase=False,
+    )
+
+    sam = tmp_path / f"cdr{seed}.sam"
+    sam.write_bytes(to_sam(ref_len, reads))
+    for backend in ("numpy", "jax"):
+        res = bam_to_consensus(
+            sam, realign=True, min_depth=1, min_overlap=7,
+            clip_decay_threshold=0.1, mask_ends=10, trim_ends=False,
+            uppercase=False, backend=backend,
+        )
+        assert res.consensuses[0].sequence == ref_seq, (seed, backend)
+        assert res.refs_changes["ref1"] == ref_changes, (seed, backend)
