@@ -38,7 +38,13 @@ BASELINE_MBASES_PER_S = 0.069  # reference end-to-end, 1 CPU core (SURVEY §6)
 
 TPU_ATTEMPT_TIMEOUT_S = 420.0  # first compile ~20-40s + tunneled transfers
 CPU_ATTEMPT_TIMEOUT_S = 300.0
-RELAY_WAIT_S = 30.0
+#: how long to wait for the relay to answer before falling back — the
+#: round-2 verdict flagged a single 30 s probe as throwing away whole
+#: uptime windows; the driver's end-of-round run deserves a longer grace
+RELAY_WAIT_S = float(os.environ.get("KINDEL_TPU_BENCH_RELAY_WAIT_S", "90"))
+#: TPU attempts before CPU fallback (a crash retries; a full-timeout
+#: hang does not — a second identical hang would double the stall)
+TPU_ATTEMPTS = max(1, int(os.environ.get("KINDEL_TPU_BENCH_TPU_ATTEMPTS", "2")))
 
 
 def _synthesize_bam(path: Path, ref_len: int = 6_097_032,
@@ -146,9 +152,19 @@ def main() -> None:
     argv = [sys.executable, str(REPO / "bench.py")]
     child_marker = {"KINDEL_TPU_BENCH_CHILD": "1"}
 
-    # Attempt 1: the tunneled accelerator, but only if its relay answers.
+    # Accelerator attempts: each re-probes the relay first (cheap when
+    # it is down), retries crashes, and does not retry a full-timeout
+    # hang (a second identical hang would just double the stall).
     if hz.pool_advertised():
-        if hz.wait_for_relay(RELAY_WAIT_S):
+        for attempt in range(TPU_ATTEMPTS):
+            if not hz.wait_for_relay(RELAY_WAIT_S):
+                errors.append(
+                    f"accelerator relay dead (no listener on "
+                    f"{hz.RELAY_PORTS} after {RELAY_WAIT_S:.0f}s, "
+                    f"attempt {attempt + 1})"
+                )
+                print(errors[-1], file=sys.stderr)
+                break
             env = hz.accelerator_env()
             env.update(child_marker)
             proc = hz.run_child(argv, env, TPU_ATTEMPT_TIMEOUT_S)
@@ -166,16 +182,12 @@ def main() -> None:
                 errors.append("tpu attempt silently ran on cpu backend")
             else:
                 errors.append(
-                    f"tpu attempt rc={proc.returncode}: "
+                    f"tpu attempt {attempt + 1} rc={proc.returncode}: "
                     f"{_tail(proc.stderr, 400)}"
                 )
             print(errors[-1], file=sys.stderr)
-        else:
-            errors.append(
-                f"accelerator relay dead (no listener on "
-                f"{hz.RELAY_PORTS} after {RELAY_WAIT_S:.0f}s)"
-            )
-            print(errors[-1], file=sys.stderr)
+            if proc.returncode == 124:  # run_child's watchdog timeout rc
+                break  # hung to the deadline — don't stall another round
 
     # Attempt 2: CPU with the accelerator hook scrubbed — always possible.
     env = hz.scrubbed_cpu_env()

@@ -14,6 +14,7 @@ Usage: python benchmarks/relay_watch.py [--once]
 from __future__ import annotations
 
 import json
+import os
 import socket
 import subprocess
 import sys
@@ -50,15 +51,24 @@ MICROPROF_LOG = REPO / "MICROPROF_TPU.log"
 
 def run_microprof(ts_iso: str) -> None:
     """After a successful TPU bench, capture one per-phase attribution
-    (now measuring the packed single-transfer wire) for BASELINE."""
+    (now measuring the packed single-transfer wire) for BASELINE. Runs
+    under _hermetic.accelerator_env so a broken-but-registered backend
+    fails loudly instead of silently profiling the CPU; the 'device:'
+    line is always kept so the log can never pass a CPU profile off as
+    TPU evidence."""
     try:
+        sys.path.insert(0, str(REPO))
+        import _hermetic as hz
+
         proc = subprocess.run(
             [sys.executable, str(REPO / "benchmarks" / "microprof.py")],
             capture_output=True, text=True, timeout=300, cwd=REPO,
+            env=hz.accelerator_env(),
         )
+        head = proc.stdout[:200]  # holds the 'device: ...' line
         with MICROPROF_LOG.open("a") as fh:
             fh.write(f"=== {ts_iso} rc={proc.returncode}\n")
-            fh.write(proc.stdout[-2000:] + "\n")
+            fh.write(head + "\n...\n" + proc.stdout[-1500:] + "\n")
             if proc.returncode != 0:  # keep the traceback as evidence too
                 fh.write(proc.stderr[-2000:] + "\n")
     except Exception as e:  # evidence capture must never kill the watcher
@@ -69,12 +79,19 @@ def run_microprof(ts_iso: str) -> None:
 def run_bench() -> dict:
     t0 = time.time()
     try:
+        # the watcher has just probed the relay and retries on its own
+        # cadence — pin bench to one quick-probe TPU attempt so its
+        # worst case (~420+300 s) stays inside this 900 s kill window
+        env = dict(os.environ)
+        env["KINDEL_TPU_BENCH_RELAY_WAIT_S"] = "15"
+        env["KINDEL_TPU_BENCH_TPU_ATTEMPTS"] = "1"
         proc = subprocess.run(
             [sys.executable, str(REPO / "bench.py")],
             capture_output=True,
             text=True,
             timeout=900,
             cwd=REPO,
+            env=env,
         )
         line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
         try:
