@@ -180,11 +180,12 @@ def main() -> None:
                 # JAX_PLATFORMS pinning should make this impossible, but
                 # never report a hook-tainted CPU run as the accelerator.
                 errors.append("tpu attempt silently ran on cpu backend")
-            else:
-                errors.append(
-                    f"tpu attempt {attempt + 1} rc={proc.returncode}: "
-                    f"{_tail(proc.stderr, 400)}"
-                )
+                print(errors[-1], file=sys.stderr)
+                break  # deterministic misconfiguration — retry won't help
+            errors.append(
+                f"tpu attempt {attempt + 1} rc={proc.returncode}: "
+                f"{_tail(proc.stderr, 400)}"
+            )
             print(errors[-1], file=sys.stderr)
             if proc.returncode == 124:  # run_child's watchdog timeout rc
                 break  # hung to the deadline — don't stall another round

@@ -22,6 +22,8 @@ import time
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:  # for the _hermetic import in run_microprof
+    sys.path.insert(0, str(REPO))
 PROBE_LOG = REPO / "RELAY_LOG.jsonl"
 BENCH_LOG = REPO / "BENCH_ATTEMPTS.jsonl"
 PORTS = (8082, 8083, 8087)
@@ -57,7 +59,6 @@ def run_microprof(ts_iso: str) -> None:
     line is always kept so the log can never pass a CPU profile off as
     TPU evidence."""
     try:
-        sys.path.insert(0, str(REPO))
         import _hermetic as hz
 
         proc = subprocess.run(
@@ -65,10 +66,15 @@ def run_microprof(ts_iso: str) -> None:
             capture_output=True, text=True, timeout=300, cwd=REPO,
             env=hz.accelerator_env(),
         )
-        head = proc.stdout[:200]  # holds the 'device: ...' line
         with MICROPROF_LOG.open("a") as fh:
             fh.write(f"=== {ts_iso} rc={proc.returncode}\n")
-            fh.write(head + "\n...\n" + proc.stdout[-1500:] + "\n")
+            if len(proc.stdout) > 1700:
+                # long output: keep the 'device: ...' head AND the tail
+                fh.write(
+                    proc.stdout[:200] + "\n...\n" + proc.stdout[-1500:] + "\n"
+                )
+            else:
+                fh.write(proc.stdout + "\n")
             if proc.returncode != 0:  # keep the traceback as evidence too
                 fh.write(proc.stderr[-2000:] + "\n")
     except Exception as e:  # evidence capture must never kill the watcher

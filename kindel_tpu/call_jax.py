@@ -9,7 +9,12 @@ Transfer-minimal by design. The host↔device link can be the bottleneck
   * downloads, on the fast path, a dense 2-bit ACGT plane plus a 1-bit
     exception mask (N / deletion-skip, disambiguated by flags gathered at
     the sparse deletion positions) and two depth scalars — ~L/4 + L/8
-    bytes; the masks path ships 4-bit emission codes + three bitmasks.
+    bytes; the masks path ships 4-bit emission codes + three bitmasks;
+  * and each direction crosses the tunnel as ONE packed uint8 buffer
+    (pack_kernel_args up, the _pack_wire result down) — a tunneled fetch
+    pays a round trip per array, so eight small uploads and seven small
+    downloads collapse to one each (round 3; per-phase attribution in
+    BASELINE.md showed the d2h round trips as the largest phase).
 
 For a 6.1 Mb reference that is ~1.3 MB up / ~2.3 MB down instead of
 ~14 MB up / ~146 MB down for naive event upload + count-tensor download.
@@ -193,16 +198,6 @@ def _decide(weights, deletions, ins_totals, del_pos, ins_pos, min_depth,
         (exc_bits, del_flags, ins_flags),
         dmin,
         dmax,
-    )
-
-
-@partial(jax.jit, static_argnames=("length", "want_masks"))
-def fused_call_kernel(op_r_start, op_off, base_packed, del_pos, ins_pos,
-                      ins_cnt, n_events, min_depth, *, length: int,
-                      want_masks: bool):
-    return _call_core(
-        op_r_start, op_off, base_packed, del_pos, ins_pos, ins_cnt,
-        n_events, min_depth, length, want_masks,
     )
 
 
