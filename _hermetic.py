@@ -142,3 +142,37 @@ def run_child(
 def python_child(code: str, env: dict, timeout: float):
     """`python -c code` under the watchdog."""
     return run_child([sys.executable, "-c", code], env, timeout)
+
+
+#: Watchdog for the PJRT-init pre-flight. Healthy client creation over the
+#: tunnel measures ~2-15 s; a wedged backend hangs in make_c_api_client
+#: forever (observed 2026-07-30: ports open, client init never returns).
+PJRT_PROBE_TIMEOUT_S = 90.0
+
+
+def pjrt_probe(timeout: float = PJRT_PROBE_TIMEOUT_S) -> tuple[bool, str]:
+    """Cheap pre-flight distinguishing relay failure mode 2b: ports accept
+    TCP but the PJRT client hangs during initialization. Spawns a child
+    that creates the accelerator backend and runs one tiny computation;
+    returns (ok, note). Callers use it to skip a full bench watchdog burn
+    (420 s) when the backend cannot even initialize (90 s)."""
+    code = (
+        "import jax, jax.numpy as jnp\n"
+        "d = jax.devices()\n"
+        "x = jnp.ones((8, 8))\n"
+        "jax.block_until_ready(x + x)\n"
+        "print('PJRT_OK', jax.default_backend(), len(d))\n"
+    )
+    proc = python_child(code, accelerator_env(), timeout)
+    out = (proc.stdout or "").strip().splitlines()
+    ok_line = next((ln for ln in out if ln.startswith("PJRT_OK")), None)
+    if proc.returncode == 0 and ok_line and " cpu " not in f" {ok_line} ":
+        return True, ok_line
+    if ok_line and " cpu " in f" {ok_line} ":
+        # JAX_PLATFORMS pinning lost somewhere — never let a CPU run pass
+        # as (or obscure) accelerator evidence.
+        return False, f"pjrt probe ran on cpu backend: {ok_line}"
+    if proc.returncode == 124:
+        return False, f"pjrt client init hung >{timeout:.0f}s (ports open)"
+    tail = (proc.stderr or "")[-300:]
+    return False, f"pjrt probe rc={proc.returncode}: {tail}"

@@ -165,6 +165,17 @@ def main() -> None:
                 )
                 print(errors[-1], file=sys.stderr)
                 break
+            if os.environ.get("KINDEL_TPU_BENCH_SKIP_PJRT_PROBE"):
+                ok, note = True, "probe skipped (caller pre-flighted)"
+            else:
+                ok, note = hz.pjrt_probe()
+            if not ok:
+                # Ports open but the PJRT client cannot initialize — the
+                # full bench child would hang to its 420 s watchdog on the
+                # same init path, so record the sharper evidence and stop.
+                errors.append(note)
+                print(errors[-1], file=sys.stderr)
+                break
             env = hz.accelerator_env()
             env.update(child_marker)
             proc = hz.run_child(argv, env, TPU_ATTEMPT_TIMEOUT_S)

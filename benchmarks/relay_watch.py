@@ -22,8 +22,11 @@ import time
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
-if str(REPO) not in sys.path:  # for the _hermetic import in run_microprof
+if str(REPO) not in sys.path:
     sys.path.insert(0, str(REPO))
+
+import _hermetic as hz  # noqa: E402  (stdlib-only; needs REPO on sys.path)
+
 PROBE_LOG = REPO / "RELAY_LOG.jsonl"
 BENCH_LOG = REPO / "BENCH_ATTEMPTS.jsonl"
 PORTS = (8082, 8083, 8087)
@@ -48,6 +51,14 @@ def append(path: Path, obj: dict) -> None:
         fh.write(json.dumps(obj) + "\n")
 
 
+def stamp(ts: float) -> dict:
+    """The shared {ts, iso} prefix of every log record in this file."""
+    return {
+        "ts": round(ts, 1),
+        "iso": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(ts)),
+    }
+
+
 MICROPROF_LOG = REPO / "MICROPROF_TPU.log"
 
 
@@ -59,8 +70,6 @@ def run_microprof(ts_iso: str) -> None:
     line is always kept so the log can never pass a CPU profile off as
     TPU evidence."""
     try:
-        import _hermetic as hz
-
         proc = subprocess.run(
             [sys.executable, str(REPO / "benchmarks" / "microprof.py")],
             capture_output=True, text=True, timeout=300, cwd=REPO,
@@ -85,12 +94,14 @@ def run_microprof(ts_iso: str) -> None:
 def run_bench() -> dict:
     t0 = time.time()
     try:
-        # the watcher has just probed the relay and retries on its own
-        # cadence — pin bench to one quick-probe TPU attempt so its
-        # worst case (~420+300 s) stays inside this 900 s kill window
+        # the watcher has just probed relay + PJRT init on its own
+        # cadence — pin bench to one TPU attempt with its own pre-flight
+        # suppressed, so worst case (~15 s relay wait + 420 s TPU child +
+        # 300 s CPU child ≈ 735 s) stays inside this 900 s kill window
         env = dict(os.environ)
         env["KINDEL_TPU_BENCH_RELAY_WAIT_S"] = "15"
         env["KINDEL_TPU_BENCH_TPU_ATTEMPTS"] = "1"
+        env["KINDEL_TPU_BENCH_SKIP_PJRT_PROBE"] = "1"
         proc = subprocess.run(
             [sys.executable, str(REPO / "bench.py")],
             capture_output=True,
@@ -131,7 +142,7 @@ def main() -> None:
         up = all(ports.values())
         append(
             PROBE_LOG,
-            {"ts": round(now, 1), "iso": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(now)), "ports": {str(k): v for k, v in ports.items()}, "relay_up": up},
+            {**stamp(now), "ports": {str(k): v for k, v in ports.items()}, "relay_up": up},
         )
         # throttle: ports-up-but-cpu-fallback must not re-run the multi-
         # minute bench every probe cycle — any attempt counts for
@@ -142,9 +153,37 @@ def main() -> None:
             and now - last_attempt > FAIL_RETRY_S
         ):
             last_attempt = now
+            # Pre-flight: ports-open-but-client-hung (observed 2026-07-30)
+            # would burn bench's full 420 s watchdog; a 90 s PJRT probe
+            # converts that into sharp, cheap evidence in both logs. Only
+            # meaningful when the pool hook is advertised — without it
+            # bench.py skips its TPU loop and still yields a CPU record.
+            pjrt_ok, pjrt_note = True, "pool not advertised"
+            if hz.pool_advertised():
+                pjrt_ok, pjrt_note = hz.pjrt_probe()
+                append(
+                    PROBE_LOG,
+                    {
+                        **stamp(time.time()),
+                        "pjrt_ok": pjrt_ok,
+                        "pjrt_note": pjrt_note,
+                    },
+                )
+            if not pjrt_ok:
+                append(
+                    BENCH_LOG,
+                    {
+                        **stamp(now),
+                        "skipped": "pjrt preflight failed",
+                        "note": pjrt_note,
+                    },
+                )
+                if once:
+                    break
+                time.sleep(PERIOD)
+                continue
             result = run_bench()
-            result["ts"] = round(now, 1)
-            result["iso"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(now))
+            result.update(stamp(now))
             append(BENCH_LOG, result)
             if result.get("backend") == "tpu" and result.get("rc") == 0:
                 last_tpu_bench = now
@@ -155,10 +194,7 @@ def main() -> None:
                 append(
                     PROBE_LOG,
                     {
-                        "ts": round(now2, 1),
-                        "iso": time.strftime(
-                            "%Y-%m-%dT%H:%M:%SZ", time.gmtime(now2)
-                        ),
+                        **stamp(now2),
                         "ports": {str(k): v for k, v in ports2.items()},
                         "relay_up": all(ports2.values()),
                     },
