@@ -55,6 +55,7 @@ from kindel_tpu.io.records import (
     OP_EQ,
     OP_X,
 )
+from kindel_tpu.io import native
 
 #: channel order matches the reference's dict insertion order
 #: {"A","T","G","C","N"} (/root/reference/kindel/kindel.py:29) — argmax ties
@@ -239,15 +240,29 @@ def _fast_events(out, insertions, batch, kept_ops, op_code, op_len, op_i,
     m = np.flatnonzero(is_m)
     if len(m):
         lens = op_len[m]
-        pos = ragged_indices(r_start[m], lens)
-        qidx = ragged_indices(q_abs[m], lens)
-        rid = np.repeat(rid_op[m], lens)
-        L = np.repeat(L_op[m], lens)
-        pos = _wrap(pos, L)
-        ok = (pos >= 0) & (pos < L)
-        out["match"][0].append(rid[ok])
-        out["match"][1].append(pos[ok])
-        out["match"][2].append(BASE_CODE[seq[qidx[ok]]])
+        expanded = (
+            native.expand_match_events(
+                r_start[m], q_abs[m], lens, rid_op[m], L_op[m],
+                seq, BASE_CODE,
+            )
+            if native.available()
+            else None
+        )
+        if expanded is not None:
+            # fused C++ pass: ragged expand + wrap + bounds + code gather
+            out["match"][0].append(expanded[0])
+            out["match"][1].append(expanded[1])
+            out["match"][2].append(expanded[2])
+        else:
+            pos = ragged_indices(r_start[m], lens)
+            qidx = ragged_indices(q_abs[m], lens)
+            rid = np.repeat(rid_op[m], lens)
+            L = np.repeat(L_op[m], lens)
+            pos = _wrap(pos, L)
+            ok = (pos >= 0) & (pos < L)
+            out["match"][0].append(rid[ok])
+            out["match"][1].append(pos[ok])
+            out["match"][2].append(BASE_CODE[seq[qidx[ok]]])
 
     # --- D: one event per deleted reference position ---
     d = np.flatnonzero(op_code == OP_D)

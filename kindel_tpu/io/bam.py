@@ -80,26 +80,42 @@ def _fields_from_offsets(data: bytes, offs: np.ndarray, ref_names, ref_lens) -> 
     flag = _gather_scalar(buf, offs + 14, "<u2", 2)
     l_seq = _gather_scalar(buf, offs + 16, "<i4", 4).astype(np.int64)
 
+    from kindel_tpu.io import native
+
+    use_native = native.available()
+
     # CIGAR: u32 little-endian words, len<<4 | op
     cig_starts = offs + 32 + l_read_name
-    cig_bytes = buf[ragged_indices(cig_starts, 4 * n_cigar)]
-    cig_u32 = cig_bytes.view("<u4").astype(np.int64)
-    cig_op = (cig_u32 & 0xF).astype(np.uint8)
-    cig_len = (cig_u32 >> 4).astype(np.int64)
+    parsed = (
+        native.parse_cigar(buf, cig_starts, n_cigar) if use_native else None
+    )
+    if parsed is not None:
+        cig_op, cig_len = parsed
+    else:
+        cig_bytes = buf[ragged_indices(cig_starts, 4 * n_cigar)]
+        cig_u32 = cig_bytes.view("<u4").astype(np.int64)
+        cig_op = (cig_u32 & 0xF).astype(np.uint8)
+        cig_len = (cig_u32 >> 4).astype(np.int64)
     cig_off = np.zeros(len(offs) + 1, dtype=np.int64)
     np.cumsum(n_cigar, out=cig_off[1:])
 
     # SEQ: 4-bit packed, high nibble first
     seq_starts = cig_starts + 4 * n_cigar
-    seq_nbytes = (l_seq + 1) // 2
-    packed = buf[ragged_indices(seq_starts, seq_nbytes)]
-    nibbles = np.empty(2 * len(packed), dtype=np.uint8)
-    nibbles[0::2] = packed >> 4
-    nibbles[1::2] = packed & 0xF
-    # Trim odd-length padding nibble per read
-    local = ragged_local_offsets(2 * seq_nbytes)
-    keep = local < np.repeat(l_seq, 2 * seq_nbytes)
-    seq = SEQ_NT16[nibbles[keep]]
+    seq = (
+        native.unpack_seq(buf, seq_starts, l_seq, SEQ_NT16)
+        if use_native
+        else None
+    )
+    if seq is None:
+        seq_nbytes = (l_seq + 1) // 2
+        packed = buf[ragged_indices(seq_starts, seq_nbytes)]
+        nibbles = np.empty(2 * len(packed), dtype=np.uint8)
+        nibbles[0::2] = packed >> 4
+        nibbles[1::2] = packed & 0xF
+        # Trim odd-length padding nibble per read
+        local = ragged_local_offsets(2 * seq_nbytes)
+        keep = local < np.repeat(l_seq, 2 * seq_nbytes)
+        seq = SEQ_NT16[nibbles[keep]]
     seq_off = np.zeros(len(offs) + 1, dtype=np.int64)
     np.cumsum(l_seq, out=seq_off[1:])
 

@@ -60,10 +60,18 @@ def _load():
 
 def _load_locked():
     global _lib
+    global _build_tried
     if _lib is None and not _LIB_PATH.exists():
         _try_build()
     if _lib is None and _LIB_PATH.exists():
         lib = ctypes.CDLL(str(_LIB_PATH))
+        if not hasattr(lib, "expand_match_events"):
+            # stale .so from before the expansion kernels: rebuild once
+            _build_tried = False
+            _try_build()
+            lib = ctypes.CDLL(str(_LIB_PATH))
+            if not hasattr(lib, "expand_match_events"):
+                return None
         i64 = ctypes.c_int64
         u8p = np.ctypeslib.ndpointer(dtype=np.uint8, flags="C_CONTIGUOUS")
         i64p = np.ctypeslib.ndpointer(dtype=np.int64, flags="C_CONTIGUOUS")
@@ -73,11 +81,28 @@ def _load_locked():
         lib.bgzf_inflate.argtypes = [ctypes.c_char_p, i64, u8p, i64]
         lib.bgzf_decompressed_size.restype = i64
         lib.bgzf_decompressed_size.argtypes = [ctypes.c_char_p, i64]
+        lib.ragged_indices64.restype = i64
+        lib.ragged_indices64.argtypes = [i64p, i64p, i64, i64p]
+        lib.ragged_local64.restype = i64
+        lib.ragged_local64.argtypes = [i64p, i64, i64p]
+        lib.parse_cigar.restype = i64
+        lib.parse_cigar.argtypes = [u8p, i64, i64p, i64p, i64, u8p, i64p]
+        lib.unpack_seq.restype = i64
+        lib.unpack_seq.argtypes = [u8p, i64, i64p, i64p, i64, u8p, u8p]
+        lib.expand_match_events.restype = i64
+        lib.expand_match_events.argtypes = [
+            i64p, i64p, i64p, i64p, i64p, i64, u8p, i64, u8p,
+            i64p, i64p, u8p,
+        ]
         _lib = lib
     return _lib
 
 
 def available() -> bool:
+    import os
+
+    if os.environ.get("KINDEL_TPU_DISABLE_NATIVE"):
+        return False
     return _load() is not None
 
 
@@ -129,3 +154,75 @@ def parse_bam_bytes(data: bytes):
         off += 8 + l_name
     offs = scan_record_offsets(data, off)
     return pybam._fields_from_offsets(data, offs, ref_names, ref_lens)
+
+
+def _c64(a) -> np.ndarray:
+    return np.ascontiguousarray(a, dtype=np.int64)
+
+
+def ragged_indices(starts, lens) -> np.ndarray:
+    """Native ragged-range index expansion (io.records.ragged_indices)."""
+    lens = _c64(lens)
+    out = np.empty(int(lens.sum()), dtype=np.int64)
+    n = _load().ragged_indices64(_c64(starts), lens, len(lens), out)
+    assert n == len(out)
+    return out
+
+
+def ragged_local_offsets(lens) -> np.ndarray:
+    """Native within-range offsets (io.records.ragged_local_offsets)."""
+    lens = _c64(lens)
+    out = np.empty(int(lens.sum()), dtype=np.int64)
+    n = _load().ragged_local64(lens, len(lens), out)
+    assert n == len(out)
+    return out
+
+
+def parse_cigar(buf: np.ndarray, starts, n_ops):
+    """Fused CIGAR word parse → (op uint8[], len int64[]); None on any
+    out-of-bounds word (caller falls back to the numpy path)."""
+    starts, n_ops = _c64(starts), _c64(n_ops)
+    total = int(n_ops.sum())
+    out_op = np.empty(total, dtype=np.uint8)
+    out_len = np.empty(total, dtype=np.int64)
+    n = _load().parse_cigar(
+        buf, len(buf), starts, n_ops, len(starts), out_op, out_len
+    )
+    if n != total:
+        return None
+    return out_op, out_len
+
+
+def unpack_seq(buf: np.ndarray, starts, l_seq, nt16: np.ndarray):
+    """Fused 4-bit SEQ decode → ASCII uint8[]; None on out-of-bounds."""
+    starts, l_seq = _c64(starts), _c64(l_seq)
+    total = int(l_seq.sum())
+    out = np.empty(total, dtype=np.uint8)
+    n = _load().unpack_seq(
+        buf, len(buf), starts, l_seq, len(starts),
+        np.ascontiguousarray(nt16, dtype=np.uint8), out,
+    )
+    if n != total:
+        return None
+    return out
+
+
+def expand_match_events(r_start, q_abs, lens, rid, L, seq: np.ndarray,
+                        base_code: np.ndarray):
+    """Fused M/=/X expansion with wrap + bounds filter + base-code map →
+    (rid int64[], pos int64[], base uint8[]); None on out-of-bounds."""
+    r_start, q_abs, lens = _c64(r_start), _c64(q_abs), _c64(lens)
+    rid, L = _c64(rid), _c64(L)
+    cap = int(lens.sum())
+    out_rid = np.empty(cap, dtype=np.int64)
+    out_pos = np.empty(cap, dtype=np.int64)
+    out_base = np.empty(cap, dtype=np.uint8)
+    n = _load().expand_match_events(
+        r_start, q_abs, lens, rid, L, len(lens),
+        np.ascontiguousarray(seq, dtype=np.uint8), len(seq),
+        np.ascontiguousarray(base_code, dtype=np.uint8),
+        out_rid, out_pos, out_base,
+    )
+    if n < 0:
+        return None
+    return out_rid[:n], out_pos[:n], out_base[:n]

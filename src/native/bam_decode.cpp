@@ -118,4 +118,105 @@ int64_t bgzf_decompressed_size(const uint8_t* data, int64_t len) {
     return total;
 }
 
+// ---------------------------------------------------------------------------
+// Hot-path expansion kernels. The numpy formulations of these (io/records.py,
+// events.py, io/bam.py) are multi-pass over large int64 temporaries; each
+// kernel below is one sequential-write pass. All are optional: Python keeps
+// byte-identical fallbacks and uses these only when the library loads.
+
+// Flat gather indices for ragged ranges [starts[i], starts[i]+lens[i]).
+// Mirrors kindel_tpu.io.records.ragged_indices. Returns elements written.
+int64_t ragged_indices64(const int64_t* starts, const int64_t* lens,
+                         int64_t n, int64_t* out) {
+    int64_t k = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        const int64_t s = starts[i], m = lens[i];
+        for (int64_t j = 0; j < m; ++j) out[k++] = s + j;
+    }
+    return k;
+}
+
+// 0..len-1 offsets of each flattened element within its range.
+// Mirrors kindel_tpu.io.records.ragged_local_offsets.
+int64_t ragged_local64(const int64_t* lens, int64_t n, int64_t* out) {
+    int64_t k = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        const int64_t m = lens[i];
+        for (int64_t j = 0; j < m; ++j) out[k++] = j;
+    }
+    return k;
+}
+
+// Fused CIGAR parse: n_ops[r] little-endian u32 words at byte starts[r] of
+// `buf`; writes op codes (word & 0xF) and lengths (word >> 4) contiguously.
+// Replaces the gather + view + two astype passes in _fields_from_offsets.
+// Returns total ops, or -1 when any word lies outside the buffer.
+int64_t parse_cigar(const uint8_t* buf, int64_t buf_len,
+                    const int64_t* starts, const int64_t* n_ops,
+                    int64_t n_reads, uint8_t* out_op, int64_t* out_len) {
+    int64_t k = 0;
+    for (int64_t r = 0; r < n_reads; ++r) {
+        int64_t off = starts[r];
+        const int64_t m = n_ops[r];
+        if (off < 0 || off + 4 * m > buf_len) return -1;
+        for (int64_t j = 0; j < m; ++j, off += 4, ++k) {
+            uint32_t w;
+            std::memcpy(&w, buf + off, 4);
+            out_op[k] = static_cast<uint8_t>(w & 0xF);
+            out_len[k] = static_cast<int64_t>(w >> 4);
+        }
+    }
+    return k;
+}
+
+// Fused SEQ decode: l_seq[r] bases packed two-per-byte (high nibble first)
+// at byte starts[r]; maps nibbles through the 16-entry `nt16` table into
+// contiguous ASCII. Replaces the ragged gather + nibble split + trim-mask
+// passes in _fields_from_offsets. Returns total bases, or -1 out-of-bounds.
+int64_t unpack_seq(const uint8_t* buf, int64_t buf_len,
+                   const int64_t* starts, const int64_t* l_seq,
+                   int64_t n_reads, const uint8_t* nt16, uint8_t* out) {
+    int64_t k = 0;
+    for (int64_t r = 0; r < n_reads; ++r) {
+        const int64_t s = starts[r], m = l_seq[r];
+        if (s < 0 || s + (m + 1) / 2 > buf_len) return -1;
+        for (int64_t j = 0; j < m; ++j) {
+            const uint8_t byte = buf[s + (j >> 1)];
+            out[k++] = nt16[(j & 1) ? (byte & 0xF) : (byte >> 4)];
+        }
+    }
+    return k;
+}
+
+// Fused M/=/X event expansion (the dominant event class): for op i and
+// j < lens[i], position r_start[i]+j wraps Python-negative-index style
+// (p in [-L, 0) maps to p+L) and is kept when 0 <= p < L[i]; the matching
+// query base seq[q_abs[i]+j] maps through the 256-entry base_code table.
+// Replaces two ragged_indices, two repeats, the wrap, the bounds mask and
+// the code gather in events._fast_events. Returns events kept, or -1 when
+// a query index leaves the seq buffer.
+int64_t expand_match_events(const int64_t* r_start, const int64_t* q_abs,
+                            const int64_t* lens, const int64_t* rid,
+                            const int64_t* L, int64_t n_ops,
+                            const uint8_t* seq, int64_t seq_len,
+                            const uint8_t* base_code, int64_t* out_rid,
+                            int64_t* out_pos, uint8_t* out_base) {
+    int64_t k = 0;
+    for (int64_t i = 0; i < n_ops; ++i) {
+        const int64_t m = lens[i], ln = L[i], rd = rid[i];
+        const int64_t rs = r_start[i], q0 = q_abs[i];
+        if (m > 0 && (q0 < 0 || q0 + m > seq_len)) return -1;
+        for (int64_t j = 0; j < m; ++j) {
+            int64_t p = rs + j;
+            if (p < 0) p += ln;
+            if (p < 0 || p >= ln) continue;
+            out_rid[k] = rd;
+            out_pos[k] = p;
+            out_base[k] = base_code[seq[q0 + j]];
+            ++k;
+        }
+    }
+    return k;
+}
+
 }  // extern "C"

@@ -36,3 +36,104 @@ def test_native_bam_decode_matches_python(native, data_root):
 
 def test_native_rejects_garbage(native):
     assert native.bgzf_decompress(b"\x1f\x8b" + b"junkjunkjunkjunkjunk") is None
+
+
+# --- expansion kernels: native one-pass C++ vs the numpy formulations ---
+
+
+def _numpy_ragged_indices(starts, lens):
+    starts = np.asarray(starts, dtype=np.int64)
+    lens = np.asarray(lens, dtype=np.int64)
+    total = int(lens.sum())
+    if total == 0:
+        return np.empty(0, dtype=np.int64)
+    ends = np.cumsum(lens)
+    flat = np.arange(total, dtype=np.int64)
+    base = np.repeat(ends - lens, lens)
+    return np.repeat(starts, lens) + (flat - base)
+
+
+def test_ragged_kernels_match_numpy_fuzz(native):
+    rng = np.random.default_rng(7)
+    for _ in range(50):
+        n = int(rng.integers(0, 40))
+        starts = rng.integers(-50, 50, size=n)
+        lens = rng.integers(0, 20, size=n)  # includes empty ranges
+        np.testing.assert_array_equal(
+            native.ragged_indices(starts, lens),
+            _numpy_ragged_indices(starts, lens),
+        )
+        exp_local = _numpy_ragged_indices(np.zeros(n, np.int64), lens)
+        np.testing.assert_array_equal(
+            native.ragged_local_offsets(lens), exp_local
+        )
+
+
+def test_fields_from_offsets_native_matches_numpy(native, data_root, monkeypatch):
+    raw = (data_root / "data_minimap2" / "1.1.multi.bam").read_bytes()
+    data = bgzf.decompress(raw)
+    with_native = parse_bam_bytes(data)
+    monkeypatch.setenv("KINDEL_TPU_DISABLE_NATIVE", "1")
+    pure = parse_bam_bytes(data)
+    np.testing.assert_array_equal(pure.seq, with_native.seq)
+    np.testing.assert_array_equal(pure.cig_op, with_native.cig_op)
+    np.testing.assert_array_equal(pure.cig_len, with_native.cig_len)
+    np.testing.assert_array_equal(pure.seq_off, with_native.seq_off)
+
+
+def test_extract_events_native_matches_numpy(native, data_root, monkeypatch):
+    """End-to-end event-stream identity with the fused M/=/X expansion on
+    vs off — covers the wrap/bounds/base-code semantics of
+    expand_match_events against the numpy branch, on a real multi-contig
+    BAM (clips, indels) and the clipped viral BAM."""
+    from kindel_tpu.events import extract_events
+    from kindel_tpu.io import load_alignment
+
+    for rel in ("data_minimap2/1.1.multi.bam", "data_bwa_mem/1.1.sub_test.bam"):
+        batch = load_alignment(data_root / rel)
+        ev_native = extract_events(batch)
+        monkeypatch.setenv("KINDEL_TPU_DISABLE_NATIVE", "1")
+        ev_pure = extract_events(batch)
+        monkeypatch.delenv("KINDEL_TPU_DISABLE_NATIVE")
+        for f in (
+            "match_rid", "match_pos", "match_base", "del_rid", "del_pos",
+            "cs_rid", "cs_pos", "ce_rid", "ce_pos",
+            "csw_rid", "csw_pos", "csw_base",
+            "cew_rid", "cew_pos", "cew_base",
+        ):
+            np.testing.assert_array_equal(
+                getattr(ev_pure, f), getattr(ev_native, f), err_msg=f
+            )
+        assert ev_pure.insertions == ev_native.insertions
+
+
+def test_expand_match_events_wrap_and_bounds(native):
+    """Negative start positions wrap Python-style exactly once (p in
+    [-L, 0) → p+L); anything still outside [0, L) is dropped — pinned
+    against the numpy branch's _wrap + mask semantics."""
+    from kindel_tpu.events import BASE_CODE
+
+    seq = np.frombuffer(b"ACGTACGTACGTACGTACGT", dtype=np.uint8).copy()
+    r_start = np.array([-3, -25, 8], dtype=np.int64)
+    q_abs = np.array([0, 5, 10], dtype=np.int64)
+    lens = np.array([5, 4, 5], dtype=np.int64)
+    rid = np.array([0, 0, 1], dtype=np.int64)
+    L = np.array([10, 10, 10], dtype=np.int64)
+    got = native.expand_match_events(r_start, q_abs, lens, rid, L, seq, BASE_CODE)
+    pos = _numpy_ragged_indices(r_start, lens)
+    qidx = _numpy_ragged_indices(q_abs, lens)
+    rid_f = np.repeat(rid, lens)
+    L_f = np.repeat(L, lens)
+    pos = np.where(pos < 0, pos + L_f, pos)
+    ok = (pos >= 0) & (pos < L_f)
+    np.testing.assert_array_equal(got[0], rid_f[ok])
+    np.testing.assert_array_equal(got[1], pos[ok])
+    np.testing.assert_array_equal(got[2], BASE_CODE[seq[qidx[ok]]])
+    # out-of-bounds query index → None (caller falls back to numpy)
+    assert (
+        native.expand_match_events(
+            r_start, np.array([0, 5, 18], dtype=np.int64), lens, rid, L,
+            seq, BASE_CODE,
+        )
+        is None
+    )
