@@ -20,6 +20,7 @@ import numpy as np
 _LIB_PATH = Path(__file__).parent / "_kindel_native.so"
 _lib = None
 _build_tried = False
+_stale = False  # terminal: a stale .so was found and recovery failed
 _lock = threading.Lock()
 
 
@@ -58,19 +59,55 @@ def _load():
         return _load_locked()
 
 
+def _load_fresh_copy():
+    """dlopen the on-disk library under a unique temporary pathname so the
+    handle cannot come from glibc's by-pathname dlopen cache. The temp file
+    is unlinked right after loading (the mapping stays valid on Linux)."""
+    import os
+    import shutil
+    import tempfile
+
+    tmp = None
+    try:
+        fd, tmp = tempfile.mkstemp(
+            suffix=".so", prefix="_kindel_native_", dir=str(_LIB_PATH.parent)
+        )
+        os.close(fd)
+        shutil.copy2(str(_LIB_PATH), tmp)
+        return ctypes.CDLL(tmp)
+    except OSError:
+        return None
+    finally:
+        if tmp is not None:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+
 def _load_locked():
     global _lib
     global _build_tried
+    global _stale
+    if _stale:
+        return None
     if _lib is None and not _LIB_PATH.exists():
         _try_build()
     if _lib is None and _LIB_PATH.exists():
         lib = ctypes.CDLL(str(_LIB_PATH))
         if not hasattr(lib, "expand_match_events"):
-            # stale .so from before the expansion kernels: rebuild once
+            # Stale .so from before the expansion kernels: rebuild once.
+            # glibc's dlopen caches handles by pathname, so re-CDLLing the
+            # same path after the rebuild would return the stale handle —
+            # load the rebuilt library through a fresh uniquely-named copy
+            # (unlinked immediately; the mapping survives on Linux).
             _build_tried = False
             _try_build()
-            lib = ctypes.CDLL(str(_LIB_PATH))
-            if not hasattr(lib, "expand_match_events"):
+            lib = _load_fresh_copy()
+            if lib is None or not hasattr(lib, "expand_match_events"):
+                # recovery failed: cache the negative result so the hot
+                # path never re-spawns make / re-dlopens per call
+                _stale = True
                 return None
         i64 = ctypes.c_int64
         u8p = np.ctypeslib.ndpointer(dtype=np.uint8, flags="C_CONTIGUOUS")
@@ -160,21 +197,30 @@ def _c64(a) -> np.ndarray:
     return np.ascontiguousarray(a, dtype=np.int64)
 
 
-def ragged_indices(starts, lens) -> np.ndarray:
-    """Native ragged-range index expansion (io.records.ragged_indices)."""
+def ragged_indices(starts, lens) -> np.ndarray | None:
+    """Native ragged-range index expansion (io.records.ragged_indices).
+    None on negative lengths or a short write (caller falls back to numpy,
+    which raises the clean ValueError for bad input)."""
     lens = _c64(lens)
+    if len(lens) and lens.min() < 0:
+        return None
     out = np.empty(int(lens.sum()), dtype=np.int64)
     n = _load().ragged_indices64(_c64(starts), lens, len(lens), out)
-    assert n == len(out)
+    if n != len(out):
+        return None
     return out
 
 
-def ragged_local_offsets(lens) -> np.ndarray:
-    """Native within-range offsets (io.records.ragged_local_offsets)."""
+def ragged_local_offsets(lens) -> np.ndarray | None:
+    """Native within-range offsets (io.records.ragged_local_offsets).
+    None on negative lengths or a short write (caller falls back)."""
     lens = _c64(lens)
+    if len(lens) and lens.min() < 0:
+        return None
     out = np.empty(int(lens.sum()), dtype=np.int64)
     n = _load().ragged_local64(lens, len(lens), out)
-    assert n == len(out)
+    if n != len(out):
+        return None
     return out
 
 
@@ -182,6 +228,8 @@ def parse_cigar(buf: np.ndarray, starts, n_ops):
     """Fused CIGAR word parse → (op uint8[], len int64[]); None on any
     out-of-bounds word (caller falls back to the numpy path)."""
     starts, n_ops = _c64(starts), _c64(n_ops)
+    if len(n_ops) and n_ops.min() < 0:
+        return None
     total = int(n_ops.sum())
     out_op = np.empty(total, dtype=np.uint8)
     out_len = np.empty(total, dtype=np.int64)
@@ -194,8 +242,11 @@ def parse_cigar(buf: np.ndarray, starts, n_ops):
 
 
 def unpack_seq(buf: np.ndarray, starts, l_seq, nt16: np.ndarray):
-    """Fused 4-bit SEQ decode → ASCII uint8[]; None on out-of-bounds."""
+    """Fused 4-bit SEQ decode → ASCII uint8[]; None on out-of-bounds or
+    negative lengths (reachable from untrusted BAM l_seq fields)."""
     starts, l_seq = _c64(starts), _c64(l_seq)
+    if len(l_seq) and l_seq.min() < 0:
+        return None
     total = int(l_seq.sum())
     out = np.empty(total, dtype=np.uint8)
     n = _load().unpack_seq(
@@ -213,6 +264,8 @@ def expand_match_events(r_start, q_abs, lens, rid, L, seq: np.ndarray,
     (rid int64[], pos int64[], base uint8[]); None on out-of-bounds."""
     r_start, q_abs, lens = _c64(r_start), _c64(q_abs), _c64(lens)
     rid, L = _c64(rid), _c64(L)
+    if len(lens) and lens.min() < 0:
+        return None
     cap = int(lens.sum())
     out_rid = np.empty(cap, dtype=np.int64)
     out_pos = np.empty(cap, dtype=np.int64)

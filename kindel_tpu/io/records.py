@@ -76,7 +76,9 @@ def ragged_indices(starts: np.ndarray, lens: np.ndarray) -> np.ndarray:
     from kindel_tpu.io import native
 
     if native.available():
-        return native.ragged_indices(starts, lens)
+        res = native.ragged_indices(starts, lens)
+        if res is not None:
+            return res
     # within-range offsets 0..len-1 for each range
     ends = np.cumsum(lens)
     flat = np.arange(total, dtype=np.int64)
@@ -94,7 +96,9 @@ def ragged_local_offsets(lens: np.ndarray) -> np.ndarray:
     from kindel_tpu.io import native
 
     if native.available():
-        return native.ragged_local_offsets(lens)
+        res = native.ragged_local_offsets(lens)
+        if res is not None:
+            return res
     ends = np.cumsum(lens)
     return np.arange(total, dtype=np.int64) - np.repeat(ends - lens, lens)
 

@@ -125,9 +125,14 @@ int64_t bgzf_decompressed_size(const uint8_t* data, int64_t len) {
 // byte-identical fallbacks and uses these only when the library loads.
 
 // Flat gather indices for ragged ranges [starts[i], starts[i]+lens[i]).
-// Mirrors kindel_tpu.io.records.ragged_indices. Returns elements written.
+// Mirrors kindel_tpu.io.records.ragged_indices. Returns elements written,
+// or -1 on any negative length (the caller allocates sum(lens); a negative
+// entry makes that smaller than the elements the positive entries write,
+// so writing anything would overrun the allocation).
 int64_t ragged_indices64(const int64_t* starts, const int64_t* lens,
                          int64_t n, int64_t* out) {
+    for (int64_t i = 0; i < n; ++i)
+        if (lens[i] < 0) return -1;
     int64_t k = 0;
     for (int64_t i = 0; i < n; ++i) {
         const int64_t s = starts[i], m = lens[i];
@@ -137,8 +142,11 @@ int64_t ragged_indices64(const int64_t* starts, const int64_t* lens,
 }
 
 // 0..len-1 offsets of each flattened element within its range.
-// Mirrors kindel_tpu.io.records.ragged_local_offsets.
+// Mirrors kindel_tpu.io.records.ragged_local_offsets. Returns -1 on any
+// negative length (same allocation contract as ragged_indices64).
 int64_t ragged_local64(const int64_t* lens, int64_t n, int64_t* out) {
+    for (int64_t i = 0; i < n; ++i)
+        if (lens[i] < 0) return -1;
     int64_t k = 0;
     for (int64_t i = 0; i < n; ++i) {
         const int64_t m = lens[i];
@@ -154,6 +162,11 @@ int64_t ragged_local64(const int64_t* lens, int64_t n, int64_t* out) {
 int64_t parse_cigar(const uint8_t* buf, int64_t buf_len,
                     const int64_t* starts, const int64_t* n_ops,
                     int64_t n_reads, uint8_t* out_op, int64_t* out_len) {
+    // whole-array pre-pass: out_op/out_len are sized by sum(n_ops), so with
+    // mixed signs the positive entries alone would overrun them before a
+    // per-iteration check ever saw the negative entry
+    for (int64_t r = 0; r < n_reads; ++r)
+        if (n_ops[r] < 0) return -1;
     int64_t k = 0;
     for (int64_t r = 0; r < n_reads; ++r) {
         int64_t off = starts[r];
@@ -176,6 +189,9 @@ int64_t parse_cigar(const uint8_t* buf, int64_t buf_len,
 int64_t unpack_seq(const uint8_t* buf, int64_t buf_len,
                    const int64_t* starts, const int64_t* l_seq,
                    int64_t n_reads, const uint8_t* nt16, uint8_t* out) {
+    // same allocation contract as parse_cigar: reject all-negative up front
+    for (int64_t r = 0; r < n_reads; ++r)
+        if (l_seq[r] < 0) return -1;
     int64_t k = 0;
     for (int64_t r = 0; r < n_reads; ++r) {
         const int64_t s = starts[r], m = l_seq[r];
@@ -201,6 +217,9 @@ int64_t expand_match_events(const int64_t* r_start, const int64_t* q_abs,
                             const uint8_t* seq, int64_t seq_len,
                             const uint8_t* base_code, int64_t* out_rid,
                             int64_t* out_pos, uint8_t* out_base) {
+    // out buffers are sized by sum(lens): reject negatives before writing
+    for (int64_t i = 0; i < n_ops; ++i)
+        if (lens[i] < 0) return -1;
     int64_t k = 0;
     for (int64_t i = 0; i < n_ops; ++i) {
         const int64_t m = lens[i], ln = L[i], rd = rid[i];

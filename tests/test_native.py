@@ -137,3 +137,54 @@ def test_expand_match_events_wrap_and_bounds(native):
         )
         is None
     )
+
+
+def test_negative_lengths_rejected(native):
+    """Mixed-sign lengths must never reach the C++ write loops: the Python
+    allocation is sum(lens) while positive entries alone would write more
+    (a heap overflow before this guard). Every ragged wrapper returns None
+    so callers fall back to numpy, which raises a clean ValueError."""
+    starts = np.array([0, 10], dtype=np.int64)
+    bad = np.array([5, -3], dtype=np.int64)
+    assert native.ragged_indices(starts, bad) is None
+    assert native.ragged_local_offsets(bad) is None
+
+    seq = np.frombuffer(b"ACGTACGT", dtype=np.uint8).copy()
+    from kindel_tpu.events import BASE_CODE
+
+    assert (
+        native.expand_match_events(
+            starts, starts, bad, np.zeros(2, np.int64),
+            np.full(2, 100, np.int64), seq, BASE_CODE,
+        )
+        is None
+    )
+    buf = np.zeros(64, dtype=np.uint8)
+    nt16 = np.frombuffer(b"=ACMGRSVTWYHKDBN", dtype=np.uint8).copy()
+    assert native.unpack_seq(buf, starts, bad, nt16) is None
+    assert native.parse_cigar(buf, starts, bad) is None
+
+
+def test_negative_l_seq_bam_record_clean_error(native, data_root):
+    """A BAM record carrying a negative l_seq (untrusted input; the field is
+    signed <i4) must raise a clean ValueError through the full decode, not
+    corrupt memory. Reproduces the advisor's segfault case."""
+    from kindel_tpu.io import bgzf as bz
+    from kindel_tpu.io.bam import parse_bam_bytes as py_parse
+    import struct
+
+    raw = (data_root / "data_minimap2" / "1.1.multi.bam").read_bytes()
+    data = bytearray(bz.decompress(raw))
+    # find the first record body offset: walk header exactly as the decoder
+    l_text = struct.unpack_from("<i", data, 4)[0]
+    off = 8 + l_text
+    n_ref = struct.unpack_from("<i", data, off)[0]
+    off += 4
+    for _ in range(n_ref):
+        l_name = struct.unpack_from("<i", data, off)[0]
+        off += 8 + l_name
+    body = off + 4  # past block_size
+    struct.pack_into("<i", data, body + 16, -7)  # l_seq := negative
+    for fn in (py_parse, native.parse_bam_bytes):
+        with pytest.raises(ValueError):
+            fn(bytes(data))
