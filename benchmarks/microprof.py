@@ -31,7 +31,8 @@ def main() -> None:
     from kindel_tpu.call import _insertion_calls, assemble
     from kindel_tpu.call_jax import (
         CallUnit,
-        decode_fast,
+        covered_index,
+        decode_compact,
         fused_call_kernel_packed,
         pack_kernel_args,
         unpack_wire,
@@ -39,6 +40,7 @@ def main() -> None:
     from kindel_tpu.events import extract_events
     from kindel_tpu.io import load_alignment
     from kindel_tpu.pileup import build_insertion_table
+    from kindel_tpu.call_jax import _compact_bucket
 
     print(f"device: {jax.devices()[0]}", flush=True)
 
@@ -48,14 +50,22 @@ def main() -> None:
 
     # warmup / compile
     u = CallUnit(ev, rid)
-    up, (o_pad, b_pad, d_pad, i_pad) = pack_kernel_args(u)
+    up, (o_pad, b_pad, nn_pad, d_pad, i_pad) = pack_kernel_args(u)
+    cov = covered_index(u.op_r_start, u.op_lens())
+    c_pad = _compact_bucket(len(cov))
     buf = jax.device_put(up)
     jax.block_until_ready(buf)
     out = fused_call_kernel_packed(
-        buf, o_pad=o_pad, b_pad=b_pad, d_pad=d_pad, i_pad=i_pad,
-        length=u.L, want_masks=False,
+        buf, o_pad=o_pad, b_pad=b_pad, nn_pad=nn_pad, d_pad=d_pad,
+        i_pad=i_pad,
+        length=u.L, want_masks=False, c_pad=c_pad,
     )
     jax.block_until_ready(out)
+    print(
+        f"wire: up={up.nbytes}B down={out.nbytes}B covered={len(cov)}"
+        f"/{u.L} (compact c_pad={c_pad})",
+        flush=True,
+    )
 
     for trial in range(3):
         t0 = time.perf_counter()
@@ -64,25 +74,30 @@ def main() -> None:
         ev = extract_events(batch)
         t2 = time.perf_counter()
         u = CallUnit(ev, rid)
+        cov = covered_index(u.op_r_start, u.op_lens())
+        c_pad = _compact_bucket(len(cov))
         t3 = time.perf_counter()
-        up, (o_pad, b_pad, d_pad, i_pad) = pack_kernel_args(u)
+        up, (o_pad, b_pad, nn_pad, d_pad, i_pad) = pack_kernel_args(u)
         buf = jax.device_put(up)  # ONE h2d transfer (round-3 packing)
         jax.block_until_ready(buf)
         t4 = time.perf_counter()
         out = fused_call_kernel_packed(
-            buf, o_pad=o_pad, b_pad=b_pad, d_pad=d_pad, i_pad=i_pad,
-            length=u.L, want_masks=False,
+            buf, o_pad=o_pad, b_pad=b_pad, nn_pad=nn_pad, d_pad=d_pad,
+        i_pad=i_pad,
+            length=u.L, want_masks=False, c_pad=c_pad,
         )
         jax.block_until_ready(out)
         t5 = time.perf_counter()
         # ONE packed buffer, one d2h transfer (round-3 wire packing)
         plane, parts, _dmin, _dmax = unpack_wire(
-            np.asarray(out), u.L, d_pad, i_pad, want_masks=False
+            np.asarray(out), u.L, d_pad, i_pad, want_masks=False,
+            c_pad=c_pad,
         )
         exc_bits, del_bits, ins_bits = parts
         t6 = time.perf_counter()
-        masks = decode_fast(
-            plane, exc_bits, del_bits, ins_bits, u.L, u.del_pos, u.ins_pos
+        masks = decode_compact(
+            plane, exc_bits, del_bits, ins_bits, u.L, cov, u.del_pos,
+            u.ins_pos,
         )
         # match the production path: resolve insertion strings when any emit
         ins_calls = (

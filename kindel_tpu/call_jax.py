@@ -81,6 +81,7 @@ def _call_core(
     want_masks: bool,
     valid_len=None,  # optional int32 scalar: row's true ref length
     keep_dense: bool = False,
+    c_pad: int | None = None,  # static: compact-covered wire width
 ):
     """Reconstruct match events, scatter counts, call every position.
 
@@ -94,7 +95,21 @@ def _call_core(
     base = jnp.stack(
         [base_packed >> 4, base_packed & 0xF], axis=1
     ).reshape(E_pad).astype(jnp.int32)
+    return _call_core_codes(
+        op_r_start, op_off, base, del_pos, ins_pos, ins_cnt, n_events,
+        min_depth, length, want_masks, valid_len, keep_dense, c_pad,
+    )
 
+
+def _call_core_codes(
+    op_r_start, op_off, base, del_pos, ins_pos, ins_cnt, n_events,
+    min_depth, length: int, want_masks: bool, valid_len=None,
+    keep_dense: bool = False, c_pad: int | None = None,
+):
+    """_call_core after base-code unpacking — entry point for upload
+    formats that decode their own codes (the 2-bit + sparse-N packed
+    wire below)."""
+    E_pad = base.shape[0]
     k = jnp.arange(E_pad, dtype=jnp.int32)
     # span-id per event via boundary scatter + prefix sum (a binary search
     # per event would cost ~log(spans) serialized gather rounds; the scan
@@ -118,7 +133,7 @@ def _call_core(
     )
     out = _decide(
         weights, deletions, ins_totals, del_pos, ins_pos, min_depth,
-        want_masks, valid_len,
+        want_masks, valid_len, c_pad=c_pad,
     )
     if keep_dense:
         return out + (weights, deletions)
@@ -126,7 +141,7 @@ def _call_core(
 
 
 def _decide(weights, deletions, ins_totals, del_pos, ins_pos, min_depth,
-            want_masks: bool, valid_len=None):
+            want_masks: bool, valid_len=None, c_pad: int | None = None):
     """Per-position call decisions + wire-format packing over count
     tensors — the second half of _call_core, shared with the streamed
     counts-input kernel (counts_call_kernel). del_pos/ins_pos feed the
@@ -174,6 +189,45 @@ def _decide(weights, deletions, ins_totals, del_pos, ins_pos, min_depth,
         )
         return emit_packed, masks_packed, dmin, dmax
 
+    exc = del_mask | n_mask | (base_code == N_CHANNELS)  # ties emit N too
+    plane = ((base_code - 1) & 3).astype(jnp.uint8)
+    del_flags = del_mask[jnp.where(del_pos < length, del_pos, 0)]
+    ins_flags = ins_mask[jnp.where(ins_pos < length, ins_pos, 0)]
+
+    if c_pad is not None:
+        # compact-covered wire: every uncovered position (zero match-event
+        # depth) emits either N (n_mask — depth < min_depth always holds
+        # there) or a deletion-skip (recovered host-side from the sparse
+        # del_pos + del_flags), so only *covered* positions carry
+        # information. The host knows the covered set exactly — it uploaded
+        # the match op spans — so the device compacts the 2-bit plane and
+        # the exception mask down to the covered slots (cumsum rank) and
+        # ships ~3C/8 bytes instead of ~3L/8. On low-coverage inputs (the
+        # bacterial bench is 0.28×) that is a ~4× cut of the largest wire
+        # segment; C == L degenerates gracefully to the dense cost.
+        # covered must be the FULL match-event footprint (incl. the N
+        # channel — acgt_depth alone would drop N-only positions and
+        # shift every later compact slot off the host's span union)
+        covered = weights.sum(axis=1) > 0
+        slot = jnp.cumsum(covered.astype(jnp.int32)) - 1
+        tgt = jnp.where(covered, slot, np.int32(c_pad))  # c_pad → dropped
+        comp = (
+            jnp.zeros(c_pad, jnp.uint8).at[tgt].set(plane, mode="drop")
+        )
+        exc_comp = (
+            jnp.zeros(c_pad, jnp.bool_).at[tgt].set(exc, mode="drop")
+        )
+        comp_packed = (
+            (comp[0::4] << 6) | (comp[1::4] << 4)
+            | (comp[2::4] << 2) | comp[3::4]
+        )
+        return (
+            comp_packed,
+            (jnp.packbits(exc_comp), del_flags, ins_flags),
+            dmin,
+            dmax,
+        )
+
     # fast path: minimal wire format. A dense 2-bit ACGT plane carries the
     # common case; positions that emit something other than their plane
     # base — deletion skips and Ns (incl. ties and min-depth) — are exactly
@@ -181,8 +235,6 @@ def _decide(weights, deletions, ins_totals, del_pos, ins_pos, min_depth,
     # the deletion flags gathered at the (sparse, already-known) del_pos.
     # Insertion emission likewise gathers at ins_pos. ~L/4 + L/8 bytes
     # shipped instead of L/2.
-    exc = del_mask | n_mask | (base_code == N_CHANNELS)  # ties emit N too
-    plane = ((base_code - 1) & 3).astype(jnp.uint8)
     pad4 = (-plane.shape[0]) % 4
     if pad4:
         plane = jnp.concatenate([plane, jnp.zeros(pad4, jnp.uint8)])
@@ -190,12 +242,9 @@ def _decide(weights, deletions, ins_totals, del_pos, ins_pos, min_depth,
         (plane[0::4] << 6) | (plane[1::4] << 4)
         | (plane[2::4] << 2) | plane[3::4]
     )
-    exc_bits = jnp.packbits(exc)
-    del_flags = del_mask[jnp.where(del_pos < length, del_pos, 0)]
-    ins_flags = ins_mask[jnp.where(ins_pos < length, ins_pos, 0)]
     return (
         plane_packed,
-        (exc_bits, del_flags, ins_flags),
+        (jnp.packbits(exc), del_flags, ins_flags),
         dmin,
         dmax,
     )
@@ -229,35 +278,61 @@ def _pack_wire(main, parts, dmin, dmax):
     return jnp.concatenate(segs)
 
 
+def unpack_base_codes(base_packed: np.ndarray, n_events: int) -> np.ndarray:
+    """Inverse of compress_match_events' 4-bit pairing: uint8 codes[E]."""
+    codes = np.empty(len(base_packed) * 2, dtype=np.uint8)
+    codes[0::2] = base_packed >> 4
+    codes[1::2] = base_packed & 0xF
+    return codes[:n_events]
+
+
 def pack_kernel_args(u: "CallUnit", min_depth: int = 1):
-    """Pad + pack one unit's six event arrays AND the two scalars into a
+    """Pad + pack one unit's event arrays AND the two scalars into a
     single uint8 upload buffer (one h2d round trip instead of eight).
+    Base codes ship as a 2-bit plane plus a sparse list of N-event
+    indices (code 4 is rare in real reads), halving the dominant upload
+    segment vs the 4-bit pairs the batched kernels use.
     Layout (little-endian int32 unless noted):
-    [op_r_start 4·O | op_off 4·O | base_packed B (uint8) |
-     del_pos 4·D | ins_pos 4·I | ins_cnt 4·I | n_events 4 | min_depth 4]
-    Returns (buf, (o_pad, b_pad, d_pad, i_pad)) — the pad geometry is
-    static (bucketed) and keys the kernel's compile cache exactly like
-    the unpacked path."""
+    [op_r_start 4·O | op_off 4·O | plane2 B (uint8, 4 codes/byte) |
+     n_idx 4·NN | del_pos 4·D | ins_pos 4·I | ins_cnt 4·I |
+     n_events 4 | min_depth 4]
+    Returns (buf, (o_pad, b_pad, nn_pad, d_pad, i_pad)) — the pad
+    geometry is static (bucketed) and keys the kernel's compile cache."""
+    codes = unpack_base_codes(u.base_packed, u.n_events)
+    n_idx = np.flatnonzero(codes == N_CHANNELS - 1).astype(np.int32)
+
     O_pad = _bucket(len(u.op_r_start), 256)
-    B_pad = _bucket(len(u.base_packed), 1024)
+    B_pad = _bucket(-(-len(codes) // 4), 512)
+    NN_pad = _bucket(len(n_idx), 64)
     D_pad = _bucket(len(u.del_pos), 256)
     I_pad = _bucket(len(u.ins_pos), 256)
+    plane2 = np.zeros(4 * B_pad, dtype=np.uint8)
+    plane2[: len(codes)] = codes & 3
+    plane2_packed = (
+        (plane2[0::4] << 6) | (plane2[1::4] << 4)
+        | (plane2[2::4] << 2) | plane2[3::4]
+    )
     segs = [
         _pad(u.op_r_start, O_pad, PAD_POS).view(np.uint8),
         _pad(u.op_off, O_pad, np.int32(u.n_events)).view(np.uint8),
-        # astype, not view: _pad of an EMPTY array defaults to int32
-        _pad(u.base_packed, B_pad, 0).astype(np.uint8, copy=False),
+        plane2_packed,
+        # pad sentinel 4·B_pad == len(base) on device → scatter-dropped
+        _pad(n_idx, NN_pad, np.int32(4 * B_pad)).view(np.uint8),
         _pad(u.del_pos, D_pad, PAD_POS).view(np.uint8),
         _pad(u.ins_pos, I_pad, PAD_POS).view(np.uint8),
         _pad(u.ins_cnt, I_pad, 0).view(np.uint8),
-        np.asarray([u.n_events, min_depth], np.int32).view(np.uint8),
+        np.asarray(
+            [u.n_events, min_depth, getattr(u, "valid_len", None) or u.L],
+            np.int32,
+        ).view(np.uint8),
     ]
-    return np.concatenate(segs), (O_pad, B_pad, D_pad, I_pad)
+    return np.concatenate(segs), (O_pad, B_pad, NN_pad, D_pad, I_pad)
 
 
-def _unpack_kernel_args(buf, o_pad: int, b_pad: int, d_pad: int,
-                        i_pad: int):
-    """Device-side inverse of pack_kernel_args (traced; bitcasts only)."""
+def _unpack_kernel_args(buf, o_pad: int, b_pad: int, nn_pad: int,
+                        d_pad: int, i_pad: int):
+    """Device-side inverse of pack_kernel_args (traced; bitcasts, a 2-bit
+    unpack, and one sparse N-restoration scatter)."""
 
     def i32(seg):
         return jax.lax.bitcast_convert_type(
@@ -265,43 +340,58 @@ def _unpack_kernel_args(buf, o_pad: int, b_pad: int, d_pad: int,
         )
 
     offs = np.cumsum(
-        [0, 4 * o_pad, 4 * o_pad, b_pad, 4 * d_pad, 4 * i_pad, 4 * i_pad]
+        [0, 4 * o_pad, 4 * o_pad, b_pad, 4 * nn_pad, 4 * d_pad,
+         4 * i_pad, 4 * i_pad]
     )
     op_r_start = i32(buf[offs[0]: offs[1]])
     op_off = i32(buf[offs[1]: offs[2]])
-    base_packed = buf[offs[2]: offs[3]]
-    del_pos = i32(buf[offs[3]: offs[4]])
-    ins_pos = i32(buf[offs[4]: offs[5]])
-    ins_cnt = i32(buf[offs[5]: offs[6]])
-    scalars = i32(buf[offs[6]: offs[6] + 8])
-    return (op_r_start, op_off, base_packed, del_pos, ins_pos, ins_cnt,
-            scalars[0], scalars[1])
+    plane2 = buf[offs[2]: offs[3]]
+    n_idx = i32(buf[offs[3]: offs[4]])
+    del_pos = i32(buf[offs[4]: offs[5]])
+    ins_pos = i32(buf[offs[5]: offs[6]])
+    ins_cnt = i32(buf[offs[6]: offs[7]])
+    scalars = i32(buf[offs[7]: offs[7] + 12])
+    base = jnp.stack(
+        [plane2 >> 6, (plane2 >> 4) & 3, (plane2 >> 2) & 3, plane2 & 3],
+        axis=1,
+    ).reshape(4 * b_pad).astype(jnp.int32)
+    base = base.at[n_idx].set(N_CHANNELS - 1, mode="drop")
+    return (op_r_start, op_off, base, del_pos, ins_pos, ins_cnt,
+            scalars[0], scalars[1], scalars[2])
 
 
 @partial(
     jax.jit,
-    static_argnames=("o_pad", "b_pad", "d_pad", "i_pad", "length",
-                     "want_masks"),
+    static_argnames=("o_pad", "b_pad", "nn_pad", "d_pad", "i_pad",
+                     "length", "want_masks", "c_pad"),
 )
-def fused_call_kernel_packed(buf, *, o_pad: int, b_pad: int, d_pad: int,
-                             i_pad: int, length: int, want_masks: bool):
+def fused_call_kernel_packed(buf, *, o_pad: int, b_pad: int, nn_pad: int,
+                             d_pad: int, i_pad: int, length: int,
+                             want_masks: bool, c_pad: int | None = None):
     """Single-buffer-in, single-buffer-out fused call: unpack the
-    uint8 upload (pack_kernel_args), run _call_core, pack the wire.
+    uint8 upload (pack_kernel_args), run the call core, pack the wire.
     Result layout — masks path:
     [emit ⌈L/2⌉ | del ⌈L/8⌉ | n ⌈L/8⌉ | ins ⌈L/8⌉ | dmin,dmax 8B];
     fast path:
     [plane ⌈L/4⌉ | exc ⌈L/8⌉ | del_flags ⌈D/8⌉ | ins_flags ⌈I/8⌉ | 8B]
-    with D/I the padded sparse-event widths (_wire_sizes is the single
-    source of truth for these offsets; unpack_wire decodes)."""
-    args = _unpack_kernel_args(buf, o_pad, b_pad, d_pad, i_pad)
-    main, parts, dmin, dmax = _call_core(
-        *args, length, want_masks,
+    with D/I the padded sparse-event widths; compact path (c_pad set,
+    the covered-position count bucketed):
+    [comp_plane C/4 | exc_cov C/8 | del_flags ⌈D/8⌉ | ins_flags ⌈I/8⌉ | 8B]
+    (_wire_sizes is the single source of truth for these offsets;
+    unpack_wire decodes)."""
+    (op_r_start, op_off, base, del_pos, ins_pos, ins_cnt, n_events,
+     min_depth, valid_len) = _unpack_kernel_args(
+        buf, o_pad, b_pad, nn_pad, d_pad, i_pad
+    )
+    main, parts, dmin, dmax = _call_core_codes(
+        op_r_start, op_off, base, del_pos, ins_pos, ins_cnt, n_events,
+        min_depth, length, want_masks, valid_len=valid_len, c_pad=c_pad,
     )
     return _pack_wire(main, parts, dmin, dmax)
 
 
 def _wire_sizes(length: int, d_pad: int, i_pad: int, want_masks: bool,
-                extra_bitmasks: int = 0):
+                extra_bitmasks: int = 0, c_pad: int | None = None):
     """Byte sizes of each packed-wire segment, in producer order — the
     single source of truth for every decoder. extra_bitmasks appends
     that many ⌈L/8⌉ segments (the batched realign kernel's two CDR
@@ -309,18 +399,20 @@ def _wire_sizes(length: int, d_pad: int, i_pad: int, want_masks: bool,
     l8 = -(-length // 8)
     if want_masks:
         sizes = [-(-length // 2), l8, l8, l8]
+    elif c_pad is not None:
+        sizes = [c_pad // 4, c_pad // 8, -(-d_pad // 8), -(-i_pad // 8)]
     else:
         sizes = [-(-length // 4), l8, -(-d_pad // 8), -(-i_pad // 8)]
     return sizes + [l8] * extra_bitmasks
 
 
 def unpack_wire(buf: np.ndarray, length: int, d_pad: int, i_pad: int,
-                want_masks: bool):
+                want_masks: bool, c_pad: int | None = None):
     """Split the packed wire buffer back into (main, parts, dmin, dmax).
     Bool flag segments come back bit-packed; decode_fast/masks_from_wire
     accept the packed forms via np.unpackbits below."""
     buf = np.asarray(buf)
-    sizes = _wire_sizes(length, d_pad, i_pad, want_masks)
+    sizes = _wire_sizes(length, d_pad, i_pad, want_masks, c_pad=c_pad)
     offs = np.cumsum([0] + sizes)
     segs = [buf[offs[i]: offs[i + 1]] for i in range(len(sizes))]
     dmin, dmax = unpack_depth_scalars(buf[offs[-1]: offs[-1] + 8])
@@ -442,6 +534,104 @@ def masks_from_wire(emit_packed, masks_packed, L: int):
     return emit, masks
 
 
+def _compact_bucket(n: int) -> int:
+    """Pad size for the compact wire's covered axis: power-of-two up to
+    256 Ki, then the next 256 Ki multiple — the wire ships ~3·c_pad/8
+    bytes, so pure power-of-two padding would waste up to ~50% of the
+    transfer on multi-megabase covered sets (compile-cache growth stays
+    bounded: one entry per 256 Ki step actually seen)."""
+    step = 1 << 18
+    if n <= step:
+        return _bucket(n)
+    return -(-n // step) * step
+
+
+def _use_compact_wire() -> bool:
+    """Compact the fast-path wire only when host↔device transfers cross a
+    real (possibly tunneled) wire. On the CPU backend fetching an array is
+    a memcpy, so paying device FLOPs to compact is pure loss there.
+    KINDEL_TPU_COMPACT_WIRE=1/0 overrides (tests pin the compact path on
+    the CPU suite; 0 provides an escape hatch on device)."""
+    import os
+
+    override = os.environ.get("KINDEL_TPU_COMPACT_WIRE")
+    if override is not None:
+        return override not in ("0", "")
+    return jax.default_backend() != "cpu"
+
+
+def covered_intervals(op_r_start: np.ndarray, op_lens: np.ndarray):
+    """Merged [start, end) intervals of the union of the match op spans —
+    the exact set of positions with match-event depth > 0, computed on
+    host from the same spans the kernel upload carries (so the device's
+    cumsum compaction rank and this order agree by construction)."""
+    keep = op_lens > 0
+    starts = op_r_start[keep].astype(np.int64)
+    ends = starts + op_lens[keep]
+    if len(starts) == 0:
+        return starts, ends
+    order = np.argsort(starts, kind="stable")
+    starts, ends = starts[order], ends[order]
+    run_max = np.maximum.accumulate(ends)
+    new = np.r_[True, starts[1:] > run_max[:-1]]
+    m_starts = starts[new]
+    # each merged run ends at the max end seen before the next run starts
+    idx = np.r_[np.flatnonzero(new)[1:] - 1, len(ends) - 1]
+    m_ends = run_max[idx]
+    return m_starts, m_ends
+
+
+def covered_index(op_r_start: np.ndarray, op_lens: np.ndarray) -> np.ndarray:
+    """Sorted positions with match coverage (flat expansion of
+    covered_intervals) — the host-side mapping from compact wire slots
+    back to reference positions."""
+    from kindel_tpu.io.records import ragged_indices
+
+    m_starts, m_ends = covered_intervals(op_r_start, op_lens)
+    return ragged_indices(m_starts, m_ends - m_starts)
+
+
+def decode_compact(comp_packed: np.ndarray, exc_bits: np.ndarray,
+                   del_flag_bits: np.ndarray, ins_flag_bits: np.ndarray,
+                   L: int, covered_idx: np.ndarray, del_pos: np.ndarray,
+                   ins_pos: np.ndarray) -> CallMasks:
+    """Rebuild assembler inputs from the compact-covered wire: uncovered
+    positions default to N; the compacted 2-bit plane fills covered
+    positions; the compacted exception mask flips covered ties /
+    deletion-dominant sites back to N; sparse del/ins flags as in
+    decode_fast."""
+    C = len(covered_idx)
+    comp_packed = np.asarray(comp_packed)
+    plane = np.empty(comp_packed.shape[0] * 4, dtype=np.uint8)
+    plane[0::4] = comp_packed >> 6
+    plane[1::4] = (comp_packed >> 4) & 3
+    plane[2::4] = (comp_packed >> 2) & 3
+    plane[3::4] = comp_packed & 3
+    base_char = np.full(L, EMIT_ASCII[N_CHANNELS], dtype=np.uint8)
+    base_char[covered_idx] = EMIT_ASCII[1:5][plane[:C]]
+    exc = np.unpackbits(np.asarray(exc_bits))[:C].astype(bool)
+    base_char[covered_idx[exc]] = EMIT_ASCII[N_CHANNELS]
+
+    del_flags = np.unpackbits(
+        np.asarray(del_flag_bits)
+    )[: len(del_pos)].astype(bool)
+    ins_flags = np.unpackbits(
+        np.asarray(ins_flag_bits)
+    )[: len(ins_pos)].astype(bool)
+    del_mask = np.zeros(L, dtype=bool)
+    if len(del_pos):
+        del_mask[del_pos[(del_pos < L) & del_flags]] = True
+    ins_mask = np.zeros(L, dtype=bool)
+    if len(ins_pos):
+        ins_mask[ins_pos[(ins_pos < L) & ins_flags]] = True
+    return CallMasks(
+        base_char=base_char,
+        del_mask=del_mask,
+        n_mask=np.zeros(L, dtype=bool),
+        ins_mask=ins_mask,
+    )
+
+
 def decode_fast(plane_packed: np.ndarray, exc_bits: np.ndarray,
                 del_flag_bits: np.ndarray, ins_flag_bits: np.ndarray,
                 L: int, del_pos: np.ndarray,
@@ -534,6 +724,13 @@ class CallUnit:
             self.ins_pos = np.asarray(ipos, np.int32)
             self.ins_cnt = np.asarray(icnt, np.int32)
 
+    def op_lens(self) -> np.ndarray:
+        """Per-span event counts (the ragged structure of op_r_start):
+        consecutive op_off diffs, closed by n_events."""
+        if len(self.op_off) == 0:
+            return np.empty(0, dtype=np.int64)
+        return np.diff(np.r_[self.op_off.astype(np.int64), self.n_events])
+
 
 def device_call(ev: EventSet, rid: int, min_depth: int = 1,
                 want_masks: bool = True):
@@ -545,13 +742,21 @@ def device_call(ev: EventSet, rid: int, min_depth: int = 1,
     is rebuilt from the 2-bit wire format (see decode_fast)."""
     u = CallUnit(ev, rid)
     L, ip = u.L, u.ins_pos
-    up, (o_pad, b_pad, d_pad, i_pad) = pack_kernel_args(u, min_depth)
+    up, (o_pad, b_pad, nn_pad, d_pad, i_pad) = pack_kernel_args(
+        u, min_depth
+    )
+    c_pad = None
+    covered_idx = None
+    if not want_masks and _use_compact_wire():
+        covered_idx = covered_index(u.op_r_start, u.op_lens())
+        c_pad = _compact_bucket(len(covered_idx))
     buf = fused_call_kernel_packed(
-        jnp.asarray(up), o_pad=o_pad, b_pad=b_pad, d_pad=d_pad,
-        i_pad=i_pad, length=L, want_masks=want_masks,
+        jnp.asarray(up), o_pad=o_pad, b_pad=b_pad, nn_pad=nn_pad,
+        d_pad=d_pad, i_pad=i_pad, length=L, want_masks=want_masks,
+        c_pad=c_pad,
     )
     main_out, parts, dmin, dmax = unpack_wire(
-        buf, L, d_pad, i_pad, want_masks
+        buf, L, d_pad, i_pad, want_masks, c_pad=c_pad
     )
 
     if want_masks:
@@ -559,9 +764,15 @@ def device_call(ev: EventSet, rid: int, min_depth: int = 1,
         return emit, masks, dmin, dmax
 
     exc_bits, del_bits, ins_bits = parts
-    masks = decode_fast(
-        main_out, exc_bits, del_bits, ins_bits, L, u.del_pos, ip,
-    )
+    if covered_idx is not None:
+        masks = decode_compact(
+            main_out, exc_bits, del_bits, ins_bits, L, covered_idx,
+            u.del_pos, ip,
+        )
+    else:
+        masks = decode_fast(
+            main_out, exc_bits, del_bits, ins_bits, L, u.del_pos, ip,
+        )
     return None, masks, dmin, dmax
 
 
@@ -582,7 +793,25 @@ def call_consensus_fused(
     per-reference report without any count-tensor download. When the caller
     does not need per-position change markers, neither emission codes nor
     dense decision masks are shipped — the sequence reconstructs from the
-    2-bit plane + exception bitmask wire format (decode_fast)."""
+    2-bit plane + exception bitmask wire format (decode_fast).
+
+    KINDEL_TPU_SLABS=N (N>1) routes this through the slab-pipelined path
+    (kindel_tpu.pipeline) to overlap wire+decode with device compute on
+    tunneled accelerators; output is byte-identical."""
+    if not build_changes:
+        import os
+
+        n_slabs = int(os.environ.get("KINDEL_TPU_SLABS", "1"))
+        # tiny contigs: slabbing buys nothing below ~64k positions a slab
+        n_slabs = max(1, min(n_slabs, int(ev.ref_lens[rid]) // 65536))
+        if n_slabs > 1:
+            from kindel_tpu.pipeline import pipelined_consensus
+
+            return pipelined_consensus(
+                ev, rid, n_slabs, pileup=pileup, cdr_patches=cdr_patches,
+                trim_ends=trim_ends, min_depth=min_depth,
+                uppercase=uppercase,
+            )
     _emit, masks, dmin, dmax = device_call(
         ev, rid, min_depth, want_masks=build_changes
     )

@@ -1,0 +1,187 @@
+"""Slab-pipelined single-device consensus — overlap the d2h wire and the
+host decode with device compute.
+
+On a tunneled accelerator the fused call's wall time is dominated by
+serial [dispatch → compute → download → host decode] latency, not by
+FLOPs (BASELINE.md per-phase: device compute ~0.20 s vs download ~0.32 s
+for 6.1 Mb). This module splits the position axis into S contiguous
+slabs, dispatches every slab's fused kernel asynchronously (JAX dispatch
+is non-blocking), queues each result's d2h copy immediately
+(`copy_to_host_async`), and then decodes slab k on host while slabs
+k+1.. are still computing/transferring. The device pipeline and the
+host decode run concurrently; wall time approaches
+max(device total, host total) + one slab of latency.
+
+Each slab kernel sees [s0, s0+SL+1) — one halo position past the slab so
+`depth_next` (the insertion-emission denominator,
+/root/reference/kindel/kindel.py:414-417) is exact at the slab edge; the
+halo column's outputs are dropped on host. Depth-report scalars are
+masked to the slab's true window (valid_len) and min/max-combined on
+host. Byte-identity with the single-kernel path is pinned by
+tests/test_jax_backend.py::test_slab_pipeline_matches_single.
+
+This is the single-device analogue of the position-sharded product path
+(kindel_tpu/parallel/product.py): same axis, but sliced in *time* for
+wire/host overlap instead of in *space* across a mesh.
+"""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+
+import numpy as np
+
+from kindel_tpu.call import CallMasks, CallResult, _insertion_calls, assemble
+from kindel_tpu.call_jax import (
+    CallUnit,
+    EMIT_ASCII,
+    _compact_bucket,
+    _use_compact_wire,
+    covered_index,
+    decode_compact,
+    decode_fast,
+    fused_call_kernel_packed,
+    pack_kernel_args,
+    unpack_base_codes,
+    unpack_wire,
+)
+from kindel_tpu.events import EventSet, N_CHANNELS
+from kindel_tpu.pileup import build_insertion_table
+
+
+def _slab_views(u: CallUnit, n_slabs: int):
+    """Partition one CallUnit's event tensors into n_slabs position
+    windows [s0, s1) with a one-position halo on the kernel inputs.
+    Spans crossing a boundary are clipped into both sides; base codes are
+    gathered per slab (events are span-contiguous, so this is one ragged
+    gather per slab)."""
+    from kindel_tpu.io.records import ragged_indices
+
+    SL = -(-u.L // n_slabs)
+    starts = u.op_r_start.astype(np.int64)
+    lens = u.op_lens()
+    ends = starts + lens
+    # unpack the unit's 4-bit pairs once; slabs re-pack their slices
+    codes = unpack_base_codes(u.base_packed, u.n_events)
+    op_off64 = u.op_off.astype(np.int64)
+
+    slabs = []
+    for s in range(n_slabs):
+        s0 = s * SL
+        s1 = min(s0 + SL, u.L)
+        hi = s0 + SL + 1  # halo: one position past the slab window
+        sel = (starts < hi) & (ends > s0)
+        cs = np.maximum(starts[sel], s0)
+        ce = np.minimum(ends[sel], hi)
+        ev_start = op_off64[sel] + (cs - starts[sel])
+        ev_len = ce - cs
+        local_codes = codes[ragged_indices(ev_start, ev_len)]
+        if len(local_codes) % 2:
+            local_codes = np.r_[local_codes, np.uint8(0)]
+        packed = (local_codes[0::2] << 4) | local_codes[1::2]
+        op_off_local = np.r_[
+            np.int64(0), np.cumsum(ev_len)[:-1]
+        ].astype(np.int32) if len(ev_len) else np.empty(0, np.int32)
+
+        dsel = (u.del_pos >= s0) & (u.del_pos < s1)
+        isel = (u.ins_pos >= s0) & (u.ins_pos < s1)
+        slabs.append(
+            SimpleNamespace(
+                s0=s0,
+                s1=s1,
+                L=SL + 1,
+                valid_len=s1 - s0,
+                op_r_start=(cs - s0).astype(np.int32),
+                op_off=op_off_local,
+                op_lens_arr=ev_len,
+                base_packed=packed,
+                n_events=int(ev_len.sum()),
+                del_pos=(u.del_pos[dsel] - s0).astype(np.int32),
+                ins_pos=(u.ins_pos[isel] - s0).astype(np.int32),
+                ins_cnt=u.ins_cnt[isel],
+            )
+        )
+    return slabs
+
+
+def pipelined_consensus(
+    ev: EventSet,
+    rid: int,
+    n_slabs: int,
+    pileup=None,
+    cdr_patches=None,
+    trim_ends: bool = False,
+    min_depth: int = 1,
+    uppercase: bool = False,
+):
+    """Slab-pipelined equivalent of call_consensus_fused(...,
+    build_changes=False). Returns (CallResult, depth_min, depth_max)."""
+    import jax.numpy as jnp
+
+    u = CallUnit(ev, rid)
+    assert n_slabs > 1, "caller clamps (call_consensus_fused routes n==1)"
+    slabs = _slab_views(u, n_slabs)
+
+    # dispatch every slab asynchronously, then queue its d2h copy
+    compact = _use_compact_wire()
+    inflight = []
+    for sl in slabs:
+        up, (o_pad, b_pad, nn_pad, d_pad, i_pad) = pack_kernel_args(
+            sl, min_depth
+        )
+        cov = c_pad = None
+        if compact:
+            cov = covered_index(sl.op_r_start, sl.op_lens_arr)
+            c_pad = _compact_bucket(len(cov))
+        wire = fused_call_kernel_packed(
+            jnp.asarray(up), o_pad=o_pad, b_pad=b_pad, nn_pad=nn_pad,
+            d_pad=d_pad, i_pad=i_pad, length=sl.L, want_masks=False,
+            c_pad=c_pad,
+        )
+        try:
+            wire.copy_to_host_async()
+        except AttributeError:
+            pass  # CPU arrays in some jax versions
+        inflight.append((sl, cov, c_pad, d_pad, i_pad, wire))
+
+    # decode slab k (shared wire decoders) while slabs k+1.. compute /
+    # transfer; each slab's [0, valid_len) window is spliced into the
+    # global masks, which drops the halo column
+    base_char = np.full(u.L, EMIT_ASCII[N_CHANNELS], dtype=np.uint8)
+    del_mask = np.zeros(u.L, dtype=bool)
+    ins_mask = np.zeros(u.L, dtype=bool)
+    dmin, dmax = 2**31 - 1, -1
+    for sl, cov, c_pad, d_pad, i_pad, wire in inflight:
+        main, parts, s_dmin, s_dmax = unpack_wire(
+            np.asarray(wire), sl.L, d_pad, i_pad, want_masks=False,
+            c_pad=c_pad,
+        )
+        if cov is not None:
+            m = decode_compact(
+                main, *parts, sl.L, cov, sl.del_pos, sl.ins_pos
+            )
+        else:
+            m = decode_fast(
+                main, *parts, sl.L, sl.del_pos, sl.ins_pos
+            )
+        v = sl.valid_len
+        base_char[sl.s0: sl.s0 + v] = m.base_char[:v]
+        del_mask[sl.s0: sl.s0 + v] = m.del_mask[:v]
+        ins_mask[sl.s0: sl.s0 + v] = m.ins_mask[:v]
+        dmin, dmax = min(dmin, s_dmin), max(dmax, s_dmax)
+
+    masks = CallMasks(
+        base_char=base_char,
+        del_mask=del_mask,
+        n_mask=np.zeros(u.L, dtype=bool),
+        ins_mask=ins_mask,
+    )
+    ins_calls = {}
+    if masks.ins_mask.any():
+        tab = pileup.ins if pileup is not None else build_insertion_table(ev, rid)
+        ins_calls = _insertion_calls(tab)
+    res = assemble(
+        masks, ins_calls, cdr_patches, trim_ends, min_depth, uppercase,
+        build_changes=False,
+    )
+    return res, dmin, dmax

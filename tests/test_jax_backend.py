@@ -49,11 +49,14 @@ def test_fused_call_equivalence(bwa_events):
     assert dmax == int(pileup.acgt_depth.max())
 
 
-def test_emit_only_fast_path(bwa_events):
+@pytest.mark.parametrize("compact", ["1", "0"])
+def test_emit_only_fast_path(bwa_events, compact, monkeypatch):
     """build_changes=False skips the dense mask download; sequence must be
-    identical to the full-masks path."""
+    identical to the full-masks path — in both fast wire formats (the
+    compact-covered wire degenerates to C≈L on this full-coverage BAM)."""
     from kindel_tpu.call_jax import call_consensus_fused
 
+    monkeypatch.setenv("KINDEL_TPU_COMPACT_WIRE", compact)
     rid = bwa_events.present_ref_ids[0]
     full, _, _ = call_consensus_fused(bwa_events, rid, build_changes=True)
     fast, _, _ = call_consensus_fused(bwa_events, rid, build_changes=False)
@@ -273,3 +276,139 @@ def test_fused_batch_groups_footprint():
     ev2 = SimpleNamespace(ref_lens=[MAX_PAD_SAFE_BLOCK + 10, 1000, 2000])
     groups2 = w._fused_batch_groups(ev2, [0, 1, 2])
     assert [0] in groups2
+
+
+def _sam(ref_len, reads):
+    lines = [b"@HD\tVN:1.6", f"@SQ\tSN:ref1\tLN:{ref_len}".encode()]
+    for i, (pos1, cigar, seq) in enumerate(reads):
+        lines.append(
+            f"r{i}\t0\tref1\t{pos1}\t60\t{cigar}\t*\t0\t0\t{seq}\t*".encode()
+        )
+    return b"\n".join(lines) + b"\n"
+
+
+@pytest.mark.parametrize("compact", ["1", "0"])
+@pytest.mark.parametrize("min_depth", [1, 2])
+def test_compact_wire_low_coverage_edges(min_depth, compact, monkeypatch):
+    """The compact-covered wire (device_call fast path) on a sparse layout
+    exercising every branch the compaction must preserve: uncovered gaps
+    (→ N), a deletion whose span has zero match depth (→ skip, recovered
+    from sparse del flags), a tie (→ N among covered), a depth-1 site
+    under min_depth=2 (→ N among covered), and an insertion."""
+    from kindel_tpu.call import call_consensus
+    from kindel_tpu.io.sam import parse_sam_bytes
+    from kindel_tpu.call_jax import call_consensus_fused
+    from kindel_tpu.pileup import build_pileups
+
+    monkeypatch.setenv("KINDEL_TPU_COMPACT_WIRE", compact)
+    reads = [
+        (11, "6M", "ACGTAC"),          # island 1: covered 10..16
+        (11, "6M", "ACGTAC"),          # depth 2 on island 1
+        (31, "3M4D3M", "GGGTTT"),      # island 2 with an internal del span
+        (51, "2M", "AA"),              # island 3: depth 1 (N under md=2)
+        (61, "2M", "CC"),              # tie partner 1
+        (61, "2M", "GG"),              # tie partner 2 → N,N
+        (13, "2M2I2M", "GTACTA"),      # insertion inside island 1
+    ]
+    ev = extract_events(parse_sam_bytes(_sam(100, reads)))
+    pileup = next(iter(build_pileups(ev).values()))
+    rid = ev.present_ref_ids[0]
+    np_res = call_consensus(
+        pileup, min_depth=min_depth, build_changes=False
+    )
+    jx_res, dmin, dmax = call_consensus_fused(
+        ev, rid, pileup=pileup, min_depth=min_depth, build_changes=False
+    )
+    assert np_res.sequence == jx_res.sequence
+    assert dmin == int(pileup.acgt_depth.min())
+    assert dmax == int(pileup.acgt_depth.max())
+    # non-vacuity: the layout really has gaps, a del island, and a tie
+    assert "NNN" in np_res.sequence
+
+
+def test_covered_intervals_merge():
+    from kindel_tpu.call_jax import covered_index, covered_intervals
+
+    # overlapping, contained, adjacent, and disjoint spans in scrambled order
+    starts = np.array([20, 0, 3, 8, 40, 5], dtype=np.int64)
+    lens = np.array([5, 5, 4, 2, 1, 5], dtype=np.int64)
+    m_starts, m_ends = covered_intervals(starts, lens)
+    expect = np.zeros(64, dtype=bool)
+    for s, n in zip(starts, lens):
+        expect[s : s + n] = True
+    got = np.zeros(64, dtype=bool)
+    for s, e in zip(m_starts, m_ends):
+        assert e > s
+        got[s:e] = True
+    np.testing.assert_array_equal(got, expect)
+    np.testing.assert_array_equal(covered_index(starts, lens), np.flatnonzero(expect))
+    # empty and zero-length spans
+    z_starts, z_ends = covered_intervals(
+        np.array([7], dtype=np.int64), np.array([0], dtype=np.int64)
+    )
+    assert len(z_starts) == 0 and len(z_ends) == 0
+
+
+def test_slab_pipeline_matches_single(data_root, monkeypatch):
+    """The slab-pipelined path (KINDEL_TPU_SLABS) must be byte-identical
+    to the single-kernel fused path on the bacterial-scale BAM — slab
+    boundaries, the depth_next halo, per-slab del/ins flag remapping, and
+    the depth-scalar combine all pinned. Uses the real 6.1 Mb BAM so
+    slabs are non-trivial (>64k positions each)."""
+    from kindel_tpu.call_jax import call_consensus_fused
+    from kindel_tpu.pileup import build_pileups
+
+    bam = data_root / "data_minimap2_bact" / "bact.tiny.bam"
+    ev = extract_events(load_alignment(bam))
+    rid = ev.present_ref_ids[0]
+    pileup = next(iter(build_pileups(ev).values()))
+
+    monkeypatch.setenv("KINDEL_TPU_COMPACT_WIRE", "1")
+    single, dmin1, dmax1 = call_consensus_fused(
+        ev, rid, build_changes=False
+    )
+    # pin the compact path against the numpy oracle on real data with
+    # N-carrying reads (N-only-covered positions shift compact slots if
+    # the device covered-set definition drifts from the host span union)
+    from kindel_tpu.call import call_consensus
+
+    oracle = call_consensus(pileup, build_changes=False)
+    assert single.sequence == oracle.sequence
+    for n in (2, 5, 8):
+        monkeypatch.setenv("KINDEL_TPU_SLABS", str(n))
+        piped, dmin2, dmax2 = call_consensus_fused(
+            ev, rid, build_changes=False
+        )
+        assert piped.sequence == single.sequence, f"n_slabs={n}"
+        assert (dmin2, dmax2) == (dmin1, dmax1), f"n_slabs={n}"
+    assert dmin1 == int(pileup.acgt_depth.min())
+    assert dmax1 == int(pileup.acgt_depth.max())
+
+
+@pytest.mark.parametrize("compact", ["1", "0"])
+def test_slab_pipeline_synthetic_edges(monkeypatch, compact):
+    """Slab pipeline on a synthetic layout where events straddle the
+    exact slab boundary: spans crossing, a deletion at the boundary, and
+    an insertion whose depth_next denominator crosses into the next
+    slab. L=131072*2 so two 64k+ slabs are allowed."""
+    from kindel_tpu.call_jax import call_consensus_fused
+    from kindel_tpu.io.sam import parse_sam_bytes
+
+    L = 262144
+    B = 131072  # slab boundary with n_slabs=2
+    reads = [
+        (B - 2, "8M", "ACGTACGT"),          # straddles the boundary
+        (B - 2, "8M", "ACGTACGT"),
+        (B - 3, "3M2D3M", "TTTGGG"),        # deletion spanning boundary
+        (B, "2M2I2M", "CCAATT"),            # insertion right at boundary
+        (B - 1, "2M", "TA"),                # depth_next across boundary
+        (100, "4M", "GGGG"),                # far-away island in slab 0
+    ]
+    monkeypatch.setenv("KINDEL_TPU_COMPACT_WIRE", compact)
+    ev = extract_events(parse_sam_bytes(_sam(L, reads)))
+    rid = ev.present_ref_ids[0]
+    single, d1, x1 = call_consensus_fused(ev, rid, build_changes=False)
+    monkeypatch.setenv("KINDEL_TPU_SLABS", "2")
+    piped, d2, x2 = call_consensus_fused(ev, rid, build_changes=False)
+    assert piped.sequence == single.sequence
+    assert (d1, x1) == (d2, x2)
