@@ -116,6 +116,51 @@ def main() -> None:
             flush=True,
         )
 
+    # --- dispatch-latency probe: how much of "compute" is per-dispatch
+    # relay/PJRT overhead rather than XLA program time? A trivial kernel's
+    # round trip is almost pure overhead; the fused kernel's true device
+    # time is roughly compute_phase - this.
+    import jax.numpy as jnp
+
+    @jax.jit
+    def _tiny(x):
+        return x * 2 + 1
+
+    t = jnp.ones(128, jnp.int32)
+    jax.block_until_ready(_tiny(t))  # compile
+    lat = []
+    for _ in range(5):
+        a = time.perf_counter()
+        jax.block_until_ready(_tiny(t))
+        lat.append(time.perf_counter() - a)
+    lat.sort()
+    print(
+        f"dispatch-latency: median={lat[2]*1e3:.1f}ms "
+        f"min={lat[0]*1e3:.1f}ms max={lat[-1]*1e3:.1f}ms", flush=True,
+    )
+
+    # --- slab pipeline A/B (KINDEL_TPU_SLABS): consensus-call wall only
+    # (decode/extract are config-independent). The watcher banks this log
+    # from TPU sessions; the best config becomes the device default.
+    import os
+
+    from kindel_tpu.call_jax import call_consensus_fused
+
+    for n in (1, 2, 4, 8):
+        os.environ["KINDEL_TPU_SLABS"] = str(n)
+        walls = []
+        for _ in range(3):
+            a = time.perf_counter()
+            res, _dm, _dx = call_consensus_fused(ev, rid, build_changes=False)
+            walls.append(time.perf_counter() - a)
+        walls.sort()
+        print(
+            f"slabs={n}: call-wall median={walls[1]:.3f}s "
+            f"min={walls[0]:.3f}s (3 trials, first includes compile)",
+            flush=True,
+        )
+    os.environ.pop("KINDEL_TPU_SLABS", None)
+
 
 if __name__ == "__main__":
     main()

@@ -298,7 +298,9 @@ def pack_kernel_args(u: "CallUnit", min_depth: int = 1):
      n_events 4 | min_depth 4]
     Returns (buf, (o_pad, b_pad, nn_pad, d_pad, i_pad)) — the pad
     geometry is static (bucketed) and keys the kernel's compile cache."""
-    codes = unpack_base_codes(u.base_packed, u.n_events)
+    codes = getattr(u, "base_codes", None)
+    if codes is None:
+        codes = unpack_base_codes(u.base_packed, u.n_events)
     n_idx = np.flatnonzero(codes == N_CHANNELS - 1).astype(np.int32)
 
     O_pad = _bucket(len(u.op_r_start), 256)
@@ -322,7 +324,8 @@ def pack_kernel_args(u: "CallUnit", min_depth: int = 1):
         _pad(u.ins_pos, I_pad, PAD_POS).view(np.uint8),
         _pad(u.ins_cnt, I_pad, 0).view(np.uint8),
         np.asarray(
-            [u.n_events, min_depth, getattr(u, "valid_len", None) or u.L],
+            [u.n_events, min_depth,
+             u.L if getattr(u, "valid_len", None) is None else u.valid_len],
             np.int32,
         ).view(np.uint8),
     ]
@@ -795,13 +798,17 @@ def call_consensus_fused(
     dense decision masks are shipped — the sequence reconstructs from the
     2-bit plane + exception bitmask wire format (decode_fast).
 
-    KINDEL_TPU_SLABS=N (N>1) routes this through the slab-pipelined path
-    (kindel_tpu.pipeline) to overlap wire+decode with device compute on
-    tunneled accelerators; output is byte-identical."""
+    The no-changes path runs slab-pipelined by default (KINDEL_TPU_SLABS,
+    default 4, clamped for small contigs; =1 forces the single fused
+    kernel) — kindel_tpu.pipeline overlaps wire+decode with device
+    compute; output is byte-identical either way."""
     if not build_changes:
         import os
 
-        n_slabs = int(os.environ.get("KINDEL_TPU_SLABS", "1"))
+        # default 4: measured better than single-kernel even on CPU
+        # (cache locality, benchmarks/microprof.py A/B) and overlaps the
+        # wire with compute on tunneled devices
+        n_slabs = int(os.environ.get("KINDEL_TPU_SLABS", "4"))
         # tiny contigs: slabbing buys nothing below ~64k positions a slab
         n_slabs = max(1, min(n_slabs, int(ev.ref_lens[rid]) // 65536))
         if n_slabs > 1:

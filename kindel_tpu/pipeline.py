@@ -76,9 +76,6 @@ def _slab_views(u: CallUnit, n_slabs: int):
         ev_start = op_off64[sel] + (cs - starts[sel])
         ev_len = ce - cs
         local_codes = codes[ragged_indices(ev_start, ev_len)]
-        if len(local_codes) % 2:
-            local_codes = np.r_[local_codes, np.uint8(0)]
-        packed = (local_codes[0::2] << 4) | local_codes[1::2]
         op_off_local = np.r_[
             np.int64(0), np.cumsum(ev_len)[:-1]
         ].astype(np.int32) if len(ev_len) else np.empty(0, np.int32)
@@ -94,7 +91,9 @@ def _slab_views(u: CallUnit, n_slabs: int):
                 op_r_start=(cs - s0).astype(np.int32),
                 op_off=op_off_local,
                 op_lens_arr=ev_len,
-                base_packed=packed,
+                # raw uint8 codes, consumed directly by pack_kernel_args
+                # (no 4-bit re-pack/unpack round trip per slab)
+                base_codes=local_codes,
                 n_events=int(ev_len.sum()),
                 del_pos=(u.del_pos[dsel] - s0).astype(np.int32),
                 ins_pos=(u.ins_pos[isel] - s0).astype(np.int32),

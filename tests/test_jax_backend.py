@@ -364,6 +364,7 @@ def test_slab_pipeline_matches_single(data_root, monkeypatch):
     pileup = next(iter(build_pileups(ev).values()))
 
     monkeypatch.setenv("KINDEL_TPU_COMPACT_WIRE", "1")
+    monkeypatch.setenv("KINDEL_TPU_SLABS", "1")  # true single-kernel anchor
     single, dmin1, dmax1 = call_consensus_fused(
         ev, rid, build_changes=False
     )
@@ -405,6 +406,7 @@ def test_slab_pipeline_synthetic_edges(monkeypatch, compact):
         (100, "4M", "GGGG"),                # far-away island in slab 0
     ]
     monkeypatch.setenv("KINDEL_TPU_COMPACT_WIRE", compact)
+    monkeypatch.setenv("KINDEL_TPU_SLABS", "1")  # single-kernel baseline
     ev = extract_events(parse_sam_bytes(_sam(L, reads)))
     rid = ev.present_ref_ids[0]
     single, d1, x1 = call_consensus_fused(ev, rid, build_changes=False)
