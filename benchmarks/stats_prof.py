@@ -1,0 +1,67 @@
+"""Bytes-over-wire accounting for the stats workloads (VERDICT r4 item 3).
+
+Runs `weights --backend jax` (the heaviest stats table) twice on the same
+BAM — compact nonzero-rows u16 wire vs dense int32 download — and prints
+each run's measured d2h bytes (kindel_tpu.utils.wirestats) and wall time,
+plus the parity check. On the tunneled TPU the byte ratio is the expected
+end-to-end win; on CPU the bytes still prove the wire contract.
+
+Usage: python benchmarks/stats_prof.py [bam_path]
+"""
+
+import os
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def main() -> None:
+    bam = Path(
+        sys.argv[1]
+        if len(sys.argv) > 1
+        else "/root/reference/tests/data_minimap2_bact/bact.tiny.bam"
+    )
+    import jax
+
+    from kindel_tpu import workloads
+    from kindel_tpu.utils import wirestats
+
+    print(f"device: {jax.devices()[0]}  bam: {bam.name}", flush=True)
+
+    # untimed warm-up so the first timed mode doesn't absorb the shared
+    # scatter-kernel jit compiles (byte counters are reset afterwards)
+    workloads.weights(bam, backend="jax")
+
+    outputs = {}
+    for mode in ("dense", "compact"):
+        if mode == "compact":
+            os.environ["KINDEL_TPU_COMPACT_STATS"] = "1"  # even on CPU
+            os.environ.pop("KINDEL_TPU_DENSE_STATS", None)
+        else:
+            os.environ["KINDEL_TPU_DENSE_STATS"] = "1"
+            os.environ.pop("KINDEL_TPU_COMPACT_STATS", None)
+        wirestats.reset()
+        t0 = time.perf_counter()
+        df = workloads.weights(bam, backend="jax")
+        wall = time.perf_counter() - t0
+        snap = wirestats.snapshot()
+        outputs[mode] = df
+        print(
+            f"{mode}: d2h={snap['d2h_bytes']/1e6:.2f} MB in "
+            f"{snap['d2h_fetches']} fetches, wall={wall:.2f}s, "
+            f"rows={len(df)}",
+            flush=True,
+        )
+    os.environ.pop("KINDEL_TPU_DENSE_STATS", None)
+    os.environ.pop("KINDEL_TPU_COMPACT_STATS", None)
+    same = outputs["dense"].equals(outputs["compact"])
+    print(f"parity: {'identical' if same else 'DIVERGED'}", flush=True)
+    if not same:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -62,7 +62,8 @@ class _RefState:
 
     __slots__ = ("L", "w", "csw", "cew", "cs", "ce", "d")
 
-    def __init__(self, L: int, device: bool, full: bool):
+    def __init__(self, L: int, device: bool, full: bool,
+                 clip_weights: bool = True):
         self.L = L
         if device and L * N_CHANNELS > _MAX_FLAT:
             raise ValueError(
@@ -81,8 +82,8 @@ class _RefState:
         self.d = zeros(L + 1)
         # clip channels only materialize when realign / full pileups need
         # them — the plain consensus path never touches them
-        self.csw = zeros(L * N_CHANNELS) if full else None
-        self.cew = zeros(L * N_CHANNELS) if full else None
+        self.csw = zeros(L * N_CHANNELS) if full and clip_weights else None
+        self.cew = zeros(L * N_CHANNELS) if full and clip_weights else None
         self.cs = zeros(L + 1) if full else None
         self.ce = zeros(L + 1) if full else None
 
@@ -146,10 +147,12 @@ class StreamAccumulatorBase:
 class StreamAccumulator(StreamAccumulatorBase):
     """Order-independent additive reduction over streamed ReadBatches."""
 
-    def __init__(self, backend: str = "numpy", full: bool = False):
+    def __init__(self, backend: str = "numpy", full: bool = False,
+                 clip_weights: bool = True):
         super().__init__()
         self.device = backend == "jax"
         self.full = full
+        self.clip_weights = clip_weights
 
     # -- helpers -----------------------------------------------------------
 
@@ -178,7 +181,10 @@ class StreamAccumulator(StreamAccumulatorBase):
     # -- per-chunk reduction -----------------------------------------------
 
     def _new_state(self, rid: int) -> _RefState:
-        return _RefState(int(self.ref_lens[rid]), self.device, self.full)
+        return _RefState(
+            int(self.ref_lens[rid]), self.device, self.full,
+            self.clip_weights,
+        )
 
     def _reduce(self, st: _RefState, ev, rid: int) -> None:
         L = st.L
@@ -196,14 +202,15 @@ class StreamAccumulator(StreamAccumulatorBase):
         )
         st.d = self._add(st.d, stream(ev.del_rid, ev.del_pos), L + 1)
         if self.full:
-            st.csw = self._add(
-                st.csw, stream(ev.csw_rid, ev.csw_pos, ev.csw_base),
-                L * N_CHANNELS,
-            )
-            st.cew = self._add(
-                st.cew, stream(ev.cew_rid, ev.cew_pos, ev.cew_base),
-                L * N_CHANNELS,
-            )
+            if self.clip_weights:
+                st.csw = self._add(
+                    st.csw, stream(ev.csw_rid, ev.csw_pos, ev.csw_base),
+                    L * N_CHANNELS,
+                )
+                st.cew = self._add(
+                    st.cew, stream(ev.cew_rid, ev.cew_pos, ev.cew_base),
+                    L * N_CHANNELS,
+                )
             st.cs = self._add(st.cs, stream(ev.cs_rid, ev.cs_pos), L + 1)
             st.ce = self._add(st.ce, stream(ev.ce_rid, ev.ce_pos), L + 1)
 
@@ -217,21 +224,28 @@ class StreamAccumulator(StreamAccumulatorBase):
         tab = insertion_table_from_counter(self.insertions, rid, st.L)
 
         def host(a, shape=None):
-            out = np.asarray(a)
+            if a is None:
+                return None
             if self.device:
-                _check_depth_ceiling(out, self.ref_names[rid])
-            return out.reshape(shape) if shape else out
+                from kindel_tpu.pileup_jax import fetch_counts_host
+
+                n_cols = N_CHANNELS if shape else 1
+                out = fetch_counts_host(a, a.size // n_cols, n_cols=n_cols)
+                _check_depth_ceiling(out.reshape(-1), self.ref_names[rid])
+                return out.astype(np.int32, copy=False)
+            out = np.asarray(a)
+            return (out.reshape(shape) if shape else out).astype(np.int32)
 
         L = st.L
         return Pileup(
             ref_id=self.ref_names[rid],
             ref_len=L,
-            weights=host(st.w, (L, N_CHANNELS)).astype(np.int32),
-            clip_start_weights=host(st.csw, (L, N_CHANNELS)).astype(np.int32),
-            clip_end_weights=host(st.cew, (L, N_CHANNELS)).astype(np.int32),
-            clip_starts=host(st.cs).astype(np.int32),
-            clip_ends=host(st.ce).astype(np.int32),
-            deletions=host(st.d).astype(np.int32),
+            weights=host(st.w, (L, N_CHANNELS)),
+            clip_start_weights=host(st.csw, (L, N_CHANNELS)),
+            clip_end_weights=host(st.cew, (L, N_CHANNELS)),
+            clip_starts=host(st.cs),
+            clip_ends=host(st.ce),
+            deletions=host(st.d),
             ins=tab,
         )
 
@@ -240,10 +254,13 @@ def stream_pileups(
     path,
     chunk_bytes: int = DEFAULT_CHUNK_BYTES,
     backend: str = "numpy",
+    clip_weights: bool = True,
 ) -> dict[str, Pileup]:
     """Bounded-RSS replacement for build_pileups(extract_events(load…)):
     same output, O(chunk + L) host memory."""
-    acc = StreamAccumulator(backend=backend, full=True)
+    acc = StreamAccumulator(
+        backend=backend, full=True, clip_weights=clip_weights
+    )
     for batch in stream_alignment(path, chunk_bytes):
         acc.add_batch(batch)
     return {acc.ref_names[rid]: acc.pileup(rid) for rid in acc.present}

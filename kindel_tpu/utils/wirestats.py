@@ -1,0 +1,32 @@
+"""Process-wide host↔device wire byte accounting.
+
+The tunneled-TPU links move single-digit MB/s, so transfer volume is a
+first-class performance metric (BASELINE.md per-phase tables). Download
+helpers record their fetched bytes here; benchmarks/stats_prof.py reads
+the counters to prove a transfer optimization shipped fewer bytes rather
+than guessing from wall time.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_lock = threading.Lock()
+_counters = {"d2h_bytes": 0, "d2h_fetches": 0}
+
+
+def add_d2h(n_bytes: int) -> None:
+    with _lock:
+        _counters["d2h_bytes"] += int(n_bytes)
+        _counters["d2h_fetches"] += 1
+
+
+def snapshot() -> dict:
+    with _lock:
+        return dict(_counters)
+
+
+def reset() -> None:
+    with _lock:
+        for k in _counters:
+            _counters[k] = 0
