@@ -142,6 +142,10 @@ def fetch_counts_host(dev_arr, n_rows: int, n_cols: int = N_CHANNELS,
             jax.default_backend() == "cpu"
             and not os.environ.get("KINDEL_TPU_COMPACT_STATS")
         )
+        # short references: the dense payload is already smaller than the
+        # compact path's bucketed-minimum block, and one round trip beats
+        # the meta+rows pair on a high-latency link
+        or dev_arr.size * 4 <= 64 << 10
     )
     if not dense:
         meta = np.asarray(_counts_meta(dev_arr, n_cols=n_cols))

@@ -1,9 +1,17 @@
-"""Device-side statistics kernels for the weights/features workloads.
+"""Device-side statistics kernels — the scipy replacement for pipelines
+that keep stats resident on device.
 
 The reference computes per-site Shannon entropy and Jeffreys binomial
 confidence intervals with one scipy call per site
 (/root/reference/kindel/kindel.py:614-624 — flagged HOT in SURVEY §3.2).
-Here both are jitted whole-axis reductions:
+Here both are jitted whole-axis reductions.
+
+NOTE: the weights/features TSV builders (kindel_tpu.workloads) now use
+the exact host forms for BOTH backends — the f32 kernels here can print
+one ulp-at-3dp off the scipy oracle on rounding-boundary values, and the
+byte-identical-backends invariant outranks device residency for table
+output (VERDICT r3 weakness 6). These kernels remain for device-resident
+consumers and are accuracy-pinned by tests/test_stats.py:
 
   * entropy — plain jnp vector math over the [L, 4] relative-frequency
     block (scipy semantics: rows renormalized, 0·log0 = 0, all-zero → nan);

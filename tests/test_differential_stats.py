@@ -264,3 +264,25 @@ def test_consensus_matches_reference_jax_sharded(seed, realign, tmp_path):
     )
     assert res.consensuses[0].sequence == ref_seq, f"seed={seed}"
     assert res.refs_changes["ref1"] == ref_changes
+
+
+def test_weights_tsv_backend_byte_identity(data_root, tmp_path):
+    """VERDICT r4 item 7: the full weights/features/variants TSVs must be
+    byte-for-byte identical between backends on the golden corpus — one
+    decision procedure, no f32-vs-f64 rounding cracks."""
+    from kindel_tpu import workloads
+
+    for rel in (
+        "data_bwa_mem/1.1.sub_test.bam",
+        "data_minimap2/1.1.multi.bam",
+    ):
+        bam = data_root / rel
+        for fn, kwargs in (
+            (workloads.weights, {}),
+            (workloads.weights, {"relative": True}),
+            (workloads.features, {}),
+            (workloads.variants, {}),
+        ):
+            np_tsv = fn(bam, backend="numpy", **kwargs).to_csv(sep="\t")
+            jx_tsv = fn(bam, backend="jax", **kwargs).to_csv(sep="\t")
+            assert np_tsv == jx_tsv, (rel, fn.__name__, kwargs)
