@@ -459,6 +459,15 @@ class _GroupedDispatch:
         )
 
     def assemble(self, pool, paths=None) -> list:
+        from kindel_tpu.utils.progress import Progress
+
+        prog = Progress(
+            "cohort call", total=len(self.units), unit="refs",
+            # one group == one dispatch: a single-group cohort would only
+            # ever print its final state, which is noise, not progress
+            force=False if len(self.groups) <= 1 else None,
+        )
+        done = 0
         results: list = [None] * len(self.units)
         while self._pending is not None:
             idxs, out = self._pending
@@ -468,6 +477,9 @@ class _GroupedDispatch:
             )
             for i, o in zip(idxs, outs):
                 results[i] = o
+            done += len(idxs)
+            prog.update(done)
+        prog.close(k=done)
         return results
 
 
@@ -490,8 +502,12 @@ def stream_bam_to_results(
     chunk k's batched kernel, host threads are already decoding chunk k+1,
     and chunk k-1's outputs are being spliced/yielded. Bounded memory:
     at most three chunks of units are alive at once."""
+    from kindel_tpu.utils.progress import Progress
+
     opts = BatchOptions(**opt_kwargs)
     bam_paths = list(bam_paths)
+    prog = Progress("cohort", total=len(bam_paths), unit="samples")
+    n_done = 0
     chunks = [
         bam_paths[i : i + chunk_size]
         for i in range(0, len(bam_paths), chunk_size)
@@ -547,8 +563,12 @@ def stream_bam_to_results(
                 outputs = disp_prev.assemble(pool, paths_prev)
                 grouped = _fold_results(units_prev, outputs, len(paths_prev))
                 for i, p in enumerate(paths_prev):
+                    n_done += 1
+                    prog.update(n_done, extra=str(getattr(p, "name", p)))
                     yield p, grouped[i]
             for p in empty_paths:  # after k-1's outputs: preserves input order
+                n_done += 1
+                prog.update(n_done)
                 yield p, SampleResult()
             if load_err is not None:
                 if next_load is not None:  # don't stall the raise behind
@@ -557,6 +577,7 @@ def stream_bam_to_results(
             pending = next_pending
             if load is None:
                 break
+    prog.close(k=n_done)
 
 
 def stream_bam_to_consensus(

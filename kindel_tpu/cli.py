@@ -16,6 +16,24 @@ import sys
 from kindel_tpu import __version__, workloads
 
 
+def _progress_parent() -> argparse.ArgumentParser:
+    """--progress is accepted both before and after the subcommand
+    (every other option lives on the subparser, so users will naturally
+    type it there)."""
+    p = argparse.ArgumentParser(add_help=False)
+    p.add_argument(
+        # SUPPRESS: the subparser copies its parsed namespace over the
+        # root's, so an ordinary default here would clobber a
+        # root-position `--progress`; with SUPPRESS the attribute only
+        # exists where the flag was actually given
+        "--progress", action="store_true", default=argparse.SUPPRESS,
+        help="report progress on stderr (chunks, contigs, cohort samples; "
+             "also auto-enabled when stderr is a terminal — the reference's "
+             "tqdm-bars equivalent)",
+    )
+    return p
+
+
 def _add_backend(p: argparse.ArgumentParser):
     p.add_argument(
         "--backend",
@@ -271,8 +289,19 @@ def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="kindel-tpu",
         description="TPU-native indel-aware consensus from aligned BAMs",
+        parents=[_progress_parent()],
     )
     sub = parser.add_subparsers(dest="command", required=True)
+    # every subcommand also accepts --progress (argparse applies a
+    # subparser default only when the root hasn't set the attribute, so
+    # either position wins and neither clobbers the other)
+    _orig_add_parser = sub.add_parser
+
+    def _add_parser(*a, **k):
+        k.setdefault("parents", []).append(_progress_parent())
+        return _orig_add_parser(*a, **k)
+
+    sub.add_parser = _add_parser
 
     _consensus_parser(sub)
 
@@ -389,6 +418,10 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if getattr(args, "progress", False):
+        import os
+
+        os.environ["KINDEL_TPU_PROGRESS"] = "1"
     if args.command == "version":
         print(f"kindel-tpu {__version__}")
         return 0

@@ -216,7 +216,33 @@ def stream_alignment(
     Every yielded batch shares the file's ref_names/ref_lens, so
     per-chunk event extraction + additive reduction reproduces the
     slurped result exactly.
+
+    Progress (opt-in, kindel_tpu.utils.progress): one stderr counter of
+    chunks + reads covers every streamed path, mirroring the reference's
+    "loading sequences" bar (kindel.py:40).
     """
+    from kindel_tpu.utils.progress import Progress
+
+    prog = Progress(f"streaming {Path(path).name}", unit="chunks")
+    total_reads = 0
+
+    def tick(batch):
+        nonlocal total_reads
+        total_reads += len(batch.pos)
+        prog.update(extra=f"({total_reads} reads)")
+        return batch
+
+    gen = _stream_alignment_impl(path, chunk_bytes)
+    try:
+        for batch in gen:
+            yield tick(batch)
+    finally:
+        prog.close(extra=f"({total_reads} reads)")
+
+
+def _stream_alignment_impl(
+    path, chunk_bytes: int = DEFAULT_CHUNK_BYTES
+) -> Iterator[ReadBatch]:
     path = Path(path)
     with open(path, "rb") as fh:
         head = fh.read(4)

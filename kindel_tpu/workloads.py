@@ -296,88 +296,99 @@ def bam_to_consensus(
                     )
                 )
 
-    for rid in ev.present_ref_ids:
-        ref_id = ev.ref_names[rid]
-        if rid in batched_out:
-            seq, changes, report = batched_out[rid]
-            refs_reports[ref_id] = report
-            refs_changes[ref_id] = changes
-            consensuses.append(seq)
-            continue
-        shard_ok = _shard_ok(rid)
-        if backend == "jax" and (shard_ok or realign):
-            # Position-sharded product path: every channel reduces on its
-            # shard's device, the call runs on device with a ppermute halo,
-            # and realign walks the device-resident clip tensors sparsely
-            # (kindel_tpu.parallel.product; SURVEY §5's headline axis).
-            # Under --realign this path engages even single-device (a
-            # 1-shard mesh): the clip channels then reduce on device
-            # instead of via a dense host pileup (VERDICT r2 item 3).
-            from kindel_tpu.parallel.mesh import make_mesh
-            from kindel_tpu.parallel.product import sharded_consensus
+    from kindel_tpu.utils.progress import Progress
 
-            mesh = None if shard_ok else make_mesh({"sp": 1})
-            with maybe_phase(f"sharded call+assemble [{ref_id}]"):
-                res, depth_min, depth_max, cdr_patches = sharded_consensus(
-                    ev, rid, mesh=mesh, realign=realign,
-                    min_depth=min_depth, min_overlap=min_overlap,
-                    clip_decay_threshold=clip_decay_threshold,
-                    mask_ends=mask_ends, trim_ends=trim_ends,
-                    uppercase=uppercase,
+    prog = Progress(
+        "building consensus", total=len(ev.present_ref_ids), unit="contigs"
+    )
+    # finally-close: an exception must not leave a half-drawn \r line
+    # for the traceback to overprint
+    try:
+        for done, rid in enumerate(ev.present_ref_ids):
+            prog.update(done, extra=ev.ref_names[rid])
+            ref_id = ev.ref_names[rid]
+            if rid in batched_out:
+                seq, changes, report = batched_out[rid]
+                refs_reports[ref_id] = report
+                refs_changes[ref_id] = changes
+                consensuses.append(seq)
+                continue
+            shard_ok = _shard_ok(rid)
+            if backend == "jax" and (shard_ok or realign):
+                # Position-sharded product path: every channel reduces on its
+                # shard's device, the call runs on device with a ppermute halo,
+                # and realign walks the device-resident clip tensors sparsely
+                # (kindel_tpu.parallel.product; SURVEY §5's headline axis).
+                # Under --realign this path engages even single-device (a
+                # 1-shard mesh): the clip channels then reduce on device
+                # instead of via a dense host pileup (VERDICT r2 item 3).
+                from kindel_tpu.parallel.mesh import make_mesh
+                from kindel_tpu.parallel.product import sharded_consensus
+
+                mesh = None if shard_ok else make_mesh({"sp": 1})
+                with maybe_phase(f"sharded call+assemble [{ref_id}]"):
+                    res, depth_min, depth_max, cdr_patches = sharded_consensus(
+                        ev, rid, mesh=mesh, realign=realign,
+                        min_depth=min_depth, min_overlap=min_overlap,
+                        clip_decay_threshold=clip_decay_threshold,
+                        mask_ends=mask_ends, trim_ends=trim_ends,
+                        uppercase=uppercase,
+                    )
+                refs_reports[ref_id] = build_report(
+                    ref_id, depth_min, depth_max, res.changes, cdr_patches,
+                    bam_path, realign, min_depth, min_overlap,
+                    clip_decay_threshold, trim_ends, uppercase,
                 )
+                refs_changes[ref_id] = res.changes
+                consensuses.append(
+                    Sequence(name=f"{ref_id}_cns", sequence=res.sequence)
+                )
+                continue
+
+            if backend == "jax":
+                from kindel_tpu.call_jax import call_consensus_fused
+
+                cdr_patches = None  # realign routed through the product path
+                with maybe_phase(f"device call+assemble [{ref_id}]"):
+                    res, depth_min, depth_max = call_consensus_fused(
+                        ev, rid, cdr_patches=None,
+                        trim_ends=trim_ends, min_depth=min_depth,
+                        uppercase=uppercase,
+                    )
+            else:
+                with maybe_phase(f"pileup reduce [{ref_id}]"):
+                    pileup = build_pileup(ev, rid)
+                if realign:
+                    with maybe_phase(f"realign CDR [{ref_id}]"):
+                        cdrps = cdrp_consensuses(
+                            pileup,
+                            clip_decay_threshold=clip_decay_threshold,
+                            mask_ends=mask_ends,
+                        )
+                        cdr_patches = merge_cdrps(cdrps, min_overlap)
+                else:
+                    cdr_patches = None
+                with maybe_phase(f"call+assemble [{ref_id}]"):
+                    res = call_consensus(
+                        pileup,
+                        cdr_patches=cdr_patches,
+                        trim_ends=trim_ends,
+                        min_depth=min_depth,
+                        uppercase=uppercase,
+                    )
+                acgt = pileup.acgt_depth
+                depth_min = int(acgt.min()) if len(acgt) else 0
+                depth_max = int(acgt.max()) if len(acgt) else 0
+
             refs_reports[ref_id] = build_report(
-                ref_id, depth_min, depth_max, res.changes, cdr_patches,
-                bam_path, realign, min_depth, min_overlap,
-                clip_decay_threshold, trim_ends, uppercase,
+                ref_id, depth_min, depth_max, res.changes, cdr_patches, bam_path,
+                realign, min_depth, min_overlap, clip_decay_threshold, trim_ends,
+                uppercase,
             )
             refs_changes[ref_id] = res.changes
-            consensuses.append(
-                Sequence(name=f"{ref_id}_cns", sequence=res.sequence)
-            )
-            continue
-
-        if backend == "jax":
-            from kindel_tpu.call_jax import call_consensus_fused
-
-            cdr_patches = None  # realign routed through the product path
-            with maybe_phase(f"device call+assemble [{ref_id}]"):
-                res, depth_min, depth_max = call_consensus_fused(
-                    ev, rid, cdr_patches=None,
-                    trim_ends=trim_ends, min_depth=min_depth,
-                    uppercase=uppercase,
-                )
-        else:
-            with maybe_phase(f"pileup reduce [{ref_id}]"):
-                pileup = build_pileup(ev, rid)
-            if realign:
-                with maybe_phase(f"realign CDR [{ref_id}]"):
-                    cdrps = cdrp_consensuses(
-                        pileup,
-                        clip_decay_threshold=clip_decay_threshold,
-                        mask_ends=mask_ends,
-                    )
-                    cdr_patches = merge_cdrps(cdrps, min_overlap)
-            else:
-                cdr_patches = None
-            with maybe_phase(f"call+assemble [{ref_id}]"):
-                res = call_consensus(
-                    pileup,
-                    cdr_patches=cdr_patches,
-                    trim_ends=trim_ends,
-                    min_depth=min_depth,
-                    uppercase=uppercase,
-                )
-            acgt = pileup.acgt_depth
-            depth_min = int(acgt.min()) if len(acgt) else 0
-            depth_max = int(acgt.max()) if len(acgt) else 0
-
-        refs_reports[ref_id] = build_report(
-            ref_id, depth_min, depth_max, res.changes, cdr_patches, bam_path,
-            realign, min_depth, min_overlap, clip_decay_threshold, trim_ends,
-            uppercase,
-        )
-        refs_changes[ref_id] = res.changes
-        consensuses.append(Sequence(name=f"{ref_id}_cns", sequence=res.sequence))
+            consensuses.append(Sequence(name=f"{ref_id}_cns", sequence=res.sequence))
+    finally:
+        prog.close(k=len(ev.present_ref_ids))
     return result(consensuses, refs_changes, refs_reports)
 
 
