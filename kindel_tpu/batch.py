@@ -461,25 +461,25 @@ class _GroupedDispatch:
     def assemble(self, pool, paths=None) -> list:
         from kindel_tpu.utils.progress import Progress
 
-        prog = Progress(
+        done = 0
+        results: list = [None] * len(self.units)
+        with Progress(
             "cohort call", total=len(self.units), unit="refs",
             # one group == one dispatch: a single-group cohort would only
             # ever print its final state, which is noise, not progress
             force=False if len(self.groups) <= 1 else None,
-        )
-        done = 0
-        results: list = [None] * len(self.units)
-        while self._pending is not None:
-            idxs, out = self._pending
-            self._pending = self._dispatch_next()
-            outs = _assemble_outputs(
-                [self.units[i] for i in idxs], out, self.opts, pool, paths
-            )
-            for i, o in zip(idxs, outs):
-                results[i] = o
-            done += len(idxs)
-            prog.update(done)
-        prog.close(k=done)
+        ) as prog:
+            while self._pending is not None:
+                idxs, out = self._pending
+                self._pending = self._dispatch_next()
+                outs = _assemble_outputs(
+                    [self.units[i] for i in idxs], out, self.opts, pool,
+                    paths,
+                )
+                for i, o in zip(idxs, outs):
+                    results[i] = o
+                done += len(idxs)
+                prog.update(done)
         return results
 
 
@@ -516,8 +516,10 @@ def stream_bam_to_results(
     # the prefetch wrapper gets its own single thread: submitting it to
     # `pool` would deadlock at small num_workers (the wrapper blocks on
     # pool.map tasks that can never be scheduled behind it)
+    # `prog` in the with-stack: a decode failure or an abandoned
+    # generator must still terminate the TTY progress line
     with ThreadPoolExecutor(max_workers=num_workers) as pool, \
-            ThreadPoolExecutor(max_workers=1) as prefetcher:
+            ThreadPoolExecutor(max_workers=1) as prefetcher, prog:
         next_load = (
             prefetcher.submit(_load_units, chunks[0], pool, opts)
             if chunks else None
@@ -577,7 +579,6 @@ def stream_bam_to_results(
             pending = next_pending
             if load is None:
                 break
-    prog.close(k=n_done)
 
 
 def stream_bam_to_consensus(

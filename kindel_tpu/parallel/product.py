@@ -391,6 +391,23 @@ def _counts_product_jit(
     return _package_outs(outs, mesh.shape[axis], block, realign)
 
 
+def _host_global(arr) -> np.ndarray:
+    """Host copy of a device array that may span non-addressable devices.
+
+    Single-process (every mesh the CLI builds): a plain fetch. In a
+    multi-process group — the sp axis laid across hosts — each process
+    holds only its local shards, so the full value is assembled with a
+    process_allgather collective (every process runs this in lockstep on
+    the same arrays; SURVEY §2.2 comm-backend row)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(
+            multihost_utils.process_allgather(arr, tiled=True)
+        )
+    return np.asarray(arr)
+
+
 @partial(jax.jit, static_argnames=("chunk",))
 def _fetch1d(arr, start, *, chunk: int):
     return jax.lax.dynamic_slice(arr, (start,), (chunk,))
@@ -518,7 +535,7 @@ class ShardedRef(LazyCdrWindows):
         """The packed wire buffer, downloaded once (single d2h transfer)
         and cached."""
         if self._wire_host is None:
-            self._wire_host = np.asarray(self._out["wire"])
+            self._wire_host = _host_global(self._out["wire"])
         return self._wire_host
 
     def _seg(self, key: str) -> np.ndarray:
@@ -558,10 +575,13 @@ class ShardedRef(LazyCdrWindows):
 
     def _fetch(self, key: str, start: int) -> np.ndarray:
         """One fixed-size jitted dynamic-slice download (LazyCdrWindows
-        contract; compile-once per shape)."""
+        contract; compile-once per shape). Every process runs the same
+        trigger-driven fetch sequence (the wire they derive it from is
+        identical), so these stay collective-compatible across a
+        multi-process mesh."""
         arr = self._out[key]
         fetch = _fetch2d if arr.ndim == 2 else _fetch1d
-        return np.asarray(fetch(arr, jnp.int32(start), chunk=self._chunk))
+        return _host_global(fetch(arr, jnp.int32(start), chunk=self._chunk))
 
     def _empty(self, key: str) -> np.ndarray:
         return np.empty((0,) + self._out[key].shape[1:], np.int32)

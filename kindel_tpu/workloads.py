@@ -302,16 +302,19 @@ def bam_to_consensus(
         "building consensus", total=len(ev.present_ref_ids), unit="contigs"
     )
     # finally-close: an exception must not leave a half-drawn \r line
-    # for the traceback to overprint
+    # for the traceback to overprint — and the final line must report the
+    # contig actually reached, not N/N, when one raises mid-loop
+    done = 0
     try:
-        for done, rid in enumerate(ev.present_ref_ids):
-            prog.update(done, extra=ev.ref_names[rid])
+        for idx, rid in enumerate(ev.present_ref_ids):
+            prog.update(idx, extra=ev.ref_names[rid])
             ref_id = ev.ref_names[rid]
             if rid in batched_out:
                 seq, changes, report = batched_out[rid]
                 refs_reports[ref_id] = report
                 refs_changes[ref_id] = changes
                 consensuses.append(seq)
+                done = idx + 1
                 continue
             shard_ok = _shard_ok(rid)
             if backend == "jax" and (shard_ok or realign):
@@ -387,8 +390,9 @@ def bam_to_consensus(
             )
             refs_changes[ref_id] = res.changes
             consensuses.append(Sequence(name=f"{ref_id}_cns", sequence=res.sequence))
+            done = idx + 1
     finally:
-        prog.close(k=len(ev.present_ref_ids))
+        prog.close(k=done)
     return result(consensuses, refs_changes, refs_reports)
 
 

@@ -1,0 +1,59 @@
+"""Worker for the 2-process PRODUCT-path test: join the localhost group
+(4 virtual CPU devices per process → 8 global), build a 1-D sp=8 mesh
+whose position axis SPANS the process boundary, run sharded_consensus
+with realign on (ppermute halo + lazy CDR window fetches cross
+non-addressable shards), and print the consensus digest.
+
+Usage: python tests/_dist_product_worker.py <process_id> <coordinator_port>
+(underscore prefix: not collected by pytest)."""
+
+import os
+import sys
+
+proc_id = int(sys.argv[1])
+port = int(sys.argv[2])
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_here))
+sys.path.insert(0, _here)
+
+import distfixture  # noqa: E402  (shared sample geometry)
+
+from kindel_tpu.parallel import initialize_distributed  # noqa: E402
+
+assert (
+    initialize_distributed(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=2,
+        process_id=proc_id,
+    )
+    is True
+), "process group did not come up"
+assert jax.process_count() == 2
+assert jax.device_count() == 8
+
+from jax.sharding import Mesh  # noqa: E402
+
+from kindel_tpu.events import extract_events  # noqa: E402
+from kindel_tpu.io.sam import parse_sam_bytes  # noqa: E402
+from kindel_tpu.parallel.product import sharded_consensus  # noqa: E402
+
+# sp axis across ALL devices of BOTH processes — the halo ppermute at
+# shard edge 3→4 crosses the process boundary
+mesh = Mesh(jax.devices(), ("sp",))
+procs_spanned = {d.process_index for d in mesh.devices.flat}
+assert procs_spanned == {0, 1}, procs_spanned
+
+ev = extract_events(parse_sam_bytes(distfixture.product_sam()))
+rid = ev.present_ref_ids[0]
+res, dmin, dmax, cdr = sharded_consensus(
+    ev, rid, mesh=mesh, realign=True, min_overlap=7,
+)
+print("DIGEST:" + distfixture.product_digest(res, dmin, dmax, cdr),
+      flush=True)

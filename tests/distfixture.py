@@ -32,3 +32,59 @@ def digest(outs) -> str:
     for o in outs:
         h.update(np.ascontiguousarray(o).tobytes())
     return h.hexdigest()
+
+
+def product_sam(ref_len: int = 2048, seed: int = 5) -> bytes:
+    """Synthetic SAM for the cross-process product-path test.
+
+    Layout engineered so realign actually produces a CDR patch (the lazy
+    window fetches and LCS merge run for real): an uncovered gap at
+    [1000, 1020) flanked by 20 forward-clipping reads (48M16S ending at
+    1000, clips = gap[0:16]) and 20 reverse-clipping reads (16S48M
+    starting at 1020, clips = gap[4:20]) — the 12-base clip overlap >=
+    min_overlap 7 merges into one gap-closing patch. Background random
+    reads plus deletion/insertion reads exercise every other channel."""
+    rng = np.random.default_rng(seed)
+    lines = [b"@HD\tVN:1.6", f"@SQ\tSN:prod1\tLN:{ref_len}".encode()]
+    bases = "ACGT"
+
+    def rand_seq(n):
+        return "".join(bases[b] for b in rng.integers(0, 4, size=n))
+
+    gap = rand_seq(20)  # the "true" sequence across the uncovered gap
+    left_match = rand_seq(48)
+    right_match = rand_seq(48)
+    k = 0
+
+    def read(pos1, cigar, seq):
+        nonlocal k
+        lines.append(
+            f"r{k}\t0\tprod1\t{pos1}\t60\t{cigar}\t*\t0\t0\t{seq}\t*".encode()
+        )
+        k += 1
+
+    for _ in range(20):
+        read(953, "48M16S", left_match + gap[:16])     # matches 952..1000
+        read(1021, "16S48M", gap[4:20] + right_match)  # matches 1020..1068
+    # background coverage away from the gap (none inside [1000, 1020))
+    for _ in range(40):
+        pos = int(rng.integers(0, 900))
+        read(pos + 1, "64M", rand_seq(64))
+    for _ in range(10):
+        pos = int(rng.integers(1100, ref_len - 80))
+        read(pos + 1, "30M4D30M", rand_seq(60))
+        pos = int(rng.integers(1100, ref_len - 80))
+        read(pos + 1, "30M6I24M", rand_seq(60))
+    return b"\n".join(lines) + b"\n"
+
+
+def product_digest(res, dmin: int, dmax: int, cdr) -> str:
+    """Digest of a sharded_consensus result tuple — shared by the
+    2-process product worker and its single-process oracle so the two
+    sides can never drift apart."""
+    payload = (
+        res.sequence
+        + f"|{dmin}|{dmax}|"
+        + str([(r.start, r.end, r.seq) for r in (cdr or [])])
+    )
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]

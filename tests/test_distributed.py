@@ -162,3 +162,79 @@ def test_initialize_distributed_rejects_orphan_process_id(monkeypatch):
     monkeypatch.setenv("JAX_PROCESS_ID", "3")
     with pytest.raises(ValueError, match="coordinator_address"):
         initialize_distributed()
+
+
+def test_two_process_product_path_matches_single_process():
+    """VERDICT r4 item 4: the flagship product path (ppermute halo, lazy
+    CDR window fetches) across a REAL 2-process group with the sp axis
+    SPANNING the process boundary must equal the single-process result
+    byte-for-byte. The halo crossing a non-addressable-device edge is
+    exactly where a wrong out_spec would hide."""
+    import os
+    import socket
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    import distfixture
+
+    from kindel_tpu.events import extract_events
+    from kindel_tpu.io.sam import parse_sam_bytes
+    from kindel_tpu.parallel import make_mesh
+    from kindel_tpu.parallel.product import sharded_consensus
+
+    # single-process oracle on this process's 8-device sp mesh
+    ev = extract_events(parse_sam_bytes(distfixture.product_sam()))
+    rid = ev.present_ref_ids[0]
+    res, dmin, dmax, cdr = sharded_consensus(
+        ev, rid, mesh=make_mesh({"sp": 8}), realign=True, min_overlap=7,
+    )
+    expected = distfixture.product_digest(res, dmin, dmax, cdr)
+    # non-vacuity: realign actually produced patches on this layout
+    assert cdr, "fixture produced no CDR patches; the lazy-fetch path is untested"
+
+    worker = Path(__file__).parent / "_dist_product_worker.py"
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+
+    def run_pair():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        procs = [
+            subprocess.Popen(
+                [sys.executable, str(worker), str(i), str(port)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+                env=env,
+            )
+            for i in range(2)
+        ]
+        try:
+            return procs, [p.communicate(timeout=300) for p in procs]
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
+
+    for attempt in range(3):
+        procs, outs = run_pair()
+        if all(p.returncode == 0 for p in procs):
+            break
+        bind_race = any(
+            "bind" in err.lower() or "address already in use" in err.lower()
+            for _, err in outs
+        )
+        assert bind_race and attempt < 2, (
+            f"worker rc={[p.returncode for p in procs]}; "
+            f"stderr[0] tail: {outs[0][1][-1500:]}\n"
+            f"stderr[1] tail: {outs[1][1][-1500:]}"
+        )
+
+    digests = set()
+    for out, _err in outs:
+        lines = [l for l in out.splitlines() if l.startswith("DIGEST:")]
+        assert lines, out
+        digests.add(lines[-1][len("DIGEST:"):])
+    assert digests == {expected}, (digests, expected)
