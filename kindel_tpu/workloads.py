@@ -693,6 +693,10 @@ def plot_clips(bam_path, out_path=None, backend: str = "numpy"):
     (/root/reference/kindel/kindel.py:667-703): same eight traces, rendered
     by a small self-contained SVG/JS pan-zoom chart — no plotly dependency.
     Writes <stem>.plot.html to the CWD like the reference (:702-703).
+    Render windows wider than ~4000 positions decimate by min/max
+    envelope per bucket (never stride sampling), so multi-megabase depth
+    traces keep every spike and dropout; the payload itself is full
+    resolution, so zooming recovers exact per-position detail.
     """
     import json
     import os
@@ -746,44 +750,65 @@ const svg = document.getElementById("chart");
 const W = 1200, H = 480, PAD = 40;
 let x0 = 0, x1 = Math.max(...data.map(t => t.y.length));
 const vis = data.map(() => true);
-function ymax(){let m=1;data.forEach((t,i)=>{if(!vis[i])return;
-  const a=Math.max(0,Math.floor(x0)),b=Math.min(t.y.length,Math.ceil(x1));
-  for(let j=a;j<b;j++) if(t.y[j]>m) m=t.y[j];});return m;}
+// envelope decimation: when a render window holds more positions than
+// ~4000 buckets, each lines-bucket contributes its min AND max sample
+// (in position order) rather than a stride sample — a 6 Mb depth trace
+// keeps every spike/dropout; markers keep each bucket's maximum. The
+// kept indices also carry the exact window maximum (every bucket max is
+// kept), so no separate full ymax scan is needed.
+function decimate(t){
+  const a=Math.max(0,Math.floor(x0)), b=Math.min(t.y.length,Math.ceil(x1));
+  const step=Math.max(1,Math.floor((b-a)/4000));
+  const keep=[];
+  for(let j=a;j<b;j+=step){
+    const e=Math.min(b,j+step);
+    let mi=j, ma=j;
+    for(let k=j+1;k<e;k++){ if(t.y[k]<t.y[mi]) mi=k; if(t.y[k]>t.y[ma]) ma=k; }
+    if(t.mode==="lines"){
+      keep.push(Math.min(mi,ma));
+      if(ma!==mi) keep.push(Math.max(mi,ma));
+    } else if(t.y[ma]>0) keep.push(ma);
+  }
+  return keep;
+}
 function render(){
-  const ym = ymax();
+  const kept = data.map((t,i)=>vis[i]?decimate(t):null);
+  let ym=1;
+  kept.forEach((ks,i)=>{ if(ks) for(const j of ks) if(data[i].y[j]>ym) ym=data[i].y[j];});
   const sx = (W-2*PAD)/(x1-x0), sy = (H-2*PAD)/ym;
   let out = `<line x1="${PAD}" y1="${H-PAD}" x2="${W-PAD}" y2="${H-PAD}" stroke="#333"/>`;
   out += `<line x1="${PAD}" y1="${PAD}" x2="${PAD}" y2="${H-PAD}" stroke="#333"/>`;
   out += `<text x="${PAD}" y="${PAD-8}" font-size="12">${ym}</text>`;
   out += `<text x="${W-PAD-60}" y="${H-PAD+24}" font-size="12">${Math.round(x1)}</text>`;
   out += `<text x="${PAD}" y="${H-PAD+24}" font-size="12">${Math.round(x0)+1}</text>`;
-  data.forEach((t,i)=>{ if(!vis[i]) return;
-    const a=Math.max(0,Math.floor(x0)), b=Math.min(t.y.length,Math.ceil(x1));
-    const step=Math.max(1,Math.floor((b-a)/4000));
+  data.forEach((t,i)=>{ const ks=kept[i]; if(!ks) return;
     if(t.mode==="lines"){
-      let pts=[];
-      for(let j=a;j<b;j+=step) pts.push(`${PAD+(j-x0)*sx},${H-PAD-t.y[j]*sy}`);
+      const pts=ks.map(j=>`${PAD+(j-x0)*sx},${H-PAD-t.y[j]*sy}`);
       out+=`<polyline fill="none" stroke="${colors[i%8]}" stroke-width="1" points="${pts.join(" ")}"/>`;
     } else {
-      for(let j=a;j<b;j+=step) if(t.y[j]>0)
+      for(const j of ks)
         out+=`<circle cx="${PAD+(j-x0)*sx}" cy="${H-PAD-t.y[j]*sy}" r="1.6" fill="${colors[i%8]}"/>`;
     }});
   svg.innerHTML = out;
 }
+// coalesce renders to one per frame: a full-zoom-out render scans the
+// whole multi-megabase window, and mousemove fires far above 60 Hz
+let raf=0;
+function requestRender(){ if(!raf) raf=requestAnimationFrame(()=>{raf=0;render();}); }
 const leg = document.getElementById("legend");
 data.forEach((t,i)=>{const s=document.createElement("span");
   s.textContent="■ "+t.name; s.style.color=colors[i%8];
-  s.onclick=()=>{vis[i]=!vis[i];s.classList.toggle("off");render();};
+  s.onclick=()=>{vis[i]=!vis[i];s.classList.toggle("off");requestRender();};
   leg.appendChild(s);});
 let drag=null;
 svg.addEventListener("mousedown",e=>drag={x:e.clientX,x0,x1});
 window.addEventListener("mouseup",()=>drag=null);
 window.addEventListener("mousemove",e=>{if(!drag)return;
   const dx=(e.clientX-drag.x)/svg.clientWidth*(drag.x1-drag.x0);
-  x0=drag.x0-dx; x1=drag.x1-dx; render();});
+  x0=drag.x0-dx; x1=drag.x1-dx; requestRender();});
 svg.addEventListener("wheel",e=>{e.preventDefault();
   const f=e.deltaY>0?1.2:1/1.2, c=(x0+x1)/2;
-  x0=c-(c-x0)*f; x1=c+(x1-c)*f; render();});
+  x0=c-(c-x0)*f; x1=c+(x1-c)*f; requestRender();});
 render();
 </script></body></html>
 """

@@ -120,3 +120,74 @@ def test_stats_workloads_compact_parity(data_root, monkeypatch):
             workloads.variants(bam, backend="jax").to_csv(sep="\t"),
         )
     assert frames["compact"] == frames["dense"]
+
+
+def test_plot_envelope_decimation(tmp_path, monkeypatch):
+    """VERDICT r4 item 8: the SVG chart must decimate by min/max
+    envelope, not stride sampling — a 6 Mb trace keeps narrow spikes and
+    dropouts. No JS runtime is available here, so this (a) pins the
+    template's envelope markers and full-resolution payload, and (b)
+    checks a faithful Python port of the bucket loop keeps both extrema
+    stride sampling provably drops."""
+    import json
+    import re
+
+    import numpy as np
+    from types import SimpleNamespace
+
+    import kindel_tpu.workloads as w
+
+    L = 120_000
+    y = np.full(L, 10, np.int32)
+    spike_pos, drop_pos = 34_567, 91_113  # off any 4000-bucket stride grid
+    y[spike_pos] = 500
+    y[drop_pos] = 0
+    zeros = np.zeros(L, np.int32)
+    p = SimpleNamespace(
+        ref_len=L, aligned_depth=y, clip_depth=zeros,
+        clip_start_depth=zeros, clip_end_depth=zeros,
+        clip_starts=np.zeros(L + 1, np.int32),
+        clip_ends=np.zeros(L + 1, np.int32),
+        deletions=np.zeros(L + 1, np.int32),
+        ins=SimpleNamespace(totals=np.zeros(L + 1, np.int32)),
+    )
+    monkeypatch.setattr(w, "_load_pileups", lambda *a, **k: {"s": p})
+    out = tmp_path / "spike.html"
+    w.plot_clips("spike.bam", out_path=str(out))
+    html = out.read_text()
+
+    # template must carry the envelope loop, not a bare stride sample
+    assert "let mi=j, ma=j" in html
+    assert "if(t.y[k]<t.y[mi]) mi=k" in html
+    # payload is full resolution (decimation is render-time only)
+    payload = json.loads(
+        re.search(r"const data = (\[.*?\]);\n", html, re.S).group(1)
+    )
+    trace = payload[0]["y"]
+    assert len(trace) == L and trace[spike_pos] == 500
+
+    # faithful Python port of the template's bucket loop
+    def envelope(yv, a, b):
+        step = max(1, (b - a) // 4000)
+        kept = []
+        j = a
+        while j < b:
+            e = min(b, j + step)
+            mi = ma = j
+            for k in range(j + 1, e):
+                if yv[k] < yv[mi]:
+                    mi = k
+                if yv[k] > yv[ma]:
+                    ma = k
+            kept.append(yv[min(mi, ma)])
+            if ma != mi:
+                kept.append(yv[max(mi, ma)])
+            j += step
+        return kept
+
+    kept = envelope(y, 0, L)
+    assert max(kept) == 500 and min(kept) == 0
+    # and plain stride sampling would have missed both (non-vacuity)
+    step = max(1, L // 4000)
+    strided = y[::step]
+    assert 500 not in strided and 0 not in strided
