@@ -60,6 +60,7 @@ class BatchOptions:
     min_overlap: int = 9
     clip_decay_threshold: float = 0.1
     mask_ends: int = 50
+    cdr_gap: int = 0
     trim_ends: bool = False
     uppercase: bool = False
     build_reports: bool = False
@@ -111,6 +112,7 @@ def batch_bam_to_results(
     min_overlap: int = 9,
     clip_decay_threshold: float = 0.1,
     mask_ends: int = 50,
+    cdr_gap: int = 0,
     trim_ends: bool = False,
     uppercase: bool = False,
     build_reports: bool = True,
@@ -126,7 +128,7 @@ def batch_bam_to_results(
     opts = BatchOptions(
         realign=realign, min_depth=min_depth, min_overlap=min_overlap,
         clip_decay_threshold=clip_decay_threshold, mask_ends=mask_ends,
-        trim_ends=trim_ends, uppercase=uppercase,
+        cdr_gap=cdr_gap, trim_ends=trim_ends, uppercase=uppercase,
         build_reports=build_reports, build_changes=build_changes,
     )
     bam_paths = list(bam_paths)
@@ -397,7 +399,7 @@ def _assemble_outputs(units, device_out, opts: BatchOptions, pool,
                 dense, i, u.L
             ).cdr_patches_from_triggers(
                 trig_f, trig_r, opts.clip_decay_threshold,
-                opts.mask_ends, opts.min_overlap,
+                opts.mask_ends, opts.min_overlap, max_gap=opts.cdr_gap,
             )
         if opts.want_masks:
             _emit, masks = masks_from_wire(

@@ -69,6 +69,14 @@ def _consensus_parser(sub):
         help="ignore clip dominant positions within n positions of termini",
     )
     p.add_argument(
+        "--cdr-gap", type=int, default=0, metavar="N",
+        help="pair facing clip-dominant regions across up to N uncovered "
+             "positions (beyond the reference, which requires overlapping "
+             "spans and cannot close wide divergent segments — its own "
+             "disabled gp120 case); the min-overlap merge gate still "
+             "rejects false pairs. 0 (default) = reference-exact pairing",
+    )
+    p.add_argument(
         "-t", "--trim-ends", action="store_true",
         help="trim ambiguous nucleotides (Ns) from sequence ends",
     )
@@ -92,6 +100,9 @@ def _consensus_parser(sub):
 
 
 def cmd_consensus(args) -> int:
+    if args.cdr_gap < 0:
+        print("error: --cdr-gap must be >= 0", file=sys.stderr)
+        return 2
     timer = None
     if args.profile:
         from kindel_tpu.utils.profiling import disable_profiling, enable_profiling
@@ -110,6 +121,7 @@ def cmd_consensus(args) -> int:
             uppercase=args.uppercase,
             backend=args.backend,
             stream_chunk_mb=args.stream_chunk_mb,
+            cdr_gap=args.cdr_gap,
         )
     finally:
         if timer is not None:

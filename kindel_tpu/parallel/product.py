@@ -587,12 +587,13 @@ class ShardedRef(LazyCdrWindows):
         return np.empty((0,) + self._out[key].shape[1:], np.int32)
 
     def cdr_patches(self, clip_decay_threshold: float, mask_ends: int,
-                    min_overlap: int):
+                    min_overlap: int, cdr_gap: int = 0):
         """Full CDR pipeline through the sharded tensors: sparse candidate
         discovery → lazy decay walks → pairing → LCS merge (host)."""
         trig_f, trig_r = self.trigger_positions()
         return self.cdr_patches_from_triggers(
-            trig_f, trig_r, clip_decay_threshold, mask_ends, min_overlap
+            trig_f, trig_r, clip_decay_threshold, mask_ends, min_overlap,
+            max_gap=cdr_gap,
         )
 
 
@@ -609,6 +610,7 @@ def sharded_consensus(
     uppercase: bool = False,
     build_changes: bool = True,
     axis: str = "sp",
+    cdr_gap: int = 0,
 ):
     """Position-sharded equivalent of call_jax.call_consensus_fused +
     the optional realign pipeline.
@@ -624,7 +626,7 @@ def sharded_consensus(
         sr, realign=realign, min_depth=min_depth, min_overlap=min_overlap,
         clip_decay_threshold=clip_decay_threshold, mask_ends=mask_ends,
         trim_ends=trim_ends, uppercase=uppercase,
-        build_changes=build_changes,
+        build_changes=build_changes, cdr_gap=cdr_gap,
     )
 
 
@@ -639,6 +641,7 @@ def close_sharded_ref(
     trim_ends: bool,
     uppercase: bool,
     build_changes: bool = True,
+    cdr_gap: int = 0,
 ):
     """Close one ShardedRef: (optional) lazy CDR walk → wire decode →
     host assembly. Shared by the event-built path above and the streamed
@@ -646,7 +649,7 @@ def close_sharded_ref(
 
     Returns (CallResult, depth_min, depth_max, cdr_patches)."""
     cdr_patches = (
-        sr.cdr_patches(clip_decay_threshold, mask_ends, min_overlap)
+        sr.cdr_patches(clip_decay_threshold, mask_ends, min_overlap, cdr_gap)
         if realign
         else None
     )

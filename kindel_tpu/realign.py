@@ -208,7 +208,8 @@ def cdr_end_consensuses(pileup: Pileup, clip_decay_threshold: float,
 def cdrp_consensuses(pileup_or_weights, deletions=None, clip_start_weights=None,
                      clip_end_weights=None, clip_start_depth=None,
                      clip_end_depth=None, clip_decay_threshold=0.1,
-                     mask_ends=50) -> list[tuple[Region, Region]]:
+                     mask_ends=50, *, max_gap: int = 0
+                     ) -> list[tuple[Region, Region]]:
     """Pair facing '→'/'←' regions whose spans intersect
     (reference kindel.py:278-320). Accepts either a Pileup (native API) or
     the reference's seven positional arrays (compat API, used by the
@@ -224,7 +225,7 @@ def cdrp_consensuses(pileup_or_weights, deletions=None, clip_start_weights=None,
         )
     fwd = cdr_start_consensuses(pileup, clip_decay_threshold, mask_ends)
     rev = cdr_end_consensuses(pileup, clip_decay_threshold, mask_ends)
-    return pair_regions(fwd, rev)
+    return pair_regions(fwd, rev, max_gap)
 
 
 class LazyCdrWindows:
@@ -268,7 +269,7 @@ class LazyCdrWindows:
 
     def cdr_patches_from_triggers(
         self, trig_fwd, trig_rev, clip_decay_threshold: float,
-        mask_ends: int, min_overlap: int,
+        mask_ends: int, min_overlap: int, max_gap: int = 0,
     ) -> list["Region"]:
         return lazy_cdr_patches(
             self.L, trig_fwd, trig_rev,
@@ -276,7 +277,7 @@ class LazyCdrWindows:
             self.cond("cew", clip_decay_threshold),
             lambda a, b: self.window("csw", a, b),
             lambda a, b: self.window("cew", a, b),
-            mask_ends, min_overlap,
+            mask_ends, min_overlap, max_gap=max_gap,
         )
 
 
@@ -290,6 +291,7 @@ def lazy_cdr_patches(
     win_cew,
     mask_ends: int,
     min_overlap: int,
+    max_gap: int = 0,
 ) -> list[Region]:
     """Full CDR pipeline over device-resident clip tensors: trigger
     positions (pre-computed on device, integer-exact) → lazy decay walks
@@ -299,20 +301,50 @@ def lazy_cdr_patches(
                                      mask_ends)
     rev = cdr_end_consensuses_lazy(L, trig_rev[::-1], cond_cew, win_cew,
                                    mask_ends)
-    return merge_cdrps(pair_regions(fwd, rev), min_overlap)
+    return merge_cdrps(pair_regions(fwd, rev, max_gap), min_overlap)
 
 
-def pair_regions(fwd: list[Region],
-                 rev: list[Region]) -> list[tuple[Region, Region]]:
+#: merge gate floor for gap pairs (pair_regions max_gap > 0): two ~150 bp
+#: clip extensions share a chance 7-mer with probability near 1
+#: ((150-6)²/4⁷ ≈ 1.3 expected), so the CLI's default min_overlap would
+#: let unrelated segments splice into a chimera; a chance shared 16-mer
+#: is ~5·10⁻⁶. Span-intersecting pairs keep the reference's exact gate.
+GAP_PAIR_MIN_OVERLAP = 16
+
+
+def pair_regions(fwd: list[Region], rev: list[Region],
+                 max_gap: int = 0) -> list[tuple[Region, Region]]:
     """Each '→' region pairs with the first '←' region whose span
-    intersects it (reference kindel.py:310-316)."""
+    intersects it (reference kindel.py:310-316).
+
+    Gap pairing (beyond the reference; default off): when a divergent
+    segment is wider than the soft-clip extensions — the reference's own
+    disabled gp120 CDR case (its tests/test_kindel.py:302-319,
+    "not yet implemented") — the facing spans never intersect, yet their
+    extension STRINGS still share the novel sequence carried inside the
+    clips from both sides. With max_gap > 0 (--cdr-gap), an unpaired '→'
+    region also pairs with the nearest '←' region starting within
+    max_gap to its right; merge_cdrps then applies the stricter
+    GAP_PAIR_MIN_OVERLAP gate to such pairs, so a chance short overlap
+    between unrelated segments yields a logged no-overlap warning and no
+    patch."""
     pairs: list[tuple[Region, Region]] = []
     for f in fwd:
+        hit = None
         for r in rev:
             # non-empty range intersection
             if max(f.start, r.start) < min(f.end, r.end):
-                pairs.append((f, r))
+                hit = r
                 break
+        if hit is None and max_gap > 0:
+            facing = [
+                r for r in rev
+                if r.start >= f.end and r.start - f.end <= max_gap
+            ]
+            if facing:
+                hit = min(facing, key=lambda r: r.start)
+        if hit is not None:
+            pairs.append((f, hit))
     return pairs
 
 
@@ -350,14 +382,21 @@ def merge_by_lcs(s1: str, s2: str, min_overlap: int) -> str | None:
 def merge_cdrps(cdrps, min_overlap: int) -> list[Region]:
     """Merge each paired CDR; a failed merge keeps seq None and logs a
     warning (reference kindel.py:350-366) — the caller then falls back to
-    the unpatched per-position consensus."""
+    the unpatched per-position consensus.
+
+    Pairs whose spans do not intersect can only come from gap pairing
+    (pair_regions max_gap > 0) and take the stricter
+    GAP_PAIR_MIN_OVERLAP gate — see that constant for the statistics."""
     merged: list[Region] = []
     for fwd, rev in cdrps:
-        seq = merge_by_lcs(fwd.seq, rev.seq, min_overlap)
+        gate = min_overlap
+        if rev.start >= fwd.end:  # no span intersection ⇒ gap pair
+            gate = max(min_overlap, GAP_PAIR_MIN_OVERLAP)
+        seq = merge_by_lcs(fwd.seq, rev.seq, gate)
         if not seq:
             logging.warning(
                 f"No overlap found for clip dominant region spanning "
-                f"positions {fwd.start}-{rev.end} (min_overlap = {min_overlap})"
+                f"positions {fwd.start}-{rev.end} (min_overlap = {gate})"
             )
         merged.append(Region(fwd.start, rev.end, seq, None))
     return merged

@@ -277,6 +277,7 @@ def streamed_consensus(
     uppercase: bool = False,
     backend: str = "numpy",
     chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+    cdr_gap: int = 0,
 ):
     """bam_to_consensus over a streamed decode — identical output, host
     RSS bounded by O(chunk + reference length).
@@ -303,7 +304,7 @@ def streamed_consensus(
         return _streamed_sharded_consensus(
             bam_path, realign, min_depth, min_overlap,
             clip_decay_threshold, mask_ends, trim_ends, uppercase,
-            chunk_bytes, mesh,
+            chunk_bytes, mesh, cdr_gap=cdr_gap,
         )
 
     # realign (or the numpy oracle) consumes host pileups; the plain jax
@@ -325,6 +326,7 @@ def streamed_consensus(
                         pileup,
                         clip_decay_threshold=clip_decay_threshold,
                         mask_ends=mask_ends,
+                        max_gap=cdr_gap,
                     ),
                     min_overlap,
                 )
@@ -375,6 +377,7 @@ def streamed_consensus(
 def _streamed_sharded_consensus(
     bam_path, realign, min_depth, min_overlap, clip_decay_threshold,
     mask_ends, trim_ends, uppercase, chunk_bytes, mesh=None,
+    cdr_gap: int = 0,
 ):
     """Streamed decode reduced into position-sharded device state; the
     closing call + (optional) lazy CDR walk run through the product
@@ -397,6 +400,7 @@ def _streamed_sharded_consensus(
             min_overlap=min_overlap,
             clip_decay_threshold=clip_decay_threshold,
             mask_ends=mask_ends, trim_ends=trim_ends, uppercase=uppercase,
+            cdr_gap=cdr_gap,
         )
         refs_reports[ref_id] = build_report(
             ref_id, depth_min, depth_max, res.changes, cdr_patches,

@@ -232,6 +232,7 @@ def bam_to_consensus(
     uppercase: bool = False,
     backend: str = "numpy",
     stream_chunk_mb: float | None = None,
+    cdr_gap: int = 0,
 ):
     """Infer consensus for every reference with aligned reads.
 
@@ -257,7 +258,7 @@ def bam_to_consensus(
             min_overlap=min_overlap,
             clip_decay_threshold=clip_decay_threshold, mask_ends=mask_ends,
             trim_ends=trim_ends, uppercase=uppercase, backend=backend,
-            chunk_bytes=int(chunk_mb * (1 << 20)),
+            chunk_bytes=int(chunk_mb * (1 << 20)), cdr_gap=cdr_gap,
         )
 
     consensuses = []
@@ -335,7 +336,7 @@ def bam_to_consensus(
                         min_depth=min_depth, min_overlap=min_overlap,
                         clip_decay_threshold=clip_decay_threshold,
                         mask_ends=mask_ends, trim_ends=trim_ends,
-                        uppercase=uppercase,
+                        uppercase=uppercase, cdr_gap=cdr_gap,
                     )
                 refs_reports[ref_id] = build_report(
                     ref_id, depth_min, depth_max, res.changes, cdr_patches,
@@ -367,6 +368,7 @@ def bam_to_consensus(
                             pileup,
                             clip_decay_threshold=clip_decay_threshold,
                             mask_ends=mask_ends,
+                            max_gap=cdr_gap,
                         )
                         cdr_patches = merge_cdrps(cdrps, min_overlap)
                 else:

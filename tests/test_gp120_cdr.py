@@ -1,0 +1,183 @@
+"""The reference's own disabled gp120 CDR case, exceeded.
+
+/root/reference/tests/test_kindel.py:302-319 ships a commented-out
+("Not yet implemented") test expecting `kindel consensus -r` on
+hxb2-gp120-mutated reads to reconstruct a junction subsequence across a
+divergent region "wrapping 700-1200bp". The input file was never shipped,
+but the failure mode is structural: when the divergent segment is wider
+than the soft-clip extensions, the facing CDR spans never intersect, so
+the reference's pairing (kindel.py:310-316) finds nothing — even though
+the clips from BOTH flanks carry the full novel sequence and merge
+perfectly.
+
+This file reconstructs that scenario (same geometry: a novel segment
+replacing ref[700:1200), the reference's exact expected 56-mer planted
+inside it) and pins that gap pairing (--cdr-gap / cdr_gap=)
+recovers it while the default stays reference-exact.
+"""
+
+import numpy as np
+import pytest
+
+from kindel_tpu.workloads import bam_to_consensus
+
+#: the reference's expected junction subsequence, verbatim
+#: (/root/reference/tests/test_kindel.py:304-306)
+EXPECTED_56MER = (
+    "ATCAACTCAACTGCTGTTAAATGGCAGTCTAGCAGAAGAAGAGGTAGTAATTAGAT"
+)
+
+REF_LEN = 1500
+SEG_START, SEG_END = 700, 1200  # divergent ref span ("wraps 700-1200bp")
+READ_LEN = 150
+
+
+def _gp120_like_sam(tmp_path):
+    """Reads simulated from sample = ref[:700] + NOVEL(100bp, carrying
+    the expected 56-mer) + ref[1200:]: an aligner anchors each read on
+    its longer flank match and soft-clips the rest — exactly the
+    clip-projection structure of the reference's gp120 case."""
+    rng = np.random.default_rng(17)
+    bases = "ACGT"
+
+    def rand_seq(n):
+        return "".join(bases[b] for b in rng.integers(0, 4, size=n))
+
+    novel = rand_seq(22) + EXPECTED_56MER + rand_seq(22)  # 100 bp
+    ref_left = rand_seq(SEG_START)
+    ref_right = rand_seq(REF_LEN - SEG_END)
+    sample = ref_left + novel + ref_right
+    nov_a, nov_b = SEG_START, SEG_START + len(novel)  # novel in sample coords
+
+    def ref_pos(sample_pos):  # sample coord → ref coord (flanks only)
+        return (
+            sample_pos
+            if sample_pos < nov_a
+            else sample_pos - nov_b + SEG_END
+        )
+
+    lines = [b"@HD\tVN:1.6", f"@SQ\tSN:gp120\tLN:{REF_LEN}".encode()]
+    k = 0
+    for s in range(0, len(sample) - READ_LEN + 1, 10):
+        e = s + READ_LEN
+        seq = sample[s:e]
+        left_anchor = max(0, min(e, nov_a) - s) if s < nov_a else 0
+        right_anchor = max(0, e - max(s, nov_b)) if e > nov_b else 0
+        if left_anchor >= READ_LEN:
+            cigar, pos1 = f"{READ_LEN}M", s + 1
+        elif right_anchor >= READ_LEN:
+            cigar, pos1 = f"{READ_LEN}M", ref_pos(s) + 1
+        elif left_anchor >= right_anchor and left_anchor > 0:
+            cigar, pos1 = f"{left_anchor}M{READ_LEN - left_anchor}S", s + 1
+        elif right_anchor > 0:
+            cigar = f"{READ_LEN - right_anchor}S{right_anchor}M"
+            pos1 = ref_pos(e - right_anchor) + 1
+        else:  # fully inside the novel segment: unmapped, aligner drops it
+            continue
+        lines.append(
+            f"r{k}\t0\tgp120\t{pos1}\t60\t{cigar}\t*\t0\t0\t{seq}\t*".encode()
+        )
+        k += 1
+    sam = tmp_path / "hxb2-gp120-mutated.sam"
+    sam.write_bytes(b"\n".join(lines) + b"\n")
+    return sam, sample
+
+
+@pytest.mark.parametrize(
+    "backend,stream_mb",
+    [("numpy", None), ("jax", None), ("numpy", 0.05), ("jax", 0.05)],
+)
+def test_gp120_gap_pairing_recovers_expected_subseq(tmp_path, backend,
+                                                    stream_mb):
+    """With gap pairing on, realign reconstructs the full novel segment
+    (the reference's expected 56-mer included) across the 500 bp
+    divergent span — the assertion the reference's disabled test makes.
+    Covered on the eager AND streamed (chunked-decode) routes of both
+    backends; the cohort path is pinned separately below."""
+    sam, sample = _gp120_like_sam(tmp_path)
+    res = bam_to_consensus(sam, realign=True, min_overlap=7,
+                           backend=backend, cdr_gap=600,
+                           stream_chunk_mb=stream_mb)
+    consensus = res.consensuses[0].sequence.upper()
+    assert EXPECTED_56MER in consensus
+    # the patch reconstructs the entire sample across the junction
+    assert sample.upper() in consensus
+
+
+def test_gp120_default_stays_reference_exact(tmp_path):
+    """Default (gap 0) must reproduce the reference's behavior on this
+    case — no pairing across the gap, so the divergent span stays
+    unpatched — proving the recovery above is non-vacuous AND that
+    default outputs cannot drift from reference parity."""
+    sam, _sample = _gp120_like_sam(tmp_path)
+    res = bam_to_consensus(sam, realign=True, min_overlap=7)
+    assert EXPECTED_56MER not in res.consensuses[0].sequence.upper()
+
+
+def test_gap_pairing_false_pair_rejected(tmp_path, caplog):
+    """Facing clips across a gap that share no real sequence must not
+    merge: gap pairs take the stricter GAP_PAIR_MIN_OVERLAP gate (a
+    chance shared 7-mer between unrelated ~80 bp segments is near-likely;
+    a chance 16-mer is ~1e-6), so the pair logs the no-overlap warning
+    and writes NO patch — the gapped span stays untouched Ns."""
+    import logging
+
+    from kindel_tpu.realign import GAP_PAIR_MIN_OVERLAP, merge_by_lcs
+
+    rng = np.random.default_rng(23)
+    bases = "ACGT"
+
+    def rand_seq(n):
+        return "".join(bases[b] for b in rng.integers(0, 4, size=n))
+
+    # two unrelated divergent events far apart: left reads clip into
+    # segment A, right reads clip into unrelated segment B
+    ref = rand_seq(REF_LEN)
+    lines = [b"@HD\tVN:1.6", f"@SQ\tSN:ctrl\tLN:{REF_LEN}".encode()]
+    seg_a, seg_b = rand_seq(80), rand_seq(80)
+    # non-vacuity: the unrelated extensions must NOT clear the gap gate
+    # (they may well share a >=7-mer — that is exactly the hazard)
+    assert merge_by_lcs(seg_a, seg_b, GAP_PAIR_MIN_OVERLAP) is None
+    k = 0
+    for _ in range(15):
+        lines.append(
+            f"a{k}\t0\tctrl\t{601 - 70}\t60\t70M80S\t*\t0\t0\t"
+            f"{ref[530:600] + seg_a}\t*".encode()
+        )
+        lines.append(
+            f"b{k}\t0\tctrl\t1101\t60\t80S70M\t*\t0\t0\t"
+            f"{seg_b + ref[1100:1170]}\t*".encode()
+        )
+        k += 1
+    sam = tmp_path / "falsepair.sam"
+    sam.write_bytes(b"\n".join(lines) + b"\n")
+    with caplog.at_level(logging.WARNING):
+        res = bam_to_consensus(sam, realign=True, min_overlap=7,
+                               cdr_gap=600)
+    # the failed merge is logged with the escalated gate...
+    assert any(
+        "No overlap found" in r.message
+        and f"min_overlap = {GAP_PAIR_MIN_OVERLAP}" in r.message
+        for r in caplog.records
+    )
+    # ...and the uncovered span stays unpatched Ns (no invented sequence)
+    consensus = res.consensuses[0].sequence.upper()
+    assert seg_a not in consensus and seg_b not in consensus
+    span = consensus[700:1050]
+    assert set(span) == {"N"}
+
+
+def test_gp120_gap_pairing_cohort_path(tmp_path):
+    """The cohort batch realign path (device CDR triggers + lazy window
+    fetches) honors cdr_gap too and matches the single-file result."""
+    from kindel_tpu.batch import batch_bam_to_results
+
+    sam, sample = _gp120_like_sam(tmp_path)
+    single = bam_to_consensus(sam, realign=True, min_overlap=7, cdr_gap=600)
+    cohort = batch_bam_to_results(
+        [sam], realign=True, min_overlap=7, cdr_gap=600
+    )[sam]
+    assert [s.sequence for s in cohort.consensuses] == [
+        s.sequence for s in single.consensuses
+    ]
+    assert EXPECTED_56MER in cohort.consensuses[0].sequence.upper()
