@@ -102,22 +102,55 @@ def _run_benchmark() -> dict:
     from kindel_tpu.call_jax import call_consensus_fused
     from kindel_tpu.pileup import build_pileup  # noqa: F401 (import check)
 
-    # warmup: trigger jit compilation with the real shapes
-    batch = load_alignment(bam)
-    ev = extract_events(batch)
-    rid = ev.present_ref_ids[0]
-    _ = call_consensus_fused(ev, rid, build_changes=False)[0]
+    def one_pass():
+        batch = load_alignment(bam)
+        ev = extract_events(batch)
+        total = 0
+        for rid in ev.present_ref_ids:
+            res, _dmin, _dmax = call_consensus_fused(
+                ev, rid, build_changes=False
+            )
+            total += int(ev.ref_lens[rid])
+            assert len(res.sequence) > 0
+        return total
+
+    # Slab autotune: the pipelined default (KINDEL_TPU_SLABS=4) overlaps
+    # wire with compute, but on a high-latency tunneled link the extra
+    # per-slab dispatches could cost more than the overlap saves — which
+    # way it goes is a property of THIS link, so measure both once
+    # (warmup compiles each config; the persistent compile cache makes
+    # repeat runs cheap) and time the production path with the winner.
+    # An explicit KINDEL_TPU_SLABS pins the config and skips the tune.
+    # the per-contig clamp (call_jax: n_slabs <= len//65536) makes both
+    # configs identical on small-contig inputs — skip the redundant tune
+    # and report the true effective count there
+    probe = extract_events(load_alignment(bam))
+    max_contig = max(
+        (int(probe.ref_lens[r]) for r in probe.present_ref_ids), default=0
+    )
+    clamp = max(1, max_contig // 65536)
+    if os.environ.get("KINDEL_TPU_SLABS"):
+        chosen = min(max(1, int(os.environ["KINDEL_TPU_SLABS"])), clamp)
+        one_pass()  # warmup/compile
+    elif clamp <= 1:
+        chosen = 1
+        os.environ["KINDEL_TPU_SLABS"] = "1"
+        one_pass()
+    else:
+        timings = {}
+        for slabs in ("1", "4"):
+            os.environ["KINDEL_TPU_SLABS"] = slabs
+            one_pass()  # warmup/compile for this config
+            t0 = time.perf_counter()
+            one_pass()
+            timings[int(slabs)] = time.perf_counter() - t0
+        chosen = min(min(timings, key=timings.get), clamp)
+        os.environ["KINDEL_TPU_SLABS"] = str(chosen)
 
     # timed: full pipeline — decode, event extraction, device reduce+call,
     # host assembly (jit cache warm, as in steady-state batch processing)
     t0 = time.perf_counter()
-    batch = load_alignment(bam)
-    ev = extract_events(batch)
-    total_bases = 0
-    for rid in ev.present_ref_ids:
-        res, _dmin, _dmax = call_consensus_fused(ev, rid, build_changes=False)
-        total_bases += int(ev.ref_lens[rid])
-        assert len(res.sequence) > 0
+    total_bases = one_pass()
     elapsed = time.perf_counter() - t0
 
     mbases_per_s = total_bases / elapsed / 1e6
@@ -127,6 +160,7 @@ def _run_benchmark() -> dict:
         "unit": "Mbases/s",
         "vs_baseline": round(mbases_per_s / BASELINE_MBASES_PER_S, 1),
         "backend": jax.default_backend(),
+        "slabs": chosen,
     }
 
 
