@@ -36,7 +36,10 @@ BACT_BAM = Path(
 )
 BASELINE_MBASES_PER_S = 0.069  # reference end-to-end, 1 CPU core (SURVEY §6)
 
-TPU_ATTEMPT_TIMEOUT_S = 420.0  # first compile ~20-40s + tunneled transfers
+#: first compiles ~20-40 s each (the slab autotune compiles up to three
+#: distinct configs on a cold cache) + tunneled transfers; must stay
+#: under the relay watcher's 900 s kill window minus the 300 s CPU child
+TPU_ATTEMPT_TIMEOUT_S = 560.0
 CPU_ATTEMPT_TIMEOUT_S = 300.0
 #: how long to wait for the relay to answer before falling back — the
 #: round-2 verdict flagged a single 30 s probe as throwing away whole
@@ -138,22 +141,35 @@ def _run_benchmark() -> dict:
         one_pass()
     else:
         timings = {}
-        for slabs in ("1", "4"):
-            os.environ["KINDEL_TPU_SLABS"] = slabs
+        # dedupe configs the per-contig clamp collapses (e.g. clamp 2
+        # makes "2" and "4" identical) — each distinct effective config
+        # is compiled and timed exactly once
+        for slabs in sorted({min(s, clamp) for s in (1, 2, 4)}):
+            os.environ["KINDEL_TPU_SLABS"] = str(slabs)
             one_pass()  # warmup/compile for this config
-            t0 = time.perf_counter()
-            one_pass()
-            timings[int(slabs)] = time.perf_counter() - t0
-        chosen = min(min(timings, key=timings.get), clamp)
+            # best-of-2: single-pass times are noisy on shared hosts and
+            # a mispick costs the whole headline number
+            walls = []
+            for _ in range(2):
+                t0 = time.perf_counter()
+                one_pass()
+                walls.append(time.perf_counter() - t0)
+            timings[slabs] = min(walls)
+        chosen = min(timings, key=timings.get)
         os.environ["KINDEL_TPU_SLABS"] = str(chosen)
 
     # timed: full pipeline — decode, event extraction, device reduce+call,
-    # host assembly (jit cache warm, as in steady-state batch processing)
-    t0 = time.perf_counter()
-    total_bases = one_pass()
-    elapsed = time.perf_counter() - t0
+    # host assembly (jit cache warm, as in steady-state batch processing).
+    # Best of 3 trials: single-shot walls swing ±40% on shared hosts /
+    # contended tunnels, and the recorded number must be comparable
+    # across rounds.
+    walls = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        total_bases = one_pass()
+        walls.append(time.perf_counter() - t0)
 
-    mbases_per_s = total_bases / elapsed / 1e6
+    mbases_per_s = total_bases / min(walls) / 1e6
     return {
         "metric": "consensus_throughput_bacterial",
         "value": round(mbases_per_s, 3),
@@ -161,6 +177,7 @@ def _run_benchmark() -> dict:
         "vs_baseline": round(mbases_per_s / BASELINE_MBASES_PER_S, 1),
         "backend": jax.default_backend(),
         "slabs": chosen,
+        "trials": [round(w, 3) for w in walls],
     }
 
 

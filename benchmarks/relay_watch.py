@@ -96,8 +96,8 @@ def run_bench() -> dict:
     try:
         # the watcher has just probed relay + PJRT init on its own
         # cadence — pin bench to one TPU attempt with its own pre-flight
-        # suppressed, so worst case (~15 s relay wait + 420 s TPU child +
-        # 300 s CPU child ≈ 735 s) stays inside this 900 s kill window
+        # suppressed, so worst case (~15 s relay wait + 560 s TPU child +
+        # 300 s CPU child ≈ 875 s) stays inside this 900 s kill window
         env = dict(os.environ)
         env["KINDEL_TPU_BENCH_RELAY_WAIT_S"] = "15"
         env["KINDEL_TPU_BENCH_TPU_ATTEMPTS"] = "1"
