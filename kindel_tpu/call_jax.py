@@ -286,7 +286,33 @@ def unpack_base_codes(base_packed: np.ndarray, n_events: int) -> np.ndarray:
     return codes[:n_events]
 
 
-def pack_kernel_args(u: "CallUnit", min_depth: int = 1):
+def pad_geometry(units):
+    """Bucketed pad maxima across `units` plus each unit's unpacked base
+    codes and N-event indices — the single source of the upload-buffer
+    bucket minimums, shared by the per-unit default and the slab sweep
+    (which packs every slab with the sweep maxima so one compiled kernel
+    serves all slabs). Returns (pads, [(codes, n_idx), ...])."""
+    per_unit = []
+    o = b = nn = d = i = 0
+    for u in units:
+        codes = getattr(u, "base_codes", None)
+        if codes is None:
+            codes = unpack_base_codes(u.base_packed, u.n_events)
+        n_idx = np.flatnonzero(codes == N_CHANNELS - 1).astype(np.int32)
+        per_unit.append((codes, n_idx))
+        o = max(o, len(u.op_r_start))
+        b = max(b, -(-u.n_events // 4))
+        nn = max(nn, len(n_idx))
+        d = max(d, len(u.del_pos))
+        i = max(i, len(u.ins_pos))
+    pads = (
+        _bucket(o, 256), _bucket(b, 512), _bucket(nn, 64),
+        _bucket(d, 256), _bucket(i, 256),
+    )
+    return pads, per_unit
+
+
+def pack_kernel_args(u: "CallUnit", min_depth: int = 1, geometry=None):
     """Pad + pack one unit's event arrays AND the two scalars into a
     single uint8 upload buffer (one h2d round trip instead of eight).
     Base codes ship as a 2-bit plane plus a sparse list of N-event
@@ -297,17 +323,15 @@ def pack_kernel_args(u: "CallUnit", min_depth: int = 1):
      n_idx 4·NN | del_pos 4·D | ins_pos 4·I | ins_cnt 4·I |
      n_events 4 | min_depth 4]
     Returns (buf, (o_pad, b_pad, nn_pad, d_pad, i_pad)) — the pad
-    geometry is static (bucketed) and keys the kernel's compile cache."""
-    codes = getattr(u, "base_codes", None)
-    if codes is None:
-        codes = unpack_base_codes(u.base_packed, u.n_events)
-    n_idx = np.flatnonzero(codes == N_CHANNELS - 1).astype(np.int32)
-
-    O_pad = _bucket(len(u.op_r_start), 256)
-    B_pad = _bucket(-(-len(codes) // 4), 512)
-    NN_pad = _bucket(len(n_idx), 64)
-    D_pad = _bucket(len(u.del_pos), 256)
-    I_pad = _bucket(len(u.ins_pos), 256)
+    geometry is static (bucketed) and keys the kernel's compile cache.
+    `geometry` supplies a caller-chosen (pads, (codes, n_idx)) pair from
+    pad_geometry — the slab pipeline packs every slab with the sweep's
+    shared maxima so one compiled kernel serves all slabs."""
+    if geometry is None:
+        pads, ((codes, n_idx),) = pad_geometry([u])
+    else:
+        pads, (codes, n_idx) = geometry
+    O_pad, B_pad, NN_pad, D_pad, I_pad = pads
     plane2 = np.zeros(4 * B_pad, dtype=np.uint8)
     plane2[: len(codes)] = codes & 3
     plane2_packed = (
@@ -382,6 +406,16 @@ def fused_call_kernel_packed(buf, *, o_pad: int, b_pad: int, nn_pad: int,
     [comp_plane C/4 | exc_cov C/8 | del_flags ⌈D/8⌉ | ins_flags ⌈I/8⌉ | 8B]
     (_wire_sizes is the single source of truth for these offsets;
     unpack_wire decodes)."""
+    return _call_from_packed_buf(
+        buf, o_pad, b_pad, nn_pad, d_pad, i_pad, length, want_masks,
+        c_pad,
+    )
+
+
+def _call_from_packed_buf(buf, o_pad, b_pad, nn_pad, d_pad, i_pad,
+                          length, want_masks, c_pad):
+    """Traced body shared by the whole-buffer kernel above and the
+    slab-sweep kernel below."""
     (op_r_start, op_off, base, del_pos, ins_pos, ins_cnt, n_events,
      min_depth, valid_len) = _unpack_kernel_args(
         buf, o_pad, b_pad, nn_pad, d_pad, i_pad
@@ -391,6 +425,26 @@ def fused_call_kernel_packed(buf, *, o_pad: int, b_pad: int, nn_pad: int,
         min_depth, length, want_masks, valid_len=valid_len, c_pad=c_pad,
     )
     return _pack_wire(main, parts, dmin, dmax)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("size", "o_pad", "b_pad", "nn_pad", "d_pad", "i_pad",
+                     "length", "c_pad"),
+)
+def fused_call_kernel_slab(big_buf, offset, *, size: int, o_pad: int,
+                           b_pad: int, nn_pad: int, d_pad: int,
+                           i_pad: int, length: int,
+                           c_pad: int | None = None):
+    """One slab of a pipelined sweep: slice this slab's packed upload out
+    of the sweep's single concatenated h2d buffer (traced offset, so ONE
+    compiled executable serves every slab) and run the fused call. The
+    slab pipeline packs all slabs with shared pad maxima, so `size` and
+    every pad are sweep-constants."""
+    buf = jax.lax.dynamic_slice(big_buf, (offset,), (size,))
+    return _call_from_packed_buf(
+        buf, o_pad, b_pad, nn_pad, d_pad, i_pad, length, False, c_pad
+    )
 
 
 def _wire_sizes(length: int, d_pad: int, i_pad: int, want_masks: bool,

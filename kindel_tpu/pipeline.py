@@ -40,8 +40,9 @@ from kindel_tpu.call_jax import (
     covered_index,
     decode_compact,
     decode_fast,
-    fused_call_kernel_packed,
+    fused_call_kernel_slab,
     pack_kernel_args,
+    pad_geometry,
     unpack_base_codes,
     unpack_wire,
 )
@@ -121,27 +122,42 @@ def pipelined_consensus(
     assert n_slabs > 1, "caller clamps (call_consensus_fused routes n==1)"
     slabs = _slab_views(u, n_slabs)
 
-    # dispatch every slab asynchronously, then queue its d2h copy
+    # Shared sweep geometry: every slab packs to the sweep's pad maxima,
+    # so ONE kernel compilation serves all slabs (per-slab bucketing
+    # could otherwise trigger up to n_slabs cold compiles) and the
+    # uploads concatenate into ONE h2d transfer (one round trip on a
+    # tunneled link instead of n_slabs).
     compact = _use_compact_wire()
+    covs = [
+        covered_index(sl.op_r_start, sl.op_lens_arr) if compact else None
+        for sl in slabs
+    ]
+    c_pad = (
+        _compact_bucket(max(len(c) for c in covs)) if compact else None
+    )
+    pads, per_slab = pad_geometry(slabs)
+    bufs = [
+        pack_kernel_args(sl, min_depth, geometry=(pads, per_slab[i]))[0]
+        for i, sl in enumerate(slabs)
+    ]
+    size = len(bufs[0])
+    assert all(len(b) == size for b in bufs)
+    big = jnp.asarray(np.concatenate(bufs))
+    o_pad, b_pad, nn_pad, d_pad, i_pad = pads
+
+    # dispatch every slab asynchronously, then queue its d2h copy
     inflight = []
-    for sl in slabs:
-        up, (o_pad, b_pad, nn_pad, d_pad, i_pad) = pack_kernel_args(
-            sl, min_depth
-        )
-        cov = c_pad = None
-        if compact:
-            cov = covered_index(sl.op_r_start, sl.op_lens_arr)
-            c_pad = _compact_bucket(len(cov))
-        wire = fused_call_kernel_packed(
-            jnp.asarray(up), o_pad=o_pad, b_pad=b_pad, nn_pad=nn_pad,
-            d_pad=d_pad, i_pad=i_pad, length=sl.L, want_masks=False,
+    for i, sl in enumerate(slabs):
+        wire = fused_call_kernel_slab(
+            big, jnp.int32(i * size), size=size, o_pad=o_pad, b_pad=b_pad,
+            nn_pad=nn_pad, d_pad=d_pad, i_pad=i_pad, length=sl.L,
             c_pad=c_pad,
         )
         try:
             wire.copy_to_host_async()
         except AttributeError:
             pass  # CPU arrays in some jax versions
-        inflight.append((sl, cov, c_pad, d_pad, i_pad, wire))
+        inflight.append((sl, covs[i], c_pad, d_pad, i_pad, wire))
 
     # decode slab k (shared wire decoders) while slabs k+1.. compute /
     # transfer; each slab's [0, valid_len) window is spliced into the
