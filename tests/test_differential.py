@@ -326,3 +326,70 @@ def test_cdr_heavy_realign_matches_reference(seed, tmp_path):
         )
         assert res.consensuses[0].sequence == ref_seq, (seed, backend)
         assert res.refs_changes["ref1"] == ref_changes, (seed, backend)
+
+
+_FUZZ_ORACLES: dict = {}
+
+
+@pytest.mark.parametrize("force_fused", ["1", ""])
+@pytest.mark.parametrize("seed", range(6))
+def test_fused_vs_oracle_fuzz_slab_scale(seed, force_fused, tmp_path,
+                                         monkeypatch):
+    """End-to-end jax-vs-numpy consensus equality on randomized
+    alignments at slab-exercising reference lengths (>=2 slabs after the
+    64k clamp): random sparse coverage, indels, clips, N bases, reads at
+    the extreme ends — the compact-covered wire and slab boundaries see
+    arbitrary geometry, not just the curated corpus. force_fused pins
+    the single-device slab pipeline; without it the 8-device mesh
+    routes through the sharded product path, so both jax routes fuzz."""
+    from kindel_tpu.workloads import bam_to_consensus
+
+    if force_fused:
+        monkeypatch.setenv("KINDEL_TPU_FORCE_FUSED", force_fused)
+    else:
+        # an ambient export would silently pin BOTH legs to the fused
+        # path and the sharded route would go untested
+        monkeypatch.delenv("KINDEL_TPU_FORCE_FUSED", raising=False)
+
+    rng = np.random.default_rng(1000 + seed)
+    L = int(rng.integers(140_000, 400_000))
+    lines = [b"@HD\tVN:1.6", f"@SQ\tSN:fz\tLN:{L}".encode()]
+    n_reads = int(rng.integers(30, 120))
+    for i in range(n_reads):
+        rl = int(rng.integers(40, 180))
+        pos = int(rng.integers(0, L - rl))
+        seq = "".join("ACGTN"[b] for b in rng.choice(
+            5, size=rl, p=[0.24, 0.24, 0.24, 0.24, 0.04]
+        ))
+        roll = rng.random()
+        m = rl - 12
+        if roll < 0.2:
+            cigar = f"6S{m}M6S"
+        elif roll < 0.4:
+            cigar = f"{m // 2}M{rl - m}D{m - m // 2}M"
+            seq = seq[:m]
+        elif roll < 0.55:
+            cigar = f"{m // 2}M{rl - m}I{m - m // 2}M"
+        else:
+            cigar = f"{rl}M"
+        lines.append(
+            f"r{i}\t0\tfz\t{pos + 1}\t60\t{cigar}\t*\t0\t0\t{seq}\t*".encode()
+        )
+    # pin reads at both extreme ends (slab 0 head, last slab tail)
+    lines.append(f"re0\t0\tfz\t1\t60\t50M\t*\t0\t0\t{'A' * 50}\t*".encode())
+    lines.append(
+        f"re1\t0\tfz\t{L - 49}\t60\t50M\t*\t0\t0\t{'C' * 50}\t*".encode()
+    )
+    # the oracle (and the SAM path, which the report text embeds) is
+    # independent of force_fused — compute once per seed and share the
+    # file across both legs so report comparison stays byte-exact
+    if seed not in _FUZZ_ORACLES:
+        sam = tmp_path / "fuzz.sam"
+        sam.write_bytes(b"\n".join(lines) + b"\n")
+        _FUZZ_ORACLES[seed] = (sam, bam_to_consensus(sam, backend="numpy"))
+    sam, np_res = _FUZZ_ORACLES[seed]
+    jx_res = bam_to_consensus(sam, backend="jax")
+    assert (
+        np_res.consensuses[0].sequence == jx_res.consensuses[0].sequence
+    ), f"seed={seed} L={L}"
+    assert np_res.refs_reports == jx_res.refs_reports
