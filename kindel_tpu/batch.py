@@ -61,6 +61,7 @@ class BatchOptions:
     clip_decay_threshold: float = 0.1
     mask_ends: int = 50
     cdr_gap: int = 0
+    fix_clip_artifacts: bool = False
     trim_ends: bool = False
     uppercase: bool = False
     build_reports: bool = False
@@ -113,6 +114,7 @@ def batch_bam_to_results(
     clip_decay_threshold: float = 0.1,
     mask_ends: int = 50,
     cdr_gap: int = 0,
+    fix_clip_artifacts: bool = False,
     trim_ends: bool = False,
     uppercase: bool = False,
     build_reports: bool = True,
@@ -128,7 +130,8 @@ def batch_bam_to_results(
     opts = BatchOptions(
         realign=realign, min_depth=min_depth, min_overlap=min_overlap,
         clip_decay_threshold=clip_decay_threshold, mask_ends=mask_ends,
-        cdr_gap=cdr_gap, trim_ends=trim_ends, uppercase=uppercase,
+        cdr_gap=cdr_gap, fix_clip_artifacts=fix_clip_artifacts,
+        trim_ends=trim_ends, uppercase=uppercase,
         build_reports=build_reports, build_changes=build_changes,
     )
     bam_paths = list(bam_paths)
@@ -315,7 +318,8 @@ def _dispatch_device_call(units, opts: BatchOptions):
         batched_realign_call_kernel if opts.realign else batched_call_kernel
     )
     out = kernel(
-        *dev_arrays, jnp.int32(opts.min_depth), length=L,
+        *dev_arrays, jnp.int32(opts.min_depth),
+        jnp.int32(1 if opts.fix_clip_artifacts else 0), length=L,
         want_masks=opts.want_masks,
     )
     # meta the host decoder needs to slice each row's packed wire
@@ -400,6 +404,7 @@ def _assemble_outputs(units, device_out, opts: BatchOptions, pool,
             ).cdr_patches_from_triggers(
                 trig_f, trig_r, opts.clip_decay_threshold,
                 opts.mask_ends, opts.min_overlap, max_gap=opts.cdr_gap,
+                flank_dedup=opts.fix_clip_artifacts,
             )
         if opts.want_masks:
             _emit, masks = masks_from_wire(

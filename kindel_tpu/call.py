@@ -71,9 +71,18 @@ def compute_masks(
     deletions: np.ndarray,
     ins_totals: np.ndarray,
     min_depth: int,
+    strict_ins: bool = False,
 ) -> CallMasks:
     """Vectorized per-position decisions over a [L,5] count block.
-    `deletions`/`ins_totals` are the first L entries of their tensors."""
+    `deletions`/`ins_totals` are the first L entries of their tensors.
+
+    strict_ins (the --fix-clip-artifacts rule, default off =
+    reference-exact): an insertion may only emit where
+    min(depth, depth_next) > 0. The reference's threshold
+    `ins·2 > min(cur, next)` (kindel.py:419-422) degenerates at coverage
+    boundaries — with a zero floor a SINGLE stray insertion-carrying
+    read fabricates sequence, the documented 'unwanted insertion at
+    1284' of its disabled issue23-bc75 test."""
     L = len(weights)
     acgt_depth = weights[:, :4].sum(axis=1)
     depth_next = np.r_[acgt_depth[1:], 0]  # lookahead halo (:405-410)
@@ -85,11 +94,10 @@ def compute_masks(
     # integer-exact thresholds (d > 0.5*a ⟺ 2d > a) — avoids float temporaries
     del_mask = deletions[:L].astype(np.int64) * 2 > acgt_depth
     n_mask = ~del_mask & (acgt_depth < min_depth)
-    ins_mask = (
-        ~del_mask
-        & ~n_mask
-        & (ins_totals[:L] * 2 > np.minimum(acgt_depth, depth_next))
-    )
+    floor = np.minimum(acgt_depth, depth_next)
+    ins_mask = ~del_mask & ~n_mask & (ins_totals[:L] * 2 > floor)
+    if strict_ins:
+        ins_mask &= floor > 0
     return CallMasks(base_char, del_mask, n_mask, ins_mask)
 
 
@@ -205,6 +213,7 @@ def call_consensus(
     min_depth: int = 1,
     uppercase: bool = False,
     build_changes: bool = True,
+    strict_ins: bool = False,
 ) -> CallResult:
     L = pileup.ref_len
     masks = compute_masks(
@@ -212,6 +221,7 @@ def call_consensus(
         pileup.deletions[:L],
         pileup.ins.totals[:L].astype(np.int64),
         min_depth,
+        strict_ins=strict_ins,
     )
     ins_calls = _insertion_calls(pileup.ins) if masks.ins_mask.any() else {}
     return assemble(

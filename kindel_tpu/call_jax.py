@@ -82,6 +82,7 @@ def _call_core(
     valid_len=None,  # optional int32 scalar: row's true ref length
     keep_dense: bool = False,
     c_pad: int | None = None,  # static: compact-covered wire width
+    flags=None,  # traced int32 scalar: bit 0 = strict insertions
 ):
     """Reconstruct match events, scatter counts, call every position.
 
@@ -98,13 +99,14 @@ def _call_core(
     return _call_core_codes(
         op_r_start, op_off, base, del_pos, ins_pos, ins_cnt, n_events,
         min_depth, length, want_masks, valid_len, keep_dense, c_pad,
+        flags,
     )
 
 
 def _call_core_codes(
     op_r_start, op_off, base, del_pos, ins_pos, ins_cnt, n_events,
     min_depth, length: int, want_masks: bool, valid_len=None,
-    keep_dense: bool = False, c_pad: int | None = None,
+    keep_dense: bool = False, c_pad: int | None = None, flags=None,
 ):
     """_call_core after base-code unpacking — entry point for upload
     formats that decode their own codes (the 2-bit + sparse-N packed
@@ -133,7 +135,7 @@ def _call_core_codes(
     )
     out = _decide(
         weights, deletions, ins_totals, del_pos, ins_pos, min_depth,
-        want_masks, valid_len, c_pad=c_pad,
+        want_masks, valid_len, c_pad=c_pad, flags=flags,
     )
     if keep_dense:
         return out + (weights, deletions)
@@ -141,14 +143,17 @@ def _call_core_codes(
 
 
 def _decide(weights, deletions, ins_totals, del_pos, ins_pos, min_depth,
-            want_masks: bool, valid_len=None, c_pad: int | None = None):
+            want_masks: bool, valid_len=None, c_pad: int | None = None,
+            flags=None):
     """Per-position call decisions + wire-format packing over count
     tensors — the second half of _call_core, shared with the streamed
     counts-input kernel (counts_call_kernel). del_pos/ins_pos feed the
     fast path's sparse flag gathers only (unused when want_masks).
     valid_len (traced scalar) masks the depth-report min/max to a row's
     true reference length when the position axis is padded to a batch
-    maximum (kindel_tpu.batch)."""
+    maximum (kindel_tpu.batch). `flags` is a traced int32 scalar (no
+    recompile per mode): bit 0 = strict insertions — see
+    call.compute_masks(strict_ins=...)."""
     length = weights.shape[0]
     acgt_depth = weights[:, :4].sum(axis=1)
     depth_next = jnp.concatenate([acgt_depth[1:], jnp.zeros(1, jnp.int32)])
@@ -169,11 +174,11 @@ def _decide(weights, deletions, ins_totals, del_pos, ins_pos, min_depth,
     # integer-exact thresholds: d > 0.5*a  ⟺  2d > a
     del_mask = deletions * 2 > acgt_depth
     n_mask = ~del_mask & (acgt_depth < min_depth)
-    ins_mask = (
-        ~del_mask
-        & ~n_mask
-        & (ins_totals * 2 > jnp.minimum(acgt_depth, depth_next))
-    )
+    floor = jnp.minimum(acgt_depth, depth_next)
+    ins_mask = ~del_mask & ~n_mask & (ins_totals * 2 > floor)
+    if flags is not None:
+        strict_ins = (flags & 1) != 0
+        ins_mask &= ~(strict_ins & (floor == 0))
 
     if want_masks:
         emit = jnp.where(
@@ -312,7 +317,8 @@ def pad_geometry(units):
     return pads, per_unit
 
 
-def pack_kernel_args(u: "CallUnit", min_depth: int = 1, geometry=None):
+def pack_kernel_args(u: "CallUnit", min_depth: int = 1, geometry=None,
+                     flags: int = 0):
     """Pad + pack one unit's event arrays AND the two scalars into a
     single uint8 upload buffer (one h2d round trip instead of eight).
     Base codes ship as a 2-bit plane plus a sparse list of N-event
@@ -349,7 +355,8 @@ def pack_kernel_args(u: "CallUnit", min_depth: int = 1, geometry=None):
         _pad(u.ins_cnt, I_pad, 0).view(np.uint8),
         np.asarray(
             [u.n_events, min_depth,
-             u.L if getattr(u, "valid_len", None) is None else u.valid_len],
+             u.L if getattr(u, "valid_len", None) is None else u.valid_len,
+             flags],
             np.int32,
         ).view(np.uint8),
     ]
@@ -377,14 +384,14 @@ def _unpack_kernel_args(buf, o_pad: int, b_pad: int, nn_pad: int,
     del_pos = i32(buf[offs[4]: offs[5]])
     ins_pos = i32(buf[offs[5]: offs[6]])
     ins_cnt = i32(buf[offs[6]: offs[7]])
-    scalars = i32(buf[offs[7]: offs[7] + 12])
+    scalars = i32(buf[offs[7]: offs[7] + 16])
     base = jnp.stack(
         [plane2 >> 6, (plane2 >> 4) & 3, (plane2 >> 2) & 3, plane2 & 3],
         axis=1,
     ).reshape(4 * b_pad).astype(jnp.int32)
     base = base.at[n_idx].set(N_CHANNELS - 1, mode="drop")
     return (op_r_start, op_off, base, del_pos, ins_pos, ins_cnt,
-            scalars[0], scalars[1], scalars[2])
+            scalars[0], scalars[1], scalars[2], scalars[3])
 
 
 @partial(
@@ -417,12 +424,13 @@ def _call_from_packed_buf(buf, o_pad, b_pad, nn_pad, d_pad, i_pad,
     """Traced body shared by the whole-buffer kernel above and the
     slab-sweep kernel below."""
     (op_r_start, op_off, base, del_pos, ins_pos, ins_cnt, n_events,
-     min_depth, valid_len) = _unpack_kernel_args(
+     min_depth, valid_len, flags) = _unpack_kernel_args(
         buf, o_pad, b_pad, nn_pad, d_pad, i_pad
     )
     main, parts, dmin, dmax = _call_core_codes(
         op_r_start, op_off, base, del_pos, ins_pos, ins_cnt, n_events,
         min_depth, length, want_masks, valid_len=valid_len, c_pad=c_pad,
+        flags=flags,
     )
     return _pack_wire(main, parts, dmin, dmax)
 
@@ -477,7 +485,8 @@ def unpack_wire(buf: np.ndarray, length: int, d_pad: int, i_pad: int,
 
 
 @jax.jit
-def counts_call_kernel(weights, deletions, ins_totals, min_depth):
+def counts_call_kernel(weights, deletions, ins_totals, min_depth,
+                       flags=0):
     """Call decisions straight from device-resident count tensors — the
     closing step of the streamed-accumulation path (kindel_tpu.streaming),
     where the scatters already happened chunk-by-chunk. Always the masks
@@ -485,13 +494,13 @@ def counts_call_kernel(weights, deletions, ins_totals, min_depth):
     empty = jnp.zeros(0, jnp.int32)
     return _decide(
         weights, deletions, ins_totals, empty, empty, min_depth,
-        want_masks=True,
+        want_masks=True, flags=flags,
     )
 
 
 @partial(jax.jit, static_argnames=("length", "want_masks"))
 def batched_call_kernel(op_r_start, op_off, base_packed, del_pos, ins_pos,
-                        ins_cnt, n_events, ref_lens, min_depth, *,
+                        ins_cnt, n_events, ref_lens, min_depth, flags=0, *,
                         length: int, want_masks: bool = False):
     """vmapped fused call over a batch of samples (leading axis B).
 
@@ -508,7 +517,7 @@ def batched_call_kernel(op_r_start, op_off, base_packed, del_pos, ins_pos,
     def one(ors, oo, bp, dp, ip, ic, ne, rl):
         main, parts, dmin, dmax = _call_core(
             ors, oo, bp, dp, ip, ic, ne, min_depth, length, want_masks,
-            valid_len=rl,
+            valid_len=rl, flags=flags,
         )
         return _pack_wire(main, parts, dmin, dmax)
 
@@ -522,7 +531,7 @@ def batched_call_kernel(op_r_start, op_off, base_packed, del_pos, ins_pos,
 def batched_realign_call_kernel(
     op_r_start, op_off, base_packed, del_pos, ins_pos, ins_cnt,
     n_events, ref_lens, csw_pos, csw_base, cew_pos, cew_base, min_depth,
-    *, length: int, want_masks: bool = False,
+    flags=0, *, length: int, want_masks: bool = False,
 ):
     """Batched call + on-device CDR trigger computation (cohort --realign).
 
@@ -539,7 +548,7 @@ def batched_realign_call_kernel(
     def one_full(ors, oo, bp, dp, ip, ic, ne, rl, cswp, cswb, cewp, cewb):
         out = _call_core(
             ors, oo, bp, dp, ip, ic, ne, min_depth, length, want_masks,
-            valid_len=rl, keep_dense=True,
+            valid_len=rl, keep_dense=True, flags=flags,
         )
         (main, parts, dmin, dmax), (weights, deletions) = out[:4], out[4:]
 
@@ -790,7 +799,7 @@ class CallUnit:
 
 
 def device_call(ev: EventSet, rid: int, min_depth: int = 1,
-                want_masks: bool = True):
+                want_masks: bool = True, flags: int = 0):
     """Run the fused kernel for one reference.
 
     Returns (emit_codes, masks, depth_min, depth_max). With want_masks,
@@ -800,7 +809,7 @@ def device_call(ev: EventSet, rid: int, min_depth: int = 1,
     u = CallUnit(ev, rid)
     L, ip = u.L, u.ins_pos
     up, (o_pad, b_pad, nn_pad, d_pad, i_pad) = pack_kernel_args(
-        u, min_depth
+        u, min_depth, flags=flags
     )
     c_pad = None
     covered_idx = None
@@ -842,6 +851,7 @@ def call_consensus_fused(
     min_depth: int = 1,
     uppercase: bool = False,
     build_changes: bool = True,
+    strict_ins: bool = False,
 ) -> tuple[CallResult, int, int]:
     """Fused-device equivalent of kindel_tpu.call.call_consensus. `pileup`
     supplies insertion-string majority resolution when insertions emit.
@@ -871,10 +881,11 @@ def call_consensus_fused(
             return pipelined_consensus(
                 ev, rid, n_slabs, pileup=pileup, cdr_patches=cdr_patches,
                 trim_ends=trim_ends, min_depth=min_depth,
-                uppercase=uppercase,
+                uppercase=uppercase, strict_ins=strict_ins,
             )
     _emit, masks, dmin, dmax = device_call(
-        ev, rid, min_depth, want_masks=build_changes
+        ev, rid, min_depth, want_masks=build_changes,
+        flags=1 if strict_ins else 0,
     )
     ins_calls = {}
     if masks.ins_mask.any():

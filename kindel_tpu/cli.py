@@ -77,6 +77,15 @@ def _consensus_parser(sub):
              "rejects false pairs. 0 (default) = reference-exact pairing",
     )
     p.add_argument(
+        "--fix-clip-artifacts", action="store_true",
+        help="fix two boundary artifacts the reference's own disabled "
+             "issue23 test documents: insertions no longer emit where the "
+             "min(depth, next-depth) threshold floor is zero (one stray "
+             "read fabricated sequence), and a clip extension's first base "
+             "that duplicates the unambiguous flank consensus is dropped. "
+             "Off by default = reference-exact output",
+    )
+    p.add_argument(
         "-t", "--trim-ends", action="store_true",
         help="trim ambiguous nucleotides (Ns) from sequence ends",
     )
@@ -122,6 +131,7 @@ def cmd_consensus(args) -> int:
             backend=args.backend,
             stream_chunk_mb=args.stream_chunk_mb,
             cdr_gap=args.cdr_gap,
+            fix_clip_artifacts=args.fix_clip_artifacts,
         )
     finally:
         if timer is not None:
@@ -275,6 +285,8 @@ def cmd_batch(args) -> int:
         min_overlap=args.min_overlap,
         clip_decay_threshold=args.clip_decay_threshold,
         mask_ends=args.mask_ends,
+        cdr_gap=args.cdr_gap,
+        fix_clip_artifacts=args.fix_clip_artifacts,
         trim_ends=args.trim_ends,
         uppercase=args.uppercase,
         build_reports=args.reports,
@@ -408,6 +420,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--mask-ends", type=int, default=50,
         help="ignore clip dominant positions within n positions of termini",
+    )
+    p.add_argument(
+        "--cdr-gap", type=int, default=0, metavar="N",
+        help="pair facing clip-dominant regions across up to N uncovered "
+             "positions (see the consensus subcommand's help)",
+    )
+    p.add_argument(
+        "--fix-clip-artifacts", action="store_true",
+        help="fix the reference's issue23 boundary artifacts "
+             "(see the consensus subcommand's help)",
     )
     p.add_argument(
         "--reports", action="store_true",

@@ -137,7 +137,7 @@ def _reduce_and_call_local(
     op_start, op_off, base_packed, n_ev,
     del_pos, ins_pos, ins_cnt,
     csw_pos, csw_base, cew_pos, cew_base,
-    min_depth,
+    min_depth, flags,
     *, block: int, L: int, axis: str, realign: bool,
 ):
     """One shard's slice: scatter-reduce all channels, call every position.
@@ -178,13 +178,13 @@ def _reduce_and_call_local(
     csw = weighted(csw_pos, csw_base) if realign else None
     cew = weighted(cew_pos, cew_base) if realign else None
     return _call_from_channels(
-        weights, deletions, ins_totals, csw, cew, min_depth,
+        weights, deletions, ins_totals, csw, cew, min_depth, flags,
         block=block, L=L, axis=axis, realign=realign,
     )
 
 
 def _call_from_channels(
-    weights, deletions, ins_totals, csw, cew, min_depth,
+    weights, deletions, ins_totals, csw, cew, min_depth, flags,
     *, block: int, L: int, axis: str, realign: bool,
 ):
     """Per-position call over one shard's finished channel tensors —
@@ -213,11 +213,11 @@ def _call_from_channels(
 
     del_mask = deletions * 2 > acgt
     n_mask = ~del_mask & (acgt < min_depth)
-    ins_mask = (
-        ~del_mask
-        & ~n_mask
-        & (ins_totals * 2 > jnp.minimum(acgt, depth_next))
-    )
+    # flags: traced int32 scalar, bit 0 = strict insertions (see
+    # call.compute_masks strict_ins)
+    floor = jnp.minimum(acgt, depth_next)
+    ins_mask = ~del_mask & ~n_mask & (ins_totals * 2 > floor)
+    ins_mask &= ~(((flags & 1) != 0) & (floor == 0))
     nchar = base_code == N_CHANNELS  # base emits 'N' (tie/zero-depth/argmax-N)
 
     plane = ((base_code - 1) & 3).astype(jnp.uint8)
@@ -268,7 +268,7 @@ def _product_jit(
     op_start, op_off, base_packed, n_ev,
     del_pos, ins_pos, ins_cnt,
     csw_pos, csw_base, cew_pos, cew_base,
-    min_depth,
+    min_depth, flags,
     *, mesh: Mesh, block: int, L: int, axis: str, realign: bool,
 ):
     fn = partial(
@@ -278,14 +278,14 @@ def _product_jit(
     mapped = jax.shard_map(
         fn,
         mesh=mesh,
-        in_specs=(row,) * 3 + (P(axis),) + (row,) * 7 + (P(),),
+        in_specs=(row,) * 3 + (P(axis),) + (row,) * 7 + (P(), P()),
         out_specs=_out_specs(axis, realign),
     )
     outs = mapped(
         op_start, op_off, base_packed, n_ev,
         del_pos, ins_pos, ins_cnt,
         csw_pos, csw_base, cew_pos, cew_base,
-        min_depth,
+        min_depth, flags,
     )
     return _package_outs(outs, mesh.shape[axis], block, realign)
 
@@ -345,7 +345,7 @@ def _package_outs(outs, n: int, block: int, realign: bool):
 
 
 def _counts_call_local(
-    w_flat, d, ins_pos, ins_cnt, csw_flat, cew_flat, min_depth,
+    w_flat, d, ins_pos, ins_cnt, csw_flat, cew_flat, min_depth, flags,
     *, block: int, L: int, axis: str, realign: bool,
 ):
     """Call over one shard's *accumulated* channel tensors (streamed
@@ -361,7 +361,7 @@ def _counts_call_local(
     csw = csw_flat[0].reshape(block, N_CHANNELS) if realign else None
     cew = cew_flat[0].reshape(block, N_CHANNELS) if realign else None
     return _call_from_channels(
-        weights, deletions, ins_totals, csw, cew, min_depth,
+        weights, deletions, ins_totals, csw, cew, min_depth, flags,
         block=block, L=L, axis=axis, realign=realign,
     )
 
@@ -374,7 +374,7 @@ def _counts_call_local(
     donate_argnums=(0, 1, 4, 5),
 )
 def _counts_product_jit(
-    w_flat, d, ins_pos, ins_cnt, csw_flat, cew_flat, min_depth,
+    w_flat, d, ins_pos, ins_cnt, csw_flat, cew_flat, min_depth, flags,
     *, mesh: Mesh, block: int, L: int, axis: str, realign: bool,
 ):
     fn = partial(
@@ -384,10 +384,12 @@ def _counts_product_jit(
     mapped = jax.shard_map(
         fn,
         mesh=mesh,
-        in_specs=(row,) * 6 + (P(),),
+        in_specs=(row,) * 6 + (P(), P()),
         out_specs=_out_specs(axis, realign),
     )
-    outs = mapped(w_flat, d, ins_pos, ins_cnt, csw_flat, cew_flat, min_depth)
+    outs = mapped(
+        w_flat, d, ins_pos, ins_cnt, csw_flat, cew_flat, min_depth, flags
+    )
     return _package_outs(outs, mesh.shape[axis], block, realign)
 
 
@@ -428,7 +430,7 @@ class ShardedRef(LazyCdrWindows):
 
     def __init__(self, ev: EventSet, rid: int, mesh: Mesh,
                  min_depth: int = 1, realign: bool = False,
-                 axis: str = "sp"):
+                 axis: str = "sp", flags: int = 0):
         self.L = L = int(ev.ref_lens[rid])
         self.ref_id = ev.ref_names[rid]
         n = self.n_shards = mesh.shape[axis]
@@ -483,7 +485,7 @@ class ShardedRef(LazyCdrWindows):
                 jnp.asarray(ins_b), jnp.asarray(icnt_b),
                 jnp.asarray(csw_b), jnp.asarray(cswb_b),
                 jnp.asarray(cew_b), jnp.asarray(cewb_b),
-                jnp.int32(min_depth),
+                jnp.int32(min_depth), jnp.int32(flags),
                 mesh=mesh, block=block, L=L, axis=axis, realign=realign,
             )
         self._chunk = min(4096, self.Lp)
@@ -493,6 +495,7 @@ class ShardedRef(LazyCdrWindows):
         cls, *, ref_id: str, L: int, block: int, mesh: Mesh,
         w_flat, d, csw_flat, cew_flat, ins_table,
         min_depth: int = 1, realign: bool = False, axis: str = "sp",
+        flags: int = 0,
     ):
         """Build from already-accumulated sharded count state (the
         streamed-ingest path): w/csw/cew are device-resident
@@ -524,6 +527,7 @@ class ShardedRef(LazyCdrWindows):
             self._out = _counts_product_jit(
                 w_flat, d, jnp.asarray(ins_b), jnp.asarray(icnt_b),
                 csw_flat, cew_flat, jnp.int32(min_depth),
+                jnp.int32(flags),
                 mesh=mesh, block=block, L=L, axis=axis, realign=realign,
             )
         self._chunk = min(4096, self.Lp)
@@ -587,13 +591,14 @@ class ShardedRef(LazyCdrWindows):
         return np.empty((0,) + self._out[key].shape[1:], np.int32)
 
     def cdr_patches(self, clip_decay_threshold: float, mask_ends: int,
-                    min_overlap: int, cdr_gap: int = 0):
+                    min_overlap: int, cdr_gap: int = 0,
+                    flank_dedup: bool = False):
         """Full CDR pipeline through the sharded tensors: sparse candidate
         discovery → lazy decay walks → pairing → LCS merge (host)."""
         trig_f, trig_r = self.trigger_positions()
         return self.cdr_patches_from_triggers(
             trig_f, trig_r, clip_decay_threshold, mask_ends, min_overlap,
-            max_gap=cdr_gap,
+            max_gap=cdr_gap, flank_dedup=flank_dedup,
         )
 
 
@@ -611,6 +616,7 @@ def sharded_consensus(
     build_changes: bool = True,
     axis: str = "sp",
     cdr_gap: int = 0,
+    strict_ins: bool = False,
 ):
     """Position-sharded equivalent of call_jax.call_consensus_fused +
     the optional realign pipeline.
@@ -620,13 +626,15 @@ def sharded_consensus(
     if mesh is None:
         mesh = make_mesh()
     sr = ShardedRef(
-        ev, rid, mesh, min_depth=min_depth, realign=realign, axis=axis
+        ev, rid, mesh, min_depth=min_depth, realign=realign, axis=axis,
+        flags=1 if strict_ins else 0,
     )
     return close_sharded_ref(
         sr, realign=realign, min_depth=min_depth, min_overlap=min_overlap,
         clip_decay_threshold=clip_decay_threshold, mask_ends=mask_ends,
         trim_ends=trim_ends, uppercase=uppercase,
         build_changes=build_changes, cdr_gap=cdr_gap,
+        flank_dedup=strict_ins,
     )
 
 
@@ -642,6 +650,7 @@ def close_sharded_ref(
     uppercase: bool,
     build_changes: bool = True,
     cdr_gap: int = 0,
+    flank_dedup: bool = False,
 ):
     """Close one ShardedRef: (optional) lazy CDR walk → wire decode →
     host assembly. Shared by the event-built path above and the streamed
@@ -649,7 +658,8 @@ def close_sharded_ref(
 
     Returns (CallResult, depth_min, depth_max, cdr_patches)."""
     cdr_patches = (
-        sr.cdr_patches(clip_decay_threshold, mask_ends, min_overlap, cdr_gap)
+        sr.cdr_patches(clip_decay_threshold, mask_ends, min_overlap,
+                       cdr_gap, flank_dedup)
         if realign
         else None
     )

@@ -155,7 +155,7 @@ class ShardedStreamAccumulator(StreamAccumulatorBase):
             st.cew = add_w(st.cew, jnp.asarray(pb), jnp.asarray(bb))
 
     def finish(self, rid: int, min_depth: int = 1,
-               realign: bool = False) -> ShardedRef:
+               realign: bool = False, flags: int = 0) -> ShardedRef:
         """Close one reference's accumulation: run the sharded call kernel
         over the finished channels and hand back the ShardedRef. The
         accumulated state is consumed (popped + donated into the call) —
@@ -172,7 +172,7 @@ class ShardedStreamAccumulator(StreamAccumulatorBase):
             csw_flat=st.csw if realign else None,
             cew_flat=st.cew if realign else None,
             ins_table=tab, min_depth=min_depth, realign=realign,
-            axis=self.axis,
+            axis=self.axis, flags=flags,
         )
         # int32 scatter ceiling (module docstring): a wrapped position's
         # ACGT depth goes negative, which surfaces in the min over valid

@@ -113,6 +113,7 @@ def pipelined_consensus(
     trim_ends: bool = False,
     min_depth: int = 1,
     uppercase: bool = False,
+    strict_ins: bool = False,
 ):
     """Slab-pipelined equivalent of call_consensus_fused(...,
     build_changes=False). Returns (CallResult, depth_min, depth_max)."""
@@ -136,8 +137,10 @@ def pipelined_consensus(
         _compact_bucket(max(len(c) for c in covs)) if compact else None
     )
     pads, per_slab = pad_geometry(slabs)
+    flags = 1 if strict_ins else 0
     bufs = [
-        pack_kernel_args(sl, min_depth, geometry=(pads, per_slab[i]))[0]
+        pack_kernel_args(sl, min_depth, geometry=(pads, per_slab[i]),
+                         flags=flags)[0]
         for i, sl in enumerate(slabs)
     ]
     size = len(bufs[0])

@@ -89,9 +89,22 @@ def _leading_true_run_rev(cond_fetch, pos: int) -> tuple[int, bool]:
     return n, False
 
 
+def _flank_base(weight_row: np.ndarray) -> str | None:
+    """The consensus base the caller would emit at a flank position, or
+    None when it is not an unambiguous A/T/G/C (zero depth, tie, or
+    N-majority) — used by the --fix-clip-artifacts boundary dedup."""
+    idx, freq, tie = argmax_base_and_tie(weight_row)
+    if freq[0] == 0 or tie[0] or int(idx[0]) == 4:
+        return None
+    from kindel_tpu.call import BASE_ASCII
+
+    return chr(BASE_ASCII[idx[0]])
+
+
 def cdr_start_consensuses_lazy(L: int, trigger_pos, cond_fetch,
                                clip_block_fetch,
-                               mask_ends: int) -> list[Region]:
+                               mask_ends: int,
+                               flank_fetch=None) -> list[Region]:
     """Rightward ('→') scan over pre-computed trigger candidates.
 
     trigger_pos: ascending positions where clip-start depth dominates
@@ -114,6 +127,15 @@ def cdr_start_consensuses_lazy(L: int, trigger_pos, cond_fetch,
         # loop exhausted without break and the end clamps to L-1
         end_pos = pos + ext if found else L - 1
         seq = _span_consensus(clip_block_fetch(pos, pos + ext))
+        if flank_fetch is not None and seq and pos > 0:
+            # --fix-clip-artifacts boundary dedup: when the first clipped
+            # base equals the unambiguous aligned consensus at pos-1, the
+            # aligner's clip boundary was ambiguous and the projection
+            # double-counts that base — the duplicated leading base of the
+            # reference's disabled issue23-bc75 case. Default off.
+            prev = _flank_base(flank_fetch(pos - 1, pos))
+            if prev is not None and seq[0] == prev:
+                seq = seq[1:]
         regions.append(Region(pos, end_pos, seq, "→"))
         claimed.append((pos, end_pos))
         logging.debug(regions[-1])
@@ -164,7 +186,8 @@ def _eager_trigger(clip_depth, w_sum, d, L, mask_ends):
 
 
 def cdr_start_consensuses(pileup: Pileup, clip_decay_threshold: float,
-                          mask_ends: int) -> list[Region]:
+                          mask_ends: int,
+                          flank_dedup: bool = False) -> list[Region]:
     """Rightward ('→') clip consensuses (reference kindel.py:156-213)."""
     L = pileup.ref_len
     if _masked_all(mask_ends, L):
@@ -182,6 +205,9 @@ def cdr_start_consensuses(pileup: Pileup, clip_decay_threshold: float,
         lambda a, b: cond[a:b],
         lambda a, b: pileup.clip_start_weights[a:b],
         mask_ends,
+        flank_fetch=(
+            (lambda a, b: pileup.weights[a:b]) if flank_dedup else None
+        ),
     )
 
 
@@ -208,7 +234,8 @@ def cdr_end_consensuses(pileup: Pileup, clip_decay_threshold: float,
 def cdrp_consensuses(pileup_or_weights, deletions=None, clip_start_weights=None,
                      clip_end_weights=None, clip_start_depth=None,
                      clip_end_depth=None, clip_decay_threshold=0.1,
-                     mask_ends=50, *, max_gap: int = 0
+                     mask_ends=50, *, max_gap: int = 0,
+                     flank_dedup: bool = False
                      ) -> list[tuple[Region, Region]]:
     """Pair facing '→'/'←' regions whose spans intersect
     (reference kindel.py:278-320). Accepts either a Pileup (native API) or
@@ -223,7 +250,9 @@ def cdrp_consensuses(pileup_or_weights, deletions=None, clip_start_weights=None,
             pileup_or_weights, deletions, clip_start_weights,
             clip_end_weights,
         )
-    fwd = cdr_start_consensuses(pileup, clip_decay_threshold, mask_ends)
+    fwd = cdr_start_consensuses(
+        pileup, clip_decay_threshold, mask_ends, flank_dedup=flank_dedup
+    )
     rev = cdr_end_consensuses(pileup, clip_decay_threshold, mask_ends)
     return pair_regions(fwd, rev, max_gap)
 
@@ -270,6 +299,7 @@ class LazyCdrWindows:
     def cdr_patches_from_triggers(
         self, trig_fwd, trig_rev, clip_decay_threshold: float,
         mask_ends: int, min_overlap: int, max_gap: int = 0,
+        flank_dedup: bool = False,
     ) -> list["Region"]:
         return lazy_cdr_patches(
             self.L, trig_fwd, trig_rev,
@@ -278,6 +308,10 @@ class LazyCdrWindows:
             lambda a, b: self.window("csw", a, b),
             lambda a, b: self.window("cew", a, b),
             mask_ends, min_overlap, max_gap=max_gap,
+            flank_fetch=(
+                (lambda a, b: self.window("weights", a, b))
+                if flank_dedup else None
+            ),
         )
 
 
@@ -292,13 +326,14 @@ def lazy_cdr_patches(
     mask_ends: int,
     min_overlap: int,
     max_gap: int = 0,
+    flank_fetch=None,
 ) -> list[Region]:
     """Full CDR pipeline over device-resident clip tensors: trigger
     positions (pre-computed on device, integer-exact) → lazy decay walks
     via the fetch callables → pairing → LCS merge (host). Shared by the
     position-sharded product path and the cohort batch path."""
     fwd = cdr_start_consensuses_lazy(L, trig_fwd, cond_csw, win_csw,
-                                     mask_ends)
+                                     mask_ends, flank_fetch=flank_fetch)
     rev = cdr_end_consensuses_lazy(L, trig_rev[::-1], cond_cew, win_cew,
                                    mask_ends)
     return merge_cdrps(pair_regions(fwd, rev, max_gap), min_overlap)

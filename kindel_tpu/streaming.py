@@ -278,6 +278,7 @@ def streamed_consensus(
     backend: str = "numpy",
     chunk_bytes: int = DEFAULT_CHUNK_BYTES,
     cdr_gap: int = 0,
+    fix_clip_artifacts: bool = False,
 ):
     """bam_to_consensus over a streamed decode — identical output, host
     RSS bounded by O(chunk + reference length).
@@ -305,6 +306,7 @@ def streamed_consensus(
             bam_path, realign, min_depth, min_overlap,
             clip_decay_threshold, mask_ends, trim_ends, uppercase,
             chunk_bytes, mesh, cdr_gap=cdr_gap,
+            fix_clip_artifacts=fix_clip_artifacts,
         )
 
     # realign (or the numpy oracle) consumes host pileups; the plain jax
@@ -327,12 +329,14 @@ def streamed_consensus(
                         clip_decay_threshold=clip_decay_threshold,
                         mask_ends=mask_ends,
                         max_gap=cdr_gap,
+                        flank_dedup=fix_clip_artifacts,
                     ),
                     min_overlap,
                 )
             res = call_consensus(
                 pileup, cdr_patches=cdr_patches, trim_ends=trim_ends,
                 min_depth=min_depth, uppercase=uppercase,
+                strict_ins=fix_clip_artifacts,
             )
             acgt = pileup.acgt_depth
             depth_min = int(acgt.min()) if len(acgt) else 0
@@ -350,6 +354,7 @@ def streamed_consensus(
                 st.d[:L],
                 jnp.asarray(tab.totals[:L].astype(np.int32)),
                 jnp.int32(min_depth),
+                jnp.int32(1 if fix_clip_artifacts else 0),
             )
             _emit, masks = masks_from_wire(emit_packed, masks_packed, L)
             ins_calls = (
@@ -377,7 +382,7 @@ def streamed_consensus(
 def _streamed_sharded_consensus(
     bam_path, realign, min_depth, min_overlap, clip_decay_threshold,
     mask_ends, trim_ends, uppercase, chunk_bytes, mesh=None,
-    cdr_gap: int = 0,
+    cdr_gap: int = 0, fix_clip_artifacts: bool = False,
 ):
     """Streamed decode reduced into position-sharded device state; the
     closing call + (optional) lazy CDR walk run through the product
@@ -394,13 +399,16 @@ def _streamed_sharded_consensus(
     consensuses, refs_changes, refs_reports = [], {}, {}
     for rid in acc.present:
         ref_id = acc.ref_names[rid]
-        sr = acc.finish(rid, min_depth=min_depth, realign=realign)
+        sr = acc.finish(
+            rid, min_depth=min_depth, realign=realign,
+            flags=1 if fix_clip_artifacts else 0,
+        )
         res, depth_min, depth_max, cdr_patches = close_sharded_ref(
             sr, realign=realign, min_depth=min_depth,
             min_overlap=min_overlap,
             clip_decay_threshold=clip_decay_threshold,
             mask_ends=mask_ends, trim_ends=trim_ends, uppercase=uppercase,
-            cdr_gap=cdr_gap,
+            cdr_gap=cdr_gap, flank_dedup=fix_clip_artifacts,
         )
         refs_reports[ref_id] = build_report(
             ref_id, depth_min, depth_max, res.changes, cdr_patches,

@@ -233,6 +233,7 @@ def bam_to_consensus(
     backend: str = "numpy",
     stream_chunk_mb: float | None = None,
     cdr_gap: int = 0,
+    fix_clip_artifacts: bool = False,
 ):
     """Infer consensus for every reference with aligned reads.
 
@@ -259,6 +260,7 @@ def bam_to_consensus(
             clip_decay_threshold=clip_decay_threshold, mask_ends=mask_ends,
             trim_ends=trim_ends, uppercase=uppercase, backend=backend,
             chunk_bytes=int(chunk_mb * (1 << 20)), cdr_gap=cdr_gap,
+            fix_clip_artifacts=fix_clip_artifacts,
         )
 
     consensuses = []
@@ -337,6 +339,7 @@ def bam_to_consensus(
                         clip_decay_threshold=clip_decay_threshold,
                         mask_ends=mask_ends, trim_ends=trim_ends,
                         uppercase=uppercase, cdr_gap=cdr_gap,
+                        strict_ins=fix_clip_artifacts,
                     )
                 refs_reports[ref_id] = build_report(
                     ref_id, depth_min, depth_max, res.changes, cdr_patches,
@@ -358,6 +361,7 @@ def bam_to_consensus(
                         ev, rid, cdr_patches=None,
                         trim_ends=trim_ends, min_depth=min_depth,
                         uppercase=uppercase,
+                        strict_ins=fix_clip_artifacts,
                     )
             else:
                 with maybe_phase(f"pileup reduce [{ref_id}]"):
@@ -369,6 +373,7 @@ def bam_to_consensus(
                             clip_decay_threshold=clip_decay_threshold,
                             mask_ends=mask_ends,
                             max_gap=cdr_gap,
+                            flank_dedup=fix_clip_artifacts,
                         )
                         cdr_patches = merge_cdrps(cdrps, min_overlap)
                 else:
@@ -380,6 +385,7 @@ def bam_to_consensus(
                         trim_ends=trim_ends,
                         min_depth=min_depth,
                         uppercase=uppercase,
+                        strict_ins=fix_clip_artifacts,
                     )
                 acgt = pileup.acgt_depth
                 depth_min = int(acgt.min()) if len(acgt) else 0
