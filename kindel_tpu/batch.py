@@ -405,6 +405,7 @@ def _assemble_outputs(units, device_out, opts: BatchOptions, pool,
                 trig_f, trig_r, opts.clip_decay_threshold,
                 opts.mask_ends, opts.min_overlap, max_gap=opts.cdr_gap,
                 flank_dedup=opts.fix_clip_artifacts,
+                min_depth=opts.min_depth,
             )
         if opts.want_masks:
             _emit, masks = masks_from_wire(

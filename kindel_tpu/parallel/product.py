@@ -592,13 +592,13 @@ class ShardedRef(LazyCdrWindows):
 
     def cdr_patches(self, clip_decay_threshold: float, mask_ends: int,
                     min_overlap: int, cdr_gap: int = 0,
-                    flank_dedup: bool = False):
+                    flank_dedup: bool = False, min_depth: int = 1):
         """Full CDR pipeline through the sharded tensors: sparse candidate
         discovery → lazy decay walks → pairing → LCS merge (host)."""
         trig_f, trig_r = self.trigger_positions()
         return self.cdr_patches_from_triggers(
             trig_f, trig_r, clip_decay_threshold, mask_ends, min_overlap,
-            max_gap=cdr_gap, flank_dedup=flank_dedup,
+            max_gap=cdr_gap, flank_dedup=flank_dedup, min_depth=min_depth,
         )
 
 
@@ -659,7 +659,7 @@ def close_sharded_ref(
     Returns (CallResult, depth_min, depth_max, cdr_patches)."""
     cdr_patches = (
         sr.cdr_patches(clip_decay_threshold, mask_ends, min_overlap,
-                       cdr_gap, flank_dedup)
+                       cdr_gap, flank_dedup, min_depth)
         if realign
         else None
     )

@@ -89,12 +89,19 @@ def _leading_true_run_rev(cond_fetch, pos: int) -> tuple[int, bool]:
     return n, False
 
 
-def _flank_base(weight_row: np.ndarray) -> str | None:
-    """The consensus base the caller would emit at a flank position, or
-    None when it is not an unambiguous A/T/G/C (zero depth, tie, or
-    N-majority) — used by the --fix-clip-artifacts boundary dedup."""
+def _flank_base(weight_row: np.ndarray, deletions: int,
+                min_depth: int) -> str | None:
+    """The consensus base the caller would actually EMIT at a flank
+    position, or None when it would not be an unambiguous A/T/G/C —
+    zero/thin depth (< min_depth → N), tie, N-majority, or deletion
+    dominance (2d > acgt → nothing emitted). Used by the
+    --fix-clip-artifacts boundary dedup: dropping a clip base is only
+    sound when the flank genuinely repeats it in the output."""
     idx, freq, tie = argmax_base_and_tie(weight_row)
     if freq[0] == 0 or tie[0] or int(idx[0]) == 4:
+        return None
+    acgt = int(weight_row[0, :4].sum())
+    if acgt < min_depth or 2 * int(deletions) > acgt:
         return None
     from kindel_tpu.call import BASE_ASCII
 
@@ -104,7 +111,8 @@ def _flank_base(weight_row: np.ndarray) -> str | None:
 def cdr_start_consensuses_lazy(L: int, trigger_pos, cond_fetch,
                                clip_block_fetch,
                                mask_ends: int,
-                               flank_fetch=None) -> list[Region]:
+                               flank_fetch=None,
+                               min_depth: int = 1) -> list[Region]:
     """Rightward ('→') scan over pre-computed trigger candidates.
 
     trigger_pos: ascending positions where clip-start depth dominates
@@ -133,7 +141,8 @@ def cdr_start_consensuses_lazy(L: int, trigger_pos, cond_fetch,
             # aligner's clip boundary was ambiguous and the projection
             # double-counts that base — the duplicated leading base of the
             # reference's disabled issue23-bc75 case. Default off.
-            prev = _flank_base(flank_fetch(pos - 1, pos))
+            w_row, dels = flank_fetch(pos - 1, pos)
+            prev = _flank_base(w_row, dels, min_depth)
             if prev is not None and seq[0] == prev:
                 seq = seq[1:]
         regions.append(Region(pos, end_pos, seq, "→"))
@@ -187,7 +196,8 @@ def _eager_trigger(clip_depth, w_sum, d, L, mask_ends):
 
 def cdr_start_consensuses(pileup: Pileup, clip_decay_threshold: float,
                           mask_ends: int,
-                          flank_dedup: bool = False) -> list[Region]:
+                          flank_dedup: bool = False,
+                          min_depth: int = 1) -> list[Region]:
     """Rightward ('→') clip consensuses (reference kindel.py:156-213)."""
     L = pileup.ref_len
     if _masked_all(mask_ends, L):
@@ -206,8 +216,10 @@ def cdr_start_consensuses(pileup: Pileup, clip_decay_threshold: float,
         lambda a, b: pileup.clip_start_weights[a:b],
         mask_ends,
         flank_fetch=(
-            (lambda a, b: pileup.weights[a:b]) if flank_dedup else None
+            (lambda a, b: (pileup.weights[a:b], int(pileup.deletions[a])))
+            if flank_dedup else None
         ),
+        min_depth=min_depth,
     )
 
 
@@ -235,7 +247,7 @@ def cdrp_consensuses(pileup_or_weights, deletions=None, clip_start_weights=None,
                      clip_end_weights=None, clip_start_depth=None,
                      clip_end_depth=None, clip_decay_threshold=0.1,
                      mask_ends=50, *, max_gap: int = 0,
-                     flank_dedup: bool = False
+                     flank_dedup: bool = False, min_depth: int = 1
                      ) -> list[tuple[Region, Region]]:
     """Pair facing '→'/'←' regions whose spans intersect
     (reference kindel.py:278-320). Accepts either a Pileup (native API) or
@@ -251,7 +263,8 @@ def cdrp_consensuses(pileup_or_weights, deletions=None, clip_start_weights=None,
             clip_end_weights,
         )
     fwd = cdr_start_consensuses(
-        pileup, clip_decay_threshold, mask_ends, flank_dedup=flank_dedup
+        pileup, clip_decay_threshold, mask_ends, flank_dedup=flank_dedup,
+        min_depth=min_depth,
     )
     rev = cdr_end_consensuses(pileup, clip_decay_threshold, mask_ends)
     return pair_regions(fwd, rev, max_gap)
@@ -299,7 +312,7 @@ class LazyCdrWindows:
     def cdr_patches_from_triggers(
         self, trig_fwd, trig_rev, clip_decay_threshold: float,
         mask_ends: int, min_overlap: int, max_gap: int = 0,
-        flank_dedup: bool = False,
+        flank_dedup: bool = False, min_depth: int = 1,
     ) -> list["Region"]:
         return lazy_cdr_patches(
             self.L, trig_fwd, trig_rev,
@@ -309,9 +322,15 @@ class LazyCdrWindows:
             lambda a, b: self.window("cew", a, b),
             mask_ends, min_overlap, max_gap=max_gap,
             flank_fetch=(
-                (lambda a, b: self.window("weights", a, b))
+                (
+                    lambda a, b: (
+                        self.window("weights", a, b),
+                        int(self.window("deletions", a, b)[0]),
+                    )
+                )
                 if flank_dedup else None
             ),
+            min_depth=min_depth,
         )
 
 
@@ -327,13 +346,15 @@ def lazy_cdr_patches(
     min_overlap: int,
     max_gap: int = 0,
     flank_fetch=None,
+    min_depth: int = 1,
 ) -> list[Region]:
     """Full CDR pipeline over device-resident clip tensors: trigger
     positions (pre-computed on device, integer-exact) → lazy decay walks
     via the fetch callables → pairing → LCS merge (host). Shared by the
     position-sharded product path and the cohort batch path."""
     fwd = cdr_start_consensuses_lazy(L, trig_fwd, cond_csw, win_csw,
-                                     mask_ends, flank_fetch=flank_fetch)
+                                     mask_ends, flank_fetch=flank_fetch,
+                                     min_depth=min_depth)
     rev = cdr_end_consensuses_lazy(L, trig_rev[::-1], cond_cew, win_cew,
                                    mask_ends)
     return merge_cdrps(pair_regions(fwd, rev, max_gap), min_overlap)

@@ -330,6 +330,7 @@ def streamed_consensus(
                         mask_ends=mask_ends,
                         max_gap=cdr_gap,
                         flank_dedup=fix_clip_artifacts,
+                        min_depth=min_depth,
                     ),
                     min_overlap,
                 )

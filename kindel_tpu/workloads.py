@@ -374,6 +374,7 @@ def bam_to_consensus(
                             mask_ends=mask_ends,
                             max_gap=cdr_gap,
                             flank_dedup=fix_clip_artifacts,
+                            min_depth=min_depth,
                         )
                         cdr_patches = merge_cdrps(cdrps, min_overlap)
                 else:
@@ -548,7 +549,21 @@ def _jeffreys_ci(count, nobs, alpha):
     n = np.asarray(nobs).astype(np.int64)
     stride = n.max() + 1 if len(n) else 1
     key = c * stride + n  # c <= n, both small ints: collision-free
-    uniq, inverse = np.unique(key, return_inverse=True)
+    if stride * stride <= min(1 << 26, 16 * len(key)):
+        # O(rows) presence-table dedup — np.unique's sort was the single
+        # largest phase of `weights` on a 6.1 Mb genome (~11 s of 26 s).
+        # Gated on BOTH the key space (bounded by stride²) and the row
+        # count: a short-but-deep amplicon pileup must not allocate a
+        # 64 Mi-entry table to dedup a few thousand keys the sort
+        # handles in microseconds.
+        present = np.zeros(stride * stride, dtype=bool)
+        present[key] = True
+        uniq = np.flatnonzero(present)
+        rank = np.empty(stride * stride, dtype=np.int32)
+        rank[uniq] = np.arange(len(uniq), dtype=np.int32)
+        inverse = rank[key]
+    else:  # deep pileups (large stride): fall back to the sort
+        uniq, inverse = np.unique(key, return_inverse=True)
     lower_u, upper_u = scipy.stats.beta.interval(
         1 - alpha,
         uniq // stride + 0.5,
