@@ -181,3 +181,15 @@ def test_gp120_gap_pairing_cohort_path(tmp_path):
         s.sequence for s in single.consensuses
     ]
     assert EXPECTED_56MER in cohort.consensuses[0].sequence.upper()
+
+
+def test_gap_pairing_composes_with_fix_clip_artifacts(tmp_path):
+    """Both beyond-the-reference flags at once: gap pairing still closes
+    the gp120 junction with --fix-clip-artifacts active (the strict-ins
+    and flank-dedup rules must not interfere with the gap merge)."""
+    sam, sample = _gp120_like_sam(tmp_path)
+    res = bam_to_consensus(
+        sam, realign=True, min_overlap=7, cdr_gap=600,
+        fix_clip_artifacts=True,
+    )
+    assert EXPECTED_56MER in res.consensuses[0].sequence.upper()
