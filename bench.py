@@ -36,10 +36,15 @@ BACT_BAM = Path(
 )
 BASELINE_MBASES_PER_S = 0.069  # reference end-to-end, 1 CPU core (SURVEY §6)
 
-#: first compiles ~20-40 s each (the slab autotune compiles up to three
-#: distinct configs on a cold cache) + tunneled transfers; must stay
-#: under the relay watcher's 900 s kill window minus the 300 s CPU child
+#: first compiles ~20-40 s each (the adaptive slab autotune measures 3-5
+#: configs on a cold cache, but stops expanding past TUNE_BUDGET_S) +
+#: tunneled transfers; must stay under the relay watcher's 900 s kill
+#: window minus the 300 s CPU child
 TPU_ATTEMPT_TIMEOUT_S = 560.0
+#: wall budget for the autotune phase: whatever configs are measured by
+#: this point decide the pick, so a cold cache can never starve the
+#: timed trials of their share of TPU_ATTEMPT_TIMEOUT_S
+TUNE_BUDGET_S = 300.0
 CPU_ATTEMPT_TIMEOUT_S = 300.0
 #: how long to wait for the relay to answer before falling back — the
 #: round-2 verdict flagged a single 30 s probe as throwing away whole
@@ -89,6 +94,36 @@ def _synthesize_bam(path: Path, ref_len: int = 6_097_032,
     path.write_bytes(gzip.compress(raw, 1))
 
 
+def _max_ref_len(bam: Path) -> int:
+    """Longest reference length, from the BAM header alone (no record
+    decode — the autotune clamp only needs contig scale, and decoding the
+    whole file an extra time to learn it measurably skews 1-core runs).
+    BGZF is gzip-compatible and gzip.open streams, so only the first
+    block(s) are ever decompressed. Returns 0 on anything unexpected
+    (caller treats 0 as "no clamp information")."""
+    import gzip
+    import struct
+
+    try:
+        with open(bam, "rb") as raw:
+            magic = raw.read(2)
+        opener = gzip.open if magic == b"\x1f\x8b" else open
+        with opener(bam, "rb") as fh:
+            if fh.read(4) != b"BAM\x01":
+                return 0
+            l_text = struct.unpack("<i", fh.read(4))[0]
+            fh.read(l_text)
+            n_ref = struct.unpack("<i", fh.read(4))[0]
+            longest = 0
+            for _ in range(n_ref):
+                l_name = struct.unpack("<i", fh.read(4))[0]
+                fh.read(l_name)
+                longest = max(longest, struct.unpack("<i", fh.read(4))[0])
+            return longest
+    except Exception:
+        return 0
+
+
 def _run_benchmark() -> dict:
     """The measured pipeline. Runs only in a child process (jax imported
     here, never in the parent)."""
@@ -117,34 +152,42 @@ def _run_benchmark() -> dict:
             assert len(res.sequence) > 0
         return total
 
-    # Slab autotune: the pipelined default (KINDEL_TPU_SLABS=4) overlaps
+    # Slab autotune: the pipelined slab sweep (KINDEL_TPU_SLABS) overlaps
     # wire with compute, but on a high-latency tunneled link the extra
     # per-slab dispatches could cost more than the overlap saves — which
-    # way it goes is a property of THIS link, so measure both once
+    # way it goes is a property of THIS link, so measure the grid
     # (warmup compiles each config; the persistent compile cache makes
     # repeat runs cheap) and time the production path with the winner.
     # An explicit KINDEL_TPU_SLABS pins the config and skips the tune.
     # the per-contig clamp (call_jax: n_slabs <= len//65536) makes both
     # configs identical on small-contig inputs — skip the redundant tune
-    # and report the true effective count there
-    probe = extract_events(load_alignment(bam))
-    max_contig = max(
-        (int(probe.ref_lens[r]) for r in probe.present_ref_ids), default=0
-    )
+    # and report the true effective count there. Header-only scan: the
+    # clamp needs contig scale, not a full decode (an over-estimate from
+    # a read-less contig only times configs that collapse to the same
+    # effective count — correctness is unaffected).
+    max_contig = _max_ref_len(bam)
+    if max_contig == 0:  # non-BAM / unreadable header: decode-probe fallback
+        probe = extract_events(load_alignment(bam))
+        max_contig = max(
+            (int(probe.ref_lens[r]) for r in probe.present_ref_ids), default=0
+        )
     clamp = max(1, max_contig // 65536)
-    if os.environ.get("KINDEL_TPU_SLABS"):
-        chosen = min(max(1, int(os.environ["KINDEL_TPU_SLABS"])), clamp)
+    prior_slabs = os.environ.get("KINDEL_TPU_SLABS")
+    tune: dict[int, float] = {}
+    if prior_slabs:
+        try:
+            pinned = int(prior_slabs)
+        except ValueError:
+            # malformed pin: report what call_jax will actually use
+            pinned = 16 if jax.default_backend() == "cpu" else 4
+        chosen = min(max(1, pinned), clamp)
         one_pass()  # warmup/compile
     elif clamp <= 1:
         chosen = 1
         os.environ["KINDEL_TPU_SLABS"] = "1"
         one_pass()
     else:
-        timings = {}
-        # dedupe configs the per-contig clamp collapses (e.g. clamp 2
-        # makes "2" and "4" identical) — each distinct effective config
-        # is compiled and timed exactly once
-        for slabs in sorted({min(s, clamp) for s in (1, 4, 8)}):
+        def measure(slabs: int) -> float:
             os.environ["KINDEL_TPU_SLABS"] = str(slabs)
             one_pass()  # warmup/compile for this config
             # best-of-2: single-pass times are noisy on shared hosts and
@@ -154,8 +197,26 @@ def _run_benchmark() -> dict:
                 t0 = time.perf_counter()
                 one_pass()
                 walls.append(time.perf_counter() - t0)
-            timings[slabs] = min(walls)
-        chosen = min(timings, key=timings.get)
+            return min(walls)
+
+        # geometric grid, deduped where the per-contig clamp collapses
+        # configs (e.g. clamp 2 makes "4" and "16" identical), then keep
+        # doubling while the top config is still the winner — on a 1-core
+        # CPU the slab sweep's cache-locality win peaks around 16 slabs
+        # (round-5 measurement: 4→0.35 s/pass, 16→0.27 s/pass) and the
+        # peak's position is a property of this host/link, so search it
+        t_tune = time.perf_counter()
+        for slabs in sorted({min(s, clamp) for s in (1, 4, 16)}):
+            tune[slabs] = measure(slabs)
+            if time.perf_counter() - t_tune > TUNE_BUDGET_S:
+                break  # cold-cache compiles ran long: pick from what we have
+        while time.perf_counter() - t_tune <= TUNE_BUDGET_S:
+            best = min(tune, key=tune.get)
+            nxt = min(best * 2, clamp, 64)
+            if best != max(tune) or nxt <= best or nxt in tune:
+                break
+            tune[nxt] = measure(nxt)
+        chosen = min(tune, key=tune.get)
         os.environ["KINDEL_TPU_SLABS"] = str(chosen)
 
     # timed: full pipeline — decode, event extraction, device reduce+call,
@@ -169,8 +230,15 @@ def _run_benchmark() -> dict:
         total_bases = one_pass()
         walls.append(time.perf_counter() - t0)
 
+    # restore the caller's env after tuning — the autotuned value must
+    # not leak into whatever the process runs next (ADVICE r4)
+    if prior_slabs is None:
+        os.environ.pop("KINDEL_TPU_SLABS", None)
+    else:
+        os.environ["KINDEL_TPU_SLABS"] = prior_slabs
+
     mbases_per_s = total_bases / min(walls) / 1e6
-    return {
+    result = {
         "metric": "consensus_throughput_bacterial",
         "value": round(mbases_per_s, 3),
         "unit": "Mbases/s",
@@ -178,7 +246,14 @@ def _run_benchmark() -> dict:
         "backend": jax.default_backend(),
         "slabs": chosen,
         "trials": [round(w, 3) for w in walls],
+        # contention context (VERDICT r4 weak 1): a cross-round comparison
+        # is meaningless without knowing how busy the host was
+        "loadavg_1m": round(os.getloadavg()[0], 2),
+        "ncpu": os.cpu_count(),
     }
+    if tune:
+        result["tune_s"] = {str(k): round(v, 3) for k, v in tune.items()}
+    return result
 
 
 def _parse_child_json(stdout: str) -> dict | None:

@@ -862,17 +862,24 @@ def call_consensus_fused(
     dense decision masks are shipped — the sequence reconstructs from the
     2-bit plane + exception bitmask wire format (decode_fast).
 
-    The no-changes path runs slab-pipelined by default (KINDEL_TPU_SLABS,
-    default 4, clamped for small contigs; =1 forces the single fused
-    kernel) — kindel_tpu.pipeline overlaps wire+decode with device
-    compute; output is byte-identical either way."""
+    The no-changes path runs slab-pipelined by default (KINDEL_TPU_SLABS;
+    default 16 on the CPU backend / 4 on accelerators, clamped for small
+    contigs; =1 forces the single fused kernel) — kindel_tpu.pipeline
+    overlaps wire+decode with device compute; output is byte-identical
+    either way."""
     if not build_changes:
         import os
 
-        # default 4: measured better than single-kernel even on CPU
-        # (cache locality, benchmarks/microprof.py A/B) and overlaps the
-        # wire with compute on tunneled devices
-        n_slabs = int(os.environ.get("KINDEL_TPU_SLABS", "4"))
+        # backend-aware default: on CPU the slab sweep is pure cache
+        # locality and 16 measures ~1.5× faster than 4 on the bacterial
+        # bench (bench.py tune, round 5); on an accelerator each slab is
+        # an extra dispatch over a possibly-tunneled link, so stay at 4
+        # until an on-device A/B says otherwise (benchmarks/microprof.py)
+        default = 16 if jax.default_backend() == "cpu" else 4
+        try:
+            n_slabs = int(os.environ.get("KINDEL_TPU_SLABS", default))
+        except ValueError:
+            n_slabs = default
         # tiny contigs: slabbing buys nothing below ~64k positions a slab
         n_slabs = max(1, min(n_slabs, int(ev.ref_lens[rid]) // 65536))
         if n_slabs > 1:

@@ -34,6 +34,17 @@ def _progress_parent() -> argparse.ArgumentParser:
     return p
 
 
+def _nonneg_int(value: str) -> int:
+    n = int(value)
+    if n < 0:
+        raise argparse.ArgumentTypeError("must be >= 0")
+    return n
+
+
+# argparse builds its "invalid ... value" message from type.__name__
+_nonneg_int.__name__ = "non-negative int"
+
+
 def _add_backend(p: argparse.ArgumentParser):
     p.add_argument(
         "--backend",
@@ -69,7 +80,7 @@ def _consensus_parser(sub):
         help="ignore clip dominant positions within n positions of termini",
     )
     p.add_argument(
-        "--cdr-gap", type=int, default=0, metavar="N",
+        "--cdr-gap", type=_nonneg_int, default=0, metavar="N",
         help="pair facing clip-dominant regions across up to N uncovered "
              "positions (beyond the reference, which requires overlapping "
              "spans and cannot close wide divergent segments — its own "
@@ -109,9 +120,6 @@ def _consensus_parser(sub):
 
 
 def cmd_consensus(args) -> int:
-    if args.cdr_gap < 0:
-        print("error: --cdr-gap must be >= 0", file=sys.stderr)
-        return 2
     timer = None
     if args.profile:
         from kindel_tpu.utils.profiling import disable_profiling, enable_profiling
@@ -422,7 +430,7 @@ def build_parser() -> argparse.ArgumentParser:
         help="ignore clip dominant positions within n positions of termini",
     )
     p.add_argument(
-        "--cdr-gap", type=int, default=0, metavar="N",
+        "--cdr-gap", type=_nonneg_int, default=0, metavar="N",
         help="pair facing clip-dominant regions across up to N uncovered "
              "positions (see the consensus subcommand's help)",
     )

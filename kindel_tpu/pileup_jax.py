@@ -137,10 +137,10 @@ def fetch_counts_host(dev_arr, n_rows: int, n_cols: int = N_CHANNELS,
     n_total = dev_arr.size // n_cols  # device rows incl. shard padding
     dense = bool(
         force_dense
-        or os.environ.get("KINDEL_TPU_DENSE_STATS")
+        or os.environ.get("KINDEL_TPU_DENSE_STATS", "0") not in ("0", "")
         or (
             jax.default_backend() == "cpu"
-            and not os.environ.get("KINDEL_TPU_COMPACT_STATS")
+            and os.environ.get("KINDEL_TPU_COMPACT_STATS", "0") in ("0", "")
         )
         # short references: the dense payload is already smaller than the
         # compact path's bucketed-minimum block, and one round trip beats

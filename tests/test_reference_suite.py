@@ -51,9 +51,13 @@ def test_reference_suite_unmodified(tmp_path):
     script.chmod(0o755)
 
     env = dict(os.environ)
-    env["PYTHONPATH"] = os.pathsep.join(
-        [str(REFSUITE), str(REPO), env.get("PYTHONPATH", "")]
-    )
+    # KINDEL_TPU_TEST_INSTALLED=1 (installed-package CI): omit the repo
+    # checkout from the child's import path so `kindel_tpu` must resolve
+    # from site-packages (the wheel under test), not be shadowed by the
+    # source tree; the refsuite aliases stay — they only re-export.
+    installed = env.get("KINDEL_TPU_TEST_INSTALLED", "0") not in ("0", "")
+    roots = [str(REFSUITE)] + ([] if installed else [str(REPO)])
+    env["PYTHONPATH"] = os.pathsep.join(roots + [env.get("PYTHONPATH", "")])
     env["PATH"] = str(bin_dir) + os.pathsep + env.get("PATH", "")
     # the reference suite runs the CLI ~30×; numpy backend needs no device
     env.setdefault("JAX_PLATFORMS", "cpu")

@@ -76,3 +76,19 @@ def test_version_cli(capsys):
     assert main(["version"]) == 0
     out = capsys.readouterr().out
     assert out.startswith("kindel-tpu ")
+
+def test_negative_cdr_gap_rejected_on_both_subcommands(capsys):
+    """--cdr-gap < 0 must error (exit 2) on consensus AND batch — round 4
+    validated only the consensus subcommand (ADVICE r4)."""
+    import pytest
+
+    from kindel_tpu.cli import main
+
+    for argv in (
+        ["consensus", "--cdr-gap", "-3", "x.bam"],
+        ["batch", "--cdr-gap", "-3", "x.bam"],
+    ):
+        with pytest.raises(SystemExit) as exc:
+            main(argv)
+        assert exc.value.code == 2
+        assert "must be >= 0" in capsys.readouterr().err
