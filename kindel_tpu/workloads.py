@@ -760,12 +760,20 @@ _PLOT_TEMPLATE = """<!DOCTYPE html>
  body{font-family:sans-serif;margin:12px}
  #legend span{margin-right:14px;cursor:pointer;user-select:none}
  #legend .off{opacity:.3}
- svg{border:1px solid #ccc;width:100%;height:480px}
+ #wrap{position:relative}
+ svg{border:1px solid #ccc;width:100%;height:480px;display:block}
+ #hline{position:absolute;width:1px;background:#888;pointer-events:none;display:none}
+ #tip{position:absolute;background:#fff;border:1px solid #999;border-radius:3px;
+      padding:4px 7px;font-size:11px;pointer-events:none;display:none;
+      white-space:nowrap;box-shadow:0 1px 4px rgba(0,0,0,.25)}
 </style></head><body>
 <h3>kindel-tpu clip/depth plot — __TITLE__</h3>
 <div id="legend"></div>
+<div id="wrap">
 <svg id="chart" viewBox="0 0 1200 480" preserveAspectRatio="none"></svg>
-<p>drag to pan, wheel to zoom (x)</p>
+<div id="hline"></div><div id="tip"></div>
+</div>
+<p>drag to pan, wheel to zoom (x), hover for per-position values</p>
 <script>
 const data = __DATA__;
 const colors = ["#1f77b4","#ff7f0e","#2ca02c","#d62728","#9467bd","#8c564b","#e377c2","#7f7f7f"];
@@ -821,17 +829,47 @@ function requestRender(){ if(!raf) raf=requestAnimationFrame(()=>{raf=0;render()
 const leg = document.getElementById("legend");
 data.forEach((t,i)=>{const s=document.createElement("span");
   s.textContent="■ "+t.name; s.style.color=colors[i%8];
-  s.onclick=()=>{vis[i]=!vis[i];s.classList.toggle("off");requestRender();};
+  s.onclick=()=>{vis[i]=!vis[i];s.classList.toggle("off");hideHover();requestRender();};
   leg.appendChild(s);});
 let drag=null;
 svg.addEventListener("mousedown",e=>drag={x:e.clientX,x0,x1});
-window.addEventListener("mouseup",()=>drag=null);
+window.addEventListener("mouseup",()=>{drag=null;hideHover();});
 window.addEventListener("mousemove",e=>{if(!drag)return;
   const dx=(e.clientX-drag.x)/svg.clientWidth*(drag.x1-drag.x0);
   x0=drag.x0-dx; x1=drag.x1-dx; requestRender();});
-svg.addEventListener("wheel",e=>{e.preventDefault();
+svg.addEventListener("wheel",e=>{e.preventDefault();hideHover();
   const f=e.deltaY>0?1.2:1/1.2, c=(x0+x1)/2;
   x0=c-(c-x0)*f; x1=c+(x1-c)*f; requestRender();});
+// hover readout (parity with the reference's plotly per-point hover):
+// reads the FULL-resolution payload at the hovered position, so the
+// values are exact even when the rendered trace is envelope-decimated
+const wrap=document.getElementById("wrap");
+const hline=document.getElementById("hline");
+const tip=document.getElementById("tip");
+function hideHover(){hline.style.display="none";tip.style.display="none";}
+svg.addEventListener("mouseleave",hideHover);
+svg.addEventListener("mousemove",e=>{
+  if(drag){hideHover();return;}
+  const r=svg.getBoundingClientRect();
+  const px=(e.clientX-r.left)/r.width*W;           // viewBox x
+  const pos=Math.round(x0+(px-PAD)/((W-2*PAD)/(x1-x0)));
+  const n=Math.max(...data.map(t=>t.y.length));
+  if(pos<0||pos>=n||px<PAD||px>W-PAD){hideHover();return;}
+  const sxpx=((pos-x0)*(W-2*PAD)/(x1-x0)+PAD)/W*r.width; // snapped css x
+  hline.style.left=sxpx+"px";
+  hline.style.top=(PAD/H*r.height)+"px";
+  hline.style.height=((H-2*PAD)/H*r.height)+"px";
+  hline.style.display="block";
+  let rows=`<b>pos ${pos+1}</b>`;
+  data.forEach((t,i)=>{ if(!vis[i]||pos>=t.y.length) return;
+    rows+=`<br><span style="color:${colors[i%8]}">■</span> ${t.name}: ${t.y[pos]}`;});
+  tip.innerHTML=rows;
+  tip.style.display="block";
+  const flip=sxpx+10+tip.offsetWidth>r.width;  // measured after innerHTML
+  tip.style.left=flip?"":(sxpx+10)+"px";
+  tip.style.right=flip?(r.width-sxpx+10)+"px":"";
+  tip.style.top=Math.min(e.clientY-r.top+12,r.height-data.length*14-30)+"px";
+});
 render();
 </script></body></html>
 """
