@@ -131,6 +131,28 @@ def measure(bam: Path, mode: str, backend: str, chunk_mb,
     return rec
 
 
+def measure_two_process(bam: Path, chunk_mb) -> list[dict]:
+    """Launch a real 2-process JAX group (localhost coordinator, 4 virtual
+    devices each) running the streamed×sharded path via _rss_dist_worker;
+    returns both workers' JSON records (per-process peak RSS + digest).
+    Uses the shared harness in tests/distfixture.py (port reservation +
+    bind-race retry + cleanup) so a transient port steal cannot abort a
+    long benchmark run."""
+    sys.path.insert(0, str(REPO / "tests"))
+    import distfixture
+
+    worker = Path(__file__).parent / "_rss_dist_worker.py"
+    outs = distfixture.run_two_process(
+        worker, extra_argv=(bam, chunk_mb), timeout=3600,
+    )
+    recs = []
+    for _rc, out, _err in outs:
+        rec = json.loads(out.strip().splitlines()[-1])
+        print(json.dumps(rec))
+        recs.append(rec)
+    return recs
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--gb", type=float, default=1.0,
@@ -145,6 +167,10 @@ def main():
                     help="also run the streamed path on an N-device "
                          "virtual CPU mesh and assert sharded execution + "
                          "identical output (0 disables)")
+    ap.add_argument("--procs", type=int, default=0, choices=(0, 2),
+                    help="also run a REAL 2-process JAX group (sp=8 "
+                         "spanning both) and report per-process peak RSS "
+                         "(the shard-local host-memory proof)")
     ap.add_argument("--keep", action="store_true")
     args = ap.parse_args()
 
@@ -183,6 +209,19 @@ def main():
             file=sys.stderr,
         )
         if not (same and meshed["sharded"]):
+            sys.exit(1)
+    if args.procs:
+        recs = measure_two_process(bam, args.chunk_mb)
+        same = all(r["digest"] == stream["digest"] for r in recs)
+        peak = max(r["max_rss_mb"] for r in recs)
+        print(
+            f"# 2-process: per-process peak rss "
+            f"{[r['max_rss_mb'] for r in recs]} MB (vs single-process "
+            f"streamed {stream['max_rss_mb']:.0f} MB), output "
+            f"identical={same}",
+            file=sys.stderr,
+        )
+        if not (same and peak < stream["max_rss_mb"]):
             sys.exit(1)
     if not args.keep:
         bam.unlink()

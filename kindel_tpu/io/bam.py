@@ -32,23 +32,53 @@ def _gather_scalar(buf: np.ndarray, offs: np.ndarray, dtype, width: int):
     return buf[idx].reshape(-1).view(dtype)
 
 
-def parse_bam_bytes(data: bytes) -> ReadBatch:
-    """Decode an (already decompressed) BAM byte string."""
+def parse_bam_header(data: bytes):
+    """Validated BAM header+reference-dictionary parse, shared by the pure
+    and native decoders. Returns (ref_names, ref_lens, first_record_off).
+
+    Every length field is untrusted (adversarial-fuzz hardening, round 5):
+    a lying l_text / n_ref / l_name must raise a clean ValueError — never
+    a struct.error, a giant allocation (n_ref is attacker-controlled and
+    previously sized an int64 array unchecked), or a silent misparse."""
     if data[:4] != b"BAM\x01":
         raise ValueError("not a BAM stream (bad magic)")
+    if len(data) < 12:
+        raise ValueError("truncated BAM stream (no header)")
     l_text = struct.unpack_from("<i", data, 4)[0]
+    if l_text < 0 or 8 + l_text + 4 > len(data):
+        raise ValueError(f"corrupt BAM header: l_text={l_text}")
     off = 8 + l_text
     n_ref = struct.unpack_from("<i", data, off)[0]
     off += 4
+    # each reference entry takes >= 9 bytes (l_name field + NUL + l_ref)
+    if n_ref < 0 or n_ref > (len(data) - off) // 9:
+        raise ValueError(f"corrupt BAM header: n_ref={n_ref}")
     ref_names: list[str] = []
     ref_lens = np.empty(n_ref, dtype=np.int64)
     for i in range(n_ref):
+        if off + 4 > len(data):
+            raise ValueError("corrupt BAM header: truncated reference dict")
         l_name = struct.unpack_from("<i", data, off)[0]
-        name = data[off + 4 : off + 4 + l_name - 1].decode("ascii")
+        # same 64 KiB name cap as the streamed parser (io/stream.py) so
+        # the two decoders accept/reject identical files
+        if not 0 < l_name < (1 << 16) or off + 8 + l_name > len(data):
+            raise ValueError(f"corrupt BAM reference {i}: l_name={l_name}")
+        try:
+            name = data[off + 4 : off + 4 + l_name - 1].decode("ascii")
+        except UnicodeDecodeError as exc:
+            raise ValueError(f"corrupt BAM reference {i} name") from exc
         l_ref = struct.unpack_from("<i", data, off + 4 + l_name)[0]
+        if l_ref < 0:
+            raise ValueError(f"corrupt BAM reference {i}: l_ref={l_ref}")
         ref_names.append(name)
         ref_lens[i] = l_ref
         off += 8 + l_name
+    return ref_names, ref_lens, off
+
+
+def parse_bam_bytes(data: bytes) -> ReadBatch:
+    """Decode an (already decompressed) BAM byte string."""
+    ref_names, ref_lens, off = parse_bam_header(data)
 
     # Walk record boundaries (data-dependent chain; cheap — one unpack per
     # read; the native decoder does this in C++ for very large inputs).
@@ -79,6 +109,35 @@ def _fields_from_offsets(data: bytes, offs: np.ndarray, ref_names, ref_lens) -> 
     n_cigar = _gather_scalar(buf, offs + 12, "<u2", 2).astype(np.int64)
     flag = _gather_scalar(buf, offs + 14, "<u2", 2)
     l_seq = _gather_scalar(buf, offs + 16, "<i4", 4).astype(np.int64)
+
+    # In-record bounds check over every untrusted length field BEFORE any
+    # allocation is sized from them (adversarial-fuzz hardening, round 5):
+    # a record's name+CIGAR+SEQ must fit inside its OWN block — each end
+    # is derived from the record's block_size field (at offs-4), which the
+    # offset walks already validated to lie in-buffer, so the bound is
+    # exact for the slurp, native, and streamed-chunk callers alike (a
+    # chunk's last record must not borrow bytes from the carried tail).
+    # l_seq must be non-negative and ref_id must index the reference dict
+    # (-1 = unmapped). Every decoder shares this path, so native and pure
+    # accept/reject identically by construction.
+    if len(offs):
+        block = _gather_scalar(buf, offs - 4, "<i4", 4).astype(np.int64)
+        need = 32 + l_read_name + 4 * n_cigar + (l_seq + 1) // 2
+        bad = (l_seq < 0) | (need > block)
+        if bad.any():
+            r = int(np.flatnonzero(bad)[0])
+            raise ValueError(
+                f"corrupt BAM record {r}: l_read_name={int(l_read_name[r])} "
+                f"n_cigar={int(n_cigar[r])} l_seq={int(l_seq[r])} exceed "
+                f"record extent {int(block[r])}"
+            )
+        oob = (ref_id >= len(ref_lens)) | (ref_id < -1)
+        if oob.any():
+            r = int(np.flatnonzero(oob)[0])
+            raise ValueError(
+                f"corrupt BAM record {r}: ref_id={int(ref_id[r])} "
+                f"outside reference dict of {len(ref_lens)}"
+            )
 
     from kindel_tpu.io import native
 

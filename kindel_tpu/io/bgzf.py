@@ -30,40 +30,61 @@ def _member_bsize(data: bytes, off: int) -> int | None:
     the BC extra subfield, else None."""
     if data[off : off + 2] != _GZIP_MAGIC:
         raise ValueError(f"not a gzip member at offset {off}")
+    if off + 12 > len(data):
+        raise ValueError(f"truncated gzip member header at offset {off}")
     flg = data[off + 3]
     if not flg & 4:  # no FEXTRA
         return None
     xlen = struct.unpack_from("<H", data, off + 10)[0]
     xoff = off + 12
-    xend = xoff + xlen
+    xend = min(xoff + xlen, len(data))
     while xoff + 4 <= xend:
         si1, si2, slen = struct.unpack_from("<BBH", data, xoff)
         if si1 == 66 and si2 == 67 and slen == 2:  # "BC"
+            if xoff + 6 > len(data):
+                raise ValueError(f"truncated BGZF BC subfield at {xoff}")
             return struct.unpack_from("<H", data, xoff + 4)[0] + 1
         xoff += 4 + slen
     return None
 
 
 def decompress(data: bytes) -> bytes:
-    """Decompress a BGZF (or plain single/multi-member gzip) byte string."""
+    """Decompress a BGZF (or plain single/multi-member gzip) byte string.
+
+    Malformed input — truncated members, lying BSIZE fields, corrupt
+    deflate payloads — raises ValueError (zlib.error is wrapped so callers
+    see one clean exception type for any corrupt alignment file)."""
     out = []
     off = 0
     n = len(data)
-    while off < n:
-        bsize = _member_bsize(data, off)
-        if bsize is not None:
-            # Deflate payload sits between the 18-byte BGZF header and the
-            # 8-byte CRC/ISIZE trailer.
-            payload = data[off + 18 : off + bsize - 8]
-            out.append(zlib.decompress(payload, wbits=-15))
-            off += bsize
-        else:
-            # Generic gzip member: let zlib find the member end.
-            dobj = zlib.decompressobj(wbits=31)
-            out.append(dobj.decompress(data[off:]))
-            out.append(dobj.flush())
-            consumed = len(data) - off - len(dobj.unused_data)
-            if consumed <= 0:
-                break
-            off += consumed
+    try:
+        while off < n:
+            bsize = _member_bsize(data, off)
+            if bsize is not None:
+                if bsize < 26 or off + bsize > n:
+                    raise ValueError(
+                        f"corrupt BGZF member at {off}: BSIZE={bsize}"
+                    )
+                # Deflate payload sits between the 18-byte BGZF header and
+                # the 8-byte CRC/ISIZE trailer.
+                payload = data[off + 18 : off + bsize - 8]
+                out.append(zlib.decompress(payload, wbits=-15))
+                off += bsize
+            else:
+                # Generic gzip member: let zlib find the member end.
+                dobj = zlib.decompressobj(wbits=31)
+                out.append(dobj.decompress(data[off:]))
+                out.append(dobj.flush())
+                if not dobj.eof:
+                    # input exhausted mid-member: silent partial output
+                    # would drop trailing reads without a trace
+                    raise ValueError(
+                        f"truncated gzip member at offset {off}"
+                    )
+                consumed = len(data) - off - len(dobj.unused_data)
+                if consumed <= 0:
+                    break
+                off += consumed
+    except zlib.error as exc:
+        raise ValueError(f"corrupt gzip stream at offset {off}: {exc}") from exc
     return b"".join(out)

@@ -148,7 +148,12 @@ def bgzf_decompress(data: bytes) -> bytes | None:
     (caller falls back to the generic gzip path)."""
     lib = _load()
     size = lib.bgzf_decompressed_size(data, len(data))
-    if size < 0:
+    # ISIZE fields are attacker-controlled: cap the pre-allocation at the
+    # deflate format's own ~1032:1 expansion ceiling so a tiny file full
+    # of lying trailers cannot request hundreds of GB (round-5 fuzz
+    # finding). Anything past the cap falls back to the pure path, which
+    # inflates by actual output and raises its own clean error.
+    if size < 0 or size > len(data) * 1032 + 65536:
         return None
     out = np.empty(size, dtype=np.uint8)
     n = lib.bgzf_inflate(data, len(data), out, size)
@@ -170,25 +175,14 @@ def scan_record_offsets(data: bytes, start: int) -> np.ndarray:
 
 
 def parse_bam_bytes(data: bytes):
-    """Native-assisted BAM decode; shares the vectorized numpy field
-    extraction with the pure-Python decoder."""
-    import struct
-
+    """Native-assisted BAM decode; shares the validated header parse and
+    vectorized numpy field extraction with the pure-Python decoder (so the
+    two paths accept/reject malformed input identically — only the record
+    boundary walk differs, and both walks enforce block_size >= 32 and
+    in-buffer extents)."""
     from kindel_tpu.io import bam as pybam
 
-    if data[:4] != b"BAM\x01":
-        raise ValueError("not a BAM stream (bad magic)")
-    l_text = struct.unpack_from("<i", data, 4)[0]
-    off = 8 + l_text
-    n_ref = struct.unpack_from("<i", data, off)[0]
-    off += 4
-    ref_names = []
-    ref_lens = np.empty(n_ref, dtype=np.int64)
-    for i in range(n_ref):
-        l_name = struct.unpack_from("<i", data, off)[0]
-        ref_names.append(data[off + 4 : off + 4 + l_name - 1].decode("ascii"))
-        ref_lens[i] = struct.unpack_from("<i", data, off + 4 + l_name)[0]
-        off += 8 + l_name
+    ref_names, ref_lens, off = pybam.parse_bam_header(data)
     offs = scan_record_offsets(data, off)
     return pybam._fields_from_offsets(data, offs, ref_names, ref_lens)
 

@@ -163,28 +163,62 @@ class _Prefetcher:
         return self._eof and not self._buf
 
 
+def _take_exact(pf: _Prefetcher, n: int, what: str) -> bytes:
+    """take(n) that raises ValueError (not a downstream struct.error) when
+    the stream ends early — every header length field is untrusted."""
+    out = pf.take(n)
+    if len(out) != n:
+        raise ValueError(f"truncated BAM stream reading {what}")
+    return out
+
+
 def _read_bam_header(pf: _Prefetcher):
-    """Incrementally parse magic + header text + reference dictionary."""
+    """Incrementally parse magic + header text + reference dictionary.
+
+    Same validation surface as bam.parse_bam_header (adversarial-fuzz
+    hardening, round 5), expressed incrementally: a lying l_text is
+    skipped in bounded chunks instead of buffered whole, a lying n_ref
+    cannot size an allocation (entries append as they actually parse and
+    truncation raises), and negative l_ref is rejected like the slurp
+    path so the two decoders accept/reject the same files."""
     magic = pf.take(4)
     if magic != b"BAM\x01":
         raise ValueError("not a BAM stream (bad magic)")
-    l_text = struct.unpack("<i", pf.take(4))[0]
+    l_text = struct.unpack("<i", _take_exact(pf, 4, "l_text"))[0]
     if l_text < 0:
         raise ValueError(f"corrupt BAM header: l_text={l_text}")
-    pf.take(l_text)  # SAM-format header text (unused)
-    n_ref = struct.unpack("<i", pf.take(4))[0]
+    remaining = l_text  # SAM-format header text (unused): skip chunked
+    while remaining > 0:
+        step = min(remaining, 1 << 20)
+        _take_exact(pf, step, "header text")
+        remaining -= step
+    n_ref = struct.unpack("<i", _take_exact(pf, 4, "n_ref"))[0]
     if n_ref < 0:
         raise ValueError(f"corrupt BAM header: n_ref={n_ref}")
     ref_names: list[str] = []
-    ref_lens = np.empty(n_ref, dtype=np.int64)
+    lens: list[int] = []
     for i in range(n_ref):
-        l_name = struct.unpack("<i", pf.take(4))[0]
+        l_name = struct.unpack("<i", _take_exact(pf, 4, "l_name"))[0]
         if not 0 < l_name < (1 << 16):
             raise ValueError(f"corrupt BAM reference entry: l_name={l_name}")
-        name = pf.take(l_name)[:-1].decode("ascii")
+        try:
+            name = _take_exact(pf, l_name, "ref name")[:-1].decode("ascii")
+        except UnicodeDecodeError as exc:
+            raise ValueError(f"corrupt BAM reference {i} name") from exc
+        l_ref = struct.unpack("<i", _take_exact(pf, 4, "l_ref"))[0]
+        if l_ref < 0:
+            raise ValueError(f"corrupt BAM reference {i}: l_ref={l_ref}")
         ref_names.append(name)
-        ref_lens[i] = struct.unpack("<i", pf.take(4))[0]
-    return ref_names, ref_lens
+        lens.append(l_ref)
+    return ref_names, np.asarray(lens, dtype=np.int64)
+
+
+#: largest credible single BAM record (an ultra-long nanopore read is ~4 Mb
+#: -> ~8 MB record; 256 MB is 30x headroom). A lying block_size past this
+#: would otherwise grow the carried partial-record tail without bound —
+#: the streamer would buffer the whole remaining file before discovering
+#: the truncation, defeating its O(chunk) RSS contract (round-5 fuzz).
+_MAX_RECORD_BYTES = 256 << 20
 
 
 def _scan_complete_records(data: bytes) -> tuple[np.ndarray, int]:
@@ -195,7 +229,7 @@ def _scan_complete_records(data: bytes) -> tuple[np.ndarray, int]:
     off, n = 0, len(data)
     while off + 4 <= n:
         block_size = struct.unpack_from("<i", data, off)[0]
-        if block_size < 32:
+        if block_size < 32 or block_size > _MAX_RECORD_BYTES:
             raise ValueError(
                 f"corrupt BAM record at stream offset {off}: "
                 f"block_size={block_size}"

@@ -50,13 +50,17 @@ int64_t bgzf_inflate(const uint8_t* data, int64_t len, uint8_t* out,
         if (!(data[off + 3] & 4)) return -1;
         uint16_t xlen;
         std::memcpy(&xlen, data + off + 10, 2);
+        // clamp the extra-field walk to the buffer: xlen is untrusted and
+        // off+12+xlen can lie past the end of a truncated member
         int64_t xoff = off + 12, xend = xoff + xlen;
+        if (xend > len) xend = len;
         int64_t bsize = -1;
         while (xoff + 4 <= xend) {
             uint8_t si1 = data[xoff], si2 = data[xoff + 1];
             uint16_t slen;
             std::memcpy(&slen, data + xoff + 2, 2);
             if (si1 == 66 && si2 == 67 && slen == 2) {
+                if (xoff + 6 > len) return -1;
                 uint16_t bs;
                 std::memcpy(&bs, data + xoff + 4, 2);
                 bsize = static_cast<int64_t>(bs) + 1;
@@ -96,12 +100,15 @@ int64_t bgzf_decompressed_size(const uint8_t* data, int64_t len) {
             return -1;
         uint16_t xlen;
         std::memcpy(&xlen, data + off + 10, 2);
+        // same untrusted-xlen clamp as bgzf_inflate
         int64_t xoff = off + 12, xend = xoff + xlen;
+        if (xend > len) xend = len;
         int64_t bsize = -1;
         while (xoff + 4 <= xend) {
             uint16_t slen;
             std::memcpy(&slen, data + xoff + 2, 2);
             if (data[xoff] == 66 && data[xoff + 1] == 67 && slen == 2) {
+                if (xoff + 6 > len) return -1;
                 uint16_t bs;
                 std::memcpy(&bs, data + xoff + 4, 2);
                 bsize = static_cast<int64_t>(bs) + 1;
@@ -109,7 +116,11 @@ int64_t bgzf_decompressed_size(const uint8_t* data, int64_t len) {
             }
             xoff += 4 + slen;
         }
-        if (bsize < 0 || off + bsize > len) return -1;
+        // bsize < 26 (18-byte header + 8-byte trailer) would place the
+        // ISIZE read before the member start — the exploitable OOB read
+        // this round's ASan fuzz caught (the inflate path already had the
+        // stricter bound; the size pre-pass only rejected negatives)
+        if (bsize < 26 || off + bsize > len) return -1;
         uint32_t isize;
         std::memcpy(&isize, data + off + bsize - 4, 4);
         total += isize;

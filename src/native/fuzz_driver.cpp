@@ -1,0 +1,198 @@
+// Adversarial fuzz driver for the native decode kernels, built and run
+// under AddressSanitizer/UBSan (make asan). Every exported function in
+// bam_decode.cpp is fed truncated buffers, lying length fields, negative
+// and overflowing sizes, and random corruption; the pass criterion is
+// simply that the process exits 0 with no sanitizer report — each call
+// must either succeed within bounds or return its documented error code.
+//
+// The Python-level accept/reject contract is pinned separately in
+// tests/test_decode_fuzz.py; this driver exists because ctypes callers
+// cannot see a heap-buffer-overflow that happens to land in mapped
+// memory, and ASan can.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <random>
+#include <vector>
+
+extern "C" {
+int64_t bam_scan_offsets(const uint8_t*, int64_t, int64_t, int64_t*, int64_t);
+int64_t bgzf_inflate(const uint8_t*, int64_t, uint8_t*, int64_t);
+int64_t bgzf_decompressed_size(const uint8_t*, int64_t);
+int64_t ragged_indices64(const int64_t*, const int64_t*, int64_t, int64_t*);
+int64_t ragged_local64(const int64_t*, int64_t, int64_t*);
+int64_t parse_cigar(const uint8_t*, int64_t, const int64_t*, const int64_t*,
+                    int64_t, uint8_t*, int64_t*);
+int64_t unpack_seq(const uint8_t*, int64_t, const int64_t*, const int64_t*,
+                   int64_t, const uint8_t*, uint8_t*);
+int64_t expand_match_events(const int64_t*, const int64_t*, const int64_t*,
+                            const int64_t*, const int64_t*, int64_t,
+                            const uint8_t*, int64_t, const uint8_t*,
+                            int64_t*, int64_t*, uint8_t*);
+}
+
+static std::mt19937_64 rng(2026);
+
+static int64_t ri(int64_t lo, int64_t hi) {  // inclusive
+    return lo + static_cast<int64_t>(rng() % static_cast<uint64_t>(hi - lo + 1));
+}
+
+// Exact-capacity allocations: an off-by-one write lands in ASan redzones.
+struct Buf {
+    std::vector<uint8_t> v;
+    explicit Buf(int64_t n) : v(static_cast<size_t>(n)) {}
+    uint8_t* p() { return v.data(); }
+};
+
+static void put32(std::vector<uint8_t>& b, size_t off, int32_t x) {
+    std::memcpy(b.data() + off, &x, 4);
+}
+
+// --- bam_scan_offsets: lying block_size fields, truncations ---
+static void fuzz_scan() {
+    for (int iter = 0; iter < 2000; ++iter) {
+        int64_t n = ri(0, 200);
+        std::vector<uint8_t> data(static_cast<size_t>(n));
+        for (auto& c : data) c = static_cast<uint8_t>(rng());
+        // half the time, plant plausible-but-lying block sizes
+        if (n >= 8 && (iter & 1)) {
+            put32(data, 0, static_cast<int32_t>(ri(-40, n + 40)));
+        }
+        std::vector<int64_t> out(static_cast<size_t>(n / 36 + 8));
+        bam_scan_offsets(data.data(), n, ri(0, n), out.data(),
+                         static_cast<int64_t>(out.size()));
+        // tiny capacity must hit the -2 path, never write past cap
+        int64_t tiny[1];
+        bam_scan_offsets(data.data(), n, 0, tiny, 1);
+    }
+}
+
+// --- bgzf_inflate / bgzf_decompressed_size: corrupt framing ---
+static void fuzz_bgzf() {
+    // a syntactically BGZF-ish header with adversarial XLEN/BSIZE/ISIZE
+    for (int iter = 0; iter < 2000; ++iter) {
+        int64_t n = ri(0, 128);
+        std::vector<uint8_t> d(static_cast<size_t>(n));
+        for (auto& c : d) c = static_cast<uint8_t>(rng());
+        if (n >= 18 && (iter % 3)) {
+            d[0] = 0x1f; d[1] = 0x8b; d[2] = 8; d[3] = 4;
+            uint16_t xlen = static_cast<uint16_t>(ri(0, 64));
+            std::memcpy(d.data() + 10, &xlen, 2);
+            if (n >= 18) {
+                d[12] = 66; d[13] = 67;
+                uint16_t slen = 2;
+                std::memcpy(d.data() + 14, &slen, 2);
+                uint16_t bs = static_cast<uint16_t>(ri(0, 200));
+                std::memcpy(d.data() + 16, &bs, 2);
+            }
+        }
+        bgzf_decompressed_size(d.data(), n);
+        Buf out(256);
+        bgzf_inflate(d.data(), n, out.p(), 256);
+        // zero-capacity output: ISIZE lies must be caught before writes
+        bgzf_inflate(d.data(), n, out.p(), 0);
+    }
+}
+
+// --- ragged kernels: negative/overflow lengths, exact capacity ---
+static void fuzz_ragged() {
+    for (int iter = 0; iter < 2000; ++iter) {
+        int64_t n = ri(0, 64);
+        std::vector<int64_t> starts(static_cast<size_t>(n)),
+            lens(static_cast<size_t>(n));
+        int64_t total = 0;
+        bool neg = false;
+        for (int64_t i = 0; i < n; ++i) {
+            starts[static_cast<size_t>(i)] = ri(-100, 100);
+            int64_t l = ri(iter % 4 ? 0 : -8, 16);  // negatives 1 in 4 runs
+            lens[static_cast<size_t>(i)] = l;
+            if (l < 0) neg = true; else total += l;
+        }
+        // capacity sized exactly as the Python callers size it: sum of
+        // lens when all non-negative; with negatives present the call must
+        // return -1 BEFORE writing anything, so even a zero-sized buffer
+        // is legal
+        std::vector<int64_t> out(static_cast<size_t>(neg ? 0 : total));
+        int64_t rc = ragged_indices64(starts.data(), lens.data(), n,
+                                      out.data());
+        if (neg && rc != -1) { std::fprintf(stderr, "neg accept\n"); __builtin_trap(); }
+        std::vector<int64_t> out2(static_cast<size_t>(neg ? 0 : total));
+        rc = ragged_local64(lens.data(), n, out2.data());
+        if (neg && rc != -1) { std::fprintf(stderr, "neg accept\n"); __builtin_trap(); }
+    }
+}
+
+// --- parse_cigar / unpack_seq: out-of-buffer starts, lying counts ---
+static void fuzz_parse() {
+    for (int iter = 0; iter < 2000; ++iter) {
+        int64_t blen = ri(0, 256);
+        std::vector<uint8_t> buf(static_cast<size_t>(blen));
+        for (auto& c : buf) c = static_cast<uint8_t>(rng());
+        int64_t n = ri(0, 16);
+        std::vector<int64_t> starts(static_cast<size_t>(n)),
+            counts(static_cast<size_t>(n));
+        int64_t total = 0;
+        bool neg = false;
+        for (int64_t i = 0; i < n; ++i) {
+            starts[static_cast<size_t>(i)] = ri(-16, blen + 16);
+            int64_t c = ri(iter % 4 ? 0 : -4, 12);
+            counts[static_cast<size_t>(i)] = c;
+            if (c < 0) neg = true; else total += c;
+        }
+        std::vector<uint8_t> op(static_cast<size_t>(neg ? 0 : total));
+        std::vector<int64_t> ln(static_cast<size_t>(neg ? 0 : total));
+        int64_t rc = parse_cigar(buf.data(), blen, starts.data(),
+                                 counts.data(), n, op.data(), ln.data());
+        if (neg && rc != -1) { std::fprintf(stderr, "neg accept\n"); __builtin_trap(); }
+        uint8_t nt16[16];
+        for (int i = 0; i < 16; ++i) nt16[i] = static_cast<uint8_t>('A' + i);
+        std::vector<uint8_t> seq_out(static_cast<size_t>(neg ? 0 : total));
+        rc = unpack_seq(buf.data(), blen, starts.data(), counts.data(), n,
+                        nt16, seq_out.data());
+        if (neg && rc != -1) { std::fprintf(stderr, "neg accept\n"); __builtin_trap(); }
+    }
+}
+
+// --- expand_match_events: wrap positions, out-of-range query offsets ---
+static void fuzz_expand() {
+    for (int iter = 0; iter < 2000; ++iter) {
+        int64_t seq_len = ri(0, 128);
+        std::vector<uint8_t> seq(static_cast<size_t>(seq_len));
+        for (auto& c : seq) c = static_cast<uint8_t>(rng());
+        uint8_t code[256];
+        for (int i = 0; i < 256; ++i) code[i] = static_cast<uint8_t>(i & 7);
+        int64_t n = ri(0, 16);
+        std::vector<int64_t> rs(static_cast<size_t>(n)),
+            qa(static_cast<size_t>(n)), lens(static_cast<size_t>(n)),
+            rid(static_cast<size_t>(n)), L(static_cast<size_t>(n));
+        int64_t total = 0;
+        bool neg = false;
+        for (int64_t i = 0; i < n; ++i) {
+            rs[static_cast<size_t>(i)] = ri(-300, 300);
+            qa[static_cast<size_t>(i)] = ri(-8, seq_len + 8);
+            int64_t l = ri(iter % 4 ? 0 : -4, 24);
+            lens[static_cast<size_t>(i)] = l;
+            rid[static_cast<size_t>(i)] = ri(0, 3);
+            L[static_cast<size_t>(i)] = ri(0, 200);
+            if (l < 0) neg = true; else total += l;
+        }
+        std::vector<int64_t> orid(static_cast<size_t>(neg ? 0 : total)),
+            opos(static_cast<size_t>(neg ? 0 : total));
+        std::vector<uint8_t> ob(static_cast<size_t>(neg ? 0 : total));
+        int64_t rc = expand_match_events(
+            rs.data(), qa.data(), lens.data(), rid.data(), L.data(), n,
+            seq.data(), seq_len, code, orid.data(), opos.data(), ob.data());
+        if (neg && rc != -1) { std::fprintf(stderr, "neg accept\n"); __builtin_trap(); }
+    }
+}
+
+int main() {
+    fuzz_scan();
+    fuzz_bgzf();
+    fuzz_ragged();
+    fuzz_parse();
+    fuzz_expand();
+    std::puts("fuzz_driver: ok");
+    return 0;
+}

@@ -1,6 +1,8 @@
 """Deterministic synthetic cohort + output digest shared between the
 2-process distributed test's workers and its single-process reference
-(tests/test_distributed.py, tests/_dist_worker.py)."""
+(tests/test_distributed.py, tests/_dist_worker.py), plus the shared
+two-process launch harness (port reservation, bind-race retry, cleanup)
+used by every 2-process test and benchmark."""
 
 import hashlib
 
@@ -8,6 +10,10 @@ import numpy as np
 
 REF_LEN = 512
 AXES = {"dp": 2, "sp": 4}
+#: chunk size for the streamed×sharded worker: small enough that the
+#: ~10 KB product SAM splits into several chunks (multi-chunk
+#: accumulation is the behavior under test)
+STREAM_CHUNK_BYTES = 2048
 
 
 def make_samples(n: int = 4, seed: int = 7) -> list[dict]:
@@ -76,6 +82,70 @@ def product_sam(ref_len: int = 2048, seed: int = 5) -> bytes:
         pos = int(rng.integers(1100, ref_len - 80))
         read(pos + 1, "30M6I24M", rand_seq(60))
     return b"\n".join(lines) + b"\n"
+
+
+def run_two_process(worker, extra_argv=(), timeout: float = 300,
+                    retries: int = 3):
+    """Launch a worker script twice as a localhost 2-process JAX group.
+
+    Reserves a coordinator port (bind-then-close), passes
+    `<process_id> <port> *extra_argv` to each worker, scrubs the
+    accelerator hook, retries the inherent port-reservation race (another
+    process can steal the just-released port before the coordinator
+    binds), and never leaks a worker blocked in initialize(). Returns
+    [(returncode, stdout, stderr), ...]; raises RuntimeError when a
+    worker fails for a non-race reason or races persist past `retries`.
+    """
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+
+    def run_pair():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        procs = [
+            subprocess.Popen(
+                [sys.executable, str(worker), str(i), str(port),
+                 *map(str, extra_argv)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+                env=env,
+            )
+            for i in range(2)
+        ]
+        try:
+            return [
+                (p.returncode, out, err)
+                for p, (out, err) in zip(
+                    procs, [p.communicate(timeout=timeout) for p in procs]
+                )
+            ]
+        finally:
+            for p in procs:  # never leak a worker blocked in initialize()
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
+
+    for attempt in range(retries):
+        outs = run_pair()
+        if all(rc == 0 for rc, _, _ in outs):
+            return outs
+        bind_race = any(
+            "bind" in err.lower() or "address already in use" in err.lower()
+            for _, _, err in outs
+        )
+        if not bind_race:
+            break
+    raise RuntimeError(
+        f"2-process group failed (rc={[rc for rc, _, _ in outs]}):\n"
+        f"stderr[0] tail: {outs[0][2][-1500:]}\n"
+        f"stderr[1] tail: {outs[1][2][-1500:]}"
+    )
 
 
 def product_digest(res, dmin: int, dmax: int, cdr) -> str:
