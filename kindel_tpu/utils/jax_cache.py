@@ -9,8 +9,14 @@ not once per process: every jax-importing module calls
 persistent cache at a per-user directory.
 
 Env:
-  KINDEL_TPU_COMPILE_CACHE=<dir>  — cache location (default
-                                    ~/.cache/kindel_tpu/xla)
+  KINDEL_TPU_COMPILE_CACHE=<dir>  — cache location, used exactly as given
+                                    (point prewarmed caches here). Default
+                                    ~/.cache/kindel_tpu/xla, which on the
+                                    CPU backend gains a per-host
+                                    fingerprint subdirectory — XLA:CPU AOT
+                                    entries embed the compile machine's
+                                    features and must not cross hosts
+                                    (SIGILL risk, pessimized code).
   KINDEL_TPU_COMPILE_CACHE=off    — disable
 """
 
@@ -38,9 +44,49 @@ def ensure_compilation_cache() -> None:
 
         if not loc and jax.config.jax_compilation_cache_dir is not None:
             return  # ditto, configured via jax.config.update
+        # XLA:CPU AOT entries embed the COMPILE machine's feature set; a
+        # cache written on a different host loads with "machine type
+        # doesn't match ... could lead to SIGILL" warnings and can be
+        # slower than a fresh compile (observed: entries carrying
+        # +prefer-no-scatter on a host without it). Key the DEFAULT
+        # location by a host fingerprint so CPU entries never cross
+        # machines — but only on the CPU backend (accelerator programs
+        # don't embed host features, and a shared cache across a pod's
+        # hosts is the point), and never for an explicit
+        # KINDEL_TPU_COMPILE_CACHE=<dir> (prewarmed caches live at the
+        # exact path the operator gave). Old un-tagged entries at the
+        # default location are simply not read again — one recompile.
+        # decide from the CONFIGURED platform, not jax.default_backend():
+        # the latter initializes the backend, and with an accelerator
+        # plugin registered and its relay down that call hangs — this
+        # function runs at import time. Unpinned processes (accelerator
+        # runs) keep the shared untagged location.
+        platforms = str(
+            jax.config.jax_platforms or os.environ.get("JAX_PLATFORMS", "")
+        )
+        if not loc and "cpu" in platforms:
+            cache_dir = cache_dir / _machine_tag(jax.__version__)
         cache_dir.mkdir(parents=True, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", str(cache_dir))
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     except Exception:  # cache is an optimization — never fail the pipeline
         pass
+
+
+def _machine_tag(jax_version: str) -> str:
+    """Short stable fingerprint of this host's CPU capability surface
+    (jax version + platform + /proc/cpuinfo flags when available)."""
+    import hashlib
+    import platform
+
+    parts = [platform.machine(), platform.processor() or "", jax_version]
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for line in fh:
+                if line.startswith(("flags", "Features")):
+                    parts.append(line.strip())
+                    break
+    except OSError:
+        pass
+    return hashlib.sha1("|".join(parts).encode()).hexdigest()[:12]

@@ -11,6 +11,8 @@ def test_cache_configured(tmp_path, monkeypatch):
     monkeypatch.setattr(jax_cache, "_done", False)
     try:
         jax_cache.ensure_compilation_cache()
+        # an explicit KINDEL_TPU_COMPILE_CACHE=<dir> is used EXACTLY as
+        # given (prewarmed caches must hit) — no fingerprint subdirectory
         assert jax.config.jax_compilation_cache_dir == str(tmp_path / "xla")
         assert (tmp_path / "xla").is_dir()
     finally:
@@ -36,3 +38,23 @@ def test_cache_disable(tmp_path, monkeypatch):
     jax_cache.ensure_compilation_cache()
     # disabling must not clobber an unrelated existing setting
     assert jax.config.jax_compilation_cache_dir == before
+
+
+def test_default_location_is_machine_tagged(tmp_path, monkeypatch):
+    """The DEFAULT cache location gains a per-host fingerprint subdir on
+    the CPU backend: XLA:CPU AOT entries embed the compile machine's
+    feature set, and loading another host's entries warns of SIGILL and
+    can be slower than a fresh compile."""
+    before = jax.config.jax_compilation_cache_dir
+    monkeypatch.delenv("KINDEL_TPU_COMPILE_CACHE", raising=False)
+    monkeypatch.setenv("HOME", str(tmp_path))
+    monkeypatch.setattr(jax_cache, "_done", False)
+    try:
+        jax.config.update("jax_compilation_cache_dir", None)
+        jax_cache.ensure_compilation_cache()
+        got = jax.config.jax_compilation_cache_dir
+        tag = jax_cache._machine_tag(jax.__version__)
+        assert got is not None and got.endswith(tag)
+        assert str(tmp_path) in got
+    finally:
+        jax.config.update("jax_compilation_cache_dir", before)
