@@ -40,6 +40,8 @@ def parse_sam_bytes(data: bytes) -> ReadBatch:
                     elif field.startswith(b"LN:"):
                         ln = int(field[3:])
                 if sn is not None and ln is not None:
+                    if not 0 <= ln < (1 << 62):
+                        raise ValueError(f"SAM @SQ LN out of range: {ln}")
                     name_to_id[sn] = len(ref_names)
                     ref_names.append(sn.decode("ascii"))
                     ref_lens.append(ln)
@@ -51,6 +53,16 @@ def parse_sam_bytes(data: bytes) -> ReadBatch:
         rname = fields[2]
         pos = int(fields[3]) - 1  # SAM is 1-based
         mapq = int(fields[4])
+        # range-check before the columnar numpy conversions below: an
+        # out-of-range value would otherwise surface as OverflowError
+        # from np.asarray, breaking the decode surface's ValueError-only
+        # contract (tests/test_decode_fuzz.py)
+        if not 0 <= flag < (1 << 16):
+            raise ValueError(f"SAM flag out of range: {flag}")
+        if not 0 <= mapq < (1 << 8):
+            raise ValueError(f"SAM mapq out of range: {mapq}")
+        if not -1 <= pos < (1 << 62):
+            raise ValueError(f"SAM pos out of range: {pos + 1}")
         cigar = fields[5]
         seq = fields[9].upper()
 
@@ -67,7 +79,10 @@ def parse_sam_bytes(data: bytes) -> ReadBatch:
                 if m.start() != consumed:
                     break
                 consumed = m.end()
-                cig_lens_l.append(int(m.group(1)))
+                op_len = int(m.group(1))
+                if op_len >= 1 << 31:  # BAM caps op lengths at 28 bits
+                    raise ValueError(f"SAM CIGAR op length {op_len}")
+                cig_lens_l.append(op_len)
                 cig_ops_l.append(_OP_CODE[m.group(2)])
                 n_ops += 1
             if consumed != len(cigar):

@@ -416,3 +416,56 @@ def test_round5_review_reproductions(tmp_path):
     fg.write_bytes(gzip.compress(bytes(giant)))
     with pytest.raises(ValueError):
         list(stream_alignment(fg, 4096))
+
+
+def test_sam_text_garbage_clean_errors(tmp_path):
+    """The SAM text decoder must also hold the ValueError-only contract:
+    malformed numeric fields, bad CIGAR strings, binary junk lines."""
+    from kindel_tpu.io import load_alignment
+    from kindel_tpu.io.sam import parse_sam_bytes
+
+    header = b"@SQ\tSN:r1\tLN:100\n"
+    ok_line = b"a\t0\tr1\t1\t60\t4M\t*\t0\t0\tACGT\t*\n"
+    assert parse_sam_bytes(header + ok_line).n_reads == 1
+
+    bad = [
+        header + b"a\tNOTINT\tr1\t1\t60\t4M\t*\t0\t0\tACGT\t*\n",
+        header + b"a\t0\tr1\tNOTINT\t60\t4M\t*\t0\t0\tACGT\t*\n",
+        header + b"a\t0\tr1\t1\tNOTINT\t4M\t*\t0\t0\tACGT\t*\n",
+        header + b"a\t0\tr1\t1\t60\t4Q\t*\t0\t0\tACGT\t*\n",  # bad op
+        header + b"a\t0\tr1\t1\t60\tM4\t*\t0\t0\tACGT\t*\n",  # bad order
+        b"@SQ\tSN:r1\tLN:NOTINT\n" + ok_line,  # header LN lie
+        # in-grammar but OUT-OF-RANGE integers: previously surfaced as
+        # OverflowError from the columnar numpy conversions, violating
+        # the ValueError-only contract (round-5 review finding)
+        header + b"a\t70000\tr1\t1\t60\t4M\t*\t0\t0\tACGT\t*\n",
+        header + b"a\t-1\tr1\t1\t60\t4M\t*\t0\t0\tACGT\t*\n",
+        header + b"a\t0\tr1\t1\t300\t4M\t*\t0\t0\tACGT\t*\n",
+        header + b"a\t0\tr1\t1\t-1\t4M\t*\t0\t0\tACGT\t*\n",
+        header + b"a\t0\tr1\t" + str(10 ** 30).encode()
+        + b"\t60\t4M\t*\t0\t0\tACGT\t*\n",
+        header + b"a\t0\tr1\t1\t60\t99999999999999M\t*\t0\t0\tACGT\t*\n",
+        b"@SQ\tSN:r1\tLN:" + str(10 ** 30).encode() + b"\n" + ok_line,
+    ]
+    for i, blob in enumerate(bad):
+        with pytest.raises(ValueError):
+            parse_sam_bytes(blob)
+        f = tmp_path / f"bad{i}.sam"
+        f.write_bytes(blob)
+        with pytest.raises(ValueError):
+            load_alignment(f)
+
+    # binary junk that is neither gzip nor BAM routes to the SAM parser
+    # and must come back as ValueError, not a decode crash
+    rng = np.random.default_rng(53)
+    junk = bytes(rng.integers(1, 256, 2048, dtype=np.uint8)).replace(b"\x1f", b"x")
+    f = tmp_path / "junk.sam"
+    f.write_bytes(junk)
+    try:
+        batch = load_alignment(f)
+        # accepted as (degenerate) SAM: the batch must still be
+        # structurally sound, not merely constructed
+        assert batch.seq_off.shape[0] == batch.n_reads + 1
+        assert batch.cig_off.shape[0] == batch.n_reads + 1
+    except CLEAN:
+        pass
