@@ -712,15 +712,36 @@ def decode_fast(plane_packed: np.ndarray, exc_bits: np.ndarray,
     ins_flags = np.unpackbits(
         np.asarray(ins_flag_bits)
     )[: len(ins_pos)].astype(bool)
-    plane = np.empty(plane_packed.shape[0] * 4, dtype=np.uint8)
-    plane[0::4] = plane_packed >> 6
-    plane[1::4] = (plane_packed >> 4) & 3
-    plane[2::4] = (plane_packed >> 2) & 3
-    plane[3::4] = plane_packed & 3
-    base_char = EMIT_ASCII[1:5][plane[:L]]
+    from kindel_tpu.io import native
 
-    exc = np.unpackbits(np.asarray(exc_bits))[:L].astype(bool)
-    base_char = np.where(exc, EMIT_ASCII[N_CHANNELS], base_char)
+    plane_packed = np.asarray(plane_packed)
+    exc_bits = np.asarray(exc_bits)
+    if plane_packed.shape[0] * 4 < L or exc_bits.shape[0] * 8 < L:
+        # a short wire buffer must fail loudly on BOTH paths — the numpy
+        # expansion below would otherwise silently truncate base_char
+        # while the masks stay length L
+        raise ValueError(
+            f"wire buffers too short for L={L}: plane={plane_packed.shape[0]}"
+            f" bytes, exc={exc_bits.shape[0]} bytes"
+        )
+    base_char = (
+        native.decode_plane(
+            plane_packed, exc_bits, L,
+            EMIT_ASCII[1:5], int(EMIT_ASCII[N_CHANNELS]),
+        )
+        if native.available()
+        else None
+    )
+    if base_char is None:
+        plane = np.empty(plane_packed.shape[0] * 4, dtype=np.uint8)
+        plane[0::4] = plane_packed >> 6
+        plane[1::4] = (plane_packed >> 4) & 3
+        plane[2::4] = (plane_packed >> 2) & 3
+        plane[3::4] = plane_packed & 3
+        base_char = EMIT_ASCII[1:5][plane[:L]]
+
+        exc = np.unpackbits(np.asarray(exc_bits))[:L].astype(bool)
+        base_char = np.where(exc, EMIT_ASCII[N_CHANNELS], base_char)
 
     del_mask = np.zeros(L, dtype=bool)
     if len(del_pos):

@@ -95,8 +95,8 @@ def _load_locked():
         _try_build()
     if _lib is None and _LIB_PATH.exists():
         lib = ctypes.CDLL(str(_LIB_PATH))
-        if not hasattr(lib, "expand_match_events"):
-            # Stale .so from before the expansion kernels: rebuild once.
+        if not hasattr(lib, "decode_plane"):
+            # Stale .so missing the newest kernel: rebuild once.
             # glibc's dlopen caches handles by pathname, so re-CDLLing the
             # same path after the rebuild would return the stale handle —
             # load the rebuilt library through a fresh uniquely-named copy
@@ -104,7 +104,7 @@ def _load_locked():
             _build_tried = False
             _try_build()
             lib = _load_fresh_copy()
-            if lib is None or not hasattr(lib, "expand_match_events"):
+            if lib is None or not hasattr(lib, "decode_plane"):
                 # recovery failed: cache the negative result so the hot
                 # path never re-spawns make / re-dlopens per call
                 _stale = True
@@ -130,6 +130,10 @@ def _load_locked():
         lib.expand_match_events.argtypes = [
             i64p, i64p, i64p, i64p, i64p, i64, u8p, i64, u8p,
             i64p, i64p, u8p,
+        ]
+        lib.decode_plane.restype = i64
+        lib.decode_plane.argtypes = [
+            u8p, i64, u8p, i64, i64, u8p, ctypes.c_uint8, u8p,
         ]
         _lib = lib
     return _lib
@@ -273,3 +277,21 @@ def expand_match_events(r_start, q_abs, lens, rid, L, seq: np.ndarray,
     if n < 0:
         return None
     return out_rid[:n], out_pos[:n], out_base[:n]
+
+
+def decode_plane(plane_packed: np.ndarray, exc_bits: np.ndarray, L: int,
+                 base4: np.ndarray, n_char: int) -> np.ndarray | None:
+    """Fused 2-bit plane → ASCII expansion with the exception bitmask
+    applied (call_jax.decode_fast's hot loop as one C++ pass); None when
+    the wire buffers are shorter than L demands (caller falls back to
+    the numpy path, which handles the short-buffer error)."""
+    out = np.empty(L, dtype=np.uint8)
+    plane = np.ascontiguousarray(plane_packed, dtype=np.uint8)
+    exc = np.ascontiguousarray(exc_bits, dtype=np.uint8)
+    n = _load().decode_plane(
+        plane, len(plane), exc, len(exc), L,
+        np.ascontiguousarray(base4, dtype=np.uint8), n_char, out,
+    )
+    if n != L:
+        return None
+    return out

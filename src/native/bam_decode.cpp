@@ -249,4 +249,45 @@ int64_t expand_match_events(const int64_t* r_start, const int64_t* q_abs,
     return k;
 }
 
+// Fused consensus-wire decode: expand the 2-bit base plane to ASCII
+// through the 4-entry table and apply the exception bitmask (N/skip
+// positions, MSB-first as numpy packbits writes it) in one pass.
+// Replaces four strided numpy stores + unpackbits + where in
+// call_jax.decode_fast. Caller guarantees plane holds ceil(L/4) bytes
+// and exc ceil(L/8); returns -1 when the buffers are too short.
+int64_t decode_plane(const uint8_t* plane, int64_t plane_len,
+                     const uint8_t* exc, int64_t exc_len, int64_t L,
+                     const uint8_t* base4, uint8_t n_char, uint8_t* out) {
+    if (plane_len * 4 < L || exc_len * 8 < L) return -1;
+    // byte-at-a-time LUT expansion (each packed byte -> 4 ASCII chars),
+    // then a second pass that touches only NONZERO exception bytes —
+    // exceptions (N / deletion-skip) are sparse on real pileups, so the
+    // second pass is nearly free and the first is a straight table copy
+    uint8_t lut[256][4];
+    for (int v = 0; v < 256; ++v) {
+        lut[v][0] = base4[(v >> 6) & 3];
+        lut[v][1] = base4[(v >> 4) & 3];
+        lut[v][2] = base4[(v >> 2) & 3];
+        lut[v][3] = base4[v & 3];
+    }
+    const int64_t nb = L >> 2;
+    for (int64_t j = 0; j < nb; ++j)
+        std::memcpy(out + 4 * j, lut[plane[j]], 4);
+    for (int64_t j = nb * 4; j < L; ++j)
+        out[j] = base4[(plane[j >> 2] >> (6 - 2 * (j & 3))) & 3];
+    const int64_t eb = (L + 7) / 8;
+    for (int64_t k = 0; k < eb; ++k) {
+        const uint8_t e = exc[k];
+        if (!e) continue;
+        const int64_t base = k * 8;
+        for (int b = 0; b < 8; ++b) {
+            if ((e >> (7 - b)) & 1) {
+                const int64_t j = base + b;
+                if (j < L) out[j] = n_char;
+            }
+        }
+    }
+    return L;
+}
+
 }  // extern "C"

@@ -30,6 +30,8 @@ int64_t expand_match_events(const int64_t*, const int64_t*, const int64_t*,
                             const int64_t*, const int64_t*, int64_t,
                             const uint8_t*, int64_t, const uint8_t*,
                             int64_t*, int64_t*, uint8_t*);
+int64_t decode_plane(const uint8_t*, int64_t, const uint8_t*, int64_t,
+                     int64_t, const uint8_t*, uint8_t, uint8_t*);
 }
 
 static std::mt19937_64 rng(2026);
@@ -187,12 +189,31 @@ static void fuzz_expand() {
     }
 }
 
+// --- decode_plane: short wire buffers, lying L, exact-capacity output ---
+static void fuzz_decode_plane() {
+    uint8_t base4[4] = {'A', 'C', 'G', 'T'};
+    for (int iter = 0; iter < 2000; ++iter) {
+        int64_t plane_len = ri(0, 64), exc_len = ri(0, 64);
+        std::vector<uint8_t> plane(static_cast<size_t>(plane_len)),
+            exc(static_cast<size_t>(exc_len));
+        for (auto& c : plane) c = static_cast<uint8_t>(rng());
+        for (auto& c : exc) c = static_cast<uint8_t>(rng());
+        int64_t L = ri(0, 300);  // often lies past the buffers
+        std::vector<uint8_t> out(static_cast<size_t>(L));
+        int64_t rc = decode_plane(plane.data(), plane_len, exc.data(),
+                                  exc_len, L, base4, 'N', out.data());
+        const bool fits = plane_len * 4 >= L && exc_len * 8 >= L;
+        if (fits != (rc == L)) { std::fprintf(stderr, "plane gate\n"); __builtin_trap(); }
+    }
+}
+
 int main() {
     fuzz_scan();
     fuzz_bgzf();
     fuzz_ragged();
     fuzz_parse();
     fuzz_expand();
+    fuzz_decode_plane();
     std::puts("fuzz_driver: ok");
     return 0;
 }
