@@ -59,10 +59,14 @@ def _inflate_stream(fh) -> Iterator[bytes]:
             if not buf:
                 more = fh.read(_SLAB)
                 if not more:
-                    out = dobj.flush()
-                    if out:
-                        yield out
-                    return
+                    # input exhausted mid-member (dobj is only live here
+                    # while eof is False — a finished member clears it to
+                    # None below): flushing the partial output would
+                    # silently drop every trailing read, same contract as
+                    # bgzf.decompress on the slurp path
+                    raise ValueError(
+                        "truncated gzip member at end of stream"
+                    )
                 buf = bytearray(more)
             out = dobj.decompress(bytes(buf), _MAX_INFLATE)
             if out:

@@ -469,3 +469,27 @@ def test_sam_text_garbage_clean_errors(tmp_path):
         assert batch.cig_off.shape[0] == batch.n_reads + 1
     except CLEAN:
         pass
+
+
+def test_streamed_gzip_truncation_never_silent(tmp_path):
+    """Truncating a generic-gzip (non-BGZF) BAM anywhere must raise from
+    the STREAMED path too — the generic-member branch previously flushed
+    partial output and returned on EOF, silently dropping trailing reads
+    (round-5 finding; the slurp path had the same bug fixed earlier)."""
+    import gzip
+
+    from kindel_tpu.io.stream import stream_alignment
+
+    blob = gzip.compress(bytes(_mini_bam()))
+    rng = np.random.default_rng(71)
+    cuts = set(int(c) for c in rng.integers(1, len(blob) - 1, 25))
+    cuts |= {10, 50, len(blob) // 2, len(blob) - 5, len(blob) - 1}
+    for cut in sorted(cuts):
+        f = tmp_path / "t.bam"
+        f.write_bytes(blob[:cut])
+        with pytest.raises(ValueError):
+            list(stream_alignment(f, 4096))
+    # untruncated sanity: still decodes
+    f = tmp_path / "ok.bam"
+    f.write_bytes(blob)
+    assert sum(b.n_reads for b in stream_alignment(f, 4096)) == 5
