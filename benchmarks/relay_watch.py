@@ -91,6 +91,32 @@ def run_microprof(ts_iso: str) -> None:
             fh.write(f"=== {ts_iso} microprof failed: {e}\n")
 
 
+def run_budget_probe(ts: float) -> None:
+    """After a successful TPU bench, bank the on-HBM cohort-budget
+    validation (estimate vs live buffers — relay-return checklist item
+    d). One JSON line into BENCH_ATTEMPTS.jsonl, tagged by its metric."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "benchmarks" / "budget_probe.py")],
+            capture_output=True, text=True, timeout=300, cwd=REPO,
+            env=hz.accelerator_env(),
+        )
+        line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            rec = {"error": "unparseable budget probe",
+                   "stdout_tail": line[:300]}
+        rec["rc"] = proc.returncode
+        if proc.returncode != 0 or "error" in rec:
+            # keep the traceback: this log's whole purpose is banking the
+            # rare TPU-window evidence (matches run_microprof)
+            rec["stderr_tail"] = proc.stderr[-500:]
+        append(BENCH_LOG, {**stamp(ts), **rec})
+    except Exception as e:  # evidence capture must never kill the watcher
+        append(BENCH_LOG, {**stamp(ts), "error": f"budget probe: {e}"})
+
+
 def run_bench() -> dict:
     t0 = time.time()
     try:
@@ -200,6 +226,7 @@ def main() -> None:
                     },
                 )
                 run_microprof(result["iso"])
+                run_budget_probe(time.time())
         if once:
             break
         time.sleep(PERIOD)
