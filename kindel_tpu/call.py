@@ -160,9 +160,35 @@ def assemble(
     L = len(masks.base_char)
     applied = resolve_patches(cdr_patches, L)
 
-    emit_chars = np.where(masks.n_mask, np.uint8(_N), masks.base_char)
-    keep = ~masks.del_mask
+    # deletions and insertions are sparse on real pileups, so emit by
+    # cutting contiguous runs at their positions (plain tobytes copies)
+    # instead of boolean-gathering the full length per segment — the
+    # gather was ~3 extra full-L passes per consensus
+    emit_chars = (
+        masks.base_char
+        if not masks.n_mask.any()
+        else np.where(masks.n_mask, np.uint8(_N), masks.base_char)
+    )
+    del_mask = masks.del_mask
     ins_mask = masks.ins_mask
+    # deletion RUNS collapse to single cuts (a dense majority-deletion
+    # span must cost one Python iteration, not one per position):
+    # run_starts marks each run's first position; runs_end maps it to
+    # one-past-the-run via searchsorted
+    if del_mask.any():
+        run_starts = del_mask & ~np.concatenate(([False], del_mask[:-1]))
+        rs_idx = np.flatnonzero(run_starts)
+        re_idx = (
+            np.flatnonzero(del_mask & ~np.concatenate((del_mask[1:], [False])))
+            + 1
+        )
+        cut_mask = ins_mask | run_starts
+    else:
+        rs_idx = re_idx = None
+        cut_mask = ins_mask
+
+    def _run_end(p: int) -> int:
+        return int(re_idx[np.searchsorted(rs_idx, p, side="right") - 1])
 
     parts: list[bytes] = []
 
@@ -170,13 +196,22 @@ def assemble(
         if a >= b:
             return
         prev = a
-        for off in np.flatnonzero(ins_mask[a:b]):
+        if rs_idx is not None and del_mask[a]:
+            prev = min(_run_end(a), b)  # segment starts mid-run: skip it
+        for off in np.flatnonzero(cut_mask[a:b]):
             p = a + int(off)
-            parts.append(emit_chars[prev:p][keep[prev:p]].tobytes())
-            s = ins_calls.get(p)
-            parts.append(s.lower() if s is not None else b"N")
-            prev = p
-        parts.append(emit_chars[prev:b][keep[prev:b]].tobytes())
+            if p < prev:
+                continue  # inside the straddling run already skipped
+            if prev < p:
+                parts.append(emit_chars[prev:p].tobytes())
+            if ins_mask[p]:
+                s = ins_calls.get(p)
+                parts.append(s.lower() if s is not None else b"N")
+            # a deleted run's bases are skipped wholesale; an
+            # insertion-only cut keeps its base (next copy starts at p)
+            prev = min(_run_end(p), b) if del_mask[p] else p
+        if prev < b:
+            parts.append(emit_chars[prev:b].tobytes())
 
     seg_start = 0
     for start, end, seq in applied:
