@@ -193,3 +193,27 @@ def test_gap_pairing_composes_with_fix_clip_artifacts(tmp_path):
         fix_clip_artifacts=True,
     )
     assert EXPECTED_56MER in res.consensuses[0].sequence.upper()
+
+
+def test_shipped_gp120_bam_recovers_expected_junction():
+    """Round 5: the reference DOES ship a minimap2-aligned gp120 BAM
+    (data_minimap2/hxb2-gp120-mutated.bam — the disabled test referenced
+    an unshipped .sam from a different aligner). On this real input the
+    disabled test's expected junction 56-mer
+    (/root/reference/tests/test_kindel.py:304-306) must appear in the
+    realigned consensus — under default (reference-exact) pairing, since
+    minimap2's clips here do intersect, AND unchanged under --cdr-gap
+    (the corpus sweep pins byte-identity; this pins the positive)."""
+    from pathlib import Path
+
+    bam = Path(
+        "/root/reference/tests/data_minimap2/hxb2-gp120-mutated.bam"
+    )
+    if not bam.exists():
+        pytest.skip("golden corpus unavailable")
+    for gap in (0, 600):
+        res = bam_to_consensus(
+            bam, realign=True, min_overlap=7, cdr_gap=gap
+        )
+        seq = res.consensuses[0].sequence.upper()
+        assert EXPECTED_56MER in seq, f"cdr_gap={gap}"
