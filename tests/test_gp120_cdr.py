@@ -204,13 +204,9 @@ def test_shipped_gp120_bam_recovers_expected_junction():
     realigned consensus — under default (reference-exact) pairing, since
     minimap2's clips here do intersect, AND unchanged under --cdr-gap
     (the corpus sweep pins byte-identity; this pins the positive)."""
-    from pathlib import Path
+    from conftest import require_data
 
-    bam = Path(
-        "/root/reference/tests/data_minimap2/hxb2-gp120-mutated.bam"
-    )
-    if not bam.exists():
-        pytest.skip("golden corpus unavailable")
+    bam = require_data("data_minimap2", "hxb2-gp120-mutated.bam")
     for gap in (0, 600):
         res = bam_to_consensus(
             bam, realign=True, min_overlap=7, cdr_gap=gap
