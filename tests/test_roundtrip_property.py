@@ -40,7 +40,7 @@ def alignments(draw):
     for r in range(draw(st.integers(0, 12))):
         rid = draw(st.integers(0, n_ref - 1))
         ops = []
-        for _ in range(draw(st.integers(1, 5))):
+        for _ in range(draw(st.integers(0, 5))):
             op = draw(st.sampled_from([0, 1, 2, 3, 4, 7, 8]))  # MIDNS=X
             ops.append((draw(st.integers(1, 30)), op))
         l_seq = sum(n for n, op in ops if op in _CONSUMES_QUERY)
@@ -178,10 +178,37 @@ def test_roundtrip_all_paths(ex):
                             )
                         ],
                         "seq": b.seq[s0:s1].tobytes().decode(),
-                        "name": reads[k]["name"],
                     }
                 )
                 k += 1
-        assert flat_reads == reads
+        # names are not carried in ReadBatch; compare the decoded fields
+        assert flat_reads == [
+            {k2: v for k2, v in rd.items() if k2 != "name"} for rd in reads
+        ]
     finally:
         p.unlink()
+
+
+def test_sam_seq_star_with_consuming_cigar():
+    """Directed case the generator cannot produce: SEQ '*' (omitted) with
+    a query-consuming CIGAR — common for secondary/supplementary records.
+    Must decode to an empty sequence, keep the offset tables consistent,
+    and contribute no events (matching BAM l_seq=0 semantics)."""
+    from kindel_tpu.events import extract_events
+    from kindel_tpu.io.sam import parse_sam_bytes
+
+    blob = (
+        b"@SQ\tSN:r1\tLN:100\n"
+        b"a\t256\tr1\t5\t60\t50M\t*\t0\t0\t*\t*\n"
+        b"b\t0\tr1\t1\t60\t4M\t*\t0\t0\tACGT\t*\n"
+    )
+    batch = parse_sam_bytes(blob)
+    assert batch.n_reads == 2
+    assert int(batch.seq_off[1]) - int(batch.seq_off[0]) == 0  # '*' read
+    assert batch.seq[
+        int(batch.seq_off[1]):int(batch.seq_off[2])
+    ].tobytes() == b"ACGT"
+    ev = extract_events(batch)
+    sel = ev.match_rid == 0
+    # only read b's 4 matches may produce events
+    assert len(ev.match_pos[sel]) == 4
