@@ -1,0 +1,119 @@
+"""Generative property for the realign/CDR engine.
+
+Randomized divergent-segment geometries, two regimes:
+
+- **intersecting**: the soft-clip extension spans from the two flanks
+  overlap in reference coordinates — the reference implementation's own
+  pairing regime. Default (reference-exact) realign must recover the
+  novel segment.
+- **gapped**: the spans are disjoint (the removed reference span is
+  wider than both clip extensions combined) but the clip CONTENTS still
+  overlap by >= GAP_PAIR_MIN_OVERLAP inside the novel segment — the
+  reference's disabled-gp120 class. Default realign must leave the
+  uncovered middle uncalled, and `cdr_gap` must close it.
+
+This generalizes the fixed geometries of tests/test_gp120_cdr.py and
+tests/distfixture.py to randomized widths/lengths/overlaps.
+"""
+
+from __future__ import annotations
+
+import tempfile
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+hypothesis = pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+from kindel_tpu.workloads import bam_to_consensus
+
+_B = "ACGT"
+READ = 48  # aligned flank length of the anchored reads
+
+
+def _rand_seq(rng, n):
+    return "".join(_B[i] for i in rng.integers(0, 4, size=n))
+
+
+def _divergent_sam(rng, L, s, W, novel, cl, cr):
+    """Sample genome ref[:s] + novel + ref[s+W:]; left-anchored reads end
+    at s carrying novel[:cl] as a soft clip, right-anchored reads start
+    at e=s+W carrying novel[-cr:]; background tiling covers the flanks."""
+    e = s + W
+    lines = [b"@HD\tVN:1.6", f"@SQ\tSN:dv1\tLN:{L}".encode()]
+    left_flank = _rand_seq(rng, READ)
+    right_flank = _rand_seq(rng, READ)
+    k = 0
+
+    def read(pos1, cigar, seq):
+        nonlocal k
+        lines.append(
+            f"r{k}\t0\tdv1\t{pos1}\t60\t{cigar}\t*\t0\t0\t{seq}\t*".encode()
+        )
+        k += 1
+
+    for _ in range(20):
+        read(s - READ + 1, f"{READ}M{cl}S", left_flank + novel[:cl])
+        read(e + 1, f"{cr}S{READ}M", novel[len(novel) - cr:] + right_flank)
+    for _ in range(30):  # flank coverage away from the junction
+        a = int(rng.integers(0, max(s - READ - 8, 1)))
+        read(a + 1, "40M", _rand_seq(rng, 40))
+        b = int(rng.integers(e + READ + 8, L - 48))
+        read(b + 1, "40M", _rand_seq(rng, 40))
+    return b"\n".join(lines) + b"\n"
+
+
+@st.composite
+def geometries(draw):
+    nl = draw(st.integers(20, 60))          # novel segment length
+    gapped = draw(st.booleans())
+    if gapped:
+        # clip contents overlap >= 16 inside novel, spans disjoint
+        total = draw(st.integers(nl + 16, 2 * nl))
+        W = draw(st.integers(total + 4, total + 300))
+    else:
+        # spans intersect AND contents overlap >= 7
+        W = draw(st.integers(8, 2 * nl - 8))
+        total = draw(
+            st.integers(max(W + 2, nl + 7), 2 * nl)
+        )
+    cl = draw(st.integers(max(total - nl, 1), min(nl, total - 1)))
+    cr = total - cl
+    return nl, W, cl, cr, gapped
+
+
+@settings(max_examples=15, deadline=None)
+@given(geometries(), st.integers(0, 10 ** 6))
+def test_divergent_segment_recovery(geo, seed):
+    nl, W, cl, cr, gapped = geo
+    rng = np.random.default_rng(seed)
+    L = W + 700
+    s = 300
+    novel = _rand_seq(rng, nl)
+    blob = _divergent_sam(rng, L, s, W, novel, cl, cr)
+    with tempfile.NamedTemporaryFile(suffix=".sam", delete=False) as fh:
+        fh.write(blob)
+        p = Path(fh.name)
+    try:
+        plain = bam_to_consensus(p, realign=True, min_overlap=7)
+        seq_plain = plain.consensuses[0].sequence.upper()
+        if not gapped:
+            assert novel in seq_plain, (
+                "intersecting-span geometry not recovered by "
+                f"reference-exact pairing: nl={nl} W={W} cl={cl} cr={cr}"
+            )
+        else:
+            # middle is uncovered and unmergeable without gap pairing
+            assert novel not in seq_plain
+            gap_res = bam_to_consensus(
+                p, realign=True, min_overlap=7, cdr_gap=600
+            )
+            seq_gap = gap_res.consensuses[0].sequence.upper()
+            assert novel in seq_gap, (
+                f"gap pairing failed: nl={nl} W={W} cl={cl} cr={cr} "
+                f"(content overlap {cl + cr - nl})"
+            )
+    finally:
+        p.unlink()
