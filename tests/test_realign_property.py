@@ -76,8 +76,11 @@ def geometries(draw):
     else:
         # spans intersect AND contents overlap >= 7
         W = draw(st.integers(8, 2 * nl - 8))
+        # overlap floor 12 (not the merge gate's 7): two ~100 bp clip
+        # consensuses share a spurious 7-mer with probability ~0.5, and a
+        # chance LCS tie can splice at the wrong junction on correct code
         total = draw(
-            st.integers(max(W + 2, nl + 7), 2 * nl)
+            st.integers(max(W + 2, nl + 12), 2 * nl)
         )
     cl = draw(st.integers(max(total - nl, 1), min(nl, total - 1)))
     cr = total - cl

@@ -119,6 +119,29 @@ def _read_at(ref: str, variants, a: int, b: int):
     return a, cigar, "".join(seq)
 
 
+def _sim_sam_file(ref, variants, rng, stride, extras):
+    """Write a tiled+random-extras simulated SAM for (ref, variants);
+    returns the temp Path (caller unlinks)."""
+    import tempfile
+    from pathlib import Path
+
+    L = len(ref)
+    read_len = 50
+    reads = []
+    for a in list(range(0, L - read_len, stride)) + [
+        int(rng.integers(0, L - read_len)) for _ in range(extras)
+    ]:
+        r = _read_at(ref, variants, a, a + read_len)
+        if r is not None:
+            reads.append(r)
+    sam = ["@HD\tVN:1.6", f"@SQ\tSN:t1\tLN:{L}"]
+    for i, (pos, cigar, seq) in enumerate(reads):
+        sam.append(f"r{i}\t0\tt1\t{pos + 1}\t60\t{cigar}\t*\t0\t0\t{seq}\t*")
+    with tempfile.NamedTemporaryFile(suffix=".sam", delete=False) as fh:
+        fh.write(("\n".join(sam) + "\n").encode())
+        return Path(fh.name)
+
+
 @settings(max_examples=25, deadline=None)
 @given(genomes(), st.integers(0, 10 ** 6))
 def test_consensus_recovers_sample_genome(ex, seed):
@@ -126,26 +149,8 @@ def test_consensus_recovers_sample_genome(ex, seed):
     rng = np.random.default_rng(seed)
     L = len(ref)
     read_len = 50
-    reads = []
     # dense tiling (stride 10 → depth ~5) plus random extras
-    starts = list(range(0, L - read_len, 10)) + [
-        int(rng.integers(0, L - read_len)) for _ in range(20)
-    ]
-    for a in starts:
-        r = _read_at(ref, variants, a, a + read_len)
-        if r is not None:
-            reads.append(r)
-    sam = ["@HD\tVN:1.6", f"@SQ\tSN:t1\tLN:{L}"]
-    for i, (pos, cigar, seq) in enumerate(reads):
-        sam.append(f"r{i}\t0\tt1\t{pos + 1}\t60\t{cigar}\t*\t0\t0\t{seq}\t*")
-    blob = ("\n".join(sam) + "\n").encode()
-
-    import tempfile
-    from pathlib import Path
-
-    with tempfile.NamedTemporaryFile(suffix=".sam", delete=False) as fh:
-        fh.write(blob)
-        p = Path(fh.name)
+    p = _sim_sam_file(ref, variants, rng, stride=10, extras=20)
     try:
         want = _sample_genome(ref, variants)
         for backend in ("numpy", "jax"):
@@ -157,5 +162,27 @@ def test_consensus_recovers_sample_genome(ex, seed):
             assert want.startswith(got_core), (backend, variants)
             # the covered core must reach every variant zone
             assert len(got_core) >= L - read_len - 10, backend
+    finally:
+        p.unlink()
+
+
+@settings(max_examples=8, deadline=None)
+@given(genomes(), st.integers(0, 10 ** 6))
+def test_stats_backend_byte_identity_on_random_inputs(ex, seed):
+    """The two-backend byte-identical invariant (SURVEY §7) on RANDOM
+    inputs: weights/features/variants TSVs from the numpy oracle and the
+    jax device path must be byte-equal — corpus files only sample a few
+    depth/indel profiles; the generator sweeps many."""
+    from kindel_tpu import workloads
+
+    ref, variants = ex
+    rng = np.random.default_rng(seed)
+    p = _sim_sam_file(ref, variants, rng, stride=12, extras=10)
+    try:
+        for fn in (workloads.weights, workloads.features,
+                   workloads.variants):
+            a_ = fn(p, backend="numpy").to_csv(sep="\t", index=False)
+            b_ = fn(p, backend="jax").to_csv(sep="\t", index=False)
+            assert a_ == b_, fn.__name__
     finally:
         p.unlink()
