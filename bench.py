@@ -253,6 +253,20 @@ def _run_benchmark() -> dict:
     }
     if tune:
         result["tune_s"] = {str(k): round(v, 3) for k, v in tune.items()}
+
+    # Optional serving metrics (KINDEL_TPU_BENCH_SERVE=1): a small
+    # closed-loop load run against the in-process service, so rounds can
+    # track online throughput / p99 latency / batch occupancy alongside
+    # the offline headline number. Opt-in because it adds ~seconds of
+    # wall and its own kernel-shape compiles; failure never voids the
+    # headline metric.
+    if os.environ.get("KINDEL_TPU_BENCH_SERVE"):
+        try:
+            from benchmarks.serve_load import run_load
+
+            result["serve"] = run_load(clients=4, requests_per_client=8)
+        except Exception as e:  # noqa: BLE001
+            result["serve"] = {"error": repr(e)}
     return result
 
 

@@ -1,0 +1,156 @@
+"""Closed-loop load generator for the online consensus service.
+
+K client threads each issue M synchronous requests against an
+in-process ConsensusService (no HTTP in the measured loop — this
+benchmarks the queue→batcher→worker pipeline, not socket overhead) and
+report throughput, client-observed p50/p99 latency, and the batch
+occupancy the micro-batcher achieved. Occupancy is the number the rest
+of the repo's perf story hangs on: >1 means independent requests are
+riding shared device dispatches, i.e. the cohort kernel's host↔device
+amortization is materializing *online*, not just for pre-assembled
+cohorts.
+
+Wired into bench.py's optional-metrics path: KINDEL_TPU_BENCH_SERVE=1
+attaches this report to the round's JSON line. Standalone:
+
+    python -m benchmarks.serve_load --clients 8 --requests 16
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import tempfile
+import threading
+import time
+from pathlib import Path
+
+
+def _synth_sam(dest: Path, ref_len: int = 2048, n_reads: int = 200,
+               seed: int = 0) -> Path:
+    """Small synthetic workload: per-request cost stays in the regime
+    where batching (not raw decode) dominates, which is the serving
+    property under measurement."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    lines = ["@HD\tVN:1.6", f"@SQ\tSN:load1\tLN:{ref_len}"]
+    for i in range(n_reads):
+        pos = int(rng.integers(0, ref_len - 80))
+        seq = "".join("ACGT"[b] for b in rng.integers(0, 4, size=80))
+        cigar = ("40M2D38M2S", "80M", "38M4I38M")[i % 3]
+        lines.append(
+            f"r{i}\t0\tload1\t{pos + 1}\t60\t{cigar}\t*\t0\t0\t{seq}\t*"
+        )
+    dest.write_text("\n".join(lines) + "\n")
+    return dest
+
+
+def run_load(bam_path=None, clients: int = 4, requests_per_client: int = 8,
+             max_wait_s: float = 0.01, max_batch_rows: int = 64,
+             **service_kwargs) -> dict:
+    """Run the closed loop; returns a JSON-able report dict."""
+    from kindel_tpu.serve import ConsensusClient, ConsensusService
+
+    tmp = None
+    if bam_path is None:
+        tmp = tempfile.TemporaryDirectory(prefix="kindel_serve_load_")
+        bam_path = _synth_sam(Path(tmp.name) / "load.sam")
+    payload = Path(bam_path).read_bytes()
+
+    latencies: list[float] = []
+    lat_lock = threading.Lock()
+    errors: list[str] = []
+    start_barrier = threading.Barrier(clients + 1)
+
+    try:
+        with ConsensusService(
+            max_wait_s=max_wait_s, max_batch_rows=max_batch_rows,
+            **service_kwargs,
+        ) as svc:
+            client = ConsensusClient(svc)
+            client.consensus(payload, timeout=300)  # compile warmup
+
+            def one_client():
+                start_barrier.wait()
+                for _ in range(requests_per_client):
+                    t0 = time.perf_counter()
+                    try:
+                        client.consensus(payload, timeout=300)
+                    except Exception as e:  # noqa: BLE001
+                        with lat_lock:
+                            errors.append(repr(e))
+                        continue
+                    with lat_lock:
+                        latencies.append(time.perf_counter() - t0)
+
+            threads = [
+                threading.Thread(target=one_client, name=f"load-client-{i}")
+                for i in range(clients)
+            ]
+            for t in threads:
+                t.start()
+            start_barrier.wait()
+            t_start = time.perf_counter()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t_start
+            snap = svc.metrics.snapshot()
+    finally:
+        if tmp is not None:
+            tmp.cleanup()
+
+    done = len(latencies)
+    latencies.sort()
+
+    def pct(q: float) -> float:
+        if not latencies:
+            return 0.0
+        return latencies[min(done - 1, int(q * done))]
+
+    occupancy = snap.get("kindel_serve_batch_occupancy", {})
+    # warmup ran alone before the barrier: exclude it from the coalesce
+    # ratio so the ratio reflects the loaded regime only
+    dispatches = max(int(snap.get(
+        "kindel_serve_device_dispatches_total", 0
+    )) - 1, 1)
+    return {
+        "clients": clients,
+        "requests": clients * requests_per_client,
+        "completed": done,
+        "errors": len(errors),
+        "wall_s": round(wall, 3),
+        "throughput_rps": round(done / wall, 2) if wall > 0 else 0.0,
+        "latency_p50_ms": round(pct(0.5) * 1e3, 2),
+        "latency_p99_ms": round(pct(0.99) * 1e3, 2),
+        "occupancy_mean": round(float(occupancy.get("mean", 0.0)), 2),
+        "occupancy_max": int(occupancy.get("max", 0)),
+        "device_dispatches": dispatches,
+        "coalesce_ratio": round(done / dispatches, 2),
+        "max_wait_ms": max_wait_s * 1e3,
+    }
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--bam", default=None,
+                    help="SAM/BAM to serve (default: synthetic)")
+    ap.add_argument("--clients", type=int, default=4)
+    ap.add_argument("--requests", type=int, default=8,
+                    help="requests per client")
+    ap.add_argument("--max-wait-ms", type=float, default=10.0)
+    args = ap.parse_args(argv)
+    report = run_load(
+        bam_path=args.bam, clients=args.clients,
+        requests_per_client=args.requests,
+        max_wait_s=args.max_wait_ms / 1e3,
+    )
+    print(json.dumps(report))
+    return 0 if report["errors"] == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    sys.exit(main())

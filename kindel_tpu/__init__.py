@@ -21,6 +21,9 @@ Architecture (TPU-first, not a port):
   L4  workloads       — bam_to_consensus / weights / features / variants /
                         plot (kindel_tpu.workloads)
   L5  CLI             — kindel_tpu.cli (python -m kindel_tpu)
+  L6  serving         — dynamic-batching online service: admission queue,
+                        micro-batcher, executor, live /metrics
+                        (kindel_tpu.serve; `python -m kindel_tpu serve`)
 
 Sharding/scale-out lives in kindel_tpu.parallel: the genomic position axis is
 the sequence-parallel axis, sharded over a jax.sharding.Mesh with halo

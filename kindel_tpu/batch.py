@@ -251,25 +251,46 @@ def _budget_groups(units, opts: BatchOptions) -> list[list[int]]:
     return groups
 
 
-def _dispatch_device_call(units, opts: BatchOptions):
-    """Pad + upload a cohort's units and launch the batched kernel
-    (asynchronously — jax dispatch returns before the TPU finishes).
-    With multiple visible devices, rows are sharded over a dp mesh."""
-    import jax
-
+def cohort_pad_shapes(units, opts: BatchOptions) -> tuple:
+    """Bucketed (power-of-two) pad sizes a cohort's units stack to:
+    (L, O_pad, B_pad, D_pad, I_pad, C_pad) — C_pad is None unless
+    realign. The serve micro-batcher keys its coalescing lanes on this
+    tuple so every flush of a lane reuses one compiled kernel shape."""
     L = _bucket(max(u.L for u in units), 1024)
-    # the bucketed (power-of-two) length is the actual scatter target
-    check_pad_safe_block(L, "cohort-padded reference")
     O_pad = _bucket(max(len(u.op_r_start) for u in units), 64)
     B_pad = _bucket(max(len(u.base_packed) for u in units), 256)
     D_pad = _bucket(max((len(u.del_pos) for u in units), default=1), 64)
     I_pad = _bucket(max((len(u.ins_pos) for u in units), default=1), 64)
+    C_pad = None
+    if opts.realign:
+        C_pad = _bucket(
+            max(
+                (max(len(u.csw_pos), len(u.cew_pos)) for u in units),
+                default=1,
+            ),
+            64,
+        )
+    return L, O_pad, B_pad, D_pad, I_pad, C_pad
 
-    sharding, dp = _dp_sharding(len(units))
-    # pad the row count to a dp multiple with empty dummy units (n_events
-    # 0 → all-PAD scatter → all-N rows, discarded by the caller which
-    # only reads the first len(units) rows)
-    B = -(-len(units) // dp) * dp
+
+def pack_cohort(units, opts: BatchOptions, n_rows: int | None = None,
+                shapes: tuple | None = None):
+    """Pad-and-pack a cohort's units into host-side [B, ...] arrays ready
+    for the batched kernel — the reusable step shared by the one-shot
+    cohort dispatch below and the online micro-batcher
+    (kindel_tpu.serve.batcher).
+
+    n_rows > len(units) appends empty dummy rows (n_events 0 → all-PAD
+    scatter → all-N rows the caller discards); `shapes` pins the pad
+    sizes (a serve lane pads every flush to the lane key's shapes so the
+    kernel compiles once). Returns (arrays, (L, D_pad, I_pad)) where the
+    meta tuple is what the host wire decoder needs."""
+    if shapes is None:
+        shapes = cohort_pad_shapes(units, opts)
+    L, O_pad, B_pad, D_pad, I_pad, C_pad = shapes
+    # the bucketed (power-of-two) length is the actual scatter target
+    check_pad_safe_block(L, "cohort-padded reference")
+    B = len(units) if n_rows is None else n_rows
 
     def stack(getter, pad_size, fill, dtype=np.int32):
         out = np.full((B, pad_size), fill, dtype=dtype)
@@ -295,19 +316,22 @@ def _dispatch_device_call(units, opts: BatchOptions):
         ref_lens,
     )
     if opts.realign:
-        C_pad = _bucket(
-            max(
-                (max(len(u.csw_pos), len(u.cew_pos)) for u in units),
-                default=1,
-            ),
-            64,
-        )
         arrays = arrays + (
             stack(lambda u: u.csw_pos, C_pad, PAD_POS),
             stack(lambda u: u.csw_base, C_pad, 0),
             stack(lambda u: u.cew_pos, C_pad, PAD_POS),
             stack(lambda u: u.cew_base, C_pad, 0),
         )
+    return arrays, (L, D_pad, I_pad)
+
+
+def launch_cohort_kernel(arrays, meta, opts: BatchOptions, sharding=None):
+    """Upload packed cohort arrays and launch the batched kernel
+    (asynchronously — jax dispatch returns before the device finishes).
+    Returns the (out, meta) pair _assemble_outputs consumes."""
+    import jax
+
+    L, _d_pad, _i_pad = meta
     if sharding is None:
         dev_arrays = tuple(jnp.asarray(a) for a in arrays)
     else:
@@ -323,7 +347,18 @@ def _dispatch_device_call(units, opts: BatchOptions):
         want_masks=opts.want_masks,
     )
     # meta the host decoder needs to slice each row's packed wire
-    return out, (L, D_pad, I_pad)
+    return out, meta
+
+
+def _dispatch_device_call(units, opts: BatchOptions):
+    """Pad + upload a cohort's units and launch the batched kernel.
+    With multiple visible devices, rows are sharded over a dp mesh."""
+    sharding, dp = _dp_sharding(len(units))
+    # pad the row count to a dp multiple with empty dummy units (the
+    # caller only reads the first len(units) rows)
+    B = -(-len(units) // dp) * dp
+    arrays, meta = pack_cohort(units, opts, n_rows=B)
+    return launch_cohort_kernel(arrays, meta, opts, sharding=sharding)
 
 
 @partial(jax.jit, static_argnames=("chunk",))

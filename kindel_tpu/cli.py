@@ -317,6 +317,124 @@ def cmd_batch(args) -> int:
     return 0
 
 
+def _serve_parser(sub):
+    p = sub.add_parser(
+        "serve",
+        help="online consensus service: dynamic micro-batching over the "
+             "cohort kernel, admission control, live /metrics",
+    )
+    p.add_argument(
+        "--host", default="127.0.0.1", help="HTTP bind address"
+    )
+    p.add_argument(
+        "--port", type=int, default=8765,
+        help="HTTP port (POST /v1/consensus, GET /metrics, GET /healthz); "
+             "0 binds an ephemeral port",
+    )
+    p.add_argument(
+        "--max-batch-rows", type=int, default=64,
+        help="flush a coalescing lane when it reaches this many cohort rows",
+    )
+    p.add_argument(
+        "--max-wait-ms", type=float, default=20.0,
+        help="flush a lane when its oldest request has waited this long — "
+             "bounds added latency when traffic is sparse",
+    )
+    p.add_argument(
+        "--max-depth", type=int, default=256,
+        help="absolute queue bound",
+    )
+    p.add_argument(
+        "--watermark", type=int, default=None,
+        help="admission watermark: reject with Retry-After past this queue "
+             "depth (default: --max-depth)",
+    )
+    p.add_argument(
+        "--workers", type=int, default=4,
+        help="host decode/assembly threads",
+    )
+    p.add_argument(
+        "--min-depth", type=int, default=1,
+        help="substitute Ns at coverage depths beneath this value",
+    )
+    p.add_argument(
+        "-r", "--realign", action="store_true",
+        help="attempt to reconstruct reference around soft-clip boundaries",
+    )
+    p.add_argument(
+        "--min-overlap", type=int, default=7,
+        help="match length required to close soft-clipped gaps",
+    )
+    p.add_argument(
+        "-c", "--clip-decay-threshold", type=float, default=0.1,
+        help="read depth fraction at which to cease clip extension",
+    )
+    p.add_argument(
+        "--mask-ends", type=int, default=50,
+        help="ignore clip dominant positions within n positions of termini",
+    )
+    p.add_argument(
+        "--cdr-gap", type=_nonneg_int, default=0, metavar="N",
+        help="pair facing clip-dominant regions across up to N uncovered "
+             "positions (see the consensus subcommand's help)",
+    )
+    p.add_argument(
+        "--fix-clip-artifacts", action="store_true",
+        help="fix the reference's issue23 boundary artifacts "
+             "(see the consensus subcommand's help)",
+    )
+    p.add_argument(
+        "-t", "--trim-ends", action="store_true",
+        help="trim ambiguous nucleotides (Ns) from sequence ends",
+    )
+    p.add_argument(
+        "-u", "--uppercase", action="store_true",
+        help="close gaps using uppercase alphabet",
+    )
+
+
+def cmd_serve(args) -> int:
+    """Run the online consensus service until interrupted."""
+    import time
+
+    from kindel_tpu.serve import ConsensusService
+
+    service = ConsensusService(
+        max_batch_rows=args.max_batch_rows,
+        max_wait_s=args.max_wait_ms / 1e3,
+        max_depth=args.max_depth,
+        high_watermark=args.watermark,
+        decode_workers=args.workers,
+        http_host=args.host,
+        http_port=args.port,
+        realign=args.realign,
+        min_depth=args.min_depth,
+        min_overlap=args.min_overlap,
+        clip_decay_threshold=args.clip_decay_threshold,
+        mask_ends=args.mask_ends,
+        cdr_gap=args.cdr_gap,
+        fix_clip_artifacts=args.fix_clip_artifacts,
+        trim_ends=args.trim_ends,
+        uppercase=args.uppercase,
+    )
+    service.start()
+    host, port = service.http_address
+    print(
+        f"kindel-tpu serving on http://{host}:{port} — "
+        "POST /v1/consensus (SAM/BAM body -> FASTA), GET /metrics, "
+        "GET /healthz; Ctrl-C to drain and stop",
+        file=sys.stderr,
+    )
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        print("draining…", file=sys.stderr)
+    finally:
+        service.stop(drain=True)
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="kindel-tpu",
@@ -454,6 +572,8 @@ def build_parser() -> argparse.ArgumentParser:
         help="host decode/assembly threads",
     )
 
+    _serve_parser(sub)
+
     sub.add_parser("version", help="show version")
     return parser
 
@@ -474,6 +594,7 @@ def main(argv=None) -> int:
         "variants": cmd_variants,
         "plot": cmd_plot,
         "batch": cmd_batch,
+        "serve": cmd_serve,
     }[args.command](args)
 
 

@@ -16,7 +16,14 @@ def load_alignment(path) -> ReadBatch:
     Prefers the native C++ decoder (kindel_tpu.io.native) when built; falls
     back to the vectorized numpy decoder.
     """
-    data = Path(path).read_bytes()
+    return load_alignment_bytes(Path(path).read_bytes(), label=str(path))
+
+
+def load_alignment_bytes(data: bytes, label: str = "<bytes>") -> ReadBatch:
+    """Decode in-memory SAM/BAM/BGZF bytes into a columnar ReadBatch —
+    the ingest path for payloads that never touch the filesystem (the
+    serve HTTP endpoint POSTs alignment bytes straight off the socket).
+    `label` names the payload in error messages."""
     if bgzf.is_gzipped(data):
         decompressed = None
         try:
@@ -38,5 +45,5 @@ def load_alignment(path) -> ReadBatch:
         return parse_bam_bytes(data)
     batch = parse_sam_bytes(data)
     if not batch.ref_names and batch.n_reads == 0:
-        raise ValueError(f"{path}: not a recognizable SAM/BAM file")
+        raise ValueError(f"{label}: not a recognizable SAM/BAM file")
     return batch

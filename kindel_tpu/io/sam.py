@@ -65,10 +65,11 @@ def parse_sam_bytes(data: bytes) -> ReadBatch:
             raise ValueError(f"SAM pos out of range: {pos + 1}")
         cigar = fields[5]
         seq = fields[9].upper()
-        if seq == b"*":  # SEQ unavailable (SAM spec): same as BAM l_seq=0
-            # — the read contributes no base observations (the reference
-            # would count a literal '*' token per aligned position; ours
-            # matches the BAM decoder's l_seq=0 handling instead)
+        if seq == b"*":  # SEQ unavailable (SAM spec): normalize to empty
+            # so the SAM record shape matches the BAM decoder's l_seq=0.
+            # Normalization only, not a counting fix — a literal '*' is
+            # length 1 and the len(seq) <= 1 skip gate drops such reads
+            # in both this implementation and the reference
             seq = b""
 
         ref_id_l.append(name_to_id.get(rname, -1))

@@ -1,0 +1,39 @@
+"""L6 — online serving: the cohort kernel as a continuously-batched
+service.
+
+Every other entry point in this repo is a one-shot batch job; the
+vmapped cohort kernel (kindel_tpu.batch) only amortizes host↔device
+latency for callers who already hold a whole cohort. This package turns
+it into an online service, the structure continuous-batching TPU
+serving stacks converge on (PAPERS.md: ragged paged-attention serving,
+arxiv 2604.15464; Gemma-on-TPU serving, 2605.25645):
+
+  queue.py    bounded admission queue — reject-with-retry-after past a
+              watermark, deadline-aware backpressure
+  batcher.py  dynamic micro-batcher — coalesces independent requests
+              into padded device cohorts keyed by the offline path's
+              bucket shapes; flushes on batch-full or max-wait
+  worker.py   intake/decode/dispatch executor — host-thread decode, one
+              device program per flush, per-request error isolation
+  metrics.py  thread-safe registry + /metrics + /healthz HTTP exposition
+  service.py  ConsensusService facade, ConsensusClient, POST ingest
+
+CLI: `python -m kindel_tpu serve` (see kindel_tpu.cli).
+"""
+
+from kindel_tpu.serve.batcher import Flush, MicroBatcher  # noqa: F401
+from kindel_tpu.serve.metrics import (  # noqa: F401
+    MetricsRegistry,
+    ServeHTTPServer,
+)
+from kindel_tpu.serve.queue import (  # noqa: F401
+    AdmissionError,
+    DeadlineExceeded,
+    RequestQueue,
+    ServeRequest,
+)
+from kindel_tpu.serve.service import (  # noqa: F401
+    ConsensusClient,
+    ConsensusService,
+)
+from kindel_tpu.serve.worker import ServeWorker  # noqa: F401

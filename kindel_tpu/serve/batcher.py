@@ -1,0 +1,159 @@
+"""Dynamic micro-batcher: coalesces decoded requests into device-shaped
+cohorts.
+
+Decoded requests land in *lanes* keyed by (call options, cohort pad
+shapes) — the same power-of-two bucket shapes the offline cohort path
+pads to (`kindel_tpu.batch.cohort_pad_shapes`), so every flush of a lane
+re-dispatches one already-compiled kernel shape and the vmapped
+`batched_call_kernel` runs hot under load. A lane flushes when its row
+count reaches `max_batch_rows` (batch-full) or when its oldest entry has
+waited `max_wait_s` (bounded idle latency: a single quiet request never
+waits longer than the knob, it just rides a batch of one).
+
+The batcher owns no threads — the worker's dispatch loop drives it via
+`poll`, which blocks until a flush is due. That keeps flush timing in
+exactly one place and makes the component deterministic to test: add N
+requests, poll, observe one flush.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, fields
+
+from kindel_tpu.batch import BatchOptions, cohort_pad_shapes
+
+
+def opts_key(opts: BatchOptions) -> tuple:
+    """Hashable identity of the call options — requests may only share a
+    device dispatch when every kernel/assembly knob matches."""
+    return tuple(getattr(opts, f.name) for f in fields(BatchOptions))
+
+
+@dataclass
+class Flush:
+    """One coalesced batch ready for the device."""
+
+    opts: BatchOptions
+    shapes: tuple  # cohort pad shapes every entry buckets to
+    entries: list  # [(ServeRequest, [CallUnit, ...]), ...]
+    opened_at: float  # when the lane's first entry arrived
+
+    @property
+    def n_rows(self) -> int:
+        return sum(len(units) for _, units in self.entries)
+
+
+class _Lane:
+    __slots__ = ("opts", "shapes", "entries", "opened_at", "rows")
+
+    def __init__(self, opts, shapes, now):
+        self.opts = opts
+        self.shapes = shapes
+        self.entries: list = []
+        self.opened_at = now
+        self.rows = 0
+
+
+class MicroBatcher:
+    """Shape-keyed coalescing with batch-full / max-wait flush triggers."""
+
+    def __init__(self, max_batch_rows: int = 64, max_wait_s: float = 0.02,
+                 clock=time.monotonic):
+        if max_batch_rows < 1:
+            raise ValueError("max_batch_rows must be >= 1")
+        self.max_batch_rows = max_batch_rows
+        self.max_wait_s = max_wait_s
+        self._clock = clock
+        self._lanes: dict[tuple, _Lane] = {}
+        self._ready: list[Flush] = []
+        self._cond = threading.Condition()
+        self._closed = False
+
+    @property
+    def pending_rows(self) -> int:
+        with self._cond:
+            return sum(lane.rows for lane in self._lanes.values()) + sum(
+                f.n_rows for f in self._ready
+            )
+
+    def add(self, req, units) -> None:
+        """Queue one decoded request (its CallUnits) for coalescing."""
+        if not units:
+            raise ValueError("a request with no units has nothing to batch")
+        shapes = cohort_pad_shapes(units, req.opts)
+        key = (opts_key(req.opts), shapes)
+        with self._cond:
+            now = self._clock()
+            lane = self._lanes.get(key)
+            if lane is None:
+                lane = self._lanes[key] = _Lane(req.opts, shapes, now)
+            lane.entries.append((req, units))
+            lane.rows += len(units)
+            if lane.rows >= self.max_batch_rows:
+                self._ready.append(self._seal(key, lane))
+            self._cond.notify_all()
+
+    def _seal(self, key, lane: _Lane) -> Flush:
+        del self._lanes[key]
+        return Flush(lane.opts, lane.shapes, lane.entries, lane.opened_at)
+
+    def _due_locked(self, now: float) -> Flush | None:
+        if self._ready:
+            return self._ready.pop(0)
+        oldest_key = None
+        oldest = None
+        for key, lane in self._lanes.items():
+            if oldest is None or lane.opened_at < oldest.opened_at:
+                oldest_key, oldest = key, lane
+        if oldest is not None and now - oldest.opened_at >= self.max_wait_s:
+            return self._seal(oldest_key, oldest)
+        return None
+
+    def poll(self, timeout: float | None = None) -> Flush | None:
+        """Block until a flush is due (full lane, or oldest lane aged past
+        max_wait_s). Returns None on timeout, or when closed with nothing
+        pending."""
+        deadline = None if timeout is None else self._clock() + timeout
+        with self._cond:
+            while True:
+                now = self._clock()
+                flush = self._due_locked(now)
+                if flush is not None:
+                    return flush
+                if self._closed and not self._lanes:
+                    return None
+                # sleep until the oldest lane matures or the caller's
+                # deadline, whichever is sooner
+                waits = []
+                if self._lanes:
+                    oldest = min(
+                        lane.opened_at for lane in self._lanes.values()
+                    )
+                    waits.append(oldest + self.max_wait_s - now)
+                if deadline is not None:
+                    remaining = deadline - now
+                    if remaining <= 0:
+                        return None
+                    waits.append(remaining)
+                self._cond.wait(min(waits) if waits else None)
+
+    def flush_all(self) -> list[Flush]:
+        """Seal and return everything pending (drain path)."""
+        with self._cond:
+            out = list(self._ready)
+            self._ready.clear()
+            for key in list(self._lanes):
+                out.append(self._seal(key, self._lanes[key]))
+            return out
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def close(self) -> None:
+        """Wake poll()ers; poll returns None once drained."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()

@@ -1,0 +1,190 @@
+"""Bounded request queue with admission control and deadline-aware
+backpressure.
+
+The queue is the service's only unbounded-input surface, so it is where
+load sheds: past the high watermark `submit` rejects immediately with a
+`retry-after` hint instead of letting latency grow without bound
+(clients see HTTP 429; in-process callers catch `AdmissionError`). The
+hint is derived from an EWMA of observed per-request service time, the
+same estimate used to reject deadline-infeasible requests up front —
+a request that would certainly miss its deadline wastes a batch slot
+some feasible request could have used.
+
+Expired requests (deadline already passed while queued) are dropped at
+`get` time: their futures fail with `DeadlineExceeded` and the worker
+never spends a decode thread on them.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+
+
+class AdmissionError(RuntimeError):
+    """Request rejected at the door; retry after `retry_after_s`."""
+
+    def __init__(self, message: str, retry_after_s: float):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's deadline passed before it could be served."""
+
+
+@dataclass
+class ServeRequest:
+    """One in-flight consensus request.
+
+    `payload` is a path (str/Path) or raw SAM/BAM bytes; `opts` is the
+    cohort BatchOptions the worker will call with; `deadline` is an
+    absolute monotonic timestamp or None.
+    """
+
+    payload: object
+    opts: object
+    future: Future = field(default_factory=Future)
+    enqueued_at: float = 0.0
+    deadline: float | None = None
+
+
+class RequestQueue:
+    """FIFO of ServeRequests, bounded by an admission watermark."""
+
+    #: service-time estimate before any request has completed (seconds)
+    DEFAULT_SERVICE_S = 0.25
+    #: EWMA smoothing for observed service times
+    _ALPHA = 0.2
+
+    def __init__(self, max_depth: int = 256,
+                 high_watermark: int | None = None,
+                 metrics=None, clock=time.monotonic):
+        if max_depth < 1:
+            raise ValueError("max_depth must be >= 1")
+        self.max_depth = max_depth
+        self.high_watermark = (
+            max_depth if high_watermark is None
+            else min(high_watermark, max_depth)
+        )
+        if self.high_watermark < 1:
+            raise ValueError("high_watermark must be >= 1")
+        self._clock = clock
+        self._q: deque[ServeRequest] = deque()
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._ewma_service_s = self.DEFAULT_SERVICE_S
+        self._closed = False
+        if metrics is not None:
+            self._depth_gauge = metrics.gauge(
+                "kindel_serve_queue_depth", "requests waiting for decode"
+            )
+            self._rejects = metrics.counter(
+                "kindel_serve_admission_rejects_total",
+                "requests rejected at admission (watermark or deadline)",
+            )
+            self._expired = metrics.counter(
+                "kindel_serve_deadline_expired_total",
+                "queued requests dropped because their deadline passed",
+            )
+        else:
+            self._depth_gauge = self._rejects = self._expired = None
+
+    @property
+    def depth(self) -> int:
+        return len(self._q)
+
+    def estimated_wait_s(self, depth: int | None = None) -> float:
+        """Rough time-to-service for a request entering at `depth`."""
+        d = len(self._q) if depth is None else depth
+        return self._ewma_service_s * max(d, 1)
+
+    def observe_service_time(self, seconds: float) -> None:
+        """Worker feedback: one request's enqueue→complete wall time."""
+        with self._lock:
+            self._ewma_service_s = (
+                (1 - self._ALPHA) * self._ewma_service_s
+                + self._ALPHA * max(seconds, 1e-4)
+            )
+
+    def submit(self, req: ServeRequest) -> None:
+        """Admit or reject. Raises AdmissionError past the watermark or
+        when the request's deadline is already infeasible."""
+        now = self._clock()
+        with self._not_empty:
+            if self._closed:
+                raise AdmissionError("service is shutting down", 1.0)
+            depth = len(self._q)
+            if depth >= self.high_watermark:
+                if self._rejects is not None:
+                    self._rejects.inc()
+                retry = self.estimated_wait_s(depth - self.high_watermark + 1)
+                raise AdmissionError(
+                    f"queue depth {depth} at/over watermark "
+                    f"{self.high_watermark}", max(retry, 0.05),
+                )
+            if req.deadline is not None:
+                budget = req.deadline - now
+                est = self.estimated_wait_s(depth + 1)
+                if budget <= 0 or est > budget:
+                    if self._rejects is not None:
+                        self._rejects.inc()
+                    raise AdmissionError(
+                        f"deadline budget {budget:.3f}s < estimated wait "
+                        f"{est:.3f}s", max(est - max(budget, 0), 0.05),
+                    )
+            req.enqueued_at = now
+            self._q.append(req)
+            if self._depth_gauge is not None:
+                self._depth_gauge.set(len(self._q))
+            self._not_empty.notify()
+
+    def get(self, timeout: float | None = None) -> ServeRequest | None:
+        """Pop the oldest live request; None on timeout or close.
+
+        Requests whose deadline passed while queued are failed with
+        DeadlineExceeded here and never returned."""
+        deadline = None if timeout is None else self._clock() + timeout
+        with self._not_empty:
+            while True:
+                while self._q:
+                    req = self._q.popleft()
+                    if self._depth_gauge is not None:
+                        self._depth_gauge.set(len(self._q))
+                    if (
+                        req.deadline is not None
+                        and self._clock() >= req.deadline
+                    ):
+                        if self._expired is not None:
+                            self._expired.inc()
+                        req.future.set_exception(
+                            DeadlineExceeded(
+                                "deadline passed while queued "
+                                f"({self._clock() - req.enqueued_at:.3f}s)"
+                            )
+                        )
+                        continue
+                    return req
+                if self._closed:
+                    return None
+                if deadline is None:
+                    self._not_empty.wait()
+                else:
+                    remaining = deadline - self._clock()
+                    if remaining <= 0 or not self._not_empty.wait(remaining):
+                        return None
+
+    def close(self) -> list[ServeRequest]:
+        """Stop admitting; wake blocked getters; return drained leftovers
+        (callers fail or hand them off)."""
+        with self._not_empty:
+            self._closed = True
+            leftovers = list(self._q)
+            self._q.clear()
+            if self._depth_gauge is not None:
+                self._depth_gauge.set(0)
+            self._not_empty.notify_all()
+        return leftovers

@@ -1,0 +1,196 @@
+"""ConsensusService: the assembled L6 serving stack + in-process client.
+
+    submit() ──► RequestQueue ──► intake ──► decode pool ──► MicroBatcher
+    (admission control)                                          │ flush
+                  futures  ◄── assemble ◄── device dispatch  ◄───┘
+
+One service owns one device pipeline: requests from any number of
+threads (or the HTTP ingest endpoint) coalesce into shared device
+dispatches, which is where the vmapped cohort kernel's amortization
+materializes under load. `ConsensusClient` is the synchronous wrapper
+the tests and the load benchmark use.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from concurrent.futures import Future
+from dataclasses import replace
+
+from kindel_tpu.batch import BatchOptions, SampleResult
+
+from kindel_tpu.serve.batcher import MicroBatcher
+from kindel_tpu.serve.metrics import MetricsRegistry, ServeHTTPServer
+from kindel_tpu.serve.queue import (
+    AdmissionError,
+    DeadlineExceeded,
+    RequestQueue,
+    ServeRequest,
+)
+from kindel_tpu.serve.worker import ServeWorker
+
+
+class ConsensusService:
+    """Online consensus calling over the batched cohort kernel."""
+
+    def __init__(
+        self,
+        *,
+        max_batch_rows: int = 64,
+        max_wait_s: float = 0.02,
+        max_depth: int = 256,
+        high_watermark: int | None = None,
+        decode_workers: int = 4,
+        row_bucket: int = 8,
+        http_host: str = "127.0.0.1",
+        http_port: int | None = None,
+        metrics: MetricsRegistry | None = None,
+        **consensus_opts,
+    ):
+        """consensus_opts are BatchOptions fields (min_depth, realign,
+        trim_ends, ...) applied to every request unless overridden per
+        submit(). http_port=None runs without the HTTP front end;
+        http_port=0 binds an ephemeral port (tests)."""
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self.default_opts = BatchOptions(**consensus_opts)
+        self.queue = RequestQueue(
+            max_depth=max_depth, high_watermark=high_watermark,
+            metrics=self.metrics,
+        )
+        self.batcher = MicroBatcher(
+            max_batch_rows=max_batch_rows, max_wait_s=max_wait_s
+        )
+        self.worker = ServeWorker(
+            self.queue, self.batcher, metrics=self.metrics,
+            decode_workers=decode_workers, row_bucket=row_bucket,
+        )
+        self._http: ServeHTTPServer | None = None
+        self._http_host = http_host
+        self._http_port = http_port
+        self._started_at: float | None = None
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self) -> "ConsensusService":
+        self._started_at = time.monotonic()
+        self.worker.start()
+        if self._http_port is not None:
+            self._http = ServeHTTPServer(
+                self.metrics, host=self._http_host, port=self._http_port,
+                health_fn=self.healthz,
+                post_routes={"/v1/consensus": self._handle_consensus_post},
+            ).start()
+        return self
+
+    def stop(self, drain: bool = True) -> None:
+        if self._http is not None:
+            self._http.stop()
+            self._http = None
+        self.worker.stop(drain=drain)
+
+    def __enter__(self) -> "ConsensusService":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    @property
+    def http_address(self) -> tuple[str, int] | None:
+        if self._http is None:
+            return None
+        return self._http.host, self._http.port
+
+    def healthz(self) -> dict:
+        return {
+            "status": "ok",
+            "uptime_s": (
+                round(time.monotonic() - self._started_at, 3)
+                if self._started_at is not None else 0.0
+            ),
+            "queue_depth": self.queue.depth,
+            "pending_rows": self.batcher.pending_rows,
+            "watermark": self.queue.high_watermark,
+        }
+
+    # ------------------------------------------------------------- requests
+
+    def submit(self, payload, deadline_s: float | None = None,
+               **opt_overrides) -> Future:
+        """Admit one request (path or SAM/BAM bytes). Returns a Future of
+        SampleResult. Raises AdmissionError when load-shedding."""
+        opts = (
+            replace(self.default_opts, **opt_overrides)
+            if opt_overrides else self.default_opts
+        )
+        req = ServeRequest(
+            payload=payload, opts=opts,
+            deadline=(
+                time.monotonic() + deadline_s
+                if deadline_s is not None else None
+            ),
+        )
+        self.queue.submit(req)
+        return req.future
+
+    def request(self, payload, timeout: float | None = None,
+                **opt_overrides) -> SampleResult:
+        """Synchronous submit: blocks until served (or raises)."""
+        return self.submit(payload, **opt_overrides).result(timeout=timeout)
+
+    # ---------------------------------------------------------- HTTP ingest
+
+    def _handle_consensus_post(self, body: bytes):
+        """POST /v1/consensus: SAM/BAM bytes in, FASTA text out.
+        429 + Retry-After under load shedding, 400 on undecodable input,
+        504 on deadline expiry."""
+        from kindel_tpu.io.fasta import format_fasta
+
+        try:
+            res = self.request(body)
+        except AdmissionError as e:
+            doc = {"error": str(e), "retry_after_s": e.retry_after_s}
+            return (
+                429, "application/json", json.dumps(doc).encode(),
+                {"Retry-After": max(1, round(e.retry_after_s))},
+            )
+        except DeadlineExceeded as e:
+            return 504, "text/plain", f"{e}\n".encode(), {}
+        except ValueError as e:  # decode rejection — the client's fault
+            return 400, "text/plain", f"{e}\n".encode(), {}
+        except Exception as e:  # noqa: BLE001 — server-side failure
+            return 500, "text/plain", f"{e}\n".encode(), {}
+        return (
+            200, "text/x-fasta",
+            format_fasta(res.consensuses).encode(), {},
+        )
+
+
+class ConsensusClient:
+    """Synchronous in-process client over a running ConsensusService."""
+
+    def __init__(self, service: ConsensusService):
+        self._service = service
+
+    def consensus(self, payload, timeout: float | None = None,
+                  **opts) -> list:
+        """[Sequence, ...] for one SAM/BAM path or bytes payload."""
+        return self._service.request(payload, timeout=timeout,
+                                     **opts).consensuses
+
+    def result(self, payload, timeout: float | None = None, **opts):
+        """Full workloads.result namedtuple (consensuses, changes,
+        reports) — the bam_to_consensus-shaped view of a served request."""
+        from kindel_tpu.workloads import consensus_result
+
+        return consensus_result(
+            self._service.request(
+                payload, timeout=timeout, build_reports=True,
+                build_changes=True, **opts,
+            )
+        )
+
+    def fasta(self, payload, timeout: float | None = None, **opts) -> str:
+        from kindel_tpu.io.fasta import format_fasta
+
+        return format_fasta(self.consensus(payload, timeout=timeout, **opts))

@@ -1,0 +1,276 @@
+"""Serve executor: decode on host threads, one device dispatch per flush.
+
+Three stages, mirroring the offline cohort pipeline's overlap structure
+(kindel_tpu.batch.stream_bam_to_results) but driven by arrival instead
+of by a file list:
+
+  intake    one thread pops admitted requests off the RequestQueue and
+            fans decode/event-extraction out to a host thread pool
+  decode    per-request: payload → ReadBatch → EventSet → CallUnits,
+            then into the micro-batcher. A malformed payload fails ONLY
+            its own future here — the batch a request would have joined
+            never sees it.
+  dispatch  one thread drives MicroBatcher.poll; each flush packs into
+            the lane's pinned pad shapes (kindel_tpu.batch.pack_cohort),
+            launches ONE batched device program, assembles every
+            request's FASTA on the host pool, and completes futures.
+
+Dispatch-stage failures are isolated by re-running the flush one request
+at a time, so a request that only breaks in the batched path still fails
+alone while its batch-mates complete.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+from kindel_tpu.batch import (
+    SampleResult,
+    _assemble_outputs,
+    _fold_results,
+    launch_cohort_kernel,
+    pack_cohort,
+)
+from kindel_tpu.pileup_jax import _bucket
+from kindel_tpu.utils.profiling import maybe_phase
+
+from kindel_tpu.serve.batcher import Flush, MicroBatcher
+from kindel_tpu.serve.queue import RequestQueue, ServeRequest
+
+
+def _payload_label(payload) -> str:
+    return "<bytes>" if isinstance(payload, (bytes, bytearray)) else str(
+        payload
+    )
+
+
+def decode_request(req: ServeRequest) -> list:
+    """Host stage: payload → CallUnits (empty list = no aligned reads)."""
+    from kindel_tpu.call_jax import CallUnit
+    from kindel_tpu.events import extract_events
+    from kindel_tpu.io import load_alignment, load_alignment_bytes
+
+    payload = req.payload
+    with maybe_phase("serve decode"):
+        if isinstance(payload, (bytes, bytearray)):
+            batch = load_alignment_bytes(bytes(payload))
+        else:
+            batch = load_alignment(str(payload))
+        ev = extract_events(batch)
+    units = []
+    for rid in ev.present_ref_ids:
+        u = CallUnit(ev, rid, with_ins_table=True, realign=req.opts.realign)
+        units.append(u)
+    return units
+
+
+class ServeWorker:
+    """Owns the intake/decode/dispatch machinery for one service."""
+
+    def __init__(self, queue: RequestQueue, batcher: MicroBatcher,
+                 metrics=None, decode_workers: int = 4,
+                 row_bucket: int = 8, clock=time.monotonic):
+        self.queue = queue
+        self.batcher = batcher
+        self._clock = clock
+        #: rows pad to this power-of-two bucket so repeat flushes of a
+        #: lane reuse one compiled kernel shape even as occupancy varies
+        self.row_bucket = row_bucket
+        self._decode_pool = ThreadPoolExecutor(
+            max_workers=decode_workers,
+            thread_name_prefix="kindel-serve-decode",
+        )
+        self._assemble_pool = ThreadPoolExecutor(
+            max_workers=decode_workers,
+            thread_name_prefix="kindel-serve-assemble",
+        )
+        self._intake_thread: threading.Thread | None = None
+        self._dispatch_thread: threading.Thread | None = None
+        self._draining = False
+        self._stopped = False
+        if metrics is not None:
+            self._m_requests = metrics.counter(
+                "kindel_serve_requests_total", "requests accepted"
+            )
+            self._m_failed = metrics.counter(
+                "kindel_serve_requests_failed_total",
+                "requests completed with an error",
+            )
+            self._m_dispatches = metrics.counter(
+                "kindel_serve_device_dispatches_total",
+                "batched device programs launched",
+            )
+            self._m_batch_retries = metrics.counter(
+                "kindel_serve_batch_isolation_retries_total",
+                "flushes re-run one request at a time after a batch failure",
+            )
+            self._m_occupancy = metrics.histogram(
+                "kindel_serve_batch_occupancy",
+                "requests coalesced per device dispatch",
+                buckets=(1, 2, 4, 8, 16, 32, 64, 128),
+            )
+            self._m_latency = metrics.histogram(
+                "kindel_serve_request_latency_seconds",
+                "enqueue-to-complete request latency",
+            )
+            self._m_pending_rows = metrics.gauge(
+                "kindel_serve_batcher_pending_rows",
+                "decoded rows waiting to coalesce",
+            )
+        else:
+            self._m_requests = self._m_failed = self._m_dispatches = None
+            self._m_batch_retries = None
+            self._m_occupancy = self._m_latency = self._m_pending_rows = None
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> "ServeWorker":
+        self._intake_thread = threading.Thread(
+            target=self._intake_loop, name="kindel-serve-intake", daemon=True
+        )
+        self._dispatch_thread = threading.Thread(
+            target=self._dispatch_loop, name="kindel-serve-dispatch",
+            daemon=True,
+        )
+        self._intake_thread.start()
+        self._dispatch_thread.start()
+        return self
+
+    def stop(self, drain: bool = True) -> None:
+        """Shut down. drain=True serves everything already admitted;
+        drain=False fails pending requests with RuntimeError."""
+        if self._stopped:
+            return
+        self._stopped = True
+        if not drain:
+            for req in self.queue.close():
+                _fail(req, RuntimeError("service stopped"))
+        self._draining = True
+        if self._intake_thread is not None:
+            self._intake_thread.join()
+        # everything popped from the queue is now in the decode pool;
+        # wait for those to land in the batcher (or fail their futures)
+        self._decode_pool.shutdown(wait=True)
+        if drain:
+            for req in self.queue.close():  # raced past the intake exit
+                _fail(req, RuntimeError("service stopped mid-drain"))
+        self.batcher.close()
+        if self._dispatch_thread is not None:
+            self._dispatch_thread.join()
+        self._assemble_pool.shutdown(wait=True)
+
+    # --------------------------------------------------------------- intake
+
+    def _intake_loop(self) -> None:
+        while True:
+            req = self.queue.get(timeout=0.05)
+            if req is None:
+                if self._draining and self.queue.depth == 0:
+                    return
+                continue
+            if self._m_requests is not None:
+                self._m_requests.inc()
+            self._decode_pool.submit(self._decode_one, req)
+
+    def _decode_one(self, req: ServeRequest) -> None:
+        try:
+            units = decode_request(req)
+        except BaseException as e:  # noqa: BLE001 — isolation boundary
+            _fail(req, e)
+            if self._m_failed is not None:
+                self._m_failed.inc()
+            return
+        if not units:
+            # no aligned reads: a legitimate empty result, same as
+            # bam_to_consensus on a read-less file
+            self._complete(req, SampleResult())
+            return
+        self.batcher.add(req, units)
+        if self._m_pending_rows is not None:
+            self._m_pending_rows.set(self.batcher.pending_rows)
+
+    # ------------------------------------------------------------- dispatch
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            flush = self.batcher.poll(timeout=0.25)
+            if flush is None:
+                # poll yields None on a timeout OR once the batcher is
+                # closed and drained — only the latter ends the loop
+                # (decode threads may still be filling lanes mid-drain)
+                if self.batcher.closed and self.batcher.pending_rows == 0:
+                    return
+                continue
+            self._execute(flush)
+            if self._m_pending_rows is not None:
+                self._m_pending_rows.set(self.batcher.pending_rows)
+
+    def _execute(self, flush: Flush) -> None:
+        try:
+            with maybe_phase("serve dispatch+assemble"):
+                outputs, units = self._run_entries(
+                    flush.entries, flush.opts, flush.shapes
+                )
+        except Exception:
+            # batch-level failure: isolate by re-running one request at a
+            # time so only the culpable request(s) fail
+            if self._m_batch_retries is not None:
+                self._m_batch_retries.inc()
+            for entry in flush.entries:
+                if self._m_dispatches is not None:
+                    self._m_dispatches.inc()
+                    self._m_occupancy.observe(1)
+                try:
+                    outputs, units = self._run_entries(
+                        [entry], flush.opts, None
+                    )
+                except BaseException as e:  # noqa: BLE001
+                    _fail(entry[0], e)
+                    if self._m_failed is not None:
+                        self._m_failed.inc()
+                    continue
+                self._complete_entries([entry], units, outputs, flush.opts)
+            return
+        if self._m_dispatches is not None:
+            self._m_dispatches.inc()
+            self._m_occupancy.observe(len(flush.entries))
+        self._complete_entries(flush.entries, units, outputs, flush.opts)
+
+    def _run_entries(self, entries, opts, shapes):
+        """Pack + launch + assemble one coalesced batch. Returns
+        (per-unit outputs, flat unit list in row order)."""
+        units = []
+        paths = []
+        for idx, (req, req_units) in enumerate(entries):
+            for u in req_units:
+                u.sample_idx = idx
+                units.append(u)
+            paths.append(_payload_label(req.payload))
+        n_rows = _bucket(len(units), self.row_bucket)
+        arrays, meta = pack_cohort(units, opts, n_rows=n_rows, shapes=shapes)
+        device_out = launch_cohort_kernel(arrays, meta, opts)
+        outputs = _assemble_outputs(
+            units, device_out, opts, self._assemble_pool, paths
+        )
+        return outputs, units
+
+    def _complete_entries(self, entries, units, outputs, opts) -> None:
+        grouped = _fold_results(units, outputs, len(entries))
+        for idx, (req, _req_units) in enumerate(entries):
+            self._complete(req, grouped[idx])
+
+    def _complete(self, req: ServeRequest, result: SampleResult) -> None:
+        latency = self._clock() - req.enqueued_at
+        if self._m_latency is not None:
+            self._m_latency.observe(latency)
+        self.queue.observe_service_time(latency)
+        if not req.future.set_running_or_notify_cancel():
+            return  # caller cancelled while queued
+        req.future.set_result(result)
+
+
+def _fail(req: ServeRequest, exc: BaseException) -> None:
+    if req.future.set_running_or_notify_cancel():
+        req.future.set_exception(exc)

@@ -11,15 +11,22 @@ from __future__ import annotations
 
 import os
 import sys
+import threading
 import time
 from contextlib import contextmanager
 
 
 class PhaseTimer:
-    """Accumulates named phase durations; printable as a report table."""
+    """Accumulates named phase durations; printable as a report table.
+
+    Thread-safe: the serve worker (kindel_tpu.serve.worker) times its
+    decode and dispatch stages from concurrent host threads, so phase
+    appends take a lock (list.append is atomic in CPython, but the
+    report's read of a coherent snapshot is not)."""
 
     def __init__(self):
         self.phases: list[tuple[str, float]] = []
+        self._phases_lock = threading.Lock()
         self._trace_dir = os.environ.get("KINDEL_TPU_TRACE_DIR")
         self._tracing = False
 
@@ -29,7 +36,8 @@ class PhaseTimer:
         try:
             yield
         finally:
-            self.phases.append((name, time.perf_counter() - start))
+            with self._phases_lock:
+                self.phases.append((name, time.perf_counter() - start))
 
     def start_trace(self):
         if self._trace_dir and not self._tracing:
@@ -46,9 +54,11 @@ class PhaseTimer:
             self._tracing = False
 
     def report(self) -> str:
-        total = sum(d for _, d in self.phases)
+        with self._phases_lock:
+            phases = list(self.phases)
+        total = sum(d for _, d in phases)
         lines = ["===================== PROFILE ======================"]
-        for name, dur in self.phases:
+        for name, dur in phases:
             pct = 100.0 * dur / total if total else 0.0
             lines.append(f"{name:<28s} {dur * 1e3:>10.1f} ms {pct:>5.1f}%")
         lines.append(f"{'total':<28s} {total * 1e3:>10.1f} ms")

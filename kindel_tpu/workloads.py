@@ -6,6 +6,10 @@ Mirrors the reference's API surface (/root/reference/kindel/kindel.py:488-703)
 but never implemented (README.md:106; SURVEY.md §2.1). Every workload takes
 `backend={"numpy","jax"}`: numpy is the reference-exact oracle; jax runs the
 count reduction and calling kernels jitted (and mesh-sharded) on TPU.
+
+The online serving layer (kindel_tpu.serve, L6) sits above this module:
+a served request completes with a SampleResult that `consensus_result`
+adapts back to this module's public `result` namedtuple.
 """
 
 from __future__ import annotations
@@ -24,6 +28,17 @@ from kindel_tpu.realign import cdrp_consensuses, merge_cdrps
 result = namedtuple("result", ["consensuses", "refs_changes", "refs_reports"])
 
 BACKENDS = ("numpy", "jax")
+
+
+def consensus_result(sample_result) -> result:
+    """Adapt a cohort/serve SampleResult to the public result namedtuple,
+    so a served request (kindel_tpu.serve.ConsensusClient.result) returns
+    the exact shape bam_to_consensus does."""
+    return result(
+        sample_result.consensuses,
+        sample_result.refs_changes,
+        sample_result.refs_reports,
+    )
 
 
 def _shardable_device_count() -> int:

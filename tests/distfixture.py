@@ -94,12 +94,16 @@ def run_two_process(worker, extra_argv=(), timeout: float = 300,
     process can steal the just-released port before the coordinator
     binds), and never leaks a worker blocked in initialize(). Returns
     [(returncode, stdout, stderr), ...]; raises RuntimeError when a
-    worker fails for a non-race reason or races persist past `retries`.
+    worker fails for a non-race reason, races persist past `retries`, or
+    the pair exceeds `timeout` (both workers killed, stderr tails
+    attached; the two communicates share one deadline so the worst-case
+    wall matches the documented budget).
     """
     import os
     import socket
     import subprocess
     import sys
+    import time
 
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
@@ -119,12 +123,34 @@ def run_two_process(worker, extra_argv=(), timeout: float = 300,
             for i in range(2)
         ]
         try:
-            return [
-                (p.returncode, out, err)
-                for p, (out, err) in zip(
-                    procs, [p.communicate(timeout=timeout) for p in procs]
-                )
-            ]
+            # one shared deadline for BOTH communicates: the second waits
+            # only for whatever budget the first left, so the worst-case
+            # wall time is `timeout`, not 2×timeout
+            deadline = time.monotonic() + timeout
+            pair = []
+            for p in procs:
+                try:
+                    out, err = p.communicate(
+                        timeout=max(0.0, deadline - time.monotonic())
+                    )
+                except subprocess.TimeoutExpired as e:
+                    tails = []
+                    for q in procs:  # kill BOTH before harvesting stderr
+                        if q.poll() is None:
+                            q.kill()
+                    for q in procs:
+                        try:
+                            _, err_q = q.communicate(timeout=10)
+                        except Exception:
+                            err_q = "<stderr unavailable>"
+                        tails.append((err_q or "")[-1500:])
+                    raise RuntimeError(
+                        f"2-process group timed out after {timeout:.0f}s:\n"
+                        f"stderr[0] tail: {tails[0]}\n"
+                        f"stderr[1] tail: {tails[1]}"
+                    ) from e
+                pair.append((p.returncode, out, err))
+            return pair
         finally:
             for p in procs:  # never leak a worker blocked in initialize()
                 if p.poll() is None:

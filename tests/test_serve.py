@@ -1,0 +1,312 @@
+"""Online serving (kindel_tpu.serve): correctness, coalescing, admission
+control, isolation, and the HTTP surface — all on synthetic SAM cohorts
+(no golden corpus needed) over the CPU backend's multi-threaded harness.
+
+The deterministic components (queue, batcher) are tested directly; the
+assembled service is tested end-to-end against the bam_to_consensus
+oracle, including the acceptance property that concurrent independent
+requests coalesce into one device dispatch (batch occupancy > 1).
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from kindel_tpu.batch import BatchOptions
+from kindel_tpu.serve import (
+    AdmissionError,
+    ConsensusClient,
+    ConsensusService,
+    DeadlineExceeded,
+    MetricsRegistry,
+    MicroBatcher,
+    RequestQueue,
+    ServeRequest,
+)
+from kindel_tpu.serve.worker import decode_request
+from kindel_tpu.workloads import bam_to_consensus
+
+MINI = Path(__file__).parent / "data" / "mini.sam"
+
+
+def make_sam(dest: Path, *, ref: str = "refA", L: int = 400,
+             n_reads: int = 40, seed: int = 0) -> Path:
+    """Synthetic single-reference SAM with matches, deletions, insertions
+    and soft clips — enough signal that different seeds give different
+    consensuses."""
+    rng = np.random.default_rng(seed)
+    lines = ["@HD\tVN:1.6", f"@SQ\tSN:{ref}\tLN:{L}"]
+    for i in range(n_reads):
+        pos = int(rng.integers(0, L - 60))
+        seq = "".join("ACGT"[b] for b in rng.integers(0, 4, size=60))
+        cigar = ("30M2D28M2S", "60M", "28M4I28M")[i % 3]
+        lines.append(
+            f"r{i}\t0\t{ref}\t{pos + 1}\t60\t{cigar}\t*\t0\t0\t{seq}\t*"
+        )
+    dest.write_text("\n".join(lines) + "\n")
+    return dest
+
+
+def _units_for(payload, **opt_kwargs):
+    req = ServeRequest(payload=payload, opts=BatchOptions(**opt_kwargs))
+    return req, decode_request(req)
+
+
+# --------------------------------------------------------------- components
+
+
+def test_batcher_flushes_on_max_wait_with_single_request():
+    """A lone request must not wait for a batch that never fills: the
+    oldest-lane age trigger flushes it after max_wait_s."""
+    mb = MicroBatcher(max_batch_rows=64, max_wait_s=0.08)
+    req, units = _units_for(str(MINI))
+    t0 = time.monotonic()
+    mb.add(req, units)
+    flush = mb.poll(timeout=5.0)
+    waited = time.monotonic() - t0
+    assert flush is not None
+    assert [r for r, _ in flush.entries] == [req]
+    assert waited >= 0.08 * 0.8, f"flushed too early ({waited:.3f}s)"
+    assert waited < 2.0, f"max-wait flush overshot ({waited:.3f}s)"
+
+
+def test_batcher_flushes_immediately_on_batch_full():
+    mb = MicroBatcher(max_batch_rows=2, max_wait_s=30.0)
+    r1, u1 = _units_for(str(MINI))
+    r2, u2 = _units_for(str(MINI))
+    mb.add(r1, u1)
+    mb.add(r2, u2)
+    flush = mb.poll(timeout=0.5)  # far below max_wait: full-lane trigger
+    assert flush is not None and len(flush.entries) == 2
+    assert flush.n_rows == 2
+
+
+def test_batcher_lanes_split_by_options():
+    """Requests with different call options must never share a device
+    dispatch."""
+    mb = MicroBatcher(max_batch_rows=2, max_wait_s=0.01)
+    r1, u1 = _units_for(str(MINI), min_depth=1)
+    r2, u2 = _units_for(str(MINI), min_depth=2)
+    mb.add(r1, u1)
+    mb.add(r2, u2)
+    flushes = [mb.poll(timeout=2.0), mb.poll(timeout=2.0)]
+    assert all(f is not None and len(f.entries) == 1 for f in flushes)
+    depths = sorted(f.opts.min_depth for f in flushes)
+    assert depths == [1, 2]
+
+
+def test_admission_rejects_past_watermark_and_recovers():
+    reg = MetricsRegistry()
+    q = RequestQueue(max_depth=8, high_watermark=2, metrics=reg)
+    opts = BatchOptions()
+    q.submit(ServeRequest(payload="a", opts=opts))
+    q.submit(ServeRequest(payload="b", opts=opts))
+    with pytest.raises(AdmissionError) as exc:
+        q.submit(ServeRequest(payload="c", opts=opts))
+    assert exc.value.retry_after_s > 0
+    assert reg.snapshot()["kindel_serve_admission_rejects_total"] == 1
+    # recovery: drain one, admission reopens
+    assert q.get(timeout=1.0).payload == "a"
+    q.submit(ServeRequest(payload="c", opts=opts))
+    assert q.depth == 2
+
+
+def test_queue_drops_expired_deadline_requests():
+    q = RequestQueue(max_depth=8)
+    opts = BatchOptions()
+    # shrink the service-time EWMA so the deadline is feasible at
+    # admission — the point here is the *get*-side expiry drop
+    for _ in range(40):
+        q.observe_service_time(0.001)
+    req = ServeRequest(
+        payload="x", opts=opts, deadline=time.monotonic() + 0.03
+    )
+    q.submit(req)
+    time.sleep(0.06)
+    fresh = ServeRequest(payload="y", opts=opts)
+    q.submit(fresh)
+    got = q.get(timeout=1.0)
+    assert got is fresh, "expired request must be skipped"
+    with pytest.raises(DeadlineExceeded):
+        req.future.result(timeout=0)
+
+
+def test_deadline_infeasible_rejected_at_admission():
+    q = RequestQueue(max_depth=64)
+    opts = BatchOptions()
+    for _ in range(4):
+        q.submit(ServeRequest(payload="filler", opts=opts))
+    # 4 queued × DEFAULT_SERVICE_S estimate ≫ 1 ms budget
+    with pytest.raises(AdmissionError):
+        q.submit(ServeRequest(
+            payload="x", opts=opts, deadline=time.monotonic() + 0.001
+        ))
+
+
+# ------------------------------------------------------------- the service
+
+
+def test_single_request_matches_bam_to_consensus(tmp_path):
+    sam = make_sam(tmp_path / "one.sam", seed=11)
+    want = bam_to_consensus(str(sam))
+    with ConsensusService(max_wait_s=0.01) as svc:
+        got = ConsensusClient(svc).result(str(sam), timeout=120)
+    assert [(r.name, r.sequence) for r in got.consensuses] == [
+        (r.name, r.sequence) for r in want.consensuses
+    ]
+    assert got.refs_changes == want.refs_changes
+    assert got.refs_reports == want.refs_reports
+
+
+def test_empty_input_serves_empty_result(tmp_path):
+    empty = tmp_path / "empty.sam"
+    empty.write_text("@HD\tVN:1.6\n@SQ\tSN:refZ\tLN:100\n")
+    with ConsensusService(max_wait_s=0.01) as svc:
+        assert ConsensusClient(svc).consensus(str(empty), timeout=60) == []
+
+
+def test_concurrent_mixed_requests_coalesce_and_each_is_correct(tmp_path):
+    """The acceptance property: N concurrent independent requests each
+    get their own correct FASTA, and ≥2 of them share one device
+    dispatch (batch occupancy > 1)."""
+    n = 6
+    sams = [
+        make_sam(tmp_path / f"s{i}.sam", ref=f"ref{i}", seed=100 + i)
+        for i in range(n)
+    ]
+    oracles = [bam_to_consensus(str(p)).consensuses for p in sams]
+    with ConsensusService(max_wait_s=0.5, decode_workers=4) as svc:
+        client = ConsensusClient(svc)
+        client.consensus(str(sams[0]), timeout=180)  # warm the kernel
+        results: list = [None] * n
+        errors: list = []
+
+        def one(i):
+            try:
+                results[i] = client.consensus(str(sams[i]), timeout=180)
+            except Exception as e:  # noqa: BLE001
+                errors.append((i, e))
+
+        threads = [
+            threading.Thread(target=one, args=(i,)) for i in range(n)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        snap = svc.metrics.snapshot()
+    assert not errors, errors
+    for i in range(n):
+        assert [(r.name, r.sequence) for r in results[i]] == [
+            (r.name, r.sequence) for r in oracles[i]
+        ], f"sample {i} diverged from its oracle"
+    assert snap["kindel_serve_batch_occupancy"]["max"] >= 2, (
+        "no coalescing observed", snap,
+    )
+    assert (
+        snap["kindel_serve_device_dispatches_total"] < n + 1
+    ), "every request dispatched alone"
+
+
+def test_corrupt_input_fails_only_its_own_request(tmp_path):
+    good = make_sam(tmp_path / "good.sam", seed=7)
+    bad = tmp_path / "bad.bam"
+    bad.write_bytes(b"\x1f\x8b not actually a bam")
+    want = bam_to_consensus(str(good)).consensuses
+    with ConsensusService(max_wait_s=0.05) as svc:
+        futures = [
+            svc.submit(str(good)),
+            svc.submit(str(bad)),
+            svc.submit(str(good)),
+        ]
+        with pytest.raises(ValueError):
+            futures[1].result(timeout=120)
+        for f in (futures[0], futures[2]):
+            res = f.result(timeout=120)
+            assert [(r.name, r.sequence) for r in res.consensuses] == [
+                (r.name, r.sequence) for r in want
+            ]
+        snap = svc.metrics.snapshot()
+    assert snap["kindel_serve_requests_failed_total"] == 1
+    assert snap["kindel_serve_requests_total"] == 3
+
+
+def test_service_recovers_after_watermark_rejection(tmp_path):
+    """Requests admitted while the worker is down drain once it starts;
+    admission reopens as depth falls."""
+    sam = make_sam(tmp_path / "wm.sam", seed=3)
+    svc = ConsensusService(max_wait_s=0.02, high_watermark=2)
+    try:
+        f1 = svc.submit(str(sam))
+        f2 = svc.submit(str(sam))
+        with pytest.raises(AdmissionError):
+            svc.submit(str(sam))
+        svc.start()
+        assert f1.result(timeout=120).consensuses
+        assert f2.result(timeout=120).consensuses
+        # queue drained → admission open again
+        f3 = svc.submit(str(sam))
+        assert f3.result(timeout=120).consensuses
+    finally:
+        svc.stop()
+
+
+# ----------------------------------------------------------------- HTTP
+
+
+def test_http_metrics_healthz_and_ingest(tmp_path):
+    sam = make_sam(tmp_path / "http.sam", seed=42)
+    body = sam.read_bytes()
+    want_fasta = "".join(
+        f">{r.name}\n{r.sequence}\n"
+        for r in bam_to_consensus(str(sam)).consensuses
+    )
+    with ConsensusService(max_wait_s=0.02, http_port=0) as svc:
+        host, port = svc.http_address
+        base = f"http://{host}:{port}"
+
+        # ingest: SAM bytes in, FASTA out, byte-identical to the oracle
+        req = urllib.request.Request(
+            f"{base}/v1/consensus", data=body, method="POST"
+        )
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            assert resp.status == 200
+            assert resp.read().decode() == want_fasta
+
+        # undecodable body → 400, not a 500 or a hang
+        bad = urllib.request.Request(
+            f"{base}/v1/consensus", data=b"garbage", method="POST"
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(bad, timeout=60)
+        assert exc.value.code == 400
+
+        with urllib.request.urlopen(f"{base}/healthz", timeout=30) as resp:
+            health = json.loads(resp.read())
+        assert health["status"] == "ok"
+        for field in ("queue_depth", "pending_rows", "watermark",
+                      "uptime_s"):
+            assert field in health, health
+
+        with urllib.request.urlopen(f"{base}/metrics", timeout=30) as resp:
+            metrics = resp.read().decode()
+    for name in (
+        "kindel_serve_queue_depth",
+        "kindel_serve_admission_rejects_total",
+        "kindel_serve_requests_total",
+        "kindel_serve_requests_failed_total",
+        "kindel_serve_device_dispatches_total",
+        "kindel_serve_batch_occupancy_bucket",
+        "kindel_serve_batch_occupancy_max",
+        "kindel_serve_request_latency_seconds_p50",
+        "kindel_serve_request_latency_seconds_p99",
+    ):
+        assert name in metrics, f"{name} missing from /metrics"
+    # the corrupt POST failed its own request and was counted
+    assert "kindel_serve_requests_failed_total 1" in metrics
