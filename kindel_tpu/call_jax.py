@@ -873,6 +873,7 @@ def call_consensus_fused(
     uppercase: bool = False,
     build_changes: bool = True,
     strict_ins: bool = False,
+    tuning=None,
 ) -> tuple[CallResult, int, int]:
     """Fused-device equivalent of kindel_tpu.call.call_consensus. `pileup`
     supplies insertion-string majority resolution when insertions emit.
@@ -883,26 +884,23 @@ def call_consensus_fused(
     dense decision masks are shipped — the sequence reconstructs from the
     2-bit plane + exception bitmask wire format (decode_fast).
 
-    The no-changes path runs slab-pipelined by default (KINDEL_TPU_SLABS;
-    default 16 on the CPU backend / 4 on accelerators, clamped for small
-    contigs; =1 forces the single fused kernel) — kindel_tpu.pipeline
+    The no-changes path runs slab-pipelined by default — kindel_tpu.pipeline
     overlaps wire+decode with device compute; output is byte-identical
-    either way."""
+    either way. The slab count resolves through kindel_tpu.tune
+    (`tuning` arg > KINDEL_TPU_SLABS > persisted tune store > backend
+    default 16 CPU / 4 accelerator), clamped for small contigs; 1 forces
+    the single fused kernel."""
     if not build_changes:
-        import os
+        from kindel_tpu import tune
 
-        # backend-aware default: on CPU the slab sweep is pure cache
-        # locality and 16 measures ~1.5× faster than 4 on the bacterial
-        # bench (bench.py tune, round 5); on an accelerator each slab is
-        # an extra dispatch over a possibly-tunneled link, so stay at 4
-        # until an on-device A/B says otherwise (benchmarks/microprof.py)
-        default = 16 if jax.default_backend() == "cpu" else 4
-        try:
-            n_slabs = int(os.environ.get("KINDEL_TPU_SLABS", default))
-        except ValueError:
-            n_slabs = default
+        max_contig = int(ev.ref_lens[rid])
+        n_slabs, _src = tune.resolve_slabs(
+            explicit=getattr(tuning, "n_slabs", None),
+            backend=jax.default_backend(),
+            max_contig=max_contig,
+        )
         # tiny contigs: slabbing buys nothing below ~64k positions a slab
-        n_slabs = max(1, min(n_slabs, int(ev.ref_lens[rid]) // 65536))
+        n_slabs = max(1, min(n_slabs, tune.slab_clamp(max_contig)))
         if n_slabs > 1:
             from kindel_tpu.pipeline import pipelined_consensus
 

@@ -116,6 +116,13 @@ def _consensus_parser(sub):
              "over $KINDEL_TPU_STREAM_THRESHOLD_MB (default 512) stream "
              "automatically",
     )
+    p.add_argument(
+        "--slabs", type=int, default=None, metavar="N",
+        help="pin the slab-pipeline count explicitly (top of the "
+             "explicit > $KINDEL_TPU_SLABS > tune store > default "
+             "resolution order; `kindel tune` measures and persists a "
+             "per-host winner)",
+    )
     _add_backend(p)
 
 
@@ -126,6 +133,11 @@ def cmd_consensus(args) -> int:
 
         timer = enable_profiling()
         timer.start_trace()
+    tuning = None
+    if args.slabs is not None:
+        from kindel_tpu.tune import TuningConfig
+
+        tuning = TuningConfig(n_slabs=args.slabs)
     try:
         res = workloads.bam_to_consensus(
             args.bam_path,
@@ -140,6 +152,7 @@ def cmd_consensus(args) -> int:
             stream_chunk_mb=args.stream_chunk_mb,
             cdr_gap=args.cdr_gap,
             fix_clip_artifacts=args.fix_clip_artifacts,
+            tuning=tuning,
         )
     finally:
         if timer is not None:
@@ -391,6 +404,17 @@ def _serve_parser(sub):
         "-u", "--uppercase", action="store_true",
         help="close gaps using uppercase alphabet",
     )
+    p.add_argument(
+        "--no-warmup", action="store_true",
+        help="skip the startup AOT compile warmup (first request on each "
+             "lane shape then pays its own compile)",
+    )
+    p.add_argument(
+        "--warm", action="append", default=[], metavar="PATH",
+        help="representative SAM/BAM payload(s) whose lane shapes are "
+             "precompiled at startup (repeatable); the minimal synthetic "
+             "lane is always warmed unless --no-warmup",
+    )
 
 
 def cmd_serve(args) -> int:
@@ -416,13 +440,17 @@ def cmd_serve(args) -> int:
         fix_clip_artifacts=args.fix_clip_artifacts,
         trim_ends=args.trim_ends,
         uppercase=args.uppercase,
+        warmup=not args.no_warmup,
+        warm_payloads=args.warm,
     )
     service.start()
     host, port = service.http_address
     print(
         f"kindel-tpu serving on http://{host}:{port} — "
         "POST /v1/consensus (SAM/BAM body -> FASTA), GET /metrics, "
-        "GET /healthz; Ctrl-C to drain and stop",
+        "GET /healthz; Ctrl-C to drain and stop"
+        + ("" if args.no_warmup
+           else " (AOT warmup running; /healthz flips warming -> ok)"),
         file=sys.stderr,
     )
     try:
@@ -432,6 +460,99 @@ def cmd_serve(args) -> int:
         print("draining…", file=sys.stderr)
     finally:
         service.stop(drain=True)
+    return 0
+
+
+def _tune_parser(sub):
+    p = sub.add_parser(
+        "tune",
+        help="pre-tune this host offline: measure the slab-pipeline "
+             "sweep on a representative BAM and persist the winner in "
+             "the tune store (~/.cache/kindel_tpu/tune.json) so every "
+             "later run starts hot",
+    )
+    p.add_argument(
+        "bam_path",
+        help="representative SAM/BAM file (the tuned value is keyed by "
+             "this workload's contig-scale bucket)",
+    )
+    p.add_argument(
+        "--budget-s", type=float, default=300.0,
+        help="wall budget for the measurement loop; whatever configs are "
+             "measured by then decide the pick",
+    )
+    p.add_argument(
+        "--repeats", type=int, default=2,
+        help="timed passes per config (best-of; single-pass walls are "
+             "noisy on shared hosts)",
+    )
+    p.add_argument(
+        "--dry-run", action="store_true",
+        help="measure and report, but do not write the tune store",
+    )
+
+
+def cmd_tune(args) -> int:
+    """Offline host pre-tune: the bench's budget-bounded slab search,
+    run through the library (kindel_tpu.tune) and persisted."""
+    import json
+    import time as _time
+
+    import jax
+
+    from kindel_tpu import tune
+    from kindel_tpu.call_jax import call_consensus_fused
+    from kindel_tpu.events import extract_events
+    from kindel_tpu.io import load_alignment
+
+    ev = extract_events(load_alignment(args.bam_path))
+    if not ev.present_ref_ids:
+        print(f"{args.bam_path}: no aligned reads — nothing to tune",
+              file=sys.stderr)
+        return 1
+    max_contig = max(int(ev.ref_lens[r]) for r in ev.present_ref_ids)
+    clamp = tune.slab_clamp(max_contig)
+    backend = jax.default_backend()
+    key = tune.store_key(backend, max_contig)
+
+    def one_pass(slabs: int) -> None:
+        for rid in ev.present_ref_ids:
+            res, _dmin, _dmax = call_consensus_fused(
+                ev, rid, build_changes=False,
+                tuning=tune.TuningConfig(n_slabs=slabs),
+            )
+            assert len(res.sequence) > 0
+
+    t0 = _time.perf_counter()
+    chosen, timings = tune.measured_slabs(
+        one_pass, clamp, args.budget_s, repeats=args.repeats
+    )
+    wall = _time.perf_counter() - t0
+    persisted = False
+    if not args.dry_run:
+        persisted = tune.record(
+            key,
+            {
+                "n_slabs": chosen,
+                "timings_s": {str(k): round(v, 4) for k, v in timings.items()},
+                "tune_wall_s": round(wall, 3),
+                "bam_path": str(args.bam_path),
+            },
+        )
+    print(
+        json.dumps(
+            {
+                "backend": backend,
+                "key": key,
+                "clamp": clamp,
+                "n_slabs": chosen,
+                "timings_s": {str(k): round(v, 4) for k, v in timings.items()},
+                "tune_wall_s": round(wall, 3),
+                "persisted": persisted,
+                "store": str(tune.store_path()),
+            }
+        )
+    )
     return 0
 
 
@@ -573,6 +694,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
 
     _serve_parser(sub)
+    _tune_parser(sub)
 
     sub.add_parser("version", help="show version")
     return parser
@@ -595,6 +717,7 @@ def main(argv=None) -> int:
         "plot": cmd_plot,
         "batch": cmd_batch,
         "serve": cmd_serve,
+        "tune": cmd_tune,
     }[args.command](args)
 
 

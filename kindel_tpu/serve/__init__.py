@@ -15,6 +15,9 @@ arxiv 2604.15464; Gemma-on-TPU serving, 2605.25645):
               bucket shapes; flushes on batch-full or max-wait
   worker.py   intake/decode/dispatch executor — host-thread decode, one
               device program per flush, per-request error isolation
+  warmup.py   AOT compile warmup — precompiles every startup-derivable
+              lane shape so the first request never pays a jit compile
+              (/healthz reports `warming` until done)
   metrics.py  thread-safe registry + /metrics + /healthz HTTP exposition
   service.py  ConsensusService facade, ConsensusClient, POST ingest
 
@@ -36,4 +39,5 @@ from kindel_tpu.serve.service import (  # noqa: F401
     ConsensusClient,
     ConsensusService,
 )
+from kindel_tpu.serve.warmup import warm_shapes  # noqa: F401
 from kindel_tpu.serve.worker import ServeWorker  # noqa: F401

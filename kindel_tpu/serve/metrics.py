@@ -154,6 +154,44 @@ class Histogram:
         return lines
 
 
+class Info:
+    """Constant labeled marker (value always 1) — exports configuration
+    facts (tune knob sources, warmed lane shapes) in the standard
+    `name{label="..."} 1` idiom without pretending they are
+    measurements. One sample per distinct label set; re-setting the
+    same label set overwrites it."""
+
+    def __init__(self, name: str, help_text: str = ""):
+        self.name = name
+        self.help = help_text
+        self._labels: dict[tuple, dict] = {}
+        self._lock = threading.Lock()
+
+    def set(self, **labels) -> None:
+        with self._lock:
+            self._labels[tuple(sorted(labels.items()))] = {
+                k: str(v) for k, v in labels.items()
+            }
+
+    @property
+    def value(self) -> list[dict]:
+        with self._lock:
+            return [dict(v) for v in self._labels.values()]
+
+    def render(self) -> list[str]:
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} gauge",
+        ]
+        with self._lock:
+            for labels in self._labels.values():
+                lab = ",".join(
+                    f'{k}="{v}"' for k, v in sorted(labels.items())
+                )
+                lines.append(f"{self.name}{{{lab}}} 1")
+        return lines
+
+
 def _fmt(v: float) -> str:
     return str(int(v)) if float(v).is_integer() else repr(float(v))
 
@@ -186,6 +224,9 @@ class MetricsRegistry:
 
     def histogram(self, name: str, help_text: str = "", **kw) -> Histogram:
         return self._get(Histogram, name, help_text, **kw)
+
+    def info(self, name: str, help_text: str = "") -> Info:
+        return self._get(Info, name, help_text)
 
     def render(self) -> str:
         with self._lock:

@@ -14,6 +14,8 @@ the tests and the load benchmark use.
 from __future__ import annotations
 
 import json
+import sys
+import threading
 import time
 from concurrent.futures import Future
 from dataclasses import replace
@@ -46,14 +48,62 @@ class ConsensusService:
         http_host: str = "127.0.0.1",
         http_port: int | None = None,
         metrics: MetricsRegistry | None = None,
+        warmup: bool = False,
+        warm_payloads=(),
+        tuning=None,
         **consensus_opts,
     ):
         """consensus_opts are BatchOptions fields (min_depth, realign,
         trim_ends, ...) applied to every request unless overridden per
         submit(). http_port=None runs without the HTTP front end;
-        http_port=0 binds an ephemeral port (tests)."""
+        http_port=0 binds an ephemeral port (tests).
+
+        warmup=True (the `kindel serve` default) AOT-precompiles the
+        cohort kernel for every startup-derivable lane shape on a
+        background thread — the minimal synthetic lane plus the shapes
+        of `warm_payloads` (representative SAM/BAM paths or bytes) —
+        while `/healthz` reports "warming"; the first request after
+        "ok" on a warmed lane triggers no compile. `tuning` is an
+        optional kindel_tpu.tune.TuningConfig pinning performance knobs
+        explicitly (its cohort budget feeds the dispatch grouping)."""
         self.metrics = metrics if metrics is not None else MetricsRegistry()
+        if (
+            tuning is not None
+            and getattr(tuning, "cohort_budget_mb", None) is not None
+        ):
+            consensus_opts.setdefault(
+                "cohort_budget_mb", tuning.cohort_budget_mb
+            )
         self.default_opts = BatchOptions(**consensus_opts)
+        self._warm_payloads = tuple(warm_payloads)
+        self._do_warmup = bool(warmup) or bool(self._warm_payloads)
+        #: "off" | "pending" | "warming" | "ok"
+        self._warm_state = "pending" if self._do_warmup else "off"
+        self._warm_error: str | None = None
+        self._warm_thread: threading.Thread | None = None
+        self._m_warm_seconds = self.metrics.gauge(
+            "kindel_serve_warmup_seconds",
+            "wall time of the startup AOT compile warmup",
+        )
+        self._m_warm_shapes = self.metrics.counter(
+            "kindel_serve_warmup_shapes_total",
+            "distinct lane shapes precompiled at startup",
+        )
+        self._m_warm_shape_info = self.metrics.info(
+            "kindel_serve_warmup_shape",
+            "one marker per precompiled lane shape (label: shape)",
+        )
+        self._m_tune_source = self.metrics.info(
+            "kindel_serve_tune_source",
+            "where each tuning knob's value came from "
+            "(explicit/env/cache/default)",
+        )
+        from kindel_tpu import tune
+
+        _budget, src = tune.resolve_cohort_budget_mb(
+            self.default_opts.cohort_budget_mb
+        )
+        self._m_tune_source.set(knob="cohort_budget_mb", source=src)
         self.queue = RequestQueue(
             max_depth=max_depth, high_watermark=high_watermark,
             metrics=self.metrics,
@@ -75,6 +125,12 @@ class ConsensusService:
     def start(self) -> "ConsensusService":
         self._started_at = time.monotonic()
         self.worker.start()
+        if self._do_warmup and self._warm_thread is None:
+            self._warm_state = "warming"
+            self._warm_thread = threading.Thread(
+                target=self._warm, name="kindel-serve-warmup", daemon=True
+            )
+            self._warm_thread.start()
         if self._http_port is not None:
             self._http = ServeHTTPServer(
                 self.metrics, host=self._http_host, port=self._http_port,
@@ -101,9 +157,49 @@ class ConsensusService:
             return None
         return self._http.host, self._http.port
 
+    def _warm(self) -> None:
+        """Background AOT warmup (see serve/warmup.py). A warmup failure
+        never takes the service down — the first request just pays its
+        own compile, exactly the pre-warmup behavior."""
+        from kindel_tpu.serve.warmup import warm_shapes
+
+        t0 = time.monotonic()
+        try:
+            timings = warm_shapes(
+                self.default_opts, row_bucket=self.worker.row_bucket,
+                payloads=self._warm_payloads,
+            )
+            self._m_warm_shapes.inc(len(timings))
+            for label, seconds in timings.items():
+                self._m_warm_shape_info.set(
+                    shape=label, seconds=round(seconds, 3)
+                )
+        except Exception as e:  # noqa: BLE001 — warmup is best-effort
+            self._warm_error = repr(e)
+            print(f"kindel-serve warmup failed: {e!r}", file=sys.stderr)
+        finally:
+            self._m_warm_seconds.set(round(time.monotonic() - t0, 3))
+            self._warm_state = "ok"
+
+    @property
+    def warming(self) -> bool:
+        return self._warm_state in ("pending", "warming")
+
+    def wait_warm(self, timeout: float | None = None) -> bool:
+        """Block until startup warmup finishes (True) or timeout (False).
+        No-op True when warmup is disabled."""
+        deadline = (
+            None if timeout is None else time.monotonic() + timeout
+        )
+        while self.warming:
+            if deadline is not None and time.monotonic() >= deadline:
+                return False
+            time.sleep(0.005)
+        return True
+
     def healthz(self) -> dict:
-        return {
-            "status": "ok",
+        doc = {
+            "status": "warming" if self.warming else "ok",
             "uptime_s": (
                 round(time.monotonic() - self._started_at, 3)
                 if self._started_at is not None else 0.0
@@ -111,7 +207,12 @@ class ConsensusService:
             "queue_depth": self.queue.depth,
             "pending_rows": self.batcher.pending_rows,
             "watermark": self.queue.high_watermark,
+            "warmup": self._warm_state,
+            "warmup_s": self._m_warm_seconds.value,
         }
+        if self._warm_error is not None:
+            doc["warmup_error"] = self._warm_error
+        return doc
 
     # ------------------------------------------------------------- requests
 

@@ -1,0 +1,91 @@
+"""AOT compile warmup: pay every lane shape's jit compile at startup.
+
+The micro-batcher keys coalescing lanes on (call options,
+`cohort_pad_shapes`) and pads row counts to a power-of-two bucket, so
+the set of device programs a serving process runs is small and known —
+but before this module the FIRST request to open each lane paid the
+compile (seconds to minutes on a tunneled accelerator) inside its own
+latency budget. `warm_shapes` walks exactly the dispatch path the
+worker runs (`pack_cohort` → `launch_cohort_kernel` → block on the
+wire) for every lane shape derivable at startup:
+
+  * a minimal synthetic cohort (the smallest bucket lane — every
+    "tiny request" lands there), and
+  * operator-supplied representative payloads (`kindel serve --warm
+    sample.bam`), which warm the exact shapes production traffic hits.
+
+With the persistent XLA cache (utils/jax_cache.py) the warmup is
+near-free on a host that has served before; on a cold host it moves the
+compile wall from the first request's p99 to process startup, where
+`/healthz` reports `warming` so load balancers hold traffic.
+"""
+
+from __future__ import annotations
+
+import time
+
+#: minimal synthetic cohort: two reads with matches, a deletion, an
+#: insertion and a soft clip, so every sparse-event pad axis is
+#: non-degenerate and the lane shapes equal the bucket minimums
+_SYNTH_SAM = (
+    b"@HD\tVN:1.6\n"
+    b"@SQ\tSN:warmref\tLN:512\n"
+    b"w0\t0\twarmref\t1\t60\t30M2D28M2S\t*\t0\t0\t" + b"ACGT" * 15 + b"\t*\n"
+    b"w1\t0\twarmref\t5\t60\t28M4I28M\t*\t0\t0\t" + b"TGCA" * 15 + b"\t*\n"
+)
+
+
+def decode_payload(payload, opts) -> list:
+    """Payload (path or SAM/BAM bytes) → CallUnits, through the same
+    decode the worker's decode stage runs — warmed shapes must be
+    derived exactly the way served shapes are."""
+    from kindel_tpu.serve.queue import ServeRequest
+    from kindel_tpu.serve.worker import decode_request
+
+    return decode_request(ServeRequest(payload=payload, opts=opts))
+
+
+def shape_label(shapes: tuple, n_rows: int) -> str:
+    return "r{}xL{}o{}b{}d{}i{}c{}".format(n_rows, *shapes)
+
+
+def warm_shapes(opts, row_bucket: int = 8, payloads=(),
+                include_synthetic: bool = True) -> dict[str, float]:
+    """Precompile the batched cohort kernel for every lane shape the
+    given payloads (plus the minimal synthetic cohort) land in.
+
+    Returns {shape_label: warmup_seconds} — one entry per UNIQUE
+    (pad shapes, row bucket) pair; a timing includes pack + compile +
+    one executed batch (blocked on, because jax dispatch is async and a
+    "warm" kernel that is still compiling would defeat the point)."""
+    import numpy as np
+
+    from kindel_tpu.batch import (
+        cohort_pad_shapes,
+        launch_cohort_kernel,
+        pack_cohort,
+    )
+    from kindel_tpu.pileup_jax import _bucket
+
+    cohorts: list = []
+    if include_synthetic:
+        cohorts.append(decode_payload(_SYNTH_SAM, opts))
+    for p in payloads:
+        cohorts.append(decode_payload(p, opts))
+
+    timings: dict[str, float] = {}
+    for units in cohorts:
+        if not units:
+            continue
+        shapes = cohort_pad_shapes(units, opts)
+        n_rows = _bucket(len(units), row_bucket)
+        label = shape_label(shapes, n_rows)
+        if label in timings:
+            continue
+        t0 = time.monotonic()
+        arrays, meta = pack_cohort(units, opts, n_rows=n_rows, shapes=shapes)
+        out, _meta = launch_cohort_kernel(arrays, meta, opts)
+        wire = out[0] if opts.realign else out
+        np.asarray(wire)  # block: compile + execute must have finished
+        timings[label] = time.monotonic() - t0
+    return timings

@@ -250,14 +250,34 @@ class StreamAccumulator(StreamAccumulatorBase):
         )
 
 
+def _resolve_chunk_bytes(chunk_bytes, tuning, bam_path) -> int:
+    """Explicit chunk_bytes wins; otherwise the stream-chunk knob
+    resolves through kindel_tpu.tune (TuningConfig > env pin > store),
+    falling back to DEFAULT_CHUNK_BYTES — one resolution rule for every
+    streamed entry point, applied at config-build time."""
+    if chunk_bytes is not None:
+        return chunk_bytes
+    from kindel_tpu import tune
+
+    chunk_mb, _src = tune.resolve_stream_chunk_mb(
+        getattr(tuning, "stream_chunk_mb", None), bam_path
+    )
+    if chunk_mb is not None:
+        return int(chunk_mb * (1 << 20))
+    return DEFAULT_CHUNK_BYTES
+
+
 def stream_pileups(
     path,
-    chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+    chunk_bytes: int | None = DEFAULT_CHUNK_BYTES,
     backend: str = "numpy",
     clip_weights: bool = True,
+    tuning=None,
 ) -> dict[str, Pileup]:
     """Bounded-RSS replacement for build_pileups(extract_events(load…)):
-    same output, O(chunk + L) host memory."""
+    same output, O(chunk + L) host memory. chunk_bytes=None resolves the
+    chunk size through kindel_tpu.tune (`tuning` > env > store > default)."""
+    chunk_bytes = _resolve_chunk_bytes(chunk_bytes, tuning, path)
     acc = StreamAccumulator(
         backend=backend, full=True, clip_weights=clip_weights
     )
@@ -276,15 +296,19 @@ def streamed_consensus(
     trim_ends: bool = False,
     uppercase: bool = False,
     backend: str = "numpy",
-    chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+    chunk_bytes: int | None = DEFAULT_CHUNK_BYTES,
     cdr_gap: int = 0,
     fix_clip_artifacts: bool = False,
+    tuning=None,
 ):
     """bam_to_consensus over a streamed decode — identical output, host
     RSS bounded by O(chunk + reference length).
 
     Returns the same result namedtuple as workloads.bam_to_consensus.
+    chunk_bytes=None resolves the chunk size through kindel_tpu.tune
+    (`tuning` arg > env pin > persisted store > default).
     """
+    chunk_bytes = _resolve_chunk_bytes(chunk_bytes, tuning, bam_path)
     from kindel_tpu.call import _insertion_calls, assemble, call_consensus
     from kindel_tpu.io.fasta import Sequence
     from kindel_tpu.realign import cdrp_consensuses, merge_cdrps

@@ -1,0 +1,397 @@
+"""kindel_tpu.tune — persistent autotuning + explicit knob resolution.
+
+Before this module every tuning knob was an `os.environ` read scattered
+at its point of use: the slab count lived in `call_jax.py`, the stream
+chunk in `workloads.py`, the cohort budget in `batch.py`, and the
+headline bench re-measured the slab sweep from scratch on every
+invocation and threw the winner away. SURVEY §7's compile-once/run-hot
+discipline applies to *tuning* exactly as it does to compilation: a
+host's best slab count is a property of the host/link, not of the
+process, so measure it once, persist it next to the XLA compile cache
+(`utils/jax_cache.py`), and resolve it explicitly at config-build time.
+
+Resolution order for every knob (single rule, applied uniformly):
+
+    explicit arg > env pin > persisted store > measured > default
+
+"Measured" never happens implicitly at call time — only `kindel tune`
+and `bench.py` run the budget-bounded search, and both persist the
+winner so every later entry point (CLI, workloads, serve) starts hot.
+
+The store is a small versioned JSON document
+(`~/.cache/kindel_tpu/tune.json`, `KINDEL_TPU_TUNE_CACHE` overrides,
+`=off` disables) keyed by (backend, device kind, host fingerprint,
+package version, contig-scale bucket): a tuned value must never cross a
+machine, an accelerator generation, a package upgrade, or a workload
+scale it was not measured on — the same hygiene the compile cache's
+machine tag exists for.
+
+Invariant (pinned by tests/test_env_guard.py): tuning knobs resolve
+HERE, on the host, at config-build time — never inside a jit-traced
+function body.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass
+from pathlib import Path
+
+#: slab-pipeline defaults (single source — bench.py and call_jax.py
+#: previously each hardcoded the 16/4 pair): on the CPU backend the slab
+#: sweep is pure cache locality and 16 measures ~1.5× faster than 4 on
+#: the bacterial bench (round 5); on an accelerator each slab is an
+#: extra dispatch over a possibly-tunneled link, so stay at 4 until a
+#: measurement says otherwise.
+CPU_SLAB_DEFAULT = 16
+ACCEL_SLAB_DEFAULT = 4
+
+#: geometric grid the budget-bounded search seeds with (bench round 5)
+SLAB_GRID = (1, 4, 16)
+#: hard ceiling of the doubling expansion
+MAX_SLABS = 64
+#: positions one slab must at least cover for pipelining to pay
+MIN_SLAB_POSITIONS = 65536
+
+#: device bytes one cohort group's dense tensors may occupy (see
+#: batch._row_bytes for the per-row model); the env pin is
+#: KINDEL_TPU_COHORT_BUDGET_MB
+COHORT_BUDGET_MB_DEFAULT = 512
+
+STORE_VERSION = 1
+
+
+def default_slabs(backend: str) -> int:
+    """Backend-aware slab default — the one copy of the 16/4 pair."""
+    return CPU_SLAB_DEFAULT if backend == "cpu" else ACCEL_SLAB_DEFAULT
+
+
+def slab_clamp(max_contig: int) -> int:
+    """Largest useful slab count for a contig: below ~64k positions per
+    slab the pipeline buys nothing (matches call_consensus_fused's
+    per-contig clamp)."""
+    return max(1, int(max_contig) // MIN_SLAB_POSITIONS)
+
+
+@dataclass(frozen=True)
+class TuningConfig:
+    """Resolved tuning knobs, threaded explicitly through the call
+    paths (call_jax / batch / streaming / workloads / serve) instead of
+    re-read from the environment at call time. `None` fields mean "not
+    pinned by the caller" — resolution falls through to env pin, then
+    the persisted store, then the default. `sources` records where each
+    resolved knob came from (observability: bench JSON, serve metrics)."""
+
+    n_slabs: int | None = None
+    stream_chunk_mb: float | None = None
+    cohort_budget_mb: int | None = None
+    sources: tuple = ()
+
+
+# --------------------------------------------------------------- store
+
+def store_path() -> Path | None:
+    """Tune-store location; None when disabled (KINDEL_TPU_TUNE_CACHE=off).
+    Lives beside the XLA compile cache by default — the two caches answer
+    the same question ("what did this host already learn?")."""
+    loc = os.environ.get("KINDEL_TPU_TUNE_CACHE", "")
+    if loc.lower() in {"off", "0", "none"}:
+        return None
+    if loc:
+        return Path(loc)
+    return Path.home() / ".cache" / "kindel_tpu" / "tune.json"
+
+
+def host_fingerprint() -> str:
+    """Short stable fingerprint of this host's CPU capability surface —
+    a tuned slab count is a property of the machine and must not travel
+    (same hazard class as the compile cache's machine tag)."""
+    import hashlib
+    import platform
+
+    parts = [platform.machine(), platform.processor() or ""]
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for line in fh:
+                if line.startswith(("flags", "Features")):
+                    parts.append(line.strip())
+                    break
+    except OSError:
+        pass
+    return hashlib.sha1("|".join(parts).encode()).hexdigest()[:12]
+
+
+def contig_scale_bucket(max_contig: int) -> str:
+    """Power-of-two bucket of the slab clamp — tune entries transfer
+    between workloads of the same contig scale (a 6.1 Mb genome rerun
+    hits; an amplicon panel does not inherit a chromosome's winner)."""
+    clamp = slab_clamp(max_contig)
+    b = 1
+    while b < clamp:
+        b *= 2
+    return f"clamp{b}"
+
+
+def _device_kind(backend: str) -> str:
+    """Accelerator model string, best-effort (the store key must not
+    force a backend initialization on paths that never reached one)."""
+    try:
+        import jax
+
+        return jax.devices()[0].device_kind.replace(" ", "_")
+    except Exception:
+        return backend or "unknown"
+
+
+def store_key(backend: str, max_contig: int,
+              device_kind: str | None = None) -> str:
+    """(backend, device kind, host fingerprint, package version,
+    contig-scale bucket) — the identity a tuned value is valid for."""
+    from kindel_tpu import __version__
+
+    return "|".join(
+        (
+            backend,
+            device_kind if device_kind is not None else _device_kind(backend),
+            host_fingerprint(),
+            __version__,
+            contig_scale_bucket(max_contig),
+        )
+    )
+
+
+#: parsed-store cache: (path, mtime_ns) → entries dict, so per-contig
+#: resolution in a loop does not re-read the JSON file every call
+_STORE_CACHE: tuple | None = None
+
+
+def load_store(path: Path | None = None) -> dict:
+    """Entries of the on-disk store ({} on missing/corrupt/foreign
+    version — a bad store must never fail a pipeline, it just
+    re-measures)."""
+    global _STORE_CACHE
+    if path is None:
+        path = store_path()
+    if path is None:
+        return {}
+    try:
+        mtime = path.stat().st_mtime_ns
+    except OSError:
+        return {}
+    if _STORE_CACHE is not None and _STORE_CACHE[0] == (str(path), mtime):
+        return _STORE_CACHE[1]
+    try:
+        doc = json.loads(path.read_text())
+        if not isinstance(doc, dict) or doc.get("version") != STORE_VERSION:
+            return {}
+        entries = doc.get("entries", {})
+        if not isinstance(entries, dict):
+            return {}
+    except (OSError, ValueError):
+        return {}
+    _STORE_CACHE = ((str(path), mtime), entries)
+    return entries
+
+
+def lookup(key: str, path: Path | None = None) -> dict | None:
+    entry = load_store(path).get(key)
+    return entry if isinstance(entry, dict) else None
+
+
+def record(key: str, entry: dict, path: Path | None = None) -> bool:
+    """Merge one entry into the store atomically (tmp + os.replace —
+    concurrent tuners must never leave a torn JSON document). Returns
+    False when the store is disabled or unwritable: persisting is an
+    optimization, never a failure."""
+    global _STORE_CACHE
+    if path is None:
+        path = store_path()
+    if path is None:
+        return False
+    try:
+        path.parent.mkdir(parents=True, exist_ok=True)
+        entries = dict(load_store(path))
+        merged = dict(entries.get(key) or {})
+        merged.update(entry)
+        merged["recorded_at"] = time.time()
+        entries[key] = merged
+        tmp = path.with_suffix(".json.tmp")
+        tmp.write_text(
+            json.dumps({"version": STORE_VERSION, "entries": entries},
+                       indent=1, sort_keys=True)
+        )
+        os.replace(tmp, path)
+        _STORE_CACHE = None
+        return True
+    except OSError:
+        return False
+
+
+# -------------------------------------------------------------- search
+
+def search_slabs(measure, clamp: int, budget_s: float,
+                 grid=SLAB_GRID, max_slabs: int = MAX_SLABS,
+                 clock=time.perf_counter):
+    """Budget-bounded slab-count search (lifted from bench.py into the
+    library so `kindel tune` and the bench share one implementation).
+
+    `measure(n_slabs) -> wall seconds` is the caller's probe — it
+    receives the slab count EXPLICITLY (no env mutation anywhere in the
+    search, so an exception mid-probe cannot leak state into the
+    process). Seeds a geometric grid deduped under the per-contig clamp,
+    then keeps doubling while the top config is still the winner, until
+    the wall budget is spent. Returns (chosen, {slabs: seconds})."""
+    if clamp <= 1:
+        return 1, {}
+    timings: dict[int, float] = {}
+    t0 = clock()
+    for slabs in sorted({min(s, clamp) for s in grid}):
+        timings[slabs] = measure(slabs)
+        if clock() - t0 > budget_s:
+            break  # cold-cache compiles ran long: pick from what we have
+    while clock() - t0 <= budget_s:
+        best = min(timings, key=timings.get)
+        nxt = min(best * 2, clamp, max_slabs)
+        if best != max(timings) or nxt <= best or nxt in timings:
+            break
+        timings[nxt] = measure(nxt)
+    return min(timings, key=timings.get), timings
+
+
+def measured_slabs(one_pass, clamp: int, budget_s: float,
+                   repeats: int = 2, clock=time.perf_counter):
+    """search_slabs over a caller-supplied `one_pass(n_slabs)` workload:
+    each probe warms (compiles) the config once, then takes the best of
+    `repeats` timed passes (single-pass walls are noisy on shared
+    hosts and a mispick costs the caller's whole throughput)."""
+
+    def measure(slabs: int) -> float:
+        one_pass(slabs)  # warmup/compile for this config
+        walls = []
+        for _ in range(repeats):
+            t0 = clock()
+            one_pass(slabs)
+            walls.append(clock() - t0)
+        return min(walls)
+
+    return search_slabs(measure, clamp, budget_s, clock=clock)
+
+
+# ---------------------------------------------------------- resolution
+
+def _env_int(name: str):
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return None, False
+    try:
+        return int(raw), True
+    except ValueError:
+        # malformed pin: noted as present so the caller can fall back to
+        # the DEFAULT (matching the historical bench/call_jax behavior),
+        # never to a stale store entry the operator meant to override
+        return None, True
+
+
+def resolve_slabs(explicit: int | None = None, backend: str = "cpu",
+                  max_contig: int | None = None,
+                  consult_store: bool = True) -> tuple[int, str]:
+    """The slab-count knob, resolved once on the host:
+    explicit arg > KINDEL_TPU_SLABS > tune store > default.
+    Returns (n_slabs, source) with source ∈ {"explicit", "env", "cache",
+    "default"}. The per-contig clamp stays at the call site (this is the
+    host-wide answer; a tiny contig still collapses it)."""
+    if explicit is not None:
+        return max(1, int(explicit)), "explicit"
+    pin, present = _env_int("KINDEL_TPU_SLABS")
+    if pin is not None:
+        return max(1, pin), "env"
+    if present:  # malformed pin — explicit operator intent to override
+        return default_slabs(backend), "default"
+    if consult_store and max_contig is not None:
+        entry = lookup(store_key(backend, max_contig))
+        if entry and isinstance(entry.get("n_slabs"), int):
+            return max(1, entry["n_slabs"]), "cache"
+    return default_slabs(backend), "default"
+
+
+def resolve_stream_chunk_mb(explicit: float | None = None,
+                            bam_path=None) -> tuple[float | None, str]:
+    """The streamed-decode chunk knob: explicit arg >
+    KINDEL_TPU_STREAM_CHUNK_MB > tune store pin > size-threshold auto
+    (KINDEL_TPU_STREAM_THRESHOLD_MB, default 512) > None (slurp).
+    0/0.0 anywhere means "never stream"."""
+    if explicit is not None:
+        return (float(explicit) or None), "explicit"
+    env = os.environ.get("KINDEL_TPU_STREAM_CHUNK_MB")
+    if env:
+        try:
+            return (float(env) or None), "env"
+        except ValueError:
+            pass  # malformed pin: fall through to store/default
+    entry = lookup("stream|" + host_fingerprint())
+    if entry and isinstance(entry.get("stream_chunk_mb"), (int, float)):
+        return (float(entry["stream_chunk_mb"]) or None), "cache"
+    if bam_path is not None:
+        try:
+            size = os.path.getsize(bam_path)
+        except OSError:
+            return None, "default"
+        try:
+            threshold = float(
+                os.environ.get("KINDEL_TPU_STREAM_THRESHOLD_MB", "512")
+            )
+        except ValueError:
+            threshold = 512.0
+        if size > threshold * (1 << 20):
+            return 64.0, "default"
+    return None, "default"
+
+
+def resolve_cohort_budget_mb(explicit: int | None = None) -> tuple[int, str]:
+    """The cohort device-footprint budget: explicit arg >
+    KINDEL_TPU_COHORT_BUDGET_MB > default (512 MB). Not measured — it is
+    a capacity bound, not a latency optimum."""
+    if explicit is not None and int(explicit) > 0:
+        return int(explicit), "explicit"
+    pin, _present = _env_int("KINDEL_TPU_COHORT_BUDGET_MB")
+    if pin is not None and pin > 0:
+        return pin, "env"
+    return COHORT_BUDGET_MB_DEFAULT, "default"
+
+
+def resolve(explicit: TuningConfig | None = None, backend: str = "cpu",
+            max_contig: int | None = None,
+            bam_path=None) -> TuningConfig:
+    """Resolve every knob into one immutable TuningConfig (config-build
+    time — the only place env is consulted), recording per-knob sources."""
+    e = explicit or TuningConfig()
+    n_slabs, s1 = resolve_slabs(e.n_slabs, backend, max_contig)
+    chunk, s2 = resolve_stream_chunk_mb(e.stream_chunk_mb, bam_path)
+    budget, s3 = resolve_cohort_budget_mb(e.cohort_budget_mb)
+    return TuningConfig(
+        n_slabs=n_slabs, stream_chunk_mb=chunk, cohort_budget_mb=budget,
+        sources=(("n_slabs", s1), ("stream_chunk_mb", s2),
+                 ("cohort_budget_mb", s3)),
+    )
+
+
+@contextmanager
+def env_pin(name: str, value):
+    """Temporarily pin (or, with None, unset) one env var, restoring the
+    prior state in a finally — the safe form of the cross-thread env
+    mutation the old bench search could leak on exception."""
+    prior = os.environ.get(name)
+    try:
+        if value is None:
+            os.environ.pop(name, None)
+        else:
+            os.environ[name] = str(value)
+        yield
+    finally:
+        if prior is None:
+            os.environ.pop(name, None)
+        else:
+            os.environ[name] = prior

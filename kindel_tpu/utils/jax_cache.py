@@ -26,23 +26,34 @@ import os
 from pathlib import Path
 
 _done = False
+_warned = False
 
 
 def ensure_compilation_cache() -> None:
-    global _done
+    """Configure jax's persistent compile cache once per process.
+
+    `_done` latches ONLY on success (or on the deliberate no-op paths:
+    cache off, user-configured): a transient failure — an unwritable
+    cache dir, a full disk — used to latch first and silently disable
+    the cache for the rest of the process; now it warns once and every
+    later caller retries, so a recovered filesystem re-enables the
+    cache without a restart."""
+    global _done, _warned
     if _done:
         return
-    _done = True
     loc = os.environ.get("KINDEL_TPU_COMPILE_CACHE", "")
     if loc.lower() in {"off", "0", "none"}:
+        _done = True
         return
     if not loc and os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+        _done = True
         return  # the user configured jax's cache themselves — leave it alone
     cache_dir = Path(loc) if loc else Path.home() / ".cache" / "kindel_tpu" / "xla"
     try:
         import jax
 
         if not loc and jax.config.jax_compilation_cache_dir is not None:
+            _done = True
             return  # ditto, configured via jax.config.update
         # XLA:CPU AOT entries embed the COMPILE machine's feature set; a
         # cache written on a different host loads with "machine type
@@ -62,8 +73,20 @@ def ensure_compilation_cache() -> None:
         jax.config.update("jax_compilation_cache_dir", str(cache_dir))
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-    except Exception:  # cache is an optimization — never fail the pipeline
-        pass
+        _done = True
+    except Exception as e:  # cache is an optimization — never fail the
+        # pipeline; _done stays False so the next caller retries
+        if not _warned:
+            _warned = True
+            import warnings
+
+            warnings.warn(
+                "kindel-tpu: persistent XLA compile cache not enabled "
+                f"this attempt ({e!r}); compiles will not persist until "
+                "a later attempt succeeds",
+                RuntimeWarning,
+                stacklevel=2,
+            )
 
 
 def _cpu_is_primary_backend(jax) -> bool:

@@ -1,0 +1,71 @@
+"""Tier-1 guard: tuning knobs resolve at config-build time, never at
+trace time — no `os.environ` / `os.getenv` read may appear inside a
+jit-decorated function body anywhere in kindel_tpu/ (the refactor
+invariant of the tune subsystem, kindel_tpu/tune.py).
+
+An env read inside a traced body is doubly wrong: it only runs at trace
+time (so the knob silently stops responding once the kernel is cached),
+and it makes compiled behavior depend on ambient process state that the
+compile cache key does not capture."""
+
+import ast
+from pathlib import Path
+
+PKG = Path(__file__).resolve().parent.parent / "kindel_tpu"
+
+
+def _dotted_parts(node) -> set:
+    """Every Name id / Attribute attr reachable in an expression — enough
+    to recognize jit in `jax.jit`, `jit`, `partial(jax.jit, ...)`,
+    `functools.partial(jit, static_argnames=...)`."""
+    out = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+        elif isinstance(n, ast.Attribute):
+            out.add(n.attr)
+    return out
+
+
+def _is_jit_decorated(fn) -> bool:
+    return any("jit" in _dotted_parts(d) for d in fn.decorator_list)
+
+
+def _env_read_lines(fn) -> list:
+    hits = []
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Attribute) and n.attr == "environ":
+            hits.append(n.lineno)
+        elif isinstance(n, ast.Call):
+            f = n.func
+            if (isinstance(f, ast.Attribute) and f.attr == "getenv") or (
+                isinstance(f, ast.Name) and f.id == "getenv"
+            ):
+                hits.append(n.lineno)
+    return hits
+
+
+def test_no_env_reads_inside_jit_traced_function_bodies():
+    offenders = []
+    jitted = 0
+    for py in sorted(PKG.rglob("*.py")):
+        tree = ast.parse(py.read_text(), filename=str(py))
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not _is_jit_decorated(node):
+                continue
+            jitted += 1
+            for line in _env_read_lines(node):
+                offenders.append(
+                    f"{py.relative_to(PKG.parent)}:{line} "
+                    f"(inside jitted `{node.name}`)"
+                )
+    assert not offenders, (
+        "os.environ read inside a jit-traced body — tuning knobs must "
+        "resolve at config-build time (kindel_tpu.tune):\n"
+        + "\n".join(offenders)
+    )
+    # the guard must actually be seeing the kernels: if this count ever
+    # drops to ~0 the detector went blind, not the codebase clean
+    assert jitted >= 8, f"only {jitted} jit-decorated functions found"

@@ -1,6 +1,9 @@
 """Persistent XLA compilation cache wiring (kindel_tpu/utils/jax_cache.py)."""
 
+import warnings
+
 import jax
+import pytest
 
 from kindel_tpu.utils import jax_cache
 
@@ -38,6 +41,49 @@ def test_cache_disable(tmp_path, monkeypatch):
     jax_cache.ensure_compilation_cache()
     # disabling must not clobber an unrelated existing setting
     assert jax.config.jax_compilation_cache_dir == before
+
+
+def test_transient_failure_warns_once_and_does_not_latch(tmp_path,
+                                                         monkeypatch):
+    """A transient failure (unwritable cache dir) must not silently
+    disable the cache for the rest of the process: `_done` latches only
+    on success, the first failure warns once, and a later call with a
+    healthy filesystem enables the cache."""
+    before = jax.config.jax_compilation_cache_dir
+    blocker = tmp_path / "blocker"
+    blocker.write_text("")  # a FILE where the cache dir's parent must be
+    monkeypatch.setenv("KINDEL_TPU_COMPILE_CACHE", str(blocker / "xla"))
+    monkeypatch.setattr(jax_cache, "_done", False)
+    monkeypatch.setattr(jax_cache, "_warned", False)
+    try:
+        with pytest.warns(RuntimeWarning, match="compile cache"):
+            jax_cache.ensure_compilation_cache()
+        assert jax_cache._done is False  # not latched: next call retries
+        # second failing attempt retries but stays quiet (warn once)
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            jax_cache.ensure_compilation_cache()
+        assert jax_cache._done is False
+        # recovery: a writable location succeeds and latches
+        monkeypatch.setenv("KINDEL_TPU_COMPILE_CACHE", str(tmp_path / "xla"))
+        jax_cache.ensure_compilation_cache()
+        assert jax_cache._done is True
+        assert jax.config.jax_compilation_cache_dir == str(tmp_path / "xla")
+    finally:
+        jax.config.update("jax_compilation_cache_dir", before)
+
+
+def test_success_and_noop_paths_latch(tmp_path, monkeypatch):
+    """The deliberate no-op paths (cache off) latch too — they are
+    decisions, not failures, and must not re-run per caller."""
+    before = jax.config.jax_compilation_cache_dir
+    monkeypatch.setenv("KINDEL_TPU_COMPILE_CACHE", "off")
+    monkeypatch.setattr(jax_cache, "_done", False)
+    try:
+        jax_cache.ensure_compilation_cache()
+        assert jax_cache._done is True
+    finally:
+        jax.config.update("jax_compilation_cache_dir", before)
 
 
 def test_default_location_is_machine_tagged(tmp_path, monkeypatch):

@@ -310,3 +310,93 @@ def test_http_metrics_healthz_and_ingest(tmp_path):
         assert name in metrics, f"{name} missing from /metrics"
     # the corrupt POST failed its own request and was counted
     assert "kindel_serve_requests_failed_total 1" in metrics
+
+
+# ----------------------------------------------------------------- warmup
+
+
+def test_healthz_flips_warming_to_ok(monkeypatch):
+    """/healthz reports `warming` from construction until the AOT
+    warmup thread finishes, then `ok` — deterministically, via a gated
+    stand-in for the real shape warmer."""
+    gate = threading.Event()
+
+    def gated_warm_shapes(opts, row_bucket=8, payloads=()):
+        assert gate.wait(10), "test gate never opened"
+        return {"r8xL1024o64b256d64i64cNone": 0.01}
+
+    monkeypatch.setattr(
+        "kindel_tpu.serve.warmup.warm_shapes", gated_warm_shapes
+    )
+    svc = ConsensusService(max_wait_s=0.01, warmup=True)
+    try:
+        assert svc.healthz()["status"] == "warming"  # pending before start
+        svc.start()
+        assert svc.healthz()["status"] == "warming"
+        gate.set()
+        assert svc.wait_warm(timeout=10)
+        health = svc.healthz()
+        assert health["status"] == "ok"
+        assert health["warmup"] == "ok"
+        snap = svc.metrics.snapshot()
+        assert snap["kindel_serve_warmup_shapes_total"] == 1
+        assert snap["kindel_serve_warmup_seconds"] >= 0
+    finally:
+        svc.stop()
+
+
+def test_warmup_disabled_is_ok_immediately():
+    with ConsensusService(max_wait_s=0.01) as svc:
+        health = svc.healthz()
+        assert health["status"] == "ok"
+        assert health["warmup"] == "off"
+
+
+def test_warmup_failure_degrades_to_serving(monkeypatch, tmp_path):
+    """A warmup crash must not take the service down — requests still
+    serve (paying their own compile), and /healthz surfaces the error."""
+
+    def broken_warm_shapes(opts, row_bucket=8, payloads=()):
+        raise RuntimeError("synthetic warmup failure")
+
+    monkeypatch.setattr(
+        "kindel_tpu.serve.warmup.warm_shapes", broken_warm_shapes
+    )
+    sam = make_sam(tmp_path / "wf.sam", seed=9)
+    with ConsensusService(max_wait_s=0.01, warmup=True) as svc:
+        assert svc.wait_warm(timeout=10)
+        health = svc.healthz()
+        assert health["status"] == "ok"
+        assert "synthetic warmup failure" in health["warmup_error"]
+        assert ConsensusClient(svc).consensus(str(sam), timeout=120)
+
+
+def test_warmup_first_request_compiles_nothing(tmp_path):
+    """The acceptance property: after /healthz flips to ok, the first
+    request on a warmed lane triggers NO new kernel compile (asserted
+    via the jit cache-entry counter of the cohort kernel) and its output
+    still matches the bam_to_consensus oracle."""
+    from kindel_tpu.call_jax import batched_call_kernel
+
+    sam = make_sam(tmp_path / "warm.sam", seed=5)
+    want = bam_to_consensus(str(sam)).consensuses
+    with ConsensusService(
+        max_wait_s=0.01, warm_payloads=[str(sam)]
+    ) as svc:
+        assert svc.wait_warm(timeout=300), "warmup never finished"
+        assert svc.healthz()["status"] == "ok"
+        cache_size = getattr(batched_call_kernel, "_cache_size", None)
+        if cache_size is None:
+            pytest.skip("jit cache counter unavailable on this jax")
+        before = cache_size()
+        got = ConsensusClient(svc).consensus(str(sam), timeout=120)
+        assert cache_size() == before, (
+            "first post-warmup request compiled a new kernel shape"
+        )
+        snap = svc.metrics.snapshot()
+    assert [(r.name, r.sequence) for r in got] == [
+        (r.name, r.sequence) for r in want
+    ]
+    # synthetic minimal lane + the warm payload's lane
+    assert snap["kindel_serve_warmup_shapes_total"] >= 2
+    assert snap["kindel_serve_warmup_seconds"] > 0

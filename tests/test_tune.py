@@ -1,0 +1,293 @@
+"""kindel_tpu.tune: store roundtrip, resolution-order precedence, the
+budget-bounded slab search, and env hygiene (the search must never
+mutate process state — the failure mode the old in-bench search had)."""
+
+import json
+import os
+
+import pytest
+
+from kindel_tpu import tune
+
+
+@pytest.fixture
+def store(tmp_path, monkeypatch):
+    """Isolated tune store + no ambient knob pins."""
+    path = tmp_path / "tune.json"
+    monkeypatch.setenv("KINDEL_TPU_TUNE_CACHE", str(path))
+    for var in ("KINDEL_TPU_SLABS", "KINDEL_TPU_STREAM_CHUNK_MB",
+                "KINDEL_TPU_COHORT_BUDGET_MB"):
+        monkeypatch.delenv(var, raising=False)
+    return path
+
+
+# ----------------------------------------------------------------- store
+
+
+def test_store_roundtrip(store):
+    key = tune.store_key("cpu", 10_000_000)
+    assert tune.lookup(key) is None
+    assert tune.record(key, {"n_slabs": 8, "timings_s": {"4": 0.5}})
+    entry = tune.lookup(key)
+    assert entry["n_slabs"] == 8
+    assert entry["timings_s"] == {"4": 0.5}
+    assert "recorded_at" in entry
+    doc = json.loads(store.read_text())
+    assert doc["version"] == tune.STORE_VERSION
+    # merge, not clobber: a second record keeps the key's other fields
+    assert tune.record(key, {"n_slabs": 16})
+    entry = tune.lookup(key)
+    assert entry["n_slabs"] == 16 and entry["timings_s"] == {"4": 0.5}
+
+
+def test_store_key_mismatch_falls_back_to_default(store):
+    # a winner measured at bacterial scale must not leak into an
+    # amplicon-scale run (different contig bucket -> different key)
+    tune.record(tune.store_key("cpu", 10_000_000), {"n_slabs": 8})
+    n, src = tune.resolve_slabs(backend="cpu", max_contig=50_000)
+    assert (n, src) == (tune.CPU_SLAB_DEFAULT, "default")
+    # and the matching scale hits
+    n, src = tune.resolve_slabs(backend="cpu", max_contig=10_000_000)
+    assert (n, src) == (8, "cache")
+
+
+def test_corrupt_or_foreign_store_is_empty(store):
+    store.write_text("{not json")
+    assert tune.load_store() == {}
+    store.write_text(json.dumps({"version": 999, "entries": {"k": {}}}))
+    assert tune.load_store() == {}
+    # a bad store must not break recording either
+    assert tune.record("k", {"n_slabs": 4})
+    assert tune.lookup("k")["n_slabs"] == 4
+
+
+def test_store_disabled(store, monkeypatch):
+    monkeypatch.setenv("KINDEL_TPU_TUNE_CACHE", "off")
+    assert tune.store_path() is None
+    assert tune.record("k", {"n_slabs": 4}) is False
+    assert tune.lookup("k") is None
+
+
+# ------------------------------------------------------------ resolution
+
+
+def test_resolution_precedence_arg_env_store_default(store, monkeypatch):
+    key = tune.store_key("cpu", 10_000_000)
+    tune.record(key, {"n_slabs": 7})
+    monkeypatch.setenv("KINDEL_TPU_SLABS", "3")
+    # explicit arg beats the env pin
+    assert tune.resolve_slabs(
+        explicit=5, backend="cpu", max_contig=10_000_000
+    ) == (5, "explicit")
+    # env pin beats the store
+    assert tune.resolve_slabs(
+        backend="cpu", max_contig=10_000_000
+    ) == (3, "env")
+    # store beats the default
+    monkeypatch.delenv("KINDEL_TPU_SLABS")
+    assert tune.resolve_slabs(
+        backend="cpu", max_contig=10_000_000
+    ) == (7, "cache")
+    # nothing left: backend default
+    store.unlink()
+    assert tune.resolve_slabs(
+        backend="cpu", max_contig=10_000_000
+    ) == (tune.CPU_SLAB_DEFAULT, "default")
+    assert tune.resolve_slabs(
+        backend="tpu", max_contig=10_000_000
+    ) == (tune.ACCEL_SLAB_DEFAULT, "default")
+
+
+def test_malformed_env_pin_means_default_not_stale_cache(store, monkeypatch):
+    # a malformed pin is explicit operator intent to override — it must
+    # fall to the DEFAULT, never to a store entry the operator meant to
+    # shadow (matches the historical bench/call_jax behavior)
+    tune.record(tune.store_key("cpu", 10_000_000), {"n_slabs": 7})
+    monkeypatch.setenv("KINDEL_TPU_SLABS", "not-a-number")
+    assert tune.resolve_slabs(
+        backend="cpu", max_contig=10_000_000
+    ) == (tune.CPU_SLAB_DEFAULT, "default")
+
+
+def test_stream_chunk_precedence(store, monkeypatch):
+    assert tune.resolve_stream_chunk_mb(32) == (32.0, "explicit")
+    # 0 anywhere means "never stream"
+    assert tune.resolve_stream_chunk_mb(0) == (None, "explicit")
+    monkeypatch.setenv("KINDEL_TPU_STREAM_CHUNK_MB", "16")
+    assert tune.resolve_stream_chunk_mb() == (16.0, "env")
+    assert tune.resolve_stream_chunk_mb(32) == (32.0, "explicit")
+    monkeypatch.delenv("KINDEL_TPU_STREAM_CHUNK_MB")
+    tune.record("stream|" + tune.host_fingerprint(), {"stream_chunk_mb": 8})
+    assert tune.resolve_stream_chunk_mb() == (8.0, "cache")
+
+
+def test_cohort_budget_precedence(store, monkeypatch):
+    assert tune.resolve_cohort_budget_mb() == (
+        tune.COHORT_BUDGET_MB_DEFAULT, "default",
+    )
+    monkeypatch.setenv("KINDEL_TPU_COHORT_BUDGET_MB", "128")
+    assert tune.resolve_cohort_budget_mb() == (128, "env")
+    assert tune.resolve_cohort_budget_mb(64) == (64, "explicit")
+
+
+def test_resolve_bundles_all_knobs_with_sources(store, monkeypatch):
+    monkeypatch.setenv("KINDEL_TPU_SLABS", "6")
+    cfg = tune.resolve(backend="cpu", max_contig=10_000_000)
+    assert cfg.n_slabs == 6
+    assert dict(cfg.sources)["n_slabs"] == "env"
+    assert dict(cfg.sources)["cohort_budget_mb"] == "default"
+    # explicit TuningConfig fields win over the env pin
+    cfg = tune.resolve(
+        explicit=tune.TuningConfig(n_slabs=2), backend="cpu",
+    )
+    assert cfg.n_slabs == 2 and dict(cfg.sources)["n_slabs"] == "explicit"
+
+
+def test_default_slab_constants_are_the_single_copy():
+    # the 16/4 pair previously copy-pasted between bench.py and
+    # call_jax.py lives here and only here
+    assert tune.default_slabs("cpu") == tune.CPU_SLAB_DEFAULT == 16
+    assert tune.default_slabs("tpu") == tune.ACCEL_SLAB_DEFAULT == 4
+    from pathlib import Path
+
+    call_jax_src = (
+        Path(__file__).resolve().parent.parent
+        / "kindel_tpu" / "call_jax.py"
+    ).read_text()
+    assert 'os.environ.get("KINDEL_TPU_SLABS"' not in call_jax_src
+
+
+# ---------------------------------------------------------------- search
+
+
+def test_search_grid_then_doubling_expansion():
+    times = {1: 0.5, 4: 0.3, 16: 0.2, 32: 0.15, 64: 0.4}
+    calls = []
+
+    def measure(s):
+        calls.append(s)
+        return times[s]
+
+    chosen, timings = tune.search_slabs(measure, clamp=93, budget_s=100)
+    # grid 1/4/16, then 16 is the top config and still the winner -> 32,
+    # then 32 wins -> 64, then 64 loses -> stop
+    assert calls == [1, 4, 16, 32, 64]
+    assert chosen == 32
+    assert timings == times
+
+
+def test_search_clamp_dedups_grid():
+    calls = []
+    chosen, _ = tune.search_slabs(
+        lambda s: (calls.append(s), 0.1)[1], clamp=2, budget_s=100
+    )
+    # clamp 2 collapses 4 and 16 onto 2 — measured once, not three times
+    assert calls == [1, 2]
+
+
+def test_search_trivial_clamp_measures_nothing():
+    chosen, timings = tune.search_slabs(
+        lambda s: 1 / 0, clamp=1, budget_s=100
+    )
+    assert chosen == 1 and timings == {}
+
+
+def test_search_budget_bounds_the_sweep():
+    clock_now = [0.0]
+
+    def clock():
+        return clock_now[0]
+
+    def measure(s):
+        clock_now[0] += 10.0  # every probe costs 10 "seconds"
+        return {1: 0.5, 4: 0.3, 16: 0.2}[s]
+
+    chosen, timings = tune.search_slabs(
+        measure, clamp=93, budget_s=15.0, clock=clock
+    )
+    # the second probe lands at t=20 > budget: pick from what we have,
+    # no expansion past the grid
+    assert set(timings) == {1, 4}
+    assert chosen == 4
+
+
+def test_search_mutates_no_env_even_on_probe_crash(store, monkeypatch):
+    # the old in-bench search pinned KINDEL_TPU_SLABS per probe and left
+    # it mutated when a probe raised; the library search takes the slab
+    # count as an explicit argument — no env write anywhere
+    monkeypatch.setenv("KINDEL_TPU_SLABS", "9")
+    before = dict(os.environ)
+
+    def measure(s):
+        if s == 4:
+            raise RuntimeError("probe crashed")
+        return 0.5
+
+    with pytest.raises(RuntimeError):
+        tune.search_slabs(measure, clamp=93, budget_s=100)
+    assert dict(os.environ) == before
+
+
+def test_env_pin_restores_on_exception(monkeypatch):
+    monkeypatch.delenv("KINDEL_TPU_SLABS", raising=False)
+    with pytest.raises(RuntimeError):
+        with tune.env_pin("KINDEL_TPU_SLABS", 4):
+            assert os.environ["KINDEL_TPU_SLABS"] == "4"
+            raise RuntimeError("boom")
+    assert "KINDEL_TPU_SLABS" not in os.environ
+    monkeypatch.setenv("KINDEL_TPU_SLABS", "2")
+    with pytest.raises(RuntimeError):
+        with tune.env_pin("KINDEL_TPU_SLABS", 8):
+            assert os.environ["KINDEL_TPU_SLABS"] == "8"
+            raise RuntimeError("boom")
+    assert os.environ["KINDEL_TPU_SLABS"] == "2"
+
+
+# ------------------------------------------------- integration touchpoints
+
+
+def test_call_consensus_fused_explicit_tuning_pin(store):
+    """An explicit TuningConfig beats everything — and n_slabs=1 forces
+    the single fused kernel, byte-identical to the pipelined default."""
+    jax = pytest.importorskip("jax")  # noqa: F841
+    import numpy as np
+
+    from kindel_tpu.call_jax import call_consensus_fused
+    from kindel_tpu.events import extract_events
+    from kindel_tpu.io import load_alignment_bytes
+
+    rng = np.random.default_rng(0)
+    lines = ["@HD\tVN:1.6", "@SQ\tSN:tref\tLN:400"]
+    for i in range(20):
+        pos = int(rng.integers(0, 340))
+        seq = "".join("ACGT"[b] for b in rng.integers(0, 4, size=60))
+        lines.append(f"r{i}\t0\ttref\t{pos + 1}\t60\t60M\t*\t0\t0\t{seq}\t*")
+    ev = extract_events(
+        load_alignment_bytes(("\n".join(lines) + "\n").encode())
+    )
+    rid = ev.present_ref_ids[0]
+    res1, d1, D1 = call_consensus_fused(
+        ev, rid, build_changes=False,
+        tuning=tune.TuningConfig(n_slabs=1),
+    )
+    res2, d2, D2 = call_consensus_fused(ev, rid, build_changes=False)
+    assert res1.sequence == res2.sequence
+    assert (d1, D1) == (d2, D2)
+
+
+def test_stream_chunk_env_pin_resolves_for_workloads(store, monkeypatch,
+                                                     tmp_path):
+    """workloads._resolve_stream_chunk honors TuningConfig > env."""
+    from kindel_tpu.tune import TuningConfig
+    from kindel_tpu.workloads import _resolve_stream_chunk
+
+    bam = tmp_path / "x.sam"
+    bam.write_text("@HD\tVN:1.6\n")
+    monkeypatch.setenv("KINDEL_TPU_STREAM_CHUNK_MB", "16")
+    assert _resolve_stream_chunk(str(bam), None) == 16.0
+    assert _resolve_stream_chunk(
+        str(bam), None, tuning=TuningConfig(stream_chunk_mb=4)
+    ) == 4.0
+    assert _resolve_stream_chunk(str(bam), 2.0) == 2.0
+    monkeypatch.delenv("KINDEL_TPU_STREAM_CHUNK_MB")
+    assert _resolve_stream_chunk(str(bam), None) is None  # small file
