@@ -139,19 +139,34 @@ def _run_benchmark() -> dict:
     from kindel_tpu.events import extract_events
     from kindel_tpu.io import load_alignment
     from kindel_tpu.call_jax import call_consensus_fused
+    from kindel_tpu.obs import runtime as obs_runtime
+    from kindel_tpu.obs import trace as obs_trace
+    from kindel_tpu.obs.metrics import default_registry
     from kindel_tpu.pileup import build_pileup  # noqa: F401 (import check)
+    from kindel_tpu.utils.profiling import (
+        disable_profiling,
+        enable_profiling,
+        maybe_phase,
+    )
+
+    # compile accounting from the first warmup dispatch onward — the
+    # emitted line attributes cold-start (tune/warm) vs steady-state cost
+    obs_runtime.install()
 
     def one_pass(slabs: int) -> int:
-        batch = load_alignment(bam)
-        ev = extract_events(batch)
+        with maybe_phase("decode"):
+            batch = load_alignment(bam)
+        with maybe_phase("event extraction"):
+            ev = extract_events(batch)
         total = 0
         cfg = tunelib.TuningConfig(n_slabs=slabs)
-        for rid in ev.present_ref_ids:
-            res, _dmin, _dmax = call_consensus_fused(
-                ev, rid, build_changes=False, tuning=cfg
-            )
-            total += int(ev.ref_lens[rid])
-            assert len(res.sequence) > 0
+        with maybe_phase("device call+assemble"):
+            for rid in ev.present_ref_ids:
+                res, _dmin, _dmax = call_consensus_fused(
+                    ev, rid, build_changes=False, tuning=cfg
+                )
+                total += int(ev.ref_lens[rid])
+                assert len(res.sequence) > 0
         return total
 
     # Slab autotune via kindel_tpu.tune (the search was lifted out of
@@ -221,12 +236,29 @@ def _run_benchmark() -> dict:
     # host assembly (jit cache warm, as in steady-state batch processing).
     # Best of 3 trials: single-shot walls swing ±40% on shared hosts /
     # contended tunnels, and the recorded number must be comparable
-    # across rounds.
+    # across rounds. Trials run under the span tracer + phase timer so
+    # the emitted line carries stage attribution (obs.spans/obs.phases),
+    # not just end-to-end wall; the in-memory exporter adds one list
+    # append per span (~10 spans/pass) — noise next to the measured work.
+    compiles_warm, compile_wall_warm = obs_runtime.compile_totals()
+    exporter = obs_trace.ListExporter()
+    obs_trace.enable_tracing(exporter=exporter)
+    timer = enable_profiling()
     walls = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        total_bases = one_pass(chosen)
-        walls.append(time.perf_counter() - t0)
+    try:
+        for _ in range(3):
+            t0 = time.perf_counter()
+            total_bases = one_pass(chosen)
+            walls.append(time.perf_counter() - t0)
+    finally:
+        disable_profiling()
+        obs_trace.disable_tracing()
+    spans: dict[str, dict] = {}
+    for rec in exporter.records:
+        agg = spans.setdefault(rec["name"], {"count": 0, "wall_s": 0.0})
+        agg["count"] += 1
+        agg["wall_s"] += rec["duration_s"]
+    compiles_total, compile_wall_total = obs_runtime.compile_totals()
 
     mbases_per_s = total_bases / min(walls) / 1e6
     result = {
@@ -243,6 +275,29 @@ def _run_benchmark() -> dict:
         # is meaningless without knowing how busy the host was
         "loadavg_1m": round(os.getloadavg()[0], 2),
         "ncpu": os.cpu_count(),
+        # stage attribution (kindel_tpu.obs): per-phase wall + span
+        # summary over the 3 timed trials, compile cost split warm vs
+        # trial, and the process-global metric snapshot (transfer bytes,
+        # tune provenance, stream chunks)
+        "obs": {
+            "phases": {
+                k: round(v, 3) for k, v in timer.totals().items()
+            },
+            "spans": {
+                k: {"count": v["count"], "wall_s": round(v["wall_s"], 3)}
+                for k, v in sorted(spans.items())
+            },
+            "compiles": compiles_total,
+            "compile_wall_s": round(compile_wall_total, 3),
+            "compiles_during_trials": compiles_total - compiles_warm,
+            "compile_wall_during_trials_s": round(
+                compile_wall_total - compile_wall_warm, 3
+            ),
+            "metrics": {
+                k: v for k, v in sorted(default_registry().snapshot().items())
+                if not k.startswith("kindel_jax_compile_seconds")
+            },
+        },
     }
     if tune:
         result["tune_s"] = {str(k): round(v, 3) for k, v in tune.items()}

@@ -46,6 +46,8 @@ from kindel_tpu.call_jax import (
 from kindel_tpu.events import extract_events
 from kindel_tpu.io import load_alignment
 from kindel_tpu.io.fasta import Sequence
+from kindel_tpu.obs import runtime as obs_runtime
+from kindel_tpu.obs import trace as obs_trace
 from kindel_tpu.pileup_jax import PAD_POS, _bucket, _pad, check_pad_safe_block
 from kindel_tpu.realign import LazyCdrWindows
 
@@ -334,20 +336,30 @@ def launch_cohort_kernel(arrays, meta, opts: BatchOptions, sharding=None):
     import jax
 
     L, _d_pad, _i_pad = meta
-    if sharding is None:
-        dev_arrays = tuple(jnp.asarray(a) for a in arrays)
-    else:
-        dev_arrays = tuple(
-            jax.device_put(a, sharding(a.ndim)) for a in arrays
+    h2d_bytes = sum(int(a.nbytes) for a in arrays)
+    obs_runtime.transfer_counters()[0].inc(h2d_bytes)
+    with obs_trace.span("cohort.launch") as sp:
+        if sharding is None:
+            dev_arrays = tuple(jnp.asarray(a) for a in arrays)
+        else:
+            dev_arrays = tuple(
+                jax.device_put(a, sharding(a.ndim)) for a in arrays
+            )
+        kernel = (
+            batched_realign_call_kernel if opts.realign
+            else batched_call_kernel
         )
-    kernel = (
-        batched_realign_call_kernel if opts.realign else batched_call_kernel
-    )
-    out = kernel(
-        *dev_arrays, jnp.int32(opts.min_depth),
-        jnp.int32(1 if opts.fix_clip_artifacts else 0), length=L,
-        want_masks=opts.want_masks,
-    )
+        out = kernel(
+            *dev_arrays, jnp.int32(opts.min_depth),
+            jnp.int32(1 if opts.fix_clip_artifacts else 0), length=L,
+            want_masks=opts.want_masks,
+        )
+        if sp is not obs_trace.NOOP_SPAN:
+            # span covers upload + async dispatch, not device completion
+            sp.set_attribute(
+                rows=int(arrays[0].shape[0]), L=L,
+                realign=opts.realign, h2d_bytes=h2d_bytes,
+            )
     # meta the host decoder needs to slice each row's packed wire
     return out, meta
 
@@ -600,14 +612,23 @@ def stream_bam_to_results(
                     load_err.__cause__ = e
                     units = None
                 if units:
-                    next_pending = (
-                        chunks[k], units, _GroupedDispatch(units, opts)
-                    )
+                    with obs_trace.span("cohort.chunk_dispatch") as dsp:
+                        next_pending = (
+                            chunks[k], units, _GroupedDispatch(units, opts)
+                        )
+                        if dsp is not obs_trace.NOOP_SPAN:
+                            dsp.set_attribute(
+                                chunk=k, samples=len(chunks[k]),
+                                rows=len(units),
+                            )
                 elif units is not None:
                     empty_paths = chunks[k]
             if pending is not None:
                 paths_prev, units_prev, disp_prev = pending
-                outputs = disp_prev.assemble(pool, paths_prev)
+                with obs_trace.span("cohort.chunk_assemble") as asp:
+                    outputs = disp_prev.assemble(pool, paths_prev)
+                    if asp is not obs_trace.NOOP_SPAN:
+                        asp.set_attribute(samples=len(paths_prev))
                 grouped = _fold_results(units_prev, outputs, len(paths_prev))
                 for i, p in enumerate(paths_prev):
                     n_done += 1

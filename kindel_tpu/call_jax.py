@@ -38,6 +38,8 @@ import numpy as np
 
 from kindel_tpu.call import CallMasks, CallResult, _insertion_calls, assemble
 from kindel_tpu.events import BASES, EventSet, N_CHANNELS
+from kindel_tpu.obs import runtime as obs_runtime
+from kindel_tpu.obs import trace as obs_trace
 from kindel_tpu.pileup import build_insertion_table
 from kindel_tpu.pileup_jax import PAD_POS, _bucket, _pad, check_pad_safe_block
 
@@ -476,7 +478,8 @@ def unpack_wire(buf: np.ndarray, length: int, d_pad: int, i_pad: int,
     """Split the packed wire buffer back into (main, parts, dmin, dmax).
     Bool flag segments come back bit-packed; decode_fast/masks_from_wire
     accept the packed forms via np.unpackbits below."""
-    buf = np.asarray(buf)
+    buf = np.asarray(buf)  # blocks on the device→host copy
+    obs_runtime.transfer_counters()[1].inc(int(buf.nbytes))
     sizes = _wire_sizes(length, d_pad, i_pad, want_masks, c_pad=c_pad)
     offs = np.cumsum([0] + sizes)
     segs = [buf[offs[i]: offs[i + 1]] for i in range(len(sizes))]
@@ -890,35 +893,44 @@ def call_consensus_fused(
     (`tuning` arg > KINDEL_TPU_SLABS > persisted tune store > backend
     default 16 CPU / 4 accelerator), clamped for small contigs; 1 forces
     the single fused kernel."""
-    if not build_changes:
-        from kindel_tpu import tune
+    with obs_trace.span("call.fused") as sp:
+        traced = sp is not obs_trace.NOOP_SPAN
+        if traced:
+            sp.set_attribute(ref=ev.ref_names[rid], L=int(ev.ref_lens[rid]))
+        if not build_changes:
+            from kindel_tpu import tune
 
-        max_contig = int(ev.ref_lens[rid])
-        n_slabs, _src = tune.resolve_slabs(
-            explicit=getattr(tuning, "n_slabs", None),
-            backend=jax.default_backend(),
-            max_contig=max_contig,
-        )
-        # tiny contigs: slabbing buys nothing below ~64k positions a slab
-        n_slabs = max(1, min(n_slabs, tune.slab_clamp(max_contig)))
-        if n_slabs > 1:
-            from kindel_tpu.pipeline import pipelined_consensus
-
-            return pipelined_consensus(
-                ev, rid, n_slabs, pileup=pileup, cdr_patches=cdr_patches,
-                trim_ends=trim_ends, min_depth=min_depth,
-                uppercase=uppercase, strict_ins=strict_ins,
+            max_contig = int(ev.ref_lens[rid])
+            n_slabs, _src = tune.resolve_slabs(
+                explicit=getattr(tuning, "n_slabs", None),
+                backend=jax.default_backend(),
+                max_contig=max_contig,
             )
-    _emit, masks, dmin, dmax = device_call(
-        ev, rid, min_depth, want_masks=build_changes,
-        flags=1 if strict_ins else 0,
-    )
-    ins_calls = {}
-    if masks.ins_mask.any():
-        ins_table = pileup.ins if pileup is not None else build_insertion_table(ev, rid)
-        ins_calls = _insertion_calls(ins_table)
-    res = assemble(
-        masks, ins_calls, cdr_patches, trim_ends, min_depth, uppercase,
-        build_changes,
-    )
-    return res, dmin, dmax
+            # tiny contigs: slabbing buys nothing below ~64k positions a slab
+            n_slabs = max(1, min(n_slabs, tune.slab_clamp(max_contig)))
+            if traced:
+                sp.set_attribute(n_slabs=n_slabs, slab_source=_src)
+            if n_slabs > 1:
+                from kindel_tpu.pipeline import pipelined_consensus
+
+                return pipelined_consensus(
+                    ev, rid, n_slabs, pileup=pileup, cdr_patches=cdr_patches,
+                    trim_ends=trim_ends, min_depth=min_depth,
+                    uppercase=uppercase, strict_ins=strict_ins,
+                )
+        _emit, masks, dmin, dmax = device_call(
+            ev, rid, min_depth, want_masks=build_changes,
+            flags=1 if strict_ins else 0,
+        )
+        ins_calls = {}
+        if masks.ins_mask.any():
+            ins_table = (
+                pileup.ins if pileup is not None
+                else build_insertion_table(ev, rid)
+            )
+            ins_calls = _insertion_calls(ins_table)
+        res = assemble(
+            masks, ins_calls, cdr_patches, trim_ends, min_depth, uppercase,
+            build_changes,
+        )
+        return res, dmin, dmax

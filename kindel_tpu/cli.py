@@ -17,9 +17,9 @@ from kindel_tpu import __version__, workloads
 
 
 def _progress_parent() -> argparse.ArgumentParser:
-    """--progress is accepted both before and after the subcommand
-    (every other option lives on the subparser, so users will naturally
-    type it there)."""
+    """--progress / --trace are accepted both before and after the
+    subcommand (every other option lives on the subparser, so users will
+    naturally type them there)."""
     p = argparse.ArgumentParser(add_help=False)
     p.add_argument(
         # SUPPRESS: the subparser copies its parsed namespace over the
@@ -30,6 +30,12 @@ def _progress_parent() -> argparse.ArgumentParser:
         help="report progress on stderr (chunks, contigs, cohort samples; "
              "also auto-enabled when stderr is a terminal — the reference's "
              "tqdm-bars equivalent)",
+    )
+    p.add_argument(
+        "--trace", metavar="PATH", default=argparse.SUPPRESS,
+        help="write a hierarchical span trace of this run (kindel_tpu.obs): "
+             ".json -> Perfetto/chrome://tracing trace_event document, any "
+             "other suffix -> JSONL (one span per line)",
     )
     return p
 
@@ -709,7 +715,7 @@ def main(argv=None) -> int:
     if args.command == "version":
         print(f"kindel-tpu {__version__}")
         return 0
-    return {
+    cmd = {
         "consensus": cmd_consensus,
         "weights": cmd_weights,
         "features": cmd_features,
@@ -718,7 +724,30 @@ def main(argv=None) -> int:
         "batch": cmd_batch,
         "serve": cmd_serve,
         "tune": cmd_tune,
-    }[args.command](args)
+    }[args.command]
+    trace_path = getattr(args, "trace", None)
+    if trace_path is None:
+        return cmd(args)
+    # one root span per CLI run: every phase/workload/serve span below
+    # parents into it, so the whole invocation renders as a single tree
+    from kindel_tpu.obs import runtime as obs_runtime
+    from kindel_tpu.obs import trace as obs_trace
+
+    obs_trace.enable_tracing(trace_path)
+    obs_runtime.install()
+    try:
+        with obs_trace.span(f"cli.{args.command}") as root:
+            root.set_attribute(
+                command=args.command,
+                bam_path=str(getattr(args, "bam_path", "")) or None,
+            )
+            try:
+                return cmd(args)
+            finally:
+                obs_runtime.attach_runtime(root)
+    finally:
+        obs_trace.disable_tracing()  # flush/close the exporter
+        print(f"trace written to {trace_path}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,46 @@
+"""kindel_tpu.obs — the observability spine: spans, metrics, runtime probes.
+
+Three pieces, shared by every layer (CLI, workloads, streaming, batch,
+pipeline, tune, serve) so one run produces one coherent telemetry view:
+
+  trace.py    thread-safe hierarchical span tracer (span ids, parent
+              links, attributes, events) with pluggable exporters —
+              JSONL (one span per line) and Perfetto/Chrome
+              `trace_event` JSON. `--trace PATH` on every CLI
+              subcommand; per-request trace ids in serve propagate
+              queue → batcher → worker → device dispatch. Disabled
+              tracing is a single global check returning a shared
+              no-op span: no string formatting, no allocation.
+  metrics.py  the thread-safe metric registry (Counter/Gauge/Histogram/
+              Info), lifted out of serve/metrics.py and extended with
+              label support and Prometheus text-format escaping, plus a
+              process-global default registry so streaming/batch/tune
+              record into the same exposition as serve.
+  runtime.py  JAX runtime probes — compile wall-time via
+              jax.monitoring, jit cache-entry counts of the hot
+              kernels, host↔device transfer byte counters, live
+              device-memory gauges — attached as span attributes and
+              default-registry metrics.
+
+`utils/profiling.py` (the `--profile` phase table) is a thin
+compatibility shim over spans; `serve/metrics.py` re-exports from here.
+"""
+
+from kindel_tpu.obs.metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    Info,
+    MetricsRegistry,
+    MultiRegistry,
+    default_registry,
+)
+from kindel_tpu.obs.trace import (  # noqa: F401
+    Tracer,
+    active_tracer,
+    disable_tracing,
+    enable_tracing,
+    record_span,
+    span,
+    start_span,
+)

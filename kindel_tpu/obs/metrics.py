@@ -1,0 +1,456 @@
+"""Process-wide metric registry + Prometheus text-format rendering.
+
+Lifted out of `kindel_tpu/serve/metrics.py` (which now re-exports from
+here) so every layer — streaming, batch, tune, the JAX runtime probes —
+records into the same exposition the serve HTTP endpoint renders.
+First-party on purpose (no prometheus_client dependency): the serving
+loop records a handful of counters, gauges, and histograms; the
+registry is equally readable in-process (`snapshot()`), which is what
+the deterministic tests and `benchmarks/serve_load.py` consume — any
+HTTP layer is a view, never the source of truth.
+
+Beyond the serve-era registry this adds:
+
+  * **labels**: every Counter/Gauge/Histogram is also a family —
+    `.labels(outcome="ok")` returns a get-or-create child rendered as
+    `name{outcome="ok"} v`. Label sets are expected to be small and
+    bounded (outcomes, lane shapes) — there is no eviction.
+  * **escaping per the exposition format spec**: HELP text escapes
+    `\\` and newline; label values escape `\\`, `"` and newline
+    (previously rendered raw — a help string or label value containing
+    a quote produced an unparseable exposition).
+  * **a process-global default registry** (`default_registry()`), and
+    `MultiRegistry` so serve's `/metrics` can render its own registry
+    plus the global one in a single exposition.
+
+Registration through a registry requires non-empty help text (also
+enforced statically by the tier-1 AST guard in tests/test_env_guard.py).
+"""
+
+from __future__ import annotations
+
+import bisect
+import re
+import threading
+from collections import deque
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+
+def _fmt(v: float) -> str:
+    return str(int(v)) if float(v).is_integer() else repr(float(v))
+
+
+def escape_help(text: str) -> str:
+    """HELP-line escaping per the text exposition format: backslash and
+    newline (quotes are legal raw in help text)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def escape_label_value(value) -> str:
+    """Label-value escaping: backslash, double-quote, newline."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _label_suffix(labels: dict | None) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{escape_label_value(v)}"' for k, v in sorted(labels.items())
+    )
+    return "{" + inner + "}"
+
+
+def _check_labels(labels: dict) -> dict:
+    for k in labels:
+        if not _LABEL_RE.match(k) or k.startswith("__"):
+            raise ValueError(f"invalid label name {k!r}")
+    return labels
+
+
+class _Metric:
+    """Shared family machinery: a metric is its own unlabeled series
+    plus (optionally) labeled children of the same class."""
+
+    def __init__(self, name: str, help_text: str = "",
+                 label_values: dict | None = None):
+        self.name = name
+        self.help = help_text
+        self._label_values = dict(label_values or {})
+        self._children: dict[tuple, "_Metric"] = {}
+        self._lock = threading.Lock()
+
+    def _new_child(self, labels: dict):
+        raise NotImplementedError
+
+    def labels(self, **labels):
+        """Get-or-create the child series for this label set."""
+        _check_labels(labels)
+        key = tuple(sorted((k, str(v)) for k, v in labels.items()))
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._new_child(dict(key))
+                self._children[key] = child
+            return child
+
+    def _suffix(self) -> str:
+        return _label_suffix(self._label_values)
+
+    def _header(self, type_name: str) -> list[str]:
+        return [
+            f"# HELP {self.name} {escape_help(self.help)}",
+            f"# TYPE {self.name} {type_name}",
+        ]
+
+    def _series(self) -> list["_Metric"]:
+        """Self plus labeled children; the bare series is omitted when
+        children exist and it was never touched (a family used only via
+        labels must not emit a spurious `name 0` sample)."""
+        with self._lock:
+            children = list(self._children.values())
+        if children and not self._touched():
+            return children
+        return [self] + children
+
+    def _touched(self) -> bool:
+        return True
+
+    def snapshot_value(self):
+        raise NotImplementedError
+
+    def snapshot_into(self, out: dict) -> None:
+        out[self.name + self._suffix()] = self.snapshot_value()
+        with self._lock:
+            children = list(self._children.values())
+        for c in children:
+            out[c.name + c._suffix()] = c.snapshot_value()
+
+
+class Counter(_Metric):
+    """Monotonic counter (family: `.labels(outcome="ok").inc()`)."""
+
+    def __init__(self, name: str, help_text: str = "",
+                 label_values: dict | None = None):
+        super().__init__(name, help_text, label_values)
+        self._value = 0
+
+    def _new_child(self, labels: dict) -> "Counter":
+        return Counter(self.name, self.help, labels)
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+    def _touched(self) -> bool:
+        return self._value != 0
+
+    def snapshot_value(self):
+        return self._value
+
+    def render(self) -> list[str]:
+        lines = self._header("counter")
+        for s in self._series():
+            lines.append(f"{s.name}{s._suffix()} {s._value}")
+        return lines
+
+
+class Gauge(_Metric):
+    """Instantaneous value (queue depth, pending rows, bytes in use)."""
+
+    def __init__(self, name: str, help_text: str = "",
+                 label_values: dict | None = None):
+        super().__init__(name, help_text, label_values)
+        self._value = 0.0
+        self._set_ever = False
+
+    def _new_child(self, labels: dict) -> "Gauge":
+        return Gauge(self.name, self.help, labels)
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = v
+            self._set_ever = True
+
+    def inc(self, n: float = 1) -> None:
+        with self._lock:
+            self._value += n
+            self._set_ever = True
+
+    def dec(self, n: float = 1) -> None:
+        with self._lock:
+            self._value -= n
+            self._set_ever = True
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def _touched(self) -> bool:
+        return self._set_ever
+
+    def snapshot_value(self):
+        return self._value
+
+    def render(self) -> list[str]:
+        lines = self._header("gauge")
+        for s in self._series():
+            lines.append(f"{s.name}{s._suffix()} {_fmt(s._value)}")
+        return lines
+
+
+class Histogram(_Metric):
+    """Cumulative-bucket histogram plus a bounded recent-observation
+    window for exact quantiles (p50/p99 request latency).
+
+    Prometheus histograms cannot express quantiles server-side, and the
+    serve dashboard wants them live — so alongside the standard
+    `_bucket`/`_sum`/`_count` series the renderer emits `<name>_p50` and
+    `<name>_p99` gauges computed over the last `window` observations.
+    """
+
+    def __init__(self, name: str, help_text: str = "",
+                 buckets: tuple = (0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0,
+                                   2.5, 5.0, 10.0),
+                 window: int = 4096, label_values: dict | None = None):
+        super().__init__(name, help_text, label_values)
+        self.buckets = tuple(sorted(buckets))
+        self._window = window
+        self._counts = [0] * (len(self.buckets) + 1)  # +inf bucket last
+        self._sum = 0.0
+        self._count = 0
+        self._max = 0.0
+        self._recent: deque = deque(maxlen=window)
+
+    def _new_child(self, labels: dict) -> "Histogram":
+        return Histogram(self.name, self.help, buckets=self.buckets,
+                         window=self._window, label_values=labels)
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self._counts[bisect.bisect_left(self.buckets, v)] += 1
+            self._sum += v
+            self._count += 1
+            if v > self._max:
+                self._max = v
+            self._recent.append(v)
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    @property
+    def max(self) -> float:
+        return self._max
+
+    def mean(self) -> float:
+        return self._sum / self._count if self._count else 0.0
+
+    def quantile(self, q: float) -> float:
+        """Exact quantile over the recent window (0 when empty)."""
+        with self._lock:
+            window = sorted(self._recent)
+        if not window:
+            return 0.0
+        idx = min(len(window) - 1, int(q * len(window)))
+        return window[idx]
+
+    def _touched(self) -> bool:
+        return self._count != 0
+
+    def snapshot_value(self):
+        return {
+            "count": self.count,
+            "sum": self.sum,
+            "max": self.max,
+            "mean": self.mean(),
+            "p50": self.quantile(0.5),
+            "p99": self.quantile(0.99),
+        }
+
+    def _render_series(self) -> list[str]:
+        with self._lock:
+            counts = list(self._counts)
+            total, total_sum, vmax = self._count, self._sum, self._max
+        base = dict(self._label_values)
+        lines = []
+        cum = 0
+        for bound, c in zip(self.buckets, counts):
+            cum += c
+            lines.append(
+                f"{self.name}_bucket"
+                f"{_label_suffix({**base, 'le': _fmt(bound)})} {cum}"
+            )
+        lines.append(
+            f"{self.name}_bucket{_label_suffix({**base, 'le': '+Inf'})} "
+            f"{total}"
+        )
+        suffix = self._suffix()
+        lines.append(f"{self.name}_sum{suffix} {_fmt(total_sum)}")
+        lines.append(f"{self.name}_count{suffix} {total}")
+        lines.append(f"{self.name}_max{suffix} {_fmt(vmax)}")
+        for q, label in ((0.5, "p50"), (0.99, "p99")):
+            lines.append(
+                f"{self.name}_{label}{suffix} {_fmt(self.quantile(q))}"
+            )
+        return lines
+
+    def render(self) -> list[str]:
+        lines = self._header("histogram")
+        for s in self._series():
+            lines.extend(s._render_series())
+        return lines
+
+
+class Info(_Metric):
+    """Constant labeled marker (value always 1) — exports configuration
+    facts (tune knob sources, warmed lane shapes) in the standard
+    `name{label="..."} 1` idiom without pretending they are
+    measurements. One sample per distinct label set; re-setting the
+    same label set overwrites it."""
+
+    def __init__(self, name: str, help_text: str = "",
+                 label_values: dict | None = None):
+        super().__init__(name, help_text, label_values)
+        self._labels: dict[tuple, dict] = {}
+
+    def set(self, **labels) -> None:
+        _check_labels(labels)
+        with self._lock:
+            self._labels[tuple(sorted(labels.items()))] = {
+                k: str(v) for k, v in labels.items()
+            }
+
+    @property
+    def value(self) -> list[dict]:
+        with self._lock:
+            return [dict(v) for v in self._labels.values()]
+
+    def snapshot_value(self):
+        return self.value
+
+    def snapshot_into(self, out: dict) -> None:
+        out[self.name] = self.value
+
+    def render(self) -> list[str]:
+        lines = self._header("gauge")
+        with self._lock:
+            for labels in self._labels.values():
+                lines.append(f"{self.name}{_label_suffix(labels)} 1")
+        return lines
+
+
+class MetricsRegistry:
+    """Get-or-create metric registry; render order is creation order.
+    Names must match the exposition grammar and carry non-empty help."""
+
+    def __init__(self):
+        self._metrics: dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, cls, name: str, help_text: str, **kwargs):
+        if not _NAME_RE.match(name):
+            raise ValueError(f"invalid metric name {name!r}")
+        if not help_text:
+            raise ValueError(
+                f"metric {name!r} registered without help text"
+            )
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help_text, **kwargs)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}"
+                )
+            return m
+
+    def counter(self, name: str, help_text: str = "") -> Counter:
+        return self._get(Counter, name, help_text)
+
+    def gauge(self, name: str, help_text: str = "") -> Gauge:
+        return self._get(Gauge, name, help_text)
+
+    def histogram(self, name: str, help_text: str = "", **kw) -> Histogram:
+        return self._get(Histogram, name, help_text, **kw)
+
+    def info(self, name: str, help_text: str = "") -> Info:
+        return self._get(Info, name, help_text)
+
+    def _render_into(self, out: list[str], seen: set) -> None:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            if m.name in seen:
+                continue  # first registry wins on a name collision
+            seen.add(m.name)
+            out.extend(m.render())
+
+    def render(self) -> str:
+        out: list[str] = []
+        self._render_into(out, set())
+        return "\n".join(out) + "\n"
+
+    def snapshot(self) -> dict:
+        """JSON-able view for in-process consumers (tests, load bench).
+        Labeled children appear under `name{label="v"}` keys; unlabeled
+        series keep their bare name."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        out: dict = {}
+        for m in metrics:
+            m.snapshot_into(out)
+        return out
+
+
+class MultiRegistry:
+    """Read-only union view over several registries (serve renders its
+    own registry plus the process-global one in a single exposition).
+    `refresh` is an optional callable run before each render/snapshot —
+    the hook that updates point-in-time gauges (device memory)."""
+
+    def __init__(self, *registries: MetricsRegistry, refresh=None):
+        self._registries = registries
+        self._refresh = refresh
+
+    def render(self) -> str:
+        if self._refresh is not None:
+            self._refresh()
+        out: list[str] = []
+        seen: set = set()
+        for reg in self._registries:
+            reg._render_into(out, seen)
+        return "\n".join(out) + "\n"
+
+    def snapshot(self) -> dict:
+        if self._refresh is not None:
+            self._refresh()
+        out: dict = {}
+        for reg in reversed(self._registries):
+            out.update(reg.snapshot())
+        return out
+
+
+#: the process-global registry: streaming/batch/tune/runtime metrics
+#: land here so the serve exposition (and bench snapshots) see them
+_DEFAULT = MetricsRegistry()
+
+
+def default_registry() -> MetricsRegistry:
+    return _DEFAULT

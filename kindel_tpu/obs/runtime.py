@@ -1,0 +1,211 @@
+"""JAX runtime probes: compile time, jit cache entries, transfers, memory.
+
+The span tracer and metric registry see *our* code; this module makes
+the JAX runtime underneath visible in the same telemetry:
+
+  * **compile wall-time per launch** — a `jax.monitoring` event-duration
+    listener folds every XLA compilation into
+    `kindel_jax_compiles_total` / `kindel_jax_compile_seconds` on the
+    default registry (install once via `install()`; tolerant of jax
+    versions without the hook).
+  * **jit cache-entry deltas** — `jit_cache_entries()` sums the
+    `_cache_size()` of the hot kernels (batched/realign/counts/slab), so
+    a dispatch site can attach `compiled_new=...` to its span by
+    differencing before/after (that is exactly how the serve warmup test
+    pins "first request compiles nothing").
+  * **host↔device transfer bytes** — `transfer_counters()` returns the
+    (h2d, d2h) byte counters the launch/download sites feed
+    (`kindel_device_h2d_bytes_total` / `kindel_device_d2h_bytes_total`).
+  * **live device memory** — `update_device_gauges()` refreshes
+    `kindel_jax_device_bytes_in_use` (TPU/GPU `memory_stats()`; absent
+    on CPU backends) and `kindel_jax_live_arrays`; wired as the
+    `MultiRegistry` refresh hook of the serve exposition.
+
+Everything is best-effort: a missing jax API degrades to "no data",
+never to a failed pipeline. Nothing here imports jax at module import
+time (bench.py's hermetic parent must stay jax-free).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from kindel_tpu.obs.metrics import default_registry
+
+_COMPILE_BUCKETS = (0.01, 0.05, 0.25, 1.0, 5.0, 15.0, 60.0, 300.0)
+
+#: names of the jit-wrapped hot kernels whose cache sizes we track
+_TRACKED_KERNELS = (
+    ("kindel_tpu.call_jax", "batched_call_kernel"),
+    ("kindel_tpu.call_jax", "batched_realign_call_kernel"),
+    ("kindel_tpu.call_jax", "counts_call_kernel"),
+    ("kindel_tpu.call_jax", "fused_call_kernel_slab"),
+)
+
+_install_lock = threading.Lock()
+_installed = False
+
+
+def install(registry=None) -> bool:
+    """Register the jax.monitoring compile-time listener (idempotent).
+    Returns True when the listener is active."""
+    global _installed
+    with _install_lock:
+        if _installed:
+            return True
+        reg = registry if registry is not None else default_registry()
+        compiles = reg.counter(
+            "kindel_jax_compiles_total",
+            "XLA compilations observed via jax.monitoring",
+        )
+        compile_s = reg.histogram(
+            "kindel_jax_compile_seconds",
+            "wall time of each observed XLA compilation",
+            buckets=_COMPILE_BUCKETS,
+        )
+        try:
+            from jax import monitoring
+
+            def _on_event(event, duration, **_kw):
+                # jax names its backend-compile duration events
+                # '/jax/core/compile' / '.../backend_compile' across
+                # versions — match the family, not one spelling
+                if "compile" in event:
+                    compiles.inc()
+                    compile_s.observe(float(duration))
+
+            monitoring.register_event_duration_secs_listener(_on_event)
+        except Exception:
+            return False
+        _installed = True
+        return True
+
+
+def compile_totals(registry=None) -> tuple[int, float]:
+    """(count, total wall seconds) of compilations observed so far."""
+    reg = registry if registry is not None else default_registry()
+    compiles = reg.counter(
+        "kindel_jax_compiles_total",
+        "XLA compilations observed via jax.monitoring",
+    )
+    compile_s = reg.histogram(
+        "kindel_jax_compile_seconds",
+        "wall time of each observed XLA compilation",
+        buckets=_COMPILE_BUCKETS,
+    )
+    return int(compiles.value), float(compile_s.sum)
+
+
+def jit_cache_sizes() -> dict[str, int]:
+    """Per-kernel jit cache-entry counts of the tracked hot kernels
+    (empty when jax or the _cache_size API is unavailable)."""
+    import sys
+
+    out: dict[str, int] = {}
+    for mod_name, fn_name in _TRACKED_KERNELS:
+        mod = sys.modules.get(mod_name)
+        if mod is None:
+            continue  # never force a jax import from a probe
+        try:
+            fn = getattr(mod, fn_name, None)
+            cache_size = getattr(fn, "_cache_size", None)
+            if cache_size is not None:
+                out[fn_name] = int(cache_size())
+        except Exception:
+            continue
+    return out
+
+
+def jit_cache_entries() -> int:
+    """Total tracked jit cache entries (0 when unavailable)."""
+    return sum(jit_cache_sizes().values())
+
+
+_TRANSFER: tuple | None = None
+
+
+def transfer_counters(registry=None):
+    """(h2d, d2h) byte counters the dispatch/download sites feed. The
+    default-registry pair is cached — the download site sits on the
+    per-slab hot path and must not pay a registry lookup per call."""
+    global _TRANSFER
+    if registry is None:
+        if _TRANSFER is None:
+            _TRANSFER = transfer_counters(default_registry())
+        return _TRANSFER
+    return (
+        registry.counter(
+            "kindel_device_h2d_bytes_total",
+            "host-to-device bytes uploaded by kernel dispatch sites",
+        ),
+        registry.counter(
+            "kindel_device_d2h_bytes_total",
+            "device-to-host bytes downloaded by wire/decode sites",
+        ),
+    )
+
+
+def device_memory_stats() -> dict | None:
+    """First device's memory_stats() (None on backends without it —
+    CPU — or before jax initialized)."""
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return None
+    return dict(stats) if stats else None
+
+
+def update_device_gauges(registry=None) -> None:
+    """Refresh the point-in-time device gauges (MultiRegistry refresh
+    hook: runs on every /metrics render)."""
+    import sys
+
+    reg = registry if registry is not None else default_registry()
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return
+    try:
+        live = len(jax.live_arrays())
+    except Exception:
+        live = None
+    if live is not None:
+        reg.gauge(
+            "kindel_jax_live_arrays",
+            "live jax arrays held by this process",
+        ).set(live)
+    stats = device_memory_stats()
+    if stats and "bytes_in_use" in stats:
+        reg.gauge(
+            "kindel_jax_device_bytes_in_use",
+            "bytes in use on device 0 (absent on CPU backends)",
+        ).set(int(stats["bytes_in_use"]))
+
+
+def runtime_snapshot() -> dict:
+    """One JSON-able dict of every probe (span attributes, bench)."""
+    snap: dict = {"jit_cache": jit_cache_sizes()}
+    count, wall = compile_totals()
+    snap["compiles"] = count
+    snap["compile_wall_s"] = round(wall, 3)
+    mem = device_memory_stats()
+    if mem is not None:
+        snap["device_memory"] = {
+            k: mem[k] for k in ("bytes_in_use", "peak_bytes_in_use")
+            if k in mem
+        }
+    return snap
+
+
+def attach_runtime(span) -> None:
+    """Attach the runtime snapshot to a span (no-op span safe)."""
+    snap = runtime_snapshot()
+    span.set_attribute(
+        jit_cache_entries=sum(snap["jit_cache"].values()),
+        compiles=snap["compiles"],
+        compile_wall_s=snap["compile_wall_s"],
+    )

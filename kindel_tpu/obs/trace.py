@@ -1,0 +1,400 @@
+"""Thread-safe hierarchical span tracer with pluggable exporters.
+
+A *span* is one named, timed piece of work with a parent link, free-form
+attributes, and point-in-time events. Spans form trees: a CLI run is one
+root (`cli.consensus`) whose children are the decode / extract / call
+phases; a serve request is one root (`serve.request`) whose children are
+admission, queue wait, decode, and the shared micro-batch dispatch —
+every span of a request carries the request's trace id, so one request
+renders as a single tree even though its stages execute on four
+different threads.
+
+Two propagation modes, because the two callers need different ones:
+
+  * **stacked** (`span(name)`): the common context-manager form. Each
+    thread keeps its own span stack; a nested `span()` parents to the
+    enclosing one automatically. Used by the phase instrumentation in
+    workloads/streaming/batch/pipeline.
+  * **detached** (`start_span(name, parent=...)` / `record_span`): the
+    caller owns the lifetime and threads the parent explicitly. Used by
+    serve, where a request's spans open on one thread and close on
+    another (submit thread → intake thread → dispatch thread).
+
+Disabled-tracer overhead is the design constraint (the span sites sit
+on hot paths): `span()`/`start_span()` are a single module-global check
+returning one shared immutable no-op span — no string formatting, no
+allocation beyond the context-manager protocol itself. Pinned by
+tests/test_obs.py with tracemalloc.
+
+Exporters: `JsonlExporter` (one JSON object per finished span —
+machine-diffable, what the deterministic tests consume) and
+`ChromeTraceExporter` (Perfetto/chrome://tracing `trace_event` JSON).
+`enable_tracing(path)` picks by suffix: `.json` → Chrome, else JSONL.
+
+Durations come from `time.perf_counter()`; wall-clock anchoring uses a
+single `time.time_ns()` offset captured at import (the tier-1 lint
+forbids `time.time()` deltas for duration measurement).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+
+#: perf_counter → epoch-seconds anchor (captured once; durations never
+#: touch the wall clock)
+_ANCHOR_EPOCH_S = time.time_ns() / 1e9
+_ANCHOR_PERF_S = time.perf_counter()
+
+
+def _epoch_s(perf_t: float) -> float:
+    return _ANCHOR_EPOCH_S + (perf_t - _ANCHOR_PERF_S)
+
+
+def _new_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+class Span:
+    """One live span. Not created directly — via Tracer/module helpers."""
+
+    __slots__ = (
+        "name", "trace_id", "span_id", "parent_id", "start", "end",
+        "attrs", "events", "thread", "_tracer", "_stacked",
+    )
+
+    def __init__(self, tracer: "Tracer", name: str, trace_id: str,
+                 parent_id: str | None, stacked: bool):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = _new_id()
+        self.parent_id = parent_id
+        self.start = time.perf_counter()
+        self.end: float | None = None
+        self.attrs: dict = {}
+        self.events: list = []
+        self.thread = threading.current_thread().name
+        self._tracer = tracer
+        self._stacked = stacked
+
+    def set_attribute(self, **attrs) -> None:
+        self.attrs.update(attrs)
+
+    def add_event(self, name: str, **attrs) -> None:
+        self.events.append((time.perf_counter(), name, attrs))
+
+    def finish(self) -> None:
+        """End a detached span (idempotent)."""
+        if self.end is None:
+            self._tracer._finish(self)
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if exc_type is not None and "error" not in self.attrs:
+            self.attrs["error"] = repr(exc)
+        if self._stacked:
+            self._tracer._pop(self)
+        self.finish()
+        return False
+
+
+class _NoopSpan:
+    """The shared disabled-tracing span: every method a no-op, one
+    instance for the whole process (identity-pinned by test — a fresh
+    object per call site would be an allocation per span)."""
+
+    __slots__ = ()
+
+    name = None
+    trace_id = None
+    span_id = None
+    parent_id = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+    def set_attribute(self, **attrs):
+        pass
+
+    def add_event(self, name, **attrs):
+        pass
+
+    def finish(self):
+        pass
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+# ------------------------------------------------------------- exporters
+
+
+class JsonlExporter:
+    """One JSON object per finished span, written (and flushed) as spans
+    finish — a crash loses at most the in-flight spans, and tests read
+    the file without a close handshake."""
+
+    def __init__(self, path):
+        self.path = str(path)
+        self._fh = open(self.path, "w")
+        self._lock = threading.Lock()
+
+    def export(self, record: dict) -> None:
+        line = json.dumps(record, default=str)
+        with self._lock:
+            self._fh.write(line + "\n")
+            self._fh.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            self._fh.close()
+
+
+class ChromeTraceExporter:
+    """Perfetto / chrome://tracing `trace_event` JSON: complete ("X")
+    events buffered in memory, one document written at close (the format
+    is a single JSON object, so it cannot stream)."""
+
+    def __init__(self, path):
+        self.path = str(path)
+        self._events: list[dict] = []
+        self._lock = threading.Lock()
+        self._pid = os.getpid()
+        self._tids: dict[str, int] = {}
+
+    def _tid(self, thread_name: str) -> int:
+        tid = self._tids.get(thread_name)
+        if tid is None:
+            tid = self._tids[thread_name] = len(self._tids) + 1
+        return tid
+
+    def export(self, record: dict) -> None:
+        args = dict(record.get("attrs") or {})
+        args["trace_id"] = record["trace_id"]
+        args["span_id"] = record["span_id"]
+        if record.get("parent_id"):
+            args["parent_id"] = record["parent_id"]
+        with self._lock:
+            self._events.append(
+                {
+                    "name": record["name"],
+                    "ph": "X",
+                    "ts": round(record["start_s"] * 1e6, 3),
+                    "dur": round(record["duration_s"] * 1e6, 3),
+                    "pid": self._pid,
+                    "tid": self._tid(record.get("thread", "main")),
+                    "args": args,
+                }
+            )
+            for ev in record.get("events") or []:
+                self._events.append(
+                    {
+                        "name": ev["name"],
+                        "ph": "i",
+                        "ts": round(ev["t_s"] * 1e6, 3),
+                        "pid": self._pid,
+                        "tid": self._tid(record.get("thread", "main")),
+                        "s": "t",
+                        "args": dict(ev.get("attrs") or {}),
+                    }
+                )
+
+    def close(self) -> None:
+        with self._lock:
+            doc = {
+                "traceEvents": self._events,
+                "displayTimeUnit": "ms",
+                "otherData": {"producer": "kindel-tpu obs.trace"},
+            }
+            with open(self.path, "w") as fh:
+                json.dump(doc, fh)
+            self._events = []
+
+
+class ListExporter:
+    """In-memory exporter (bench span summaries, tests)."""
+
+    def __init__(self):
+        self.records: list[dict] = []
+        self._lock = threading.Lock()
+
+    def export(self, record: dict) -> None:
+        with self._lock:
+            self.records.append(record)
+
+    def close(self) -> None:
+        pass
+
+
+# --------------------------------------------------------------- tracer
+
+
+class Tracer:
+    """Owns per-thread span stacks and one exporter. Thread-safe: spans
+    may start and finish on different threads (detached mode); stacked
+    spans are per-thread by construction."""
+
+    def __init__(self, exporter):
+        self.exporter = exporter
+        self._local = threading.local()
+
+    # -- stacks ------------------------------------------------------
+
+    def _stack(self) -> list:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    def current(self) -> Span | None:
+        st = self._stack()
+        return st[-1] if st else None
+
+    def _pop(self, sp: Span) -> None:
+        st = self._stack()
+        if st and st[-1] is sp:
+            st.pop()
+        elif sp in st:  # tolerate out-of-order exit; never corrupt others
+            st.remove(sp)
+
+    # -- span lifecycle ----------------------------------------------
+
+    def _make(self, name: str, parent, stacked: bool) -> Span:
+        if parent is None or parent is NOOP_SPAN or isinstance(
+            parent, _NoopSpan
+        ):
+            ambient = self.current()
+            if ambient is not None:
+                parent = ambient
+            else:
+                parent = None
+        if parent is None:
+            return Span(self, name, _new_id(), None, stacked)
+        return Span(self, name, parent.trace_id, parent.span_id, stacked)
+
+    def span(self, name: str, parent=None) -> Span:
+        """Context-manager span, parented to the thread's enclosing span
+        unless `parent` is given explicitly."""
+        sp = self._make(name, parent, stacked=True)
+        self._stack().append(sp)
+        return sp
+
+    def start_span(self, name: str, parent=None) -> Span:
+        """Detached span: the caller finishes it (possibly on another
+        thread) via `.finish()` or by using it as a context manager."""
+        return self._make(name, parent, stacked=False)
+
+    def record_span(self, name: str, parent, start: float, end: float,
+                    **attrs):
+        """Record an already-timed interval as a finished span (the
+        serve dispatcher times a shared flush once and records it into
+        every member request's tree). Returns the finished Span."""
+        sp = self._make(name, parent, stacked=False)
+        sp.start = start
+        sp.attrs.update(attrs)
+        sp.end = end
+        self.exporter.export(self._record(sp))
+        return sp
+
+    def _finish(self, sp: Span) -> None:
+        sp.end = time.perf_counter()
+        self.exporter.export(self._record(sp))
+
+    @staticmethod
+    def _record(sp: Span) -> dict:
+        return {
+            "name": sp.name,
+            "trace_id": sp.trace_id,
+            "span_id": sp.span_id,
+            "parent_id": sp.parent_id,
+            "start_s": round(_epoch_s(sp.start), 6),
+            "duration_s": round(sp.end - sp.start, 6),
+            "thread": sp.thread,
+            "attrs": sp.attrs,
+            "events": [
+                {
+                    "name": name,
+                    "t_s": round(_epoch_s(t), 6),
+                    "attrs": attrs,
+                }
+                for t, name, attrs in sp.events
+            ],
+        }
+
+    def close(self) -> None:
+        self.exporter.close()
+
+
+# ------------------------------------------------------------ module API
+
+_ACTIVE: Tracer | None = None
+
+
+def active_tracer() -> Tracer | None:
+    return _ACTIVE
+
+
+def open_exporter(path):
+    """Exporter for `path` by suffix: `.json` → Chrome trace_event
+    (Perfetto-loadable), anything else → JSONL."""
+    if str(path).endswith(".json"):
+        return ChromeTraceExporter(path)
+    return JsonlExporter(path)
+
+
+def enable_tracing(path=None, exporter=None) -> Tracer:
+    """Install the process tracer (replacing any active one — the
+    previous exporter is closed/flushed)."""
+    global _ACTIVE
+    if exporter is None:
+        if path is None:
+            raise ValueError("enable_tracing needs a path or an exporter")
+        exporter = open_exporter(path)
+    if _ACTIVE is not None:
+        _ACTIVE.close()
+    _ACTIVE = Tracer(exporter)
+    return _ACTIVE
+
+
+def disable_tracing() -> None:
+    """Uninstall and flush/close the active tracer (no-op when off)."""
+    global _ACTIVE
+    if _ACTIVE is not None:
+        _ACTIVE.close()
+        _ACTIVE = None
+
+
+def span(name: str, parent=None):
+    """Context-manager span against the active tracer; the shared no-op
+    span when tracing is disabled (no allocation — hot paths call this
+    unconditionally)."""
+    tracer = _ACTIVE
+    if tracer is None:
+        return NOOP_SPAN
+    return tracer.span(name, parent=parent)
+
+
+def start_span(name: str, parent=None):
+    """Detached span (caller calls .finish(), any thread); the shared
+    no-op span when tracing is disabled."""
+    tracer = _ACTIVE
+    if tracer is None:
+        return NOOP_SPAN
+    return tracer.start_span(name, parent=parent)
+
+
+def record_span(name: str, parent, start: float, end: float, **attrs):
+    """Record a pre-timed interval (perf_counter timestamps) as a
+    finished span; returns it (the no-op span when disabled)."""
+    tracer = _ACTIVE
+    if tracer is None:
+        return NOOP_SPAN
+    return tracer.record_span(name, parent, start, end, **attrs)

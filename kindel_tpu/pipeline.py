@@ -47,6 +47,8 @@ from kindel_tpu.call_jax import (
     unpack_wire,
 )
 from kindel_tpu.events import EventSet, N_CHANNELS
+from kindel_tpu.obs import runtime as obs_runtime
+from kindel_tpu.obs import trace as obs_trace
 from kindel_tpu.pileup import build_insertion_table
 
 
@@ -147,20 +149,27 @@ def pipelined_consensus(
     assert all(len(b) == size for b in bufs)
     big = jnp.asarray(np.concatenate(bufs))
     o_pad, b_pad, nn_pad, d_pad, i_pad = pads
+    h2d, _d2h = obs_runtime.transfer_counters()
+    h2d.inc(big.nbytes)
 
     # dispatch every slab asynchronously, then queue its d2h copy
     inflight = []
-    for i, sl in enumerate(slabs):
-        wire = fused_call_kernel_slab(
-            big, jnp.int32(i * size), size=size, o_pad=o_pad, b_pad=b_pad,
-            nn_pad=nn_pad, d_pad=d_pad, i_pad=i_pad, length=sl.L,
-            c_pad=c_pad,
-        )
-        try:
-            wire.copy_to_host_async()
-        except AttributeError:
-            pass  # CPU arrays in some jax versions
-        inflight.append((sl, covs[i], c_pad, d_pad, i_pad, wire))
+    with obs_trace.span("slab.dispatch") as dsp:
+        for i, sl in enumerate(slabs):
+            wire = fused_call_kernel_slab(
+                big, jnp.int32(i * size), size=size, o_pad=o_pad,
+                b_pad=b_pad, nn_pad=nn_pad, d_pad=d_pad, i_pad=i_pad,
+                length=sl.L, c_pad=c_pad,
+            )
+            try:
+                wire.copy_to_host_async()
+            except AttributeError:
+                pass  # CPU arrays in some jax versions
+            inflight.append((sl, covs[i], c_pad, d_pad, i_pad, wire))
+        if dsp is not obs_trace.NOOP_SPAN:
+            dsp.set_attribute(
+                n_slabs=n_slabs, L=u.L, h2d_bytes=int(big.nbytes)
+            )
 
     # decode slab k (shared wire decoders) while slabs k+1.. compute /
     # transfer; each slab's [0, valid_len) window is spliced into the
@@ -169,24 +178,27 @@ def pipelined_consensus(
     del_mask = np.zeros(u.L, dtype=bool)
     ins_mask = np.zeros(u.L, dtype=bool)
     dmin, dmax = 2**31 - 1, -1
-    for sl, cov, c_pad, d_pad, i_pad, wire in inflight:
-        main, parts, s_dmin, s_dmax = unpack_wire(
-            np.asarray(wire), sl.L, d_pad, i_pad, want_masks=False,
-            c_pad=c_pad,
-        )
-        if cov is not None:
-            m = decode_compact(
-                main, *parts, sl.L, cov, sl.del_pos, sl.ins_pos
+    with obs_trace.span("slab.decode") as dec:
+        for sl, cov, c_pad, d_pad, i_pad, wire in inflight:
+            main, parts, s_dmin, s_dmax = unpack_wire(
+                np.asarray(wire), sl.L, d_pad, i_pad, want_masks=False,
+                c_pad=c_pad,
             )
-        else:
-            m = decode_fast(
-                main, *parts, sl.L, sl.del_pos, sl.ins_pos
-            )
-        v = sl.valid_len
-        base_char[sl.s0: sl.s0 + v] = m.base_char[:v]
-        del_mask[sl.s0: sl.s0 + v] = m.del_mask[:v]
-        ins_mask[sl.s0: sl.s0 + v] = m.ins_mask[:v]
-        dmin, dmax = min(dmin, s_dmin), max(dmax, s_dmax)
+            if cov is not None:
+                m = decode_compact(
+                    main, *parts, sl.L, cov, sl.del_pos, sl.ins_pos
+                )
+            else:
+                m = decode_fast(
+                    main, *parts, sl.L, sl.del_pos, sl.ins_pos
+                )
+            v = sl.valid_len
+            base_char[sl.s0: sl.s0 + v] = m.base_char[:v]
+            del_mask[sl.s0: sl.s0 + v] = m.del_mask[:v]
+            ins_mask[sl.s0: sl.s0 + v] = m.ins_mask[:v]
+            dmin, dmax = min(dmin, s_dmin), max(dmax, s_dmax)
+        if dec is not obs_trace.NOOP_SPAN:
+            dec.set_attribute(n_slabs=n_slabs)
 
     masks = CallMasks(
         base_char=base_char,

@@ -23,6 +23,7 @@ import time
 from dataclasses import dataclass, fields
 
 from kindel_tpu.batch import BatchOptions, cohort_pad_shapes
+from kindel_tpu.obs import trace as obs_trace
 
 
 def opts_key(opts: BatchOptions) -> tuple:
@@ -91,9 +92,20 @@ class MicroBatcher:
                 lane = self._lanes[key] = _Lane(req.opts, shapes, now)
             lane.entries.append((req, units))
             lane.rows += len(units)
-            if lane.rows >= self.max_batch_rows:
+            sealed = lane.rows >= self.max_batch_rows
+            if sealed:
                 self._ready.append(self._seal(key, lane))
             self._cond.notify_all()
+        # trace-id propagation stage 2 of 4 (queue → BATCHER → worker →
+        # device dispatch): mark the coalescing decision on the request's
+        # own span tree (no-op span outside serve / with tracing off)
+        span = getattr(req, "span", None)
+        if span is not None and span is not obs_trace.NOOP_SPAN:
+            span.add_event(
+                "batcher.lane_add",
+                rows=len(units), lane_rows=lane.rows, sealed=sealed,
+                lane_shape="x".join(str(s) for s in shapes),
+            )
 
     def _seal(self, key, lane: _Lane) -> Flush:
         del self._lanes[key]

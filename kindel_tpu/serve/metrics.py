@@ -1,269 +1,47 @@
-"""Serve metrics: a small thread-safe registry + stdlib HTTP exposition.
+"""Serve metrics: HTTP exposition over the shared obs registry.
 
-First-party on purpose (no prometheus_client dependency): the serving
-loop records a handful of counters, gauges, and histograms, and a
-`ThreadingHTTPServer` renders them in the Prometheus text exposition
-format at `/metrics` plus a JSON liveness document at `/healthz`. The
-registry is also readable in-process (`snapshot()`), which is what the
-deterministic serve tests and `benchmarks/serve_load.py` consume —
-the HTTP layer is a view, never the source of truth.
+The thread-safe registry and metric types were lifted into
+`kindel_tpu.obs.metrics` (so streaming/batch/tune and the JAX runtime
+probes record into the same exposition the service renders); this
+module keeps the serve-facing import surface — `MetricsRegistry` et al.
+re-exported unchanged — and owns the transport: a stdlib
+`ThreadingHTTPServer` rendering `/metrics` (Prometheus text format,
+registry or MultiRegistry view) plus a JSON liveness document at
+`/healthz`. The registry is also readable in-process (`snapshot()`),
+which is what the deterministic serve tests and
+`benchmarks/serve_load.py` consume — the HTTP layer is a view, never
+the source of truth.
 """
 
 from __future__ import annotations
 
-import bisect
 import json
 import threading
-from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-
-class Counter:
-    """Monotonic counter."""
-
-    def __init__(self, name: str, help_text: str = ""):
-        self.name = name
-        self.help = help_text
-        self._value = 0
-        self._lock = threading.Lock()
-
-    def inc(self, n: int = 1) -> None:
-        with self._lock:
-            self._value += n
-
-    @property
-    def value(self) -> int:
-        return self._value
-
-    def render(self) -> list[str]:
-        return [
-            f"# HELP {self.name} {self.help}",
-            f"# TYPE {self.name} counter",
-            f"{self.name} {self._value}",
-        ]
-
-
-class Gauge:
-    """Instantaneous value (queue depth, pending rows)."""
-
-    def __init__(self, name: str, help_text: str = ""):
-        self.name = name
-        self.help = help_text
-        self._value = 0.0
-        self._lock = threading.Lock()
-
-    def set(self, v: float) -> None:
-        with self._lock:
-            self._value = v
-
-    def inc(self, n: float = 1) -> None:
-        with self._lock:
-            self._value += n
-
-    def dec(self, n: float = 1) -> None:
-        with self._lock:
-            self._value -= n
-
-    @property
-    def value(self) -> float:
-        return self._value
-
-    def render(self) -> list[str]:
-        return [
-            f"# HELP {self.name} {self.help}",
-            f"# TYPE {self.name} gauge",
-            f"{self.name} {_fmt(self._value)}",
-        ]
-
-
-class Histogram:
-    """Cumulative-bucket histogram plus a bounded recent-observation
-    window for exact quantiles (p50/p99 request latency).
-
-    Prometheus histograms cannot express quantiles server-side, and the
-    serve dashboard wants them live — so alongside the standard
-    `_bucket`/`_sum`/`_count` series the renderer emits `<name>_p50` and
-    `<name>_p99` gauges computed over the last `window` observations.
-    """
-
-    def __init__(self, name: str, help_text: str = "",
-                 buckets: tuple = (0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0,
-                                   2.5, 5.0, 10.0),
-                 window: int = 4096):
-        self.name = name
-        self.help = help_text
-        self.buckets = tuple(sorted(buckets))
-        self._counts = [0] * (len(self.buckets) + 1)  # +inf bucket last
-        self._sum = 0.0
-        self._count = 0
-        self._max = 0.0
-        self._recent: deque = deque(maxlen=window)
-        self._lock = threading.Lock()
-
-    def observe(self, v: float) -> None:
-        with self._lock:
-            self._counts[bisect.bisect_left(self.buckets, v)] += 1
-            self._sum += v
-            self._count += 1
-            if v > self._max:
-                self._max = v
-            self._recent.append(v)
-
-    @property
-    def count(self) -> int:
-        return self._count
-
-    @property
-    def sum(self) -> float:
-        return self._sum
-
-    @property
-    def max(self) -> float:
-        return self._max
-
-    def mean(self) -> float:
-        return self._sum / self._count if self._count else 0.0
-
-    def quantile(self, q: float) -> float:
-        """Exact quantile over the recent window (0 when empty)."""
-        with self._lock:
-            window = sorted(self._recent)
-        if not window:
-            return 0.0
-        idx = min(len(window) - 1, int(q * len(window)))
-        return window[idx]
-
-    def render(self) -> list[str]:
-        with self._lock:
-            counts = list(self._counts)
-            total, total_sum, vmax = self._count, self._sum, self._max
-        lines = [
-            f"# HELP {self.name} {self.help}",
-            f"# TYPE {self.name} histogram",
-        ]
-        cum = 0
-        for bound, c in zip(self.buckets, counts):
-            cum += c
-            lines.append(f'{self.name}_bucket{{le="{_fmt(bound)}"}} {cum}')
-        lines.append(f'{self.name}_bucket{{le="+Inf"}} {total}')
-        lines.append(f"{self.name}_sum {_fmt(total_sum)}")
-        lines.append(f"{self.name}_count {total}")
-        lines.append(f"{self.name}_max {_fmt(vmax)}")
-        for q, label in ((0.5, "p50"), (0.99, "p99")):
-            lines.append(f"{self.name}_{label} {_fmt(self.quantile(q))}")
-        return lines
-
-
-class Info:
-    """Constant labeled marker (value always 1) — exports configuration
-    facts (tune knob sources, warmed lane shapes) in the standard
-    `name{label="..."} 1` idiom without pretending they are
-    measurements. One sample per distinct label set; re-setting the
-    same label set overwrites it."""
-
-    def __init__(self, name: str, help_text: str = ""):
-        self.name = name
-        self.help = help_text
-        self._labels: dict[tuple, dict] = {}
-        self._lock = threading.Lock()
-
-    def set(self, **labels) -> None:
-        with self._lock:
-            self._labels[tuple(sorted(labels.items()))] = {
-                k: str(v) for k, v in labels.items()
-            }
-
-    @property
-    def value(self) -> list[dict]:
-        with self._lock:
-            return [dict(v) for v in self._labels.values()]
-
-    def render(self) -> list[str]:
-        lines = [
-            f"# HELP {self.name} {self.help}",
-            f"# TYPE {self.name} gauge",
-        ]
-        with self._lock:
-            for labels in self._labels.values():
-                lab = ",".join(
-                    f'{k}="{v}"' for k, v in sorted(labels.items())
-                )
-                lines.append(f"{self.name}{{{lab}}} 1")
-        return lines
-
-
-def _fmt(v: float) -> str:
-    return str(int(v)) if float(v).is_integer() else repr(float(v))
-
-
-class MetricsRegistry:
-    """Get-or-create metric registry; render order is creation order."""
-
-    def __init__(self):
-        self._metrics: dict[str, object] = {}
-        self._lock = threading.Lock()
-
-    def _get(self, cls, name: str, *args, **kwargs):
-        with self._lock:
-            m = self._metrics.get(name)
-            if m is None:
-                m = cls(name, *args, **kwargs)
-                self._metrics[name] = m
-            elif not isinstance(m, cls):
-                raise TypeError(
-                    f"metric {name!r} already registered as "
-                    f"{type(m).__name__}"
-                )
-            return m
-
-    def counter(self, name: str, help_text: str = "") -> Counter:
-        return self._get(Counter, name, help_text)
-
-    def gauge(self, name: str, help_text: str = "") -> Gauge:
-        return self._get(Gauge, name, help_text)
-
-    def histogram(self, name: str, help_text: str = "", **kw) -> Histogram:
-        return self._get(Histogram, name, help_text, **kw)
-
-    def info(self, name: str, help_text: str = "") -> Info:
-        return self._get(Info, name, help_text)
-
-    def render(self) -> str:
-        with self._lock:
-            metrics = list(self._metrics.values())
-        out: list[str] = []
-        for m in metrics:
-            out.extend(m.render())
-        return "\n".join(out) + "\n"
-
-    def snapshot(self) -> dict:
-        """JSON-able view for in-process consumers (tests, load bench)."""
-        with self._lock:
-            metrics = dict(self._metrics)
-        out: dict = {}
-        for name, m in metrics.items():
-            if isinstance(m, Histogram):
-                out[name] = {
-                    "count": m.count,
-                    "sum": m.sum,
-                    "max": m.max,
-                    "mean": m.mean(),
-                    "p50": m.quantile(0.5),
-                    "p99": m.quantile(0.99),
-                }
-            else:
-                out[name] = m.value
-        return out
+from kindel_tpu.obs.metrics import (  # noqa: F401 — serve import surface
+    Counter,
+    Gauge,
+    Histogram,
+    Info,
+    MetricsRegistry,
+    MultiRegistry,
+    _fmt,
+    default_registry,
+    escape_help,
+    escape_label_value,
+)
 
 
 class ServeHTTPServer:
     """`/metrics` + `/healthz` (+ caller-supplied POST routes) on a
     stdlib ThreadingHTTPServer running on a daemon thread.
 
-    `health_fn` returns the /healthz JSON document; `post_routes` maps
-    a path to `fn(body: bytes) -> (status, content_type, body_bytes,
-    extra_headers)` — the consensus ingest endpoint plugs in here so the
-    metrics module stays transport-only.
+    `registry` is anything with a `render()` — a MetricsRegistry or a
+    MultiRegistry union view; `health_fn` returns the /healthz JSON
+    document; `post_routes` maps a path to `fn(body: bytes) -> (status,
+    content_type, body_bytes, extra_headers)` — the consensus ingest
+    endpoint plugs in here so the metrics module stays transport-only.
     """
 
     #: refuse request bodies past this size before allocating (the serve
@@ -271,7 +49,7 @@ class ServeHTTPServer:
     #: untrusted input" rule — docs/DESIGN.md §8)
     MAX_BODY_BYTES = 1 << 30
 
-    def __init__(self, registry: MetricsRegistry, host: str = "127.0.0.1",
+    def __init__(self, registry, host: str = "127.0.0.1",
                  port: int = 0, health_fn=None, post_routes: dict | None = None):
         self.registry = registry
         self._health_fn = health_fn or (lambda: {"status": "ok"})

@@ -23,6 +23,8 @@ from collections import deque
 from concurrent.futures import Future
 from dataclasses import dataclass, field
 
+from kindel_tpu.obs import trace
+
 
 class AdmissionError(RuntimeError):
     """Request rejected at the door; retry after `retry_after_s`."""
@@ -42,7 +44,14 @@ class ServeRequest:
 
     `payload` is a path (str/Path) or raw SAM/BAM bytes; `opts` is the
     cohort BatchOptions the worker will call with; `deadline` is an
-    absolute monotonic timestamp or None.
+    absolute monotonic timestamp or None. `span` is the request's root
+    trace span (`serve.request`, opened at admission) — the handle every
+    downstream stage parents its own span to, which is how one request's
+    trace id propagates queue → batcher → worker → device dispatch
+    across four threads; `wait_span` is the open `serve.queue_wait`
+    child between enqueue and intake pop. Both default None and stay
+    None when the request never passed through a queue (direct
+    component tests) or when tracing is disabled (the no-op span).
     """
 
     payload: object
@@ -50,6 +59,8 @@ class ServeRequest:
     future: Future = field(default_factory=Future)
     enqueued_at: float = 0.0
     deadline: float | None = None
+    span: object = None
+    wait_span: object = None
 
 
 class RequestQueue:
@@ -112,35 +123,67 @@ class RequestQueue:
 
     def submit(self, req: ServeRequest) -> None:
         """Admit or reject. Raises AdmissionError past the watermark or
-        when the request's deadline is already infeasible."""
+        when the request's deadline is already infeasible. Opens the
+        request's root trace span plus its admission / queue-wait
+        children (all shared no-op spans when tracing is disabled)."""
         now = self._clock()
-        with self._not_empty:
-            if self._closed:
-                raise AdmissionError("service is shutting down", 1.0)
-            depth = len(self._q)
-            if depth >= self.high_watermark:
-                if self._rejects is not None:
-                    self._rejects.inc()
-                retry = self.estimated_wait_s(depth - self.high_watermark + 1)
-                raise AdmissionError(
-                    f"queue depth {depth} at/over watermark "
-                    f"{self.high_watermark}", max(retry, 0.05),
-                )
-            if req.deadline is not None:
-                budget = req.deadline - now
-                est = self.estimated_wait_s(depth + 1)
-                if budget <= 0 or est > budget:
+        if req.span is None:
+            req.span = trace.start_span("serve.request")
+            if req.span is not trace.NOOP_SPAN:
+                payload = req.payload
+                if isinstance(payload, (bytes, bytearray)):
+                    req.span.set_attribute(
+                        payload="<bytes>", payload_bytes=len(payload)
+                    )
+                else:
+                    req.span.set_attribute(payload=str(payload))
+        traced = req.span is not None and req.span is not trace.NOOP_SPAN
+        adm = trace.start_span("serve.admission", parent=req.span)
+        try:
+            with self._not_empty:
+                if self._closed:
+                    raise AdmissionError("service is shutting down", 1.0)
+                depth = len(self._q)
+                if traced:
+                    adm.set_attribute(depth=depth)
+                if depth >= self.high_watermark:
                     if self._rejects is not None:
                         self._rejects.inc()
-                    raise AdmissionError(
-                        f"deadline budget {budget:.3f}s < estimated wait "
-                        f"{est:.3f}s", max(est - max(budget, 0), 0.05),
+                    retry = self.estimated_wait_s(
+                        depth - self.high_watermark + 1
                     )
-            req.enqueued_at = now
-            self._q.append(req)
-            if self._depth_gauge is not None:
-                self._depth_gauge.set(len(self._q))
-            self._not_empty.notify()
+                    raise AdmissionError(
+                        f"queue depth {depth} at/over watermark "
+                        f"{self.high_watermark}", max(retry, 0.05),
+                    )
+                if req.deadline is not None:
+                    budget = req.deadline - now
+                    est = self.estimated_wait_s(depth + 1)
+                    if budget <= 0 or est > budget:
+                        if self._rejects is not None:
+                            self._rejects.inc()
+                        raise AdmissionError(
+                            f"deadline budget {budget:.3f}s < estimated wait "
+                            f"{est:.3f}s", max(est - max(budget, 0), 0.05),
+                        )
+                req.enqueued_at = now
+                self._q.append(req)
+                if self._depth_gauge is not None:
+                    self._depth_gauge.set(len(self._q))
+                req.wait_span = trace.start_span(
+                    "serve.queue_wait", parent=req.span
+                )
+                self._not_empty.notify()
+        except AdmissionError as e:
+            if traced:
+                adm.set_attribute(outcome="rejected")
+                adm.finish()
+                req.span.set_attribute(outcome="rejected", error=str(e))
+                req.span.finish()
+            raise
+        if traced:
+            adm.set_attribute(outcome="admitted")
+        adm.finish()
 
     def get(self, timeout: float | None = None) -> ServeRequest | None:
         """Pop the oldest live request; None on timeout or close.
@@ -166,7 +209,15 @@ class RequestQueue:
                                 f"({self._clock() - req.enqueued_at:.3f}s)"
                             )
                         )
+                        if req.wait_span is not None:
+                            req.wait_span.set_attribute(outcome="expired")
+                            req.wait_span.finish()
+                        if req.span is not None:
+                            req.span.set_attribute(outcome="expired")
+                            req.span.finish()
                         continue
+                    if req.wait_span is not None:
+                        req.wait_span.finish()
                     return req
                 if self._closed:
                     return None

@@ -22,8 +22,14 @@ from dataclasses import replace
 
 from kindel_tpu.batch import BatchOptions, SampleResult
 
+from kindel_tpu.obs import runtime as obs_runtime
 from kindel_tpu.serve.batcher import MicroBatcher
-from kindel_tpu.serve.metrics import MetricsRegistry, ServeHTTPServer
+from kindel_tpu.serve.metrics import (
+    MetricsRegistry,
+    MultiRegistry,
+    ServeHTTPServer,
+    default_registry,
+)
 from kindel_tpu.serve.queue import (
     AdmissionError,
     DeadlineExceeded,
@@ -124,6 +130,9 @@ class ConsensusService:
 
     def start(self) -> "ConsensusService":
         self._started_at = time.monotonic()
+        # fold JAX compile wall-time into the default registry so the
+        # /metrics exposition attributes cold-start cost (best-effort)
+        obs_runtime.install()
         self.worker.start()
         if self._do_warmup and self._warm_thread is None:
             self._warm_state = "warming"
@@ -132,8 +141,15 @@ class ConsensusService:
             )
             self._warm_thread.start()
         if self._http_port is not None:
+            # exposition = the service's own registry + the process-global
+            # one (streaming/batch/tune/runtime metrics), device gauges
+            # refreshed per scrape
             self._http = ServeHTTPServer(
-                self.metrics, host=self._http_host, port=self._http_port,
+                MultiRegistry(
+                    self.metrics, default_registry(),
+                    refresh=obs_runtime.update_device_gauges,
+                ),
+                host=self._http_host, port=self._http_port,
                 health_fn=self.healthz,
                 post_routes={"/v1/consensus": self._handle_consensus_post},
             ).start()

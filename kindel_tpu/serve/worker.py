@@ -33,6 +33,8 @@ from kindel_tpu.batch import (
     launch_cohort_kernel,
     pack_cohort,
 )
+from kindel_tpu.obs import runtime as obs_runtime
+from kindel_tpu.obs import trace
 from kindel_tpu.pileup_jax import _bucket
 from kindel_tpu.utils.profiling import maybe_phase
 
@@ -44,6 +46,11 @@ def _payload_label(payload) -> str:
     return "<bytes>" if isinstance(payload, (bytes, bytearray)) else str(
         payload
     )
+
+
+def _shape_label(shapes: tuple) -> str:
+    """Lane pad shapes as one metric-label-safe token ("1024x64x...")."""
+    return "x".join(str(s) for s in shapes)
 
 
 def decode_request(req: ServeRequest) -> list:
@@ -90,6 +97,7 @@ class ServeWorker:
         self._dispatch_thread: threading.Thread | None = None
         self._draining = False
         self._stopped = False
+        self._flush_seq = 0
         if metrics is not None:
             self._m_requests = metrics.counter(
                 "kindel_serve_requests_total", "requests accepted"
@@ -119,10 +127,20 @@ class ServeWorker:
                 "kindel_serve_batcher_pending_rows",
                 "decoded rows waiting to coalesce",
             )
+            self._m_outcomes = metrics.counter(
+                "kindel_serve_requests_outcome_total",
+                "completed requests by outcome label (ok/error)",
+            )
+            self._m_dispatch_s = metrics.histogram(
+                "kindel_serve_dispatch_seconds",
+                "wall time of one batched dispatch (pack + launch + "
+                "assemble), labeled by coalescing-lane shape",
+            )
         else:
             self._m_requests = self._m_failed = self._m_dispatches = None
             self._m_batch_retries = None
             self._m_occupancy = self._m_latency = self._m_pending_rows = None
+            self._m_outcomes = self._m_dispatch_s = None
 
     # ------------------------------------------------------------ lifecycle
 
@@ -175,13 +193,18 @@ class ServeWorker:
             self._decode_pool.submit(self._decode_one, req)
 
     def _decode_one(self, req: ServeRequest) -> None:
-        try:
-            units = decode_request(req)
-        except BaseException as e:  # noqa: BLE001 — isolation boundary
-            _fail(req, e)
-            if self._m_failed is not None:
-                self._m_failed.inc()
-            return
+        sp = trace.span("serve.decode", parent=req.span)
+        traced = sp is not trace.NOOP_SPAN
+        with sp:
+            try:
+                units = decode_request(req)
+            except BaseException as e:  # noqa: BLE001 — isolation boundary
+                if traced:
+                    sp.set_attribute(outcome="error", error=repr(e))
+                self._fail(req, e)
+                return
+            if traced:
+                sp.set_attribute(units=len(units))
         if not units:
             # no aligned reads: a legitimate empty result, same as
             # bam_to_consensus on a read-less file
@@ -208,10 +231,14 @@ class ServeWorker:
                 self._m_pending_rows.set(self.batcher.pending_rows)
 
     def _execute(self, flush: Flush) -> None:
+        self._flush_seq += 1
+        flush_id = self._flush_seq
+        t0 = time.perf_counter()
+        launch_window: dict = {}
         try:
             with maybe_phase("serve dispatch+assemble"):
                 outputs, units = self._run_entries(
-                    flush.entries, flush.opts, flush.shapes
+                    flush.entries, flush.opts, flush.shapes, launch_window
                 )
         except Exception:
             # batch-level failure: isolate by re-running one request at a
@@ -222,25 +249,64 @@ class ServeWorker:
                 if self._m_dispatches is not None:
                     self._m_dispatches.inc()
                     self._m_occupancy.observe(1)
+                e_t0 = time.perf_counter()
+                e_launch: dict = {}
                 try:
                     outputs, units = self._run_entries(
-                        [entry], flush.opts, None
+                        [entry], flush.opts, None, e_launch
                     )
                 except BaseException as e:  # noqa: BLE001
-                    _fail(entry[0], e)
-                    if self._m_failed is not None:
-                        self._m_failed.inc()
+                    self._fail(entry[0], e)
                     continue
+                self._record_flush_spans(
+                    [entry], flush, flush_id, e_t0, time.perf_counter(),
+                    e_launch, occupancy=1, isolated=True,
+                )
                 self._complete_entries([entry], units, outputs, flush.opts)
             return
+        t1 = time.perf_counter()
         if self._m_dispatches is not None:
             self._m_dispatches.inc()
             self._m_occupancy.observe(len(flush.entries))
+            self._m_dispatch_s.labels(
+                shape=_shape_label(flush.shapes)
+            ).observe(t1 - t0)
+        self._record_flush_spans(
+            flush.entries, flush, flush_id, t0, t1, launch_window,
+            occupancy=len(flush.entries),
+        )
         self._complete_entries(flush.entries, units, outputs, flush.opts)
 
-    def _run_entries(self, entries, opts, shapes):
+    def _record_flush_spans(self, entries, flush, flush_id, t0, t1,
+                            launch_window, occupancy,
+                            isolated: bool = False) -> None:
+        """Record the shared flush as a `serve.batch_dispatch` +
+        `serve.device_launch` pair in EVERY member request's span tree —
+        the shared micro-batch launch is part of each request's story,
+        so each tree carries a copy stamped with the common flush_id."""
+        if trace.active_tracer() is None:
+            return
+        shape = _shape_label(flush.shapes)
+        for req, _req_units in entries:
+            dsp = trace.record_span(
+                "serve.batch_dispatch", req.span, t0, t1,
+                flush_id=flush_id, occupancy=occupancy,
+                rows=flush.n_rows, lane_shape=shape, isolated=isolated,
+            )
+            trace.record_span(
+                "serve.device_launch", dsp,
+                launch_window.get("t0", t0), launch_window.get("t1", t1),
+                flush_id=flush_id, lane_shape=shape,
+                compiled_new=launch_window.get("compiled_new", 0),
+                h2d_bytes=launch_window.get("h2d_bytes", 0),
+            )
+
+    def _run_entries(self, entries, opts, shapes, launch_window=None):
         """Pack + launch + assemble one coalesced batch. Returns
-        (per-unit outputs, flat unit list in row order)."""
+        (per-unit outputs, flat unit list in row order); `launch_window`
+        (when given) receives the pack+launch interval, the jit
+        cache-entry delta, and the upload byte count for the dispatch
+        span."""
         units = []
         paths = []
         for idx, (req, req_units) in enumerate(entries):
@@ -249,8 +315,18 @@ class ServeWorker:
                 units.append(u)
             paths.append(_payload_label(req.payload))
         n_rows = _bucket(len(units), self.row_bucket)
+        probing = launch_window is not None and trace.active_tracer() is not None
+        if probing:
+            cache_before = obs_runtime.jit_cache_entries()
+            launch_window["t0"] = time.perf_counter()
         arrays, meta = pack_cohort(units, opts, n_rows=n_rows, shapes=shapes)
         device_out = launch_cohort_kernel(arrays, meta, opts)
+        if probing:
+            launch_window["t1"] = time.perf_counter()
+            launch_window["compiled_new"] = (
+                obs_runtime.jit_cache_entries() - cache_before
+            )
+            launch_window["h2d_bytes"] = sum(a.nbytes for a in arrays)
         outputs = _assemble_outputs(
             units, device_out, opts, self._assemble_pool, paths
         )
@@ -265,12 +341,28 @@ class ServeWorker:
         latency = self._clock() - req.enqueued_at
         if self._m_latency is not None:
             self._m_latency.observe(latency)
+            self._m_outcomes.labels(outcome="ok").inc()
         self.queue.observe_service_time(latency)
+        sp = req.span
+        if sp is not None and sp is not trace.NOOP_SPAN:
+            sp.set_attribute(outcome="ok", latency_s=round(latency, 6))
+            sp.finish()
         if not req.future.set_running_or_notify_cancel():
             return  # caller cancelled while queued
         req.future.set_result(result)
 
+    def _fail(self, req: ServeRequest, exc: BaseException) -> None:
+        """Fail one request's future, counting and closing its trace."""
+        if self._m_failed is not None:
+            self._m_failed.inc()
+            self._m_outcomes.labels(outcome="error").inc()
+        _fail(req, exc)
+
 
 def _fail(req: ServeRequest, exc: BaseException) -> None:
+    sp = req.span
+    if sp is not None and sp is not trace.NOOP_SPAN:
+        sp.set_attribute(outcome="error", error=repr(exc))
+        sp.finish()
     if req.future.set_running_or_notify_cancel():
         req.future.set_exception(exc)

@@ -28,10 +28,32 @@ import numpy as np
 
 from kindel_tpu.events import N_CHANNELS, extract_events
 from kindel_tpu.io.stream import DEFAULT_CHUNK_BYTES, stream_alignment
+from kindel_tpu.obs import trace as obs_trace
+from kindel_tpu.obs.metrics import default_registry
 from kindel_tpu.pileup import (
     Pileup,
     insertion_table_from_counter,
 )
+
+
+def _stream_reduce(acc, path, chunk_bytes) -> None:
+    """Drive the chunked decode→reduce loop under one span, counting
+    chunks into the process-global registry (the serve/bench exposition
+    sees streamed work too)."""
+    chunks = default_registry().counter(
+        "kindel_stream_chunks_total",
+        "streamed decode chunks reduced into accumulator state",
+    )
+    with obs_trace.span("stream.reduce") as sp:
+        n = 0
+        for batch in stream_alignment(path, chunk_bytes):
+            acc.add_batch(batch)
+            n += 1
+        chunks.inc(n)
+        if sp is not obs_trace.NOOP_SPAN:
+            sp.set_attribute(
+                chunks=n, chunk_bytes=chunk_bytes, refs=len(acc.present)
+            )
 
 #: hard framework-wide limit of the int32 flat-index scatter scheme
 #: (jax's default x64-off mode): L·N_CHANNELS must stay addressable
@@ -281,8 +303,7 @@ def stream_pileups(
     acc = StreamAccumulator(
         backend=backend, full=True, clip_weights=clip_weights
     )
-    for batch in stream_alignment(path, chunk_bytes):
-        acc.add_batch(batch)
+    _stream_reduce(acc, path, chunk_bytes)
     return {acc.ref_names[rid]: acc.pileup(rid) for rid in acc.present}
 
 
@@ -337,8 +358,7 @@ def streamed_consensus(
     # path keeps everything on device until the packed wire download
     full = realign or backend != "jax"
     acc = StreamAccumulator(backend=backend, full=full)
-    for batch in stream_alignment(bam_path, chunk_bytes):
-        acc.add_batch(batch)
+    _stream_reduce(acc, bam_path, chunk_bytes)
 
     consensuses, refs_changes, refs_reports = [], {}, {}
     for rid in acc.present:
@@ -418,8 +438,7 @@ def _streamed_sharded_consensus(
     from kindel_tpu.workloads import build_report, result
 
     acc = ShardedStreamAccumulator(mesh=mesh, full=realign)
-    for batch in stream_alignment(bam_path, chunk_bytes):
-        acc.add_batch(batch)
+    _stream_reduce(acc, bam_path, chunk_bytes)
 
     consensuses, refs_changes, refs_reports = [], {}, {}
     for rid in acc.present:

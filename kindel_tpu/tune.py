@@ -246,10 +246,26 @@ def search_slabs(measure, clamp: int, budget_s: float,
     the wall budget is spent. Returns (chosen, {slabs: seconds})."""
     if clamp <= 1:
         return 1, {}
+    from kindel_tpu.obs import trace as obs_trace
+    from kindel_tpu.obs.metrics import default_registry
+
+    probe_s = default_registry().histogram(
+        "kindel_tune_probe_seconds",
+        "wall time of one slab-search measurement probe",
+    )
+
+    def probe(slabs: int) -> float:
+        with obs_trace.span("tune.probe") as sp:
+            wall = measure(slabs)
+            probe_s.observe(wall)
+            if sp is not obs_trace.NOOP_SPAN:
+                sp.set_attribute(slabs=slabs, wall_s=round(wall, 4))
+        return wall
+
     timings: dict[int, float] = {}
     t0 = clock()
     for slabs in sorted({min(s, clamp) for s in grid}):
-        timings[slabs] = measure(slabs)
+        timings[slabs] = probe(slabs)
         if clock() - t0 > budget_s:
             break  # cold-cache compiles ran long: pick from what we have
     while clock() - t0 <= budget_s:
@@ -257,7 +273,7 @@ def search_slabs(measure, clamp: int, budget_s: float,
         nxt = min(best * 2, clamp, max_slabs)
         if best != max(timings) or nxt <= best or nxt in timings:
             break
-        timings[nxt] = measure(nxt)
+        timings[nxt] = probe(nxt)
     return min(timings, key=timings.get), timings
 
 
@@ -371,6 +387,18 @@ def resolve(explicit: TuningConfig | None = None, backend: str = "cpu",
     n_slabs, s1 = resolve_slabs(e.n_slabs, backend, max_contig)
     chunk, s2 = resolve_stream_chunk_mb(e.stream_chunk_mb, bam_path)
     budget, s3 = resolve_cohort_budget_mb(e.cohort_budget_mb)
+    # knob provenance into the shared exposition: one Info sample per
+    # (knob, source, value) — the serve /metrics and bench snapshots show
+    # WHERE each performance knob came from, not just its value
+    from kindel_tpu.obs.metrics import default_registry
+
+    info = default_registry().info(
+        "kindel_tune_resolution",
+        "tuning-knob resolution provenance (knob/source/value)",
+    )
+    info.set(knob="n_slabs", source=s1, value=str(n_slabs))
+    info.set(knob="stream_chunk_mb", source=s2, value=str(chunk))
+    info.set(knob="cohort_budget_mb", source=s3, value=str(budget))
     return TuningConfig(
         n_slabs=n_slabs, stream_chunk_mb=chunk, cohort_budget_mb=budget,
         sources=(("n_slabs", s1), ("stream_chunk_mb", s2),

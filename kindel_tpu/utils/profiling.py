@@ -1,10 +1,19 @@
-"""Per-phase wall-time instrumentation + optional XLA profiler traces.
+"""Per-phase wall-time instrumentation — compatibility shim over
+kindel_tpu.obs.
 
 The reference's only runtime observability was two tqdm progress bars
-(/root/reference/kindel/kindel.py:40,390 — SURVEY §5). kindel-tpu replaces
-them with structured phase timing (`--profile` on the CLI prints the table
-to stderr) and, when KINDEL_TPU_TRACE_DIR is set, a JAX profiler trace of
-the device phases viewable in TensorBoard/Perfetto.
+(/root/reference/kindel/kindel.py:40,390 — SURVEY §5). kindel-tpu grew
+structured phase timing here first (`--profile` prints the table to
+stderr), then a full span tracer (kindel_tpu.obs.trace, `--trace PATH`
+on every subcommand). This module is now the thin bridge between the
+two: `maybe_phase` records each phase into BOTH the active PhaseTimer
+(the human-readable table) and the active span tracer (the machine-
+readable tree), so the instrumentation sites in workloads/serve stay
+single-sourced. When KINDEL_TPU_TRACE_DIR is set, `start_trace` also
+opens a JAX profiler trace of the device phases viewable in
+TensorBoard/Perfetto — the env var is resolved at trace-start time,
+never cached at construction (tests/test_env_guard.py pins the
+no-`__init__`-env-caching rule for instrumented classes).
 """
 
 from __future__ import annotations
@@ -14,6 +23,8 @@ import sys
 import threading
 import time
 from contextlib import contextmanager
+
+from kindel_tpu.obs import trace as _trace
 
 
 class PhaseTimer:
@@ -27,7 +38,10 @@ class PhaseTimer:
     def __init__(self):
         self.phases: list[tuple[str, float]] = []
         self._phases_lock = threading.Lock()
-        self._trace_dir = os.environ.get("KINDEL_TPU_TRACE_DIR")
+        # the XLA trace dir resolves at start_trace() time, NOT here: an
+        # env var exported between construction and start must win, and
+        # instrumented classes must never cache ambient env state
+        self._trace_dir: str | None = None
         self._tracing = False
 
     @contextmanager
@@ -40,10 +54,12 @@ class PhaseTimer:
                 self.phases.append((name, time.perf_counter() - start))
 
     def start_trace(self):
-        if self._trace_dir and not self._tracing:
+        trace_dir = os.environ.get("KINDEL_TPU_TRACE_DIR")
+        if trace_dir and not self._tracing:
             import jax
 
-            jax.profiler.start_trace(self._trace_dir)
+            jax.profiler.start_trace(trace_dir)
+            self._trace_dir = trace_dir
             self._tracing = True
 
     def stop_trace(self):
@@ -52,6 +68,16 @@ class PhaseTimer:
 
             jax.profiler.stop_trace()
             self._tracing = False
+
+    def totals(self) -> dict[str, float]:
+        """Per-phase wall totals, aggregated by name (bench embeds this
+        in its JSON line)."""
+        with self._phases_lock:
+            phases = list(self.phases)
+        out: dict[str, float] = {}
+        for name, dur in phases:
+            out[name] = out.get(name, 0.0) + dur
+        return out
 
     def report(self) -> str:
         with self._phases_lock:
@@ -91,10 +117,12 @@ def disable_profiling() -> None:
 
 @contextmanager
 def maybe_phase(name: str):
-    """Record `name` against the active timer (no-op when disabled)."""
+    """Record `name` against the active timer AND as a span against the
+    active tracer (each independently a no-op when disabled)."""
     timer = _active
-    if timer is None:
-        yield
-    else:
-        with timer.phase(name):
+    with _trace.span(name):
+        if timer is None:
             yield
+        else:
+            with timer.phase(name):
+                yield

@@ -261,6 +261,38 @@ def bam_to_consensus(
     performance knobs (slab count, stream chunk) explicitly — the top of
     the explicit > env > store > default resolution order.
     """
+    from kindel_tpu.obs import trace as obs_trace
+
+    with obs_trace.span("workload.bam_to_consensus") as sp:
+        if sp is not obs_trace.NOOP_SPAN:
+            sp.set_attribute(
+                bam_path=str(bam_path), backend=backend, realign=realign
+            )
+        return _bam_to_consensus(
+            bam_path, realign=realign, min_depth=min_depth,
+            min_overlap=min_overlap,
+            clip_decay_threshold=clip_decay_threshold, mask_ends=mask_ends,
+            trim_ends=trim_ends, uppercase=uppercase, backend=backend,
+            stream_chunk_mb=stream_chunk_mb, cdr_gap=cdr_gap,
+            fix_clip_artifacts=fix_clip_artifacts, tuning=tuning,
+        )
+
+
+def _bam_to_consensus(
+    bam_path,
+    realign: bool = False,
+    min_depth: int = 1,
+    min_overlap: int = 9,
+    clip_decay_threshold: float = 0.1,
+    mask_ends: int = 50,
+    trim_ends: bool = False,
+    uppercase: bool = False,
+    backend: str = "numpy",
+    stream_chunk_mb: float | None = None,
+    cdr_gap: int = 0,
+    fix_clip_artifacts: bool = False,
+    tuning=None,
+):
     from kindel_tpu.pileup import build_pileup
     from kindel_tpu.utils.profiling import maybe_phase
 

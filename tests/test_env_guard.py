@@ -1,7 +1,19 @@
-"""Tier-1 guard: tuning knobs resolve at config-build time, never at
-trace time — no `os.environ` / `os.getenv` read may appear inside a
-jit-decorated function body anywhere in kindel_tpu/ (the refactor
-invariant of the tune subsystem, kindel_tpu/tune.py).
+"""Tier-1 AST guards over kindel_tpu/ — invariants that are cheap to
+state and expensive to debug when broken:
+
+  1. tuning knobs resolve at config-build time, never at trace time —
+     no `os.environ` / `os.getenv` read inside a jit-decorated body
+     (the refactor invariant of the tune subsystem, kindel_tpu/tune.py);
+  2. no env read inside `__init__` either — instrumented classes must
+     not cache ambient env state at construction (the PhaseTimer
+     trace-dir bug: an env var exported between construction and
+     trace-start silently lost);
+  3. durations come from `time.perf_counter()` — `time.time()` is a
+     wall clock subject to NTP steps and is banned except for an
+     explicit timestamp allowlist;
+  4. every metric registered through an obs registry carries help text
+     (also enforced at runtime by MetricsRegistry, but the static guard
+     catches sites the tests never execute).
 
 An env read inside a traced body is doubly wrong: it only runs at trace
 time (so the knob silently stops responding once the kernel is cached),
@@ -69,3 +81,125 @@ def test_no_env_reads_inside_jit_traced_function_bodies():
     # the guard must actually be seeing the kernels: if this count ever
     # drops to ~0 the detector went blind, not the codebase clean
     assert jitted >= 8, f"only {jitted} jit-decorated functions found"
+
+
+def test_no_env_reads_inside_init_methods():
+    """Instrumented classes (PhaseTimer, tracers, workers) must resolve
+    env state where it is used, never cache it at construction — an env
+    var exported between __init__ and use must win."""
+    offenders = []
+    inits = 0
+    for py in sorted(PKG.rglob("*.py")):
+        tree = ast.parse(py.read_text(), filename=str(py))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for fn in node.body:
+                if (
+                    isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and fn.name == "__init__"
+                ):
+                    inits += 1
+                    for line in _env_read_lines(fn):
+                        offenders.append(
+                            f"{py.relative_to(PKG.parent)}:{line} "
+                            f"({node.name}.__init__)"
+                        )
+    assert not offenders, (
+        "os.environ read cached at __init__ time — resolve it where it "
+        "is used instead:\n" + "\n".join(offenders)
+    )
+    assert inits >= 10, f"only {inits} __init__ methods found"
+
+
+#: wall-clock *timestamps* (not durations) where time.time() is the
+#: point: the tune store's recorded_at field is read by humans
+_TIME_TIME_ALLOWLIST = {("tune.py", "record")}
+
+
+def test_no_time_time_for_durations():
+    """Durations must come from time.perf_counter() — time.time() is
+    subject to NTP steps/smearing, and a negative "duration" in a span
+    or a latency histogram is a debugging rabbit hole. Timestamp uses
+    must be allowlisted explicitly."""
+
+    def enclosing_functions(tree):
+        out = {}  # node -> function name
+
+        def visit(node, fname):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fname = node.name
+            out[node] = fname
+            for child in ast.iter_child_nodes(node):
+                visit(child, fname)
+
+        visit(tree, "<module>")
+        return out
+
+    offenders = []
+    for py in sorted(PKG.rglob("*.py")):
+        tree = ast.parse(py.read_text(), filename=str(py))
+        owners = enclosing_functions(tree)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (
+                isinstance(f, ast.Attribute)
+                and f.attr == "time"
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "time"
+            ):
+                continue
+            key = (py.name, owners.get(node, "<module>"))
+            if key in _TIME_TIME_ALLOWLIST:
+                continue
+            offenders.append(
+                f"{py.relative_to(PKG.parent)}:{node.lineno} "
+                f"(in {owners.get(node, '<module>')})"
+            )
+    assert not offenders, (
+        "time.time() used outside the timestamp allowlist — use "
+        "time.perf_counter() for durations:\n" + "\n".join(offenders)
+    )
+
+
+def test_metric_registrations_carry_help_text():
+    """Every `.counter(...)` / `.gauge(...)` / `.histogram(...)` /
+    `.info(...)` registration call passes help text (second positional
+    arg or help_text=), and a literal help string is non-empty — the
+    exposition renders `# HELP` verbatim, and a blank one is useless to
+    whoever is staring at the dashboard."""
+    offenders = []
+    registrations = 0
+    for py in sorted(PKG.rglob("*.py")):
+        tree = ast.parse(py.read_text(), filename=str(py))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (
+                isinstance(f, ast.Attribute)
+                and f.attr in ("counter", "gauge", "histogram", "info")
+            ):
+                continue
+            registrations += 1
+            help_arg = None
+            if len(node.args) >= 2:
+                help_arg = node.args[1]
+            else:
+                for kw in node.keywords:
+                    if kw.arg == "help_text":
+                        help_arg = kw.value
+            loc = f"{py.relative_to(PKG.parent)}:{node.lineno}"
+            if help_arg is None:
+                offenders.append(f"{loc} (.{f.attr} without help text)")
+            elif isinstance(help_arg, ast.Constant) and not help_arg.value:
+                offenders.append(f"{loc} (.{f.attr} with empty help)")
+    assert not offenders, (
+        "metric registered without help text:\n" + "\n".join(offenders)
+    )
+    # blindness check, as for the jit guard above
+    assert registrations >= 15, (
+        f"only {registrations} registration calls found"
+    )
