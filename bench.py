@@ -124,6 +124,25 @@ def _max_ref_len(bam: Path) -> int:
         return 0
 
 
+def _resilience_counts(snapshot: dict) -> dict:
+    """Sum the process-global resilience counters across their label
+    children: {"retry_total", "degrade_total", "breaker_trips",
+    "numpy_fallbacks"} — all 0 on a clean run."""
+
+    def total(prefix: str) -> int:
+        return sum(
+            int(v) for k, v in snapshot.items()
+            if k == prefix or k.startswith(prefix + "{")
+        )
+
+    return {
+        "retry_total": total("kindel_retry_total"),
+        "degrade_total": total("kindel_degrade_total"),
+        "breaker_trips": total("kindel_breaker_trips_total"),
+        "numpy_fallbacks": total("kindel_fallback_numpy_total"),
+    }
+
+
 def _run_benchmark() -> dict:
     """The measured pipeline. Runs only in a child process (jax imported
     here, never in the parent)."""
@@ -298,6 +317,10 @@ def _run_benchmark() -> dict:
                 if not k.startswith("kindel_jax_compile_seconds")
             },
         },
+        # resilience posture (kindel_tpu.resilience): a round that only
+        # hit its number by retrying/degrading is not comparable to a
+        # clean one — the trajectory must be able to tell them apart
+        "resilience": _resilience_counts(default_registry().snapshot()),
     }
     if tune:
         result["tune_s"] = {str(k): round(v, 3) for k, v in tune.items()}

@@ -50,6 +50,8 @@ from kindel_tpu.obs import runtime as obs_runtime
 from kindel_tpu.obs import trace as obs_trace
 from kindel_tpu.pileup_jax import PAD_POS, _bucket, _pad, check_pad_safe_block
 from kindel_tpu.realign import LazyCdrWindows
+from kindel_tpu.resilience import faults as rfaults
+from kindel_tpu.resilience import policy as rpolicy
 
 
 @dataclass
@@ -335,6 +337,7 @@ def launch_cohort_kernel(arrays, meta, opts: BatchOptions, sharding=None):
     Returns the (out, meta) pair _assemble_outputs consumes."""
     import jax
 
+    rfaults.hook("device.dispatch")
     L, _d_pad, _i_pad = meta
     h2d_bytes = sum(int(a.nbytes) for a in arrays)
     obs_runtime.transfer_counters()[0].inc(h2d_bytes)
@@ -496,7 +499,18 @@ class _GroupedDispatch:
     asynchronously at construction, each subsequent group launched
     before the previous one's assembly — at most two groups of device
     tensors are live at once. Output order matches `units` regardless
-    of the size-sorted grouping."""
+    of the size-sorted grouping.
+
+    Resilience (kindel_tpu.resilience): launches retry transient device
+    errors with backoff; a failure surfacing at download/assembly (where
+    a real XLA OOM materializes, since dispatch is async) re-dispatches
+    the group — bisected in half on OOM, so a group whose padded
+    footprint no longer fits (e.g. after another process grabbed HBM)
+    degrades to smaller dispatches instead of failing the cohort."""
+
+    #: bisection/redispatch recursion bound: past this the failure is
+    #: not transient pressure, it is the environment — propagate
+    MAX_RECOVERY_DEPTH = 4
 
     def __init__(self, units, opts: BatchOptions):
         self.units = units
@@ -505,15 +519,46 @@ class _GroupedDispatch:
         self._pos = 0
         self._pending = self._dispatch_next()
 
+    def _launch(self, idxs):
+        units = [self.units[i] for i in idxs]
+        return rpolicy.default_policy().run(
+            "batch.cohort",
+            lambda: _dispatch_device_call(units, self.opts),
+        )
+
     def _dispatch_next(self):
         if self._pos >= len(self.groups):
             return None
         g = self.groups[self._pos]
         self._pos += 1
-        return (
-            g,
-            _dispatch_device_call([self.units[i] for i in g], self.opts),
-        )
+        return (g, self._launch(g))
+
+    def _assemble_group(self, idxs, out, pool, paths, depth=0) -> list:
+        """_assemble_outputs for one dispatched group, re-dispatching
+        (bisected on OOM) when the device call it blocks on failed."""
+        units = [self.units[i] for i in idxs]
+        try:
+            return _assemble_outputs(units, out, self.opts, pool, paths)
+        except Exception as e:
+            if depth >= self.MAX_RECOVERY_DEPTH or not rpolicy.is_transient(e):
+                raise
+            if rpolicy.is_oom(e) and len(idxs) > 1:
+                rpolicy.record_degrade("batch.cohort", "bisect", depth + 1)
+                mid = len(idxs) // 2
+                parts = [idxs[:mid], idxs[mid:]]
+            else:
+                rpolicy.record_degrade(
+                    "batch.cohort", "redispatch", depth + 1
+                )
+                parts = [idxs]
+            outs: list = []
+            for part in parts:
+                outs.extend(
+                    self._assemble_group(
+                        part, self._launch(part), pool, paths, depth + 1
+                    )
+                )
+            return outs
 
     def assemble(self, pool, paths=None) -> list:
         from kindel_tpu.utils.progress import Progress
@@ -529,10 +574,7 @@ class _GroupedDispatch:
             while self._pending is not None:
                 idxs, out = self._pending
                 self._pending = self._dispatch_next()
-                outs = _assemble_outputs(
-                    [self.units[i] for i in idxs], out, self.opts, pool,
-                    paths,
-                )
+                outs = self._assemble_group(idxs, out, pool, paths)
                 for i, o in zip(idxs, outs):
                     results[i] = o
                 done += len(idxs)

@@ -37,6 +37,13 @@ def _progress_parent() -> argparse.ArgumentParser:
              ".json -> Perfetto/chrome://tracing trace_event document, any "
              "other suffix -> JSONL (one span per line)",
     )
+    p.add_argument(
+        "--faults", metavar="SPEC", default=argparse.SUPPRESS,
+        help="activate a seeded fault-injection plan for chaos testing "
+             "(kindel_tpu.resilience), e.g. "
+             "'seed=7,device.dispatch:oom:2,io.read_chunk:truncate'; "
+             "overrides $KINDEL_TPU_FAULTS (see docs/usage.md)",
+    )
     return p
 
 
@@ -712,6 +719,17 @@ def main(argv=None) -> int:
         import os
 
         os.environ["KINDEL_TPU_PROGRESS"] = "1"
+    # fault injection activates exactly once, at startup — the hot-path
+    # hooks themselves never look at the environment
+    faults_spec = getattr(args, "faults", None)
+    if faults_spec is not None:
+        from kindel_tpu.resilience import FaultPlan, activate
+
+        activate(FaultPlan.parse(faults_spec))
+    else:
+        from kindel_tpu.resilience import activate_from_env
+
+        activate_from_env()
     if args.command == "version":
         print(f"kindel-tpu {__version__}")
         return 0

@@ -6,6 +6,7 @@ from pathlib import Path
 
 from kindel_tpu.io import bgzf
 from kindel_tpu.io.bam import parse_bam_bytes
+from kindel_tpu.io.errors import TruncatedInputError  # noqa: F401
 from kindel_tpu.io.records import ReadBatch
 from kindel_tpu.io.sam import parse_sam_bytes
 

@@ -18,6 +18,7 @@ import struct
 
 import numpy as np
 
+from kindel_tpu.io.errors import TruncatedInputError
 from kindel_tpu.io.records import ReadBatch, ragged_indices, ragged_local_offsets
 
 #: BAM 4-bit sequence code → ASCII (SAM spec table)
@@ -86,9 +87,16 @@ def parse_bam_bytes(data: bytes) -> ReadBatch:
     n = len(data)
     while off + 4 <= n:
         block_size = struct.unpack_from("<i", data, off)[0]
-        if block_size < 32 or off + 4 + block_size > n:
+        if block_size < 32:
             raise ValueError(
                 f"corrupt BAM record at byte {off}: block_size={block_size}"
+            )
+        if off + 4 + block_size > n:
+            # the record claims bytes past the end of the stream: the
+            # typed truncation error names where the input died
+            raise TruncatedInputError(
+                f"truncated BAM record (block_size={block_size}, "
+                f"{n - off - 4} bytes remain)", offset=off,
             )
         offsets.append(off + 4)  # start of record body
         off += 4 + block_size

@@ -13,6 +13,8 @@ from __future__ import annotations
 import struct
 import zlib
 
+from kindel_tpu.io.errors import TruncatedInputError
+
 #: BGZF EOF marker — an empty gzip member appended to well-formed files.
 BGZF_EOF = bytes.fromhex(
     "1f8b08040000000000ff0600424302001b0003000000000000000000"
@@ -31,7 +33,9 @@ def _member_bsize(data: bytes, off: int) -> int | None:
     if data[off : off + 2] != _GZIP_MAGIC:
         raise ValueError(f"not a gzip member at offset {off}")
     if off + 12 > len(data):
-        raise ValueError(f"truncated gzip member header at offset {off}")
+        raise TruncatedInputError(
+            "truncated gzip member header", offset=off
+        )
     flg = data[off + 3]
     if not flg & 4:  # no FEXTRA
         return None
@@ -42,7 +46,9 @@ def _member_bsize(data: bytes, off: int) -> int | None:
         si1, si2, slen = struct.unpack_from("<BBH", data, xoff)
         if si1 == 66 and si2 == 67 and slen == 2:  # "BC"
             if xoff + 6 > len(data):
-                raise ValueError(f"truncated BGZF BC subfield at {xoff}")
+                raise TruncatedInputError(
+                    "truncated BGZF BC subfield", offset=xoff
+                )
             return struct.unpack_from("<H", data, xoff + 4)[0] + 1
         xoff += 4 + slen
     return None
@@ -62,8 +68,8 @@ def decompress(data: bytes) -> bytes:
             bsize = _member_bsize(data, off)
             if bsize is not None:
                 if bsize < 26 or off + bsize > n:
-                    raise ValueError(
-                        f"corrupt BGZF member at {off}: BSIZE={bsize}"
+                    raise TruncatedInputError(
+                        f"corrupt BGZF member (BSIZE={bsize})", offset=off
                     )
                 # Deflate payload sits between the 18-byte BGZF header and
                 # the 8-byte CRC/ISIZE trailer.
@@ -78,8 +84,8 @@ def decompress(data: bytes) -> bytes:
                 if not dobj.eof:
                     # input exhausted mid-member: silent partial output
                     # would drop trailing reads without a trace
-                    raise ValueError(
-                        f"truncated gzip member at offset {off}"
+                    raise TruncatedInputError(
+                        "truncated gzip member", offset=off
                     )
                 consumed = len(data) - off - len(dobj.unused_data)
                 if consumed <= 0:

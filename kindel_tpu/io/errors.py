@@ -1,0 +1,45 @@
+"""Typed decode errors shared by the slurp and streamed decoders.
+
+`TruncatedInputError` subclasses ValueError on purpose: every existing
+caller that catches "corrupt alignment file" as ValueError keeps
+working, while callers that care (streaming retry logic, serve error
+reporting, chaos tests) can match the type and read *where* the input
+died — the byte offset inside the (decompressed or compressed) stream
+and, on the streamed path, which decode chunk was being read.
+"""
+
+from __future__ import annotations
+
+
+class TruncatedInputError(ValueError):
+    """A SAM/BAM/BGZF stream ended (or a block was corrupted) mid-record.
+
+    Attributes — any may be None when unknown at the raise site; the
+    streamed decoder back-fills `path` and `chunk_index` as the error
+    propagates up through the chunk loop:
+
+      detail       what was being decoded when the stream died
+      path         the input file (None for in-memory payloads)
+      offset       byte offset of the failure within its stream
+      chunk_index  0-based streamed-decode chunk that died
+    """
+
+    def __init__(self, detail: str, *, path=None, offset: int | None = None,
+                 chunk_index: int | None = None):
+        super().__init__(detail)
+        self.detail = detail
+        self.path = path
+        self.offset = offset
+        self.chunk_index = chunk_index
+
+    def __str__(self) -> str:
+        # composed dynamically: the streamed decoder annotates
+        # path/chunk_index after construction
+        parts = [self.detail]
+        if self.path is not None:
+            parts.append(f"file={self.path}")
+        if self.offset is not None:
+            parts.append(f"offset={self.offset}")
+        if self.chunk_index is not None:
+            parts.append(f"chunk={self.chunk_index}")
+        return " ".join(parts)

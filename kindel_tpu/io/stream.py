@@ -28,8 +28,10 @@ import numpy as np
 
 from kindel_tpu.io import bgzf
 from kindel_tpu.io.bam import _fields_from_offsets
+from kindel_tpu.io.errors import TruncatedInputError
 from kindel_tpu.io.records import ReadBatch
 from kindel_tpu.io.sam import parse_sam_bytes
+from kindel_tpu.resilience import faults as _faults
 
 _SLAB = 8 << 20  # compressed-side read size
 DEFAULT_CHUNK_BYTES = 64 << 20  # decompressed bytes per yielded batch
@@ -86,9 +88,8 @@ def _inflate_stream(fh) -> Iterator[bytes]:
             more = fh.read(_SLAB)
             if not more:
                 if buf:
-                    raise ValueError(
-                        "truncated gzip stream: "
-                        f"{len(buf)} trailing bytes"
+                    raise TruncatedInputError(
+                        f"truncated gzip stream ({len(buf)} trailing bytes)"
                     )
                 return
             buf += more
@@ -101,7 +102,7 @@ def _inflate_stream(fh) -> Iterator[bytes]:
             while len(buf) < 12 + xlen:
                 more = fh.read(_SLAB)
                 if not more:
-                    raise ValueError(
+                    raise TruncatedInputError(
                         "truncated gzip FEXTRA field at end of stream"
                     )
                 buf += more
@@ -115,8 +116,9 @@ def _inflate_stream(fh) -> Iterator[bytes]:
         while len(buf) < bsize:
             more = fh.read(_SLAB)
             if not more:
-                raise ValueError(
-                    f"truncated BGZF member: have {len(buf)} of {bsize} bytes"
+                raise TruncatedInputError(
+                    f"truncated BGZF member (have {len(buf)} of "
+                    f"{bsize} bytes)"
                 )
             buf += more
         payload = bytes(buf[18 : bsize - 8])
@@ -143,8 +145,8 @@ class _Prefetcher:
 
     def take(self, n: int) -> bytes:
         if not self.ensure(n):
-            raise ValueError(
-                f"truncated stream: wanted {n} bytes, have {len(self._buf)}"
+            raise TruncatedInputError(
+                f"truncated stream (wanted {n} bytes, have {len(self._buf)})"
             )
         out = bytes(self._buf[:n])
         del self._buf[:n]
@@ -168,11 +170,12 @@ class _Prefetcher:
 
 
 def _take_exact(pf: _Prefetcher, n: int, what: str) -> bytes:
-    """take(n) that raises ValueError (not a downstream struct.error) when
-    the stream ends early — every header length field is untrusted."""
+    """take(n) that raises TruncatedInputError (not a downstream
+    struct.error) when the stream ends early — every header length field
+    is untrusted."""
     out = pf.take(n)
     if len(out) != n:
-        raise ValueError(f"truncated BAM stream reading {what}")
+        raise TruncatedInputError(f"truncated BAM stream reading {what}")
     return out
 
 
@@ -297,27 +300,46 @@ def _stream_alignment_impl(
             yield from _stream_sam(_PrefetchReader(pf), chunk_bytes,
                                    label=path)
             return
-        ref_names, ref_lens = _read_bam_header(pf)
+        try:
+            ref_names, ref_lens = _read_bam_header(pf)
+        except TruncatedInputError as e:
+            e.path = path
+            e.chunk_index = 0
+            raise
         carry = b""
+        chunk_index = 0
         while True:
-            data = carry + pf.fill_to(chunk_bytes)
-            if not data:
-                break
-            offs, consumed = _scan_complete_records(data)
+            # the fault hook lets chaos tests truncate/stall one decode
+            # chunk (KINDEL_TPU_FAULTS="io.read_chunk:truncate"); the
+            # except arms back-fill which chunk of which file died
+            try:
+                data = carry + _faults.hook_bytes(
+                    "io.read_chunk", pf.fill_to(chunk_bytes)
+                )
+                if not data:
+                    break
+                offs, consumed = _scan_complete_records(data)
+            except TruncatedInputError as e:
+                e.path = path
+                e.chunk_index = chunk_index
+                raise
             if consumed == 0 and pf.exhausted:
-                raise ValueError(
-                    f"{path}: truncated BAM record at end of stream "
-                    f"({len(data)} trailing bytes)"
+                raise TruncatedInputError(
+                    f"truncated BAM record at end of stream "
+                    f"({len(data)} trailing bytes)",
+                    path=path, chunk_index=chunk_index,
                 )
             carry = data[consumed:]
             if len(offs):
                 yield _fields_from_offsets(data, offs, ref_names, ref_lens)
+            chunk_index += 1
             if pf.exhausted and not carry:
                 break
         if carry:
-            raise ValueError(
-                f"{path}: truncated BAM record at end of stream "
-                f"({len(carry)} trailing bytes)"
+            raise TruncatedInputError(
+                f"truncated BAM record at end of stream "
+                f"({len(carry)} trailing bytes)",
+                path=path, chunk_index=max(chunk_index - 1, 0),
             )
 
 

@@ -50,6 +50,8 @@ from kindel_tpu.events import EventSet, N_CHANNELS
 from kindel_tpu.obs import runtime as obs_runtime
 from kindel_tpu.obs import trace as obs_trace
 from kindel_tpu.pileup import build_insertion_table
+from kindel_tpu.resilience import faults as rfaults
+from kindel_tpu.resilience import policy as rpolicy
 
 
 def _slab_views(u: CallUnit, n_slabs: int):
@@ -106,7 +108,54 @@ def _slab_views(u: CallUnit, n_slabs: int):
     return slabs
 
 
+#: OOM degrade bound: halve the slab (double the count) at most this
+#: many times before propagating — 4× smaller slabs that still OOM mean
+#: the device is out of memory for reasons slab sizing cannot fix
+_MAX_SLAB_HALVINGS = 2
+
+#: never degrade past this many slabs (per-slab dispatch overhead
+#: dominates far earlier; matches the tune sweep's upper bound)
+_MAX_SLABS = 256
+
+
 def pipelined_consensus(
+    ev: EventSet,
+    rid: int,
+    n_slabs: int,
+    **kwargs,
+):
+    """Slab-pipelined equivalent of call_consensus_fused(...,
+    build_changes=False). Returns (CallResult, depth_min, depth_max).
+
+    Resilience wrapper (kindel_tpu.resilience): transient device errors
+    retry with jittered backoff; a device OOM that survives the retries
+    degrades by halving the slab size (doubling the count — each slab's
+    live output tensors shrink proportionally) and re-running, up to
+    _MAX_SLAB_HALVINGS times."""
+    retry = rpolicy.default_policy()
+    slabs = n_slabs
+    for halvings in range(_MAX_SLAB_HALVINGS + 1):
+        try:
+            return retry.run(
+                "pipeline.slab",
+                lambda s=slabs: _pipelined_consensus_impl(
+                    ev, rid, s, **kwargs
+                ),
+            )
+        except Exception as e:
+            if (
+                halvings >= _MAX_SLAB_HALVINGS
+                or not rpolicy.is_oom(e)
+                or slabs * 2 > _MAX_SLABS
+            ):
+                raise
+            rpolicy.record_degrade(
+                "pipeline.slab", "halve_slab", halvings + 1
+            )
+            slabs *= 2
+
+
+def _pipelined_consensus_impl(
     ev: EventSet,
     rid: int,
     n_slabs: int,
@@ -117,8 +166,6 @@ def pipelined_consensus(
     uppercase: bool = False,
     strict_ins: bool = False,
 ):
-    """Slab-pipelined equivalent of call_consensus_fused(...,
-    build_changes=False). Returns (CallResult, depth_min, depth_max)."""
     import jax.numpy as jnp
 
     u = CallUnit(ev, rid)
@@ -156,6 +203,7 @@ def pipelined_consensus(
     inflight = []
     with obs_trace.span("slab.dispatch") as dsp:
         for i, sl in enumerate(slabs):
+            rfaults.hook("device.dispatch")
             wire = fused_call_kernel_slab(
                 big, jnp.int32(i * size), size=size, o_pad=o_pad,
                 b_pad=b_pad, nn_pad=nn_pad, d_pad=d_pad, i_pad=i_pad,

@@ -1,0 +1,48 @@
+"""L7 — resilience: fault injection, retry/degrade policies, breaker.
+
+The production posture layer: every transient device failure should
+cost a retry, every device OOM should cost padding, and a wedged
+accelerator should flip the service into an explicit degraded mode —
+never a lost request. Three modules:
+
+  faults.py   deterministic seeded fault injection (`FaultPlan`,
+              `KINDEL_TPU_FAULTS`) with named hook points threaded
+              through the hot paths; no-ops (one global check) when
+              disabled
+  policy.py   transient-error classifier + `RetryPolicy` (exponential
+              backoff, full jitter) + degrade helpers, applied at the
+              three dispatch sites (batch cohort, pipeline slab, serve
+              flush)
+  breaker.py  `CircuitBreaker` over consecutive device failures —
+              /healthz degradation, 503 shedding, half-open probes —
+              plus the watchdog's `FlushTimeout`
+
+See docs/DESIGN.md §13 (failure model) and docs/usage.md (chaos
+testing with KINDEL_TPU_FAULTS).
+"""
+
+from kindel_tpu.resilience.breaker import (  # noqa: F401
+    CircuitBreaker,
+    FlushTimeout,
+)
+from kindel_tpu.resilience.faults import (  # noqa: F401
+    FaultPlan,
+    FaultSpec,
+    InjectedFault,
+    InjectedWorkerKill,
+    activate,
+    activate_from_env,
+    active_plan,
+    deactivate,
+    hook,
+    hook_bytes,
+)
+from kindel_tpu.resilience.policy import (  # noqa: F401
+    RetryPolicy,
+    classify,
+    default_policy,
+    is_oom,
+    is_transient,
+    record_degrade,
+    set_default_policy,
+)

@@ -1,0 +1,177 @@
+"""Circuit breaker over consecutive device failures.
+
+The serve path's last line of defense: when every flush is failing (a
+wedged accelerator, a dead tunnel), retrying per-request just burns the
+queue's latency budget and masks the outage. The breaker watches
+dispatch outcomes and flips the whole service into an explicit degraded
+mode instead:
+
+  closed     normal operation; `failure_threshold` CONSECUTIVE
+             device-level failures trip it open
+  open       new submissions shed immediately (HTTP 503 + Retry-After;
+             in-process callers get ServiceDegraded) — already-admitted
+             work keeps draining, because every admitted request's
+             future must resolve; after `reset_s` the breaker half-opens
+  half_open  exactly ONE new request is admitted as a probe; its
+             dispatch outcome decides — success closes the breaker,
+             failure re-opens it (and re-arms the reset timer)
+
+`/healthz` reports "degraded" while the breaker is not closed, so load
+balancers stop routing before clients see 503s. State transitions are
+exported as `kindel_breaker_state` (0 closed / 1 half-open / 2 open)
+on the service registry and `kindel_breaker_trips_total` on the
+process-global registry (bench.py reports trips per run).
+
+Success/failure are recorded by the worker at flush granularity, and
+only *transient-classified* failures count — one request's corrupt
+input is its own problem, not the device's.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from kindel_tpu.obs import trace as obs_trace
+from kindel_tpu.obs.metrics import default_registry
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+_STATE_CODE = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+
+class FlushTimeout(RuntimeError):
+    """A flush exceeded the watchdog deadline; only the affected
+    requests fail with this — the service keeps serving."""
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker with timed half-open probing."""
+
+    def __init__(self, failure_threshold: int = 5, reset_s: float = 5.0,
+                 clock=time.monotonic, metrics=None):
+        if failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        self.failure_threshold = failure_threshold
+        self.reset_s = reset_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._consecutive = 0
+        self._opened_at: float | None = None
+        self._probe_inflight = False
+        if metrics is not None:
+            self._m_state = metrics.gauge(
+                "kindel_breaker_state",
+                "device circuit breaker state "
+                "(0=closed, 1=half-open, 2=open)",
+            )
+            self._m_state.set(0)
+        else:
+            self._m_state = None
+        # trips land on the process-global registry so offline tooling
+        # (bench.py) sees them without holding the service registry
+        self._m_trips = default_registry().counter(
+            "kindel_breaker_trips_total",
+            "circuit breaker transitions into the open state",
+        )
+
+    # ------------------------------------------------------------ internals
+
+    def _set_state(self, state: str) -> None:
+        """Transition (lock held). Gauge + span only on actual change."""
+        if state == self._state:
+            return
+        prev, self._state = self._state, state
+        if self._m_state is not None:
+            self._m_state.set(_STATE_CODE[state])
+        if state == OPEN:
+            self._opened_at = self._clock()
+            self._m_trips.inc()
+        sp = obs_trace.span("resilience.breaker_transition")
+        with sp:
+            if sp is not obs_trace.NOOP_SPAN:
+                sp.set_attribute(
+                    from_state=prev, to_state=state,
+                    consecutive_failures=self._consecutive,
+                )
+
+    def _tick(self) -> None:
+        """Time-based open → half-open (lock held)."""
+        if (
+            self._state == OPEN
+            and self._opened_at is not None
+            and self._clock() - self._opened_at >= self.reset_s
+        ):
+            self._probe_inflight = False
+            self._set_state(HALF_OPEN)
+
+    # ------------------------------------------------------------------ API
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            self._tick()
+            return self._state
+
+    @property
+    def consecutive_failures(self) -> int:
+        return self._consecutive
+
+    def allow_admission(self) -> bool:
+        """May a NEW request enter? closed: yes; open: no; half-open:
+        exactly one probe until its outcome is recorded."""
+        with self._lock:
+            self._tick()
+            if self._state == CLOSED:
+                return True
+            if self._state == OPEN:
+                return False
+            if self._probe_inflight:
+                return False
+            self._probe_inflight = True
+            return True
+
+    def retry_after_s(self) -> float:
+        """Shed hint: time until the next half-open probe window."""
+        with self._lock:
+            self._tick()
+            if self._state == CLOSED:
+                return 0.0
+            if self._state == HALF_OPEN or self._opened_at is None:
+                return 1.0
+            return max(
+                self.reset_s - (self._clock() - self._opened_at), 0.05
+            )
+
+    def record_success(self) -> None:
+        """One device dispatch completed — closes a half-open breaker
+        and resets the consecutive-failure run."""
+        with self._lock:
+            self._tick()
+            self._consecutive = 0
+            self._probe_inflight = False
+            self._set_state(CLOSED)
+
+    def record_failure(self) -> None:
+        """One device-level (transient-classified) dispatch failure."""
+        with self._lock:
+            self._tick()
+            self._consecutive += 1
+            self._probe_inflight = False
+            if (
+                self._state == HALF_OPEN
+                or self._consecutive >= self.failure_threshold
+            ):
+                self._set_state(OPEN)
+
+    def snapshot(self) -> dict:
+        """JSON-able view for /healthz."""
+        with self._lock:
+            self._tick()
+            return {
+                "state": self._state,
+                "consecutive_failures": self._consecutive,
+            }

@@ -1,0 +1,295 @@
+"""Deterministic, seeded fault injection for chaos testing the hot paths.
+
+Production failures on a tunneled TPU — a transient XLA
+`RESOURCE_EXHAUSTED`, a hung device dispatch, a truncated BGZF member,
+a worker thread dying mid-loop — are exactly the failures CI can never
+reproduce on demand. This module makes them reproducible: a `FaultPlan`
+is a list of `FaultSpec`s (site, kind, how many times, after how many
+hits, with what probability), and the hot paths call `hook(site)` /
+`hook_bytes(site, data)` at named points:
+
+  device.dispatch   cohort / slab kernel launch (batch.py, pipeline.py,
+                    and every serve flush through launch_cohort_kernel)
+  device.compile    AOT warmup compile of one lane shape (serve/warmup)
+  io.read_chunk     one streamed decode chunk (io/stream.py)
+  serve.flush       one micro-batch flush execution (serve/worker.py)
+  serve.worker      top of the intake / dispatch loop (serve/worker.py)
+
+Fault kinds: `error` (synthetic transient RPC error), `oom` (synthetic
+XLA RESOURCE_EXHAUSTED — the retry/degrade policies classify it exactly
+like the real one), `stall` (latency injection), `truncate` (drop the
+tail of an I/O chunk), `kill` (raise through a worker loop so the
+thread dies and the supervisor's auto-restart is exercised).
+
+Disabled-path overhead is the design constraint (the hooks sit on the
+same hot paths as the obs no-op spans): `hook()` is ONE module-global
+load and a None check — no allocation, no string work — pinned by
+tests/test_resilience.py with tracemalloc.
+
+Activation: `activate(FaultPlan.parse(spec))` in-process, or the
+`KINDEL_TPU_FAULTS` env var / `--faults` CLI flag (kindel_tpu.cli calls
+`activate_from_env()` once at startup). Spec grammar, comma/semicolon
+separated::
+
+    seed=7,device.dispatch:oom:2,serve.flush:stall:delay=0.2,
+    io.read_chunk:truncate:after=1,serve.worker:kill:p=0.5
+
+Each entry is `site:kind[:times][:key=value...]` with keys `times`
+(fire at most N times, default 1), `after` (skip the first N hits of
+the site), `p` (fire probability per eligible hit — drawn from the
+plan's seeded RNG, so the same seed replays the same fault sequence),
+`delay` (stall seconds). Fired counts are recorded on the plan
+(`plan.fired`) so chaos tests can assert metrics against exactly what
+was injected.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import re
+import threading
+import time
+
+#: the fault kinds a spec may name (see module docstring)
+KINDS = ("error", "oom", "stall", "truncate", "kill")
+
+#: the hook points threaded through the hot paths (documentation +
+#: parse-time typo guard; custom sites are allowed via FaultSpec(...,
+#: known_site=False) for tests of the harness itself)
+SITES = (
+    "device.dispatch",
+    "device.compile",
+    "io.read_chunk",
+    "serve.flush",
+    "serve.worker",
+)
+
+
+class InjectedFault(RuntimeError):
+    """A synthetic fault raised by an active FaultPlan hook. The message
+    carries the same marker strings (RESOURCE_EXHAUSTED, UNAVAILABLE)
+    the transient-error classifier matches on real XLA/RPC failures, so
+    the retry/degrade machinery exercises its production code path."""
+
+    def __init__(self, site: str, kind: str, message: str):
+        super().__init__(message)
+        self.site = site
+        self.kind = kind
+
+
+class InjectedWorkerKill(InjectedFault):
+    """Raised through a worker loop so the thread dies — the supervisor
+    restart path's test vehicle. Deliberately NOT classified transient:
+    nothing should retry it; the thread must die."""
+
+
+class FaultSpec:
+    """One injectable fault: fire `kind` at `site`, at most `times`
+    times, skipping the first `after` hits, each eligible hit firing
+    with probability `p` (from the plan's seeded RNG)."""
+
+    __slots__ = ("site", "kind", "times", "after", "p", "delay_s")
+
+    def __init__(self, site: str, kind: str, times: int = 1, after: int = 0,
+                 p: float = 1.0, delay_s: float = 0.05,
+                 known_site: bool = True):
+        if kind not in KINDS:
+            raise ValueError(f"unknown fault kind {kind!r} (one of {KINDS})")
+        if known_site and site not in SITES:
+            raise ValueError(
+                f"unknown fault site {site!r} (one of {SITES})"
+            )
+        if times < 1 or after < 0 or not 0.0 < p <= 1.0 or delay_s < 0:
+            raise ValueError(
+                f"bad fault spec {site}:{kind} "
+                f"(times={times} after={after} p={p} delay={delay_s})"
+            )
+        self.site = site
+        self.kind = kind
+        self.times = times
+        self.after = after
+        self.p = p
+        self.delay_s = delay_s
+
+    def __repr__(self) -> str:
+        return (
+            f"FaultSpec({self.site}:{self.kind} times={self.times} "
+            f"after={self.after} p={self.p} delay={self.delay_s})"
+        )
+
+
+class FaultPlan:
+    """A seeded, deterministic set of FaultSpecs plus fire bookkeeping.
+
+    Thread-safe: the serve worker hits hooks from four threads. The
+    per-site hit counters and the seeded RNG advance under one lock, so
+    a given (seed, hit order) replays the same fault sequence."""
+
+    def __init__(self, specs, seed: int = 0, sleep=time.sleep):
+        self.specs = list(specs)
+        self.seed = seed
+        self._sleep = sleep
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._hits: dict[str, int] = {}
+        self._spec_fired = [0] * len(self.specs)
+        #: {(site, kind): times fired} — what chaos tests assert against
+        self.fired: dict[tuple, int] = {}
+
+    @classmethod
+    def parse(cls, text: str, sleep=time.sleep) -> "FaultPlan":
+        """Parse the KINDEL_TPU_FAULTS grammar (module docstring)."""
+        specs = []
+        seed = 0
+        for part in re.split(r"[,;]", text):
+            part = part.strip()
+            if not part:
+                continue
+            if part.startswith("seed="):
+                seed = int(part[len("seed="):])
+                continue
+            fields = part.split(":")
+            if len(fields) < 2:
+                raise ValueError(
+                    f"bad fault spec {part!r} (want site:kind[:opts])"
+                )
+            site, kind = fields[0], fields[1]
+            kwargs: dict = {}
+            for f in fields[2:]:
+                if "=" in f:
+                    k, v = f.split("=", 1)
+                else:
+                    k, v = "times", f
+                if k == "times":
+                    kwargs["times"] = int(v)
+                elif k == "after":
+                    kwargs["after"] = int(v)
+                elif k == "p":
+                    kwargs["p"] = float(v)
+                elif k == "delay":
+                    kwargs["delay_s"] = float(v)
+                else:
+                    raise ValueError(
+                        f"unknown fault spec option {k!r} in {part!r}"
+                    )
+            specs.append(FaultSpec(site, kind, **kwargs))
+        return cls(specs, seed=seed, sleep=sleep)
+
+    def hits(self, site: str) -> int:
+        with self._lock:
+            return self._hits.get(site, 0)
+
+    def fired_total(self) -> int:
+        with self._lock:
+            return sum(self.fired.values())
+
+    def _match(self, site: str) -> list[FaultSpec]:
+        """Advance the site's hit counter and return the specs that fire
+        on this hit (stalls ordered before raising kinds, so a
+        stall+error combo stalls first, then raises)."""
+        with self._lock:
+            hit = self._hits.get(site, 0) + 1
+            self._hits[site] = hit
+            due = []
+            for i, s in enumerate(self.specs):
+                if s.site != site:
+                    continue
+                if hit <= s.after:
+                    continue
+                if self._spec_fired[i] >= s.times:
+                    continue
+                if s.p < 1.0 and self._rng.random() >= s.p:
+                    continue
+                self._spec_fired[i] += 1
+                key = (site, s.kind)
+                self.fired[key] = self.fired.get(key, 0) + 1
+                due.append(s)
+        due.sort(key=lambda s: s.kind != "stall")  # stalls first
+        return due
+
+    def _raise_for(self, site: str, spec: FaultSpec) -> None:
+        if spec.kind == "kill":
+            raise InjectedWorkerKill(
+                site, "kill", f"injected worker kill at {site}"
+            )
+        if spec.kind == "oom":
+            raise InjectedFault(
+                site, "oom",
+                f"RESOURCE_EXHAUSTED: injected device OOM at {site} "
+                "while attempting to allocate",
+            )
+        # "error" (and "truncate" outside a bytes hook, where there is
+        # nothing to truncate) degrade to a generic transient failure
+        raise InjectedFault(
+            spec.site, spec.kind,
+            f"UNAVAILABLE: injected transient {spec.kind} fault at {site}",
+        )
+
+    def fire(self, site: str) -> None:
+        """Apply every due spec at this hook point (called by hook())."""
+        for spec in self._match(site):
+            if spec.kind == "stall":
+                self._sleep(spec.delay_s)
+            else:
+                self._raise_for(site, spec)
+
+    def filter_bytes(self, site: str, data: bytes) -> bytes:
+        """Bytes-hook variant: `truncate` drops the tail half of the
+        chunk (mid-stream corruption / EOF truncation downstream);
+        other kinds behave as in fire()."""
+        for spec in self._match(site):
+            if spec.kind == "stall":
+                self._sleep(spec.delay_s)
+            elif spec.kind == "truncate":
+                data = data[: len(data) // 2]
+            else:
+                self._raise_for(site, spec)
+        return data
+
+
+# ------------------------------------------------------------- module API
+
+_ACTIVE: FaultPlan | None = None
+
+
+def activate(plan: FaultPlan) -> FaultPlan:
+    """Install `plan` as the process fault plan (replacing any active)."""
+    global _ACTIVE
+    _ACTIVE = plan
+    return plan
+
+
+def deactivate() -> None:
+    global _ACTIVE
+    _ACTIVE = None
+
+
+def active_plan() -> FaultPlan | None:
+    return _ACTIVE
+
+
+def activate_from_env() -> FaultPlan | None:
+    """Activate a plan from $KINDEL_TPU_FAULTS (None when unset/empty).
+    Called once by the CLI at startup — never on a hot path."""
+    spec = os.environ.get("KINDEL_TPU_FAULTS", "")
+    if not spec:
+        return None
+    return activate(FaultPlan.parse(spec))
+
+
+def hook(site: str) -> None:
+    """Named fault hook: one global load + None check when no plan is
+    active (allocation-free, branch-once — the hot paths call this
+    unconditionally, same bar as the obs no-op span)."""
+    plan = _ACTIVE
+    if plan is not None:
+        plan.fire(site)
+
+
+def hook_bytes(site: str, data: bytes) -> bytes:
+    """Bytes-filtering fault hook (I/O sites): identity when disabled."""
+    plan = _ACTIVE
+    if plan is None:
+        return data
+    return plan.filter_bytes(site, data)

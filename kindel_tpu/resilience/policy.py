@@ -1,0 +1,235 @@
+"""Retry and degrade policies for the device dispatch sites.
+
+One transient XLA `RESOURCE_EXHAUSTED` or RPC flap should cost a
+retry, not a cohort; a genuine device OOM should cost padding (smaller
+dispatch groups), not the request. This module is the shared policy
+layer the three dispatch sites apply:
+
+  batch.cohort    offline cohort launch + assemble (kindel_tpu.batch):
+                  transient launch failures retry; an OOM surfacing at
+                  download/assembly bisects the group and re-dispatches
+  pipeline.slab   slab-pipelined single call (kindel_tpu.pipeline):
+                  transient failures retry; OOM halves the slab size
+                  (doubles the count) and re-runs
+  serve.flush     online micro-batch flush (kindel_tpu.serve.worker):
+                  retry, then bisect the flush, then a last-resort
+                  per-request numpy fallback — no admitted request is
+                  lost to a device failure
+
+Classification is string-based on purpose: XLA and the PJRT RPC layer
+surface failures as differently-typed exceptions across jax versions,
+but the status-code vocabulary in the message is stable
+(RESOURCE_EXHAUSTED / UNAVAILABLE / DEADLINE_EXCEEDED / "out of
+memory"). The injected faults (kindel_tpu.resilience.faults) carry the
+same markers, so chaos tests exercise exactly the production
+classifier.
+
+Every retry / degrade action is counted on the process-global registry
+(`kindel_retry_total{site,outcome}`, `kindel_degrade_total{site,action}`,
+`kindel_degrade_bisect_depth`) and emits a `resilience.retry` /
+`resilience.degrade` span — the serve `/metrics` exposition unions the
+global registry, so online and offline resilience activity land in one
+place (and bench.py reports the totals per run).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from types import SimpleNamespace
+
+from kindel_tpu.obs import trace as obs_trace
+from kindel_tpu.obs.metrics import default_registry
+
+#: substrings marking an error worth retrying — XLA/PJRT status codes,
+#: allocator messages, and tunneled-link RPC flaps
+TRANSIENT_MARKERS = (
+    "RESOURCE_EXHAUSTED",
+    "UNAVAILABLE",
+    "DEADLINE_EXCEEDED",
+    "ABORTED",
+    "out of memory",
+    "Out of memory",
+    "failed to allocate",
+    "Failed to allocate",
+    "Attempting to allocate",
+    "Socket closed",
+    "Connection reset",
+    "transport is closing",
+)
+
+#: the subset that means "the device ran out of memory" — the degrade
+#: policies react to these by shrinking the dispatch, not just retrying
+OOM_MARKERS = (
+    "RESOURCE_EXHAUSTED",
+    "out of memory",
+    "Out of memory",
+    "failed to allocate",
+    "Failed to allocate",
+    "Attempting to allocate",
+)
+
+
+def _message(exc: BaseException) -> str:
+    return f"{type(exc).__name__}: {exc}"
+
+
+def is_transient(exc: BaseException) -> bool:
+    """Worth a retry? Matches the stable XLA/RPC status vocabulary."""
+    from kindel_tpu.resilience.faults import InjectedWorkerKill
+
+    if isinstance(exc, InjectedWorkerKill):
+        return False  # a killed worker must die, not retry
+    msg = _message(exc)
+    return any(m in msg for m in TRANSIENT_MARKERS)
+
+
+def is_oom(exc: BaseException) -> bool:
+    """Device memory exhaustion — degrade (shrink the dispatch)."""
+    msg = _message(exc)
+    return any(m in msg for m in OOM_MARKERS)
+
+
+def classify(exc: BaseException) -> str:
+    """"transient" (retry/degrade) or "fatal" (propagate)."""
+    return "transient" if is_transient(exc) else "fatal"
+
+
+_METRICS = None
+_metrics_lock = threading.Lock()
+
+
+def _metrics():
+    """Process-global resilience counters (cached — retry paths must not
+    pay a registry lock per attempt)."""
+    global _METRICS
+    if _METRICS is None:
+        with _metrics_lock:
+            if _METRICS is None:
+                reg = default_registry()
+                _METRICS = SimpleNamespace(
+                    retries=reg.counter(
+                        "kindel_retry_total",
+                        "dispatch retry decisions by site and outcome "
+                        "(retried/recovered/exhausted/fatal)",
+                    ),
+                    degrades=reg.counter(
+                        "kindel_degrade_total",
+                        "degrade actions by site and action (bisect/"
+                        "redispatch/halve_slab/numpy_fallback)",
+                    ),
+                    bisect_depth=reg.histogram(
+                        "kindel_degrade_bisect_depth",
+                        "recursion depth of cohort bisection on device OOM",
+                        buckets=(1, 2, 3, 4, 6, 8),
+                    ),
+                    fallbacks=reg.counter(
+                        "kindel_fallback_numpy_total",
+                        "requests served by the last-resort per-request "
+                        "numpy fallback after device dispatch failed",
+                    ),
+                )
+    return _METRICS
+
+
+def record_degrade(site: str, action: str, depth: int = 1) -> None:
+    """Count one degrade decision (and its bisection depth) and mark it
+    on the ambient span tree."""
+    m = _metrics()
+    m.degrades.labels(site=site, action=action).inc()
+    if action in ("bisect", "halve_slab"):
+        m.bisect_depth.observe(depth)
+    if action == "numpy_fallback":
+        m.fallbacks.inc()
+    sp = obs_trace.span("resilience.degrade")
+    with sp:
+        if sp is not obs_trace.NOOP_SPAN:
+            sp.set_attribute(site=site, action=action, depth=depth)
+
+
+class RetryPolicy:
+    """Exponential backoff with full jitter over a transient-error
+    classifier (the AWS-style decorrelated cap: sleep ~ U(0, min(max_s,
+    base_s * 2^attempt))).
+
+    `sleep`/`rng` are injectable so tests run instantly and
+    deterministically; the default RNG is seeded per-policy so two
+    processes do not thundering-herd a shared device on recovery.
+    """
+
+    def __init__(self, max_attempts: int = 3, base_s: float = 0.05,
+                 max_s: float = 2.0, classify=is_transient,
+                 sleep=time.sleep, rng: random.Random | None = None):
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        self.max_attempts = max_attempts
+        self.base_s = base_s
+        self.max_s = max_s
+        self.classify = classify
+        self.sleep = sleep
+        self.rng = rng if rng is not None else random.Random()
+
+    def backoff_s(self, attempt: int) -> float:
+        """Full-jitter backoff for the given (1-based) retry number."""
+        cap = min(self.max_s, self.base_s * (2 ** attempt))
+        return self.rng.uniform(0, cap)
+
+    def run(self, site: str, fn):
+        """Call fn() with up to max_attempts tries. Non-transient errors
+        propagate immediately (outcome=fatal); exhausted transients
+        propagate after the last attempt (outcome=exhausted); a success
+        after >=1 retry counts outcome=recovered."""
+        m = _metrics()
+        attempt = 0
+        while True:
+            try:
+                out = fn()
+            except Exception as e:
+                transient = self.classify(e)
+                if not transient or attempt + 1 >= self.max_attempts:
+                    m.retries.labels(
+                        site=site,
+                        outcome="exhausted" if transient else "fatal",
+                    ).inc()
+                    raise
+                attempt += 1
+                m.retries.labels(site=site, outcome="retried").inc()
+                delay = self.backoff_s(attempt)
+                sp = obs_trace.span("resilience.retry")
+                with sp:
+                    if sp is not obs_trace.NOOP_SPAN:
+                        sp.set_attribute(
+                            site=site, attempt=attempt,
+                            backoff_s=round(delay, 4), error=repr(e),
+                        )
+                self.sleep(delay)
+                continue
+            if attempt:
+                m.retries.labels(site=site, outcome="recovered").inc()
+            return out
+
+
+_DEFAULT_POLICY: RetryPolicy | None = None
+_default_lock = threading.Lock()
+
+
+def default_policy() -> RetryPolicy:
+    """The process-default RetryPolicy the offline dispatch sites use
+    (serve constructs its own so the knobs are per-service)."""
+    global _DEFAULT_POLICY
+    if _DEFAULT_POLICY is None:
+        with _default_lock:
+            if _DEFAULT_POLICY is None:
+                _DEFAULT_POLICY = RetryPolicy()
+    return _DEFAULT_POLICY
+
+
+def set_default_policy(policy: RetryPolicy | None) -> RetryPolicy | None:
+    """Swap the process-default policy (tests pin a no-sleep policy);
+    returns the previous one. None resets to a fresh default."""
+    global _DEFAULT_POLICY
+    with _default_lock:
+        prev = _DEFAULT_POLICY
+        _DEFAULT_POLICY = policy
+    return prev

@@ -34,6 +34,7 @@ from kindel_tpu.serve.queue import (  # noqa: F401
     DeadlineExceeded,
     RequestQueue,
     ServeRequest,
+    ServiceDegraded,
 )
 from kindel_tpu.serve.service import (  # noqa: F401
     ConsensusClient,

@@ -20,7 +20,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from dataclasses import dataclass, field
 
 from kindel_tpu.obs import trace
@@ -32,6 +32,12 @@ class AdmissionError(RuntimeError):
     def __init__(self, message: str, retry_after_s: float):
         super().__init__(message)
         self.retry_after_s = retry_after_s
+
+
+class ServiceDegraded(AdmissionError):
+    """Shed at the door because the device circuit breaker is open —
+    the service is degraded, not overloaded (clients see HTTP 503 with
+    Retry-After, vs the watermark's 429)."""
 
 
 class DeadlineExceeded(RuntimeError):
@@ -203,12 +209,20 @@ class RequestQueue:
                     ):
                         if self._expired is not None:
                             self._expired.inc()
-                        req.future.set_exception(
-                            DeadlineExceeded(
-                                "deadline passed while queued "
-                                f"({self._clock() - req.enqueued_at:.3f}s)"
-                            )
-                        )
+                        try:
+                            # a caller may have cancelled the future while
+                            # it sat queued; the expiry settle must not
+                            # take the popping worker thread down with an
+                            # InvalidStateError
+                            if req.future.set_running_or_notify_cancel():
+                                req.future.set_exception(
+                                    DeadlineExceeded(
+                                        "deadline passed while queued "
+                                        f"({self._clock() - req.enqueued_at:.3f}s)"
+                                    )
+                                )
+                        except (InvalidStateError, RuntimeError):
+                            pass  # cancelled/settled while queued
                         if req.wait_span is not None:
                             req.wait_span.set_attribute(outcome="expired")
                             req.wait_span.finish()

@@ -30,11 +30,13 @@ from kindel_tpu.serve.metrics import (
     ServeHTTPServer,
     default_registry,
 )
+from kindel_tpu.resilience.breaker import CircuitBreaker
 from kindel_tpu.serve.queue import (
     AdmissionError,
     DeadlineExceeded,
     RequestQueue,
     ServeRequest,
+    ServiceDegraded,
 )
 from kindel_tpu.serve.worker import ServeWorker
 
@@ -57,6 +59,11 @@ class ConsensusService:
         warmup: bool = False,
         warm_payloads=(),
         tuning=None,
+        breaker_threshold: int = 5,
+        breaker_reset_s: float = 5.0,
+        watchdog_s: float | None = None,
+        retry=None,
+        numpy_fallback: bool = True,
         **consensus_opts,
     ):
         """consensus_opts are BatchOptions fields (min_depth, realign,
@@ -71,7 +78,18 @@ class ConsensusService:
         while `/healthz` reports "warming"; the first request after
         "ok" on a warmed lane triggers no compile. `tuning` is an
         optional kindel_tpu.tune.TuningConfig pinning performance knobs
-        explicitly (its cohort budget feeds the dispatch grouping)."""
+        explicitly (its cohort budget feeds the dispatch grouping).
+
+        Resilience knobs (kindel_tpu.resilience, DESIGN.md §13):
+        `breaker_threshold` consecutive device failures flip the circuit
+        breaker open — /healthz reports "degraded" and new submissions
+        shed with ServiceDegraded (HTTP 503 + Retry-After) until a
+        half-open probe succeeds after `breaker_reset_s`. `watchdog_s`
+        (None = off) times out hung flushes, failing only the affected
+        requests. `retry` is an optional
+        kindel_tpu.resilience.RetryPolicy for flush dispatch;
+        `numpy_fallback` enables the last-resort per-request host
+        fallback when the device dispatch keeps failing."""
         self.metrics = metrics if metrics is not None else MetricsRegistry()
         if (
             tuning is not None
@@ -117,9 +135,19 @@ class ConsensusService:
         self.batcher = MicroBatcher(
             max_batch_rows=max_batch_rows, max_wait_s=max_wait_s
         )
+        self.breaker = CircuitBreaker(
+            failure_threshold=breaker_threshold, reset_s=breaker_reset_s,
+            metrics=self.metrics,
+        )
+        self._m_shed = self.metrics.counter(
+            "kindel_serve_degraded_rejects_total",
+            "submissions shed because the device circuit breaker was open",
+        )
         self.worker = ServeWorker(
             self.queue, self.batcher, metrics=self.metrics,
             decode_workers=decode_workers, row_bucket=row_bucket,
+            breaker=self.breaker, retry=retry, watchdog_s=watchdog_s,
+            numpy_fallback=numpy_fallback,
         )
         self._http: ServeHTTPServer | None = None
         self._http_host = http_host
@@ -214,8 +242,17 @@ class ConsensusService:
         return True
 
     def healthz(self) -> dict:
+        if self.warming:
+            status = "warming"
+        elif self.breaker.state != "closed":
+            # breaker open / half-open: load balancers should hold
+            # traffic; submissions shed with 503 + Retry-After
+            status = "degraded"
+        else:
+            status = "ok"
         doc = {
-            "status": "warming" if self.warming else "ok",
+            "status": status,
+            "breaker": self.breaker.snapshot(),
             "uptime_s": (
                 round(time.monotonic() - self._started_at, 3)
                 if self._started_at is not None else 0.0
@@ -236,6 +273,12 @@ class ConsensusService:
                **opt_overrides) -> Future:
         """Admit one request (path or SAM/BAM bytes). Returns a Future of
         SampleResult. Raises AdmissionError when load-shedding."""
+        if not self.breaker.allow_admission():
+            self._m_shed.inc()
+            raise ServiceDegraded(
+                "service degraded: device circuit breaker is "
+                f"{self.breaker.state}", self.breaker.retry_after_s(),
+            )
         opts = (
             replace(self.default_opts, **opt_overrides)
             if opt_overrides else self.default_opts
@@ -259,12 +302,19 @@ class ConsensusService:
 
     def _handle_consensus_post(self, body: bytes):
         """POST /v1/consensus: SAM/BAM bytes in, FASTA text out.
-        429 + Retry-After under load shedding, 400 on undecodable input,
+        429 + Retry-After under load shedding, 503 + Retry-After while
+        the breaker sheds (degraded device), 400 on undecodable input,
         504 on deadline expiry."""
         from kindel_tpu.io.fasta import format_fasta
 
         try:
             res = self.request(body)
+        except ServiceDegraded as e:
+            doc = {"error": str(e), "retry_after_s": e.retry_after_s}
+            return (
+                503, "application/json", json.dumps(doc).encode(),
+                {"Retry-After": max(1, round(e.retry_after_s))},
+            )
         except AdmissionError as e:
             doc = {"error": str(e), "retry_after_s": e.retry_after_s}
             return (

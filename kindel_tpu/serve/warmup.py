@@ -66,6 +66,7 @@ def warm_shapes(opts, row_bucket: int = 8, payloads=(),
         pack_cohort,
     )
     from kindel_tpu.pileup_jax import _bucket
+    from kindel_tpu.resilience import faults as rfaults
 
     cohorts: list = []
     if include_synthetic:
@@ -82,6 +83,7 @@ def warm_shapes(opts, row_bucket: int = 8, payloads=(),
         label = shape_label(shapes, n_rows)
         if label in timings:
             continue
+        rfaults.hook("device.compile")
         t0 = time.monotonic()
         arrays, meta = pack_cohort(units, opts, n_rows=n_rows, shapes=shapes)
         out, _meta = launch_cohort_kernel(arrays, meta, opts)

@@ -15,16 +15,23 @@ of by a file list:
             launches ONE batched device program, assembles every
             request's FASTA on the host pool, and completes futures.
 
-Dispatch-stage failures are isolated by re-running the flush one request
-at a time, so a request that only breaks in the batched path still fails
-alone while its batch-mates complete.
+Failure handling is layered (kindel_tpu.resilience — DESIGN.md §13):
+a transient device error retries the flush with backoff; a device OOM
+that survives the retries bisects the flush and re-dispatches the
+halves; any other batch-level failure re-runs one request at a time so
+only the culpable request fails; a singleton that still dies on a
+transient device error is served by the per-request numpy fallback.
+A supervisor thread auto-restarts a dead intake/dispatch loop and
+watchdogs hung flushes — failing only the affected requests' futures,
+so every admitted request resolves exactly once no matter what the
+device does. Dispatch outcomes feed the service's circuit breaker.
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import InvalidStateError, ThreadPoolExecutor
 
 from kindel_tpu.batch import (
     SampleResult,
@@ -36,6 +43,9 @@ from kindel_tpu.batch import (
 from kindel_tpu.obs import runtime as obs_runtime
 from kindel_tpu.obs import trace
 from kindel_tpu.pileup_jax import _bucket
+from kindel_tpu.resilience import faults as rfaults
+from kindel_tpu.resilience import policy as rpolicy
+from kindel_tpu.resilience.breaker import FlushTimeout
 from kindel_tpu.utils.profiling import maybe_phase
 
 from kindel_tpu.serve.batcher import Flush, MicroBatcher
@@ -73,18 +83,111 @@ def decode_request(req: ServeRequest) -> list:
     return units
 
 
+def numpy_request_result(req: ServeRequest) -> SampleResult:
+    """Last-resort per-request fallback: the whole request recomputed on
+    the host numpy oracle, no device involved — the same decode→pileup→
+    call path `bam_to_consensus(backend="numpy")` runs. Slow, but a
+    wedged accelerator then degrades throughput instead of availability."""
+    from kindel_tpu.call import call_consensus
+    from kindel_tpu.events import extract_events
+    from kindel_tpu.io import load_alignment, load_alignment_bytes
+    from kindel_tpu.io.fasta import Sequence
+    from kindel_tpu.pileup import build_pileup
+    from kindel_tpu.realign import cdrp_consensuses, merge_cdrps
+    from kindel_tpu.workloads import build_report
+
+    opts = req.opts
+    payload = req.payload
+    if isinstance(payload, (bytes, bytearray)):
+        batch = load_alignment_bytes(bytes(payload))
+    else:
+        batch = load_alignment(str(payload))
+    ev = extract_events(batch)
+    res = SampleResult()
+    for rid in ev.present_ref_ids:
+        ref_id = ev.ref_names[rid]
+        pileup = build_pileup(ev, rid)
+        cdr_patches = None
+        if opts.realign:
+            cdr_patches = merge_cdrps(
+                cdrp_consensuses(
+                    pileup,
+                    clip_decay_threshold=opts.clip_decay_threshold,
+                    mask_ends=opts.mask_ends,
+                    max_gap=opts.cdr_gap,
+                    flank_dedup=opts.fix_clip_artifacts,
+                    min_depth=opts.min_depth,
+                ),
+                opts.min_overlap,
+            )
+        out = call_consensus(
+            pileup, cdr_patches=cdr_patches, trim_ends=opts.trim_ends,
+            min_depth=opts.min_depth, uppercase=opts.uppercase,
+            strict_ins=opts.fix_clip_artifacts,
+        )
+        res.consensuses.append(
+            Sequence(name=f"{ref_id}_cns", sequence=out.sequence)
+        )
+        if opts.build_changes:
+            res.refs_changes[ref_id] = out.changes
+        if opts.build_reports:
+            acgt = pileup.acgt_depth
+            dmin = int(acgt.min()) if len(acgt) else 0
+            dmax = int(acgt.max()) if len(acgt) else 0
+            res.refs_reports[ref_id] = build_report(
+                ref_id, dmin, dmax, out.changes, cdr_patches,
+                _payload_label(payload), opts.realign, opts.min_depth,
+                opts.min_overlap, opts.clip_decay_threshold,
+                opts.trim_ends, opts.uppercase,
+            )
+    return res
+
+
+def _settle(req: ServeRequest, *, result=None, exc=None) -> bool:
+    """Resolve one request's future exactly once. Returns False when it
+    was already settled (watchdog raced the dispatcher, or the caller
+    cancelled) — the loser of the race records nothing."""
+    fut = req.future
+    try:
+        if not fut.set_running_or_notify_cancel():
+            return False
+    except (InvalidStateError, RuntimeError):
+        # set_running_or_notify_cancel raises a bare RuntimeError (not
+        # InvalidStateError) on a FINISHED future — the watchdog or a
+        # cancelling caller beat us; the loser records nothing
+        return False
+    if exc is not None:
+        fut.set_exception(exc)
+    else:
+        fut.set_result(result)
+    return True
+
+
 class ServeWorker:
     """Owns the intake/decode/dispatch machinery for one service."""
 
     def __init__(self, queue: RequestQueue, batcher: MicroBatcher,
                  metrics=None, decode_workers: int = 4,
-                 row_bucket: int = 8, clock=time.monotonic):
+                 row_bucket: int = 8, clock=time.monotonic,
+                 breaker=None, retry: rpolicy.RetryPolicy | None = None,
+                 watchdog_s: float | None = None,
+                 numpy_fallback: bool = True, supervise: bool = True,
+                 supervise_interval_s: float = 0.1):
         self.queue = queue
         self.batcher = batcher
         self._clock = clock
         #: rows pad to this power-of-two bucket so repeat flushes of a
         #: lane reuse one compiled kernel shape even as occupancy varies
         self.row_bucket = row_bucket
+        #: resilience wiring (DESIGN.md §13): dispatch retry policy,
+        #: device circuit breaker fed flush outcomes, hung-flush watchdog
+        #: deadline, and the last-resort host fallback switch
+        self.breaker = breaker
+        self.retry = retry if retry is not None else rpolicy.RetryPolicy()
+        self.watchdog_s = watchdog_s
+        self.numpy_fallback = numpy_fallback
+        self.supervise = supervise
+        self.supervise_interval_s = supervise_interval_s
         self._decode_pool = ThreadPoolExecutor(
             max_workers=decode_workers,
             thread_name_prefix="kindel-serve-decode",
@@ -95,9 +198,16 @@ class ServeWorker:
         )
         self._intake_thread: threading.Thread | None = None
         self._dispatch_thread: threading.Thread | None = None
+        self._supervisor_thread: threading.Thread | None = None
+        self._stop_event = threading.Event()
         self._draining = False
         self._stopped = False
         self._flush_seq = 0
+        #: in-flight flush registry for the watchdog: key → (deadline,
+        #: entries); registered around every device dispatch attempt
+        self._inflight: dict[int, tuple] = {}
+        self._inflight_lock = threading.Lock()
+        self._inflight_seq = 0
         if metrics is not None:
             self._m_requests = metrics.counter(
                 "kindel_serve_requests_total", "requests accepted"
@@ -112,7 +222,8 @@ class ServeWorker:
             )
             self._m_batch_retries = metrics.counter(
                 "kindel_serve_batch_isolation_retries_total",
-                "flushes re-run one request at a time after a batch failure",
+                "flushes re-run split or one request at a time after a "
+                "batch failure",
             )
             self._m_occupancy = metrics.histogram(
                 "kindel_serve_batch_occupancy",
@@ -136,24 +247,53 @@ class ServeWorker:
                 "wall time of one batched dispatch (pack + launch + "
                 "assemble), labeled by coalescing-lane shape",
             )
+            self._m_watchdog = metrics.counter(
+                "kindel_serve_flush_watchdog_total",
+                "hung flushes timed out by the watchdog (only the "
+                "affected requests fail)",
+            )
+            self._m_restarts = metrics.counter(
+                "kindel_serve_worker_restarts_total",
+                "worker loop threads auto-restarted by the supervisor",
+            )
+            self._m_fallbacks = metrics.counter(
+                "kindel_serve_numpy_fallback_total",
+                "requests served by the per-request numpy fallback after "
+                "the device dispatch failed",
+            )
         else:
             self._m_requests = self._m_failed = self._m_dispatches = None
             self._m_batch_retries = None
             self._m_occupancy = self._m_latency = self._m_pending_rows = None
             self._m_outcomes = self._m_dispatch_s = None
+            self._m_watchdog = self._m_restarts = self._m_fallbacks = None
 
     # ------------------------------------------------------------ lifecycle
 
+    def _start_loop(self, which: str) -> None:
+        if which == "intake":
+            t = threading.Thread(
+                target=self._intake_loop, name="kindel-serve-intake",
+                daemon=True,
+            )
+            self._intake_thread = t
+        else:
+            t = threading.Thread(
+                target=self._dispatch_loop, name="kindel-serve-dispatch",
+                daemon=True,
+            )
+            self._dispatch_thread = t
+        t.start()
+
     def start(self) -> "ServeWorker":
-        self._intake_thread = threading.Thread(
-            target=self._intake_loop, name="kindel-serve-intake", daemon=True
-        )
-        self._dispatch_thread = threading.Thread(
-            target=self._dispatch_loop, name="kindel-serve-dispatch",
-            daemon=True,
-        )
-        self._intake_thread.start()
-        self._dispatch_thread.start()
+        self._start_loop("intake")
+        self._start_loop("dispatch")
+        if self.supervise:
+            self._supervisor_thread = threading.Thread(
+                target=self._supervise_loop, name="kindel-serve-supervisor",
+                daemon=True,
+            )
+            self._supervisor_thread.start()
         return self
 
     def stop(self, drain: bool = True) -> None:
@@ -162,6 +302,11 @@ class ServeWorker:
         if self._stopped:
             return
         self._stopped = True
+        # the supervisor must stand down before the joins below, or it
+        # could resurrect a loop the shutdown is waiting on
+        self._stop_event.set()
+        if self._supervisor_thread is not None:
+            self._supervisor_thread.join()
         if not drain:
             for req in self.queue.close():
                 _fail(req, RuntimeError("service stopped"))
@@ -179,10 +324,83 @@ class ServeWorker:
             self._dispatch_thread.join()
         self._assemble_pool.shutdown(wait=True)
 
+    # ----------------------------------------------------------- supervisor
+
+    def _supervise_loop(self) -> None:
+        """Self-healing: restart a dead intake/dispatch loop (a crashed
+        or fault-killed thread must not wedge the queue) and fail the
+        futures of watchdog-overdue flushes."""
+        while not self._stop_event.wait(self.supervise_interval_s):
+            if self._stopped or self._draining:
+                return
+            for which, t in (
+                ("intake", self._intake_thread),
+                ("dispatch", self._dispatch_thread),
+            ):
+                if t is not None and not t.is_alive():
+                    if self._m_restarts is not None:
+                        self._m_restarts.labels(loop=which).inc()
+                    sp = trace.span("serve.worker_restart")
+                    with sp:
+                        if sp is not trace.NOOP_SPAN:
+                            sp.set_attribute(loop=which)
+                    self._start_loop(which)
+            self._check_watchdog()
+
+    def _check_watchdog(self) -> None:
+        """Fail the futures of flushes past their deadline. The hung
+        dispatch thread itself cannot be unblocked — but its requests
+        resolve NOW with a typed FlushTimeout, and when (if) the thread
+        eventually finishes, _settle loses the race quietly."""
+        if self.watchdog_s is None:
+            return
+        now = time.perf_counter()
+        with self._inflight_lock:
+            overdue = [
+                (key, entries)
+                for key, (deadline, entries) in self._inflight.items()
+                if now >= deadline
+            ]
+            for key, _entries in overdue:
+                del self._inflight[key]
+        for _key, entries in overdue:
+            if self._m_watchdog is not None:
+                self._m_watchdog.inc()
+            if self.breaker is not None:
+                self.breaker.record_failure()
+            for req, _units in entries:
+                self._fail(
+                    req,
+                    FlushTimeout(
+                        f"flush exceeded the {self.watchdog_s}s watchdog "
+                        "deadline (device dispatch hung)"
+                    ),
+                )
+
+    def _watch(self, entries):
+        """Register `entries` with the watchdog for the duration of one
+        dispatch attempt; returns the registry key (None when off)."""
+        if self.watchdog_s is None:
+            return None
+        with self._inflight_lock:
+            self._inflight_seq += 1
+            key = self._inflight_seq
+            self._inflight[key] = (
+                time.perf_counter() + self.watchdog_s, entries
+            )
+        return key
+
+    def _unwatch(self, key) -> None:
+        if key is None:
+            return
+        with self._inflight_lock:
+            self._inflight.pop(key, None)
+
     # --------------------------------------------------------------- intake
 
     def _intake_loop(self) -> None:
         while True:
+            rfaults.hook("serve.worker")
             req = self.queue.get(timeout=0.05)
             if req is None:
                 if self._draining and self.queue.depth == 0:
@@ -199,6 +417,17 @@ class ServeWorker:
             try:
                 units = decode_request(req)
             except BaseException as e:  # noqa: BLE001 — isolation boundary
+                if isinstance(e, (KeyboardInterrupt, SystemExit)):
+                    # shutdown is not a per-request failure: resolve the
+                    # future with a shutdown error and let the interrupt
+                    # propagate to the executor
+                    self._fail(
+                        req,
+                        RuntimeError(
+                            f"service interrupted ({type(e).__name__})"
+                        ),
+                    )
+                    raise
                 if traced:
                     sp.set_attribute(outcome="error", error=repr(e))
                 self._fail(req, e)
@@ -218,6 +447,7 @@ class ServeWorker:
 
     def _dispatch_loop(self) -> None:
         while True:
+            rfaults.hook("serve.worker")
             flush = self.batcher.poll(timeout=0.25)
             if flush is None:
                 # poll yields None on a timeout OR once the batcher is
@@ -226,56 +456,106 @@ class ServeWorker:
                 if self.batcher.closed and self.batcher.pending_rows == 0:
                     return
                 continue
-            self._execute(flush)
+            try:
+                self._execute(flush)
+            except BaseException as e:  # noqa: BLE001
+                # the loop must never die holding unresolved futures:
+                # settle what remains, then re-raise so the thread dies
+                # visibly and the supervisor restarts it
+                for req, _units in flush.entries:
+                    self._fail(
+                        req, RuntimeError(f"serve dispatch aborted: {e!r}")
+                    )
+                raise
             if self._m_pending_rows is not None:
                 self._m_pending_rows.set(self.batcher.pending_rows)
 
     def _execute(self, flush: Flush) -> None:
         self._flush_seq += 1
-        flush_id = self._flush_seq
+        self._dispatch_entries(
+            flush.entries, flush, self._flush_seq, flush.shapes, depth=0
+        )
+
+    def _dispatch_entries(self, entries, flush: Flush, flush_id: int,
+                          shapes, depth: int) -> None:
+        """Dispatch one (possibly split) entry set: retry transients,
+        then hand failures to _recover. Every request in `entries` is
+        settled by the time this returns."""
         t0 = time.perf_counter()
         launch_window: dict = {}
+        wkey = self._watch(entries)
         try:
             with maybe_phase("serve dispatch+assemble"):
-                outputs, units = self._run_entries(
-                    flush.entries, flush.opts, flush.shapes, launch_window
+                outputs, units = self.retry.run(
+                    "serve.flush",
+                    lambda: self._run_entries(
+                        entries, flush.opts, shapes, launch_window
+                    ),
                 )
-        except Exception:
-            # batch-level failure: isolate by re-running one request at a
-            # time so only the culpable request(s) fail
-            if self._m_batch_retries is not None:
-                self._m_batch_retries.inc()
-            for entry in flush.entries:
-                if self._m_dispatches is not None:
-                    self._m_dispatches.inc()
-                    self._m_occupancy.observe(1)
-                e_t0 = time.perf_counter()
-                e_launch: dict = {}
-                try:
-                    outputs, units = self._run_entries(
-                        [entry], flush.opts, None, e_launch
-                    )
-                except BaseException as e:  # noqa: BLE001
-                    self._fail(entry[0], e)
-                    continue
-                self._record_flush_spans(
-                    [entry], flush, flush_id, e_t0, time.perf_counter(),
-                    e_launch, occupancy=1, isolated=True,
-                )
-                self._complete_entries([entry], units, outputs, flush.opts)
+        except Exception as e:
+            self._unwatch(wkey)
+            self._recover(entries, flush, flush_id, depth, e)
             return
+        self._unwatch(wkey)
+        if self.breaker is not None:
+            self.breaker.record_success()
         t1 = time.perf_counter()
         if self._m_dispatches is not None:
             self._m_dispatches.inc()
-            self._m_occupancy.observe(len(flush.entries))
+            self._m_occupancy.observe(len(entries))
             self._m_dispatch_s.labels(
                 shape=_shape_label(flush.shapes)
             ).observe(t1 - t0)
         self._record_flush_spans(
-            flush.entries, flush, flush_id, t0, t1, launch_window,
-            occupancy=len(flush.entries),
+            entries, flush, flush_id, t0, t1, launch_window,
+            occupancy=len(entries), isolated=depth > 0,
         )
-        self._complete_entries(flush.entries, units, outputs, flush.opts)
+        self._complete_entries(entries, units, outputs, flush.opts)
+
+    def _recover(self, entries, flush: Flush, flush_id: int, depth: int,
+                 exc: BaseException) -> None:
+        """Degrade ladder for a failed dispatch (retry already
+        exhausted): bisect on OOM, isolate per-request otherwise, numpy
+        fallback at the singleton — every future resolves."""
+        transient = rpolicy.is_transient(exc)
+        if self.breaker is not None and transient:
+            # only device-level failures feed the breaker: one request's
+            # corrupt input is its own problem, not the device's
+            self.breaker.record_failure()
+        if len(entries) > 1 and depth < 6:
+            if self._m_batch_retries is not None:
+                self._m_batch_retries.inc()
+            if rpolicy.is_oom(exc):
+                # the batch's padded footprint no longer fits: halves
+                # re-derive their own (smaller) pad shapes
+                rpolicy.record_degrade("serve.flush", "bisect", depth + 1)
+                mid = len(entries) // 2
+                parts = [entries[:mid], entries[mid:]]
+            else:
+                # batch-level failure of unknown blame: one request at a
+                # time, so only the culpable request(s) fail
+                parts = [[e] for e in entries]
+            for part in parts:
+                self._dispatch_entries(
+                    part, flush, flush_id, None, depth + 1
+                )
+            return
+        req, _units = entries[0]
+        if self.numpy_fallback and transient:
+            rpolicy.record_degrade(
+                "serve.flush", "numpy_fallback", depth + 1
+            )
+            if self._m_fallbacks is not None:
+                self._m_fallbacks.inc()
+            try:
+                result = numpy_request_result(req)
+            except Exception as fe:  # fallback failed too
+                fe.__cause__ = exc
+                self._fail(req, fe)
+                return
+            self._complete(req, result)
+            return
+        self._fail(req, exc)
 
     def _record_flush_spans(self, entries, flush, flush_id, t0, t1,
                             launch_window, occupancy,
@@ -307,6 +587,7 @@ class ServeWorker:
         (when given) receives the pack+launch interval, the jit
         cache-entry delta, and the upload byte count for the dispatch
         span."""
+        rfaults.hook("serve.flush")
         units = []
         paths = []
         for idx, (req, req_units) in enumerate(entries):
@@ -339,6 +620,8 @@ class ServeWorker:
 
     def _complete(self, req: ServeRequest, result: SampleResult) -> None:
         latency = self._clock() - req.enqueued_at
+        if not _settle(req, result=result):
+            return  # cancelled while queued, or the watchdog beat us
         if self._m_latency is not None:
             self._m_latency.observe(latency)
             self._m_outcomes.labels(outcome="ok").inc()
@@ -347,22 +630,21 @@ class ServeWorker:
         if sp is not None and sp is not trace.NOOP_SPAN:
             sp.set_attribute(outcome="ok", latency_s=round(latency, 6))
             sp.finish()
-        if not req.future.set_running_or_notify_cancel():
-            return  # caller cancelled while queued
-        req.future.set_result(result)
 
     def _fail(self, req: ServeRequest, exc: BaseException) -> None:
         """Fail one request's future, counting and closing its trace."""
+        if not _fail(req, exc):
+            return  # already settled — count nothing twice
         if self._m_failed is not None:
             self._m_failed.inc()
             self._m_outcomes.labels(outcome="error").inc()
-        _fail(req, exc)
 
 
-def _fail(req: ServeRequest, exc: BaseException) -> None:
+def _fail(req: ServeRequest, exc: BaseException) -> bool:
+    if not _settle(req, exc=exc):
+        return False
     sp = req.span
     if sp is not None and sp is not trace.NOOP_SPAN:
         sp.set_attribute(outcome="error", error=repr(exc))
         sp.finish()
-    if req.future.set_running_or_notify_cancel():
-        req.future.set_exception(exc)
+    return True

@@ -39,16 +39,31 @@ from kindel_tpu.pileup import (
 def _stream_reduce(acc, path, chunk_bytes) -> None:
     """Drive the chunked decode→reduce loop under one span, counting
     chunks into the process-global registry (the serve/bench exposition
-    sees streamed work too)."""
+    sees streamed work too). A truncated/corrupt input dies with the
+    typed TruncatedInputError naming which chunk of which file — the
+    span and a counter record the casualty."""
+    from kindel_tpu.io.errors import TruncatedInputError
+
     chunks = default_registry().counter(
         "kindel_stream_chunks_total",
         "streamed decode chunks reduced into accumulator state",
     )
     with obs_trace.span("stream.reduce") as sp:
         n = 0
-        for batch in stream_alignment(path, chunk_bytes):
-            acc.add_batch(batch)
-            n += 1
+        try:
+            for batch in stream_alignment(path, chunk_bytes):
+                acc.add_batch(batch)
+                n += 1
+        except TruncatedInputError as e:
+            default_registry().counter(
+                "kindel_stream_truncated_total",
+                "streamed decodes aborted by a truncated/corrupt chunk",
+            ).inc()
+            if sp is not obs_trace.NOOP_SPAN:
+                sp.set_attribute(
+                    chunks=n, truncated_chunk=e.chunk_index, error=str(e)
+                )
+            raise
         chunks.inc(n)
         if sp is not obs_trace.NOOP_SPAN:
             sp.set_attribute(

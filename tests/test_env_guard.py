@@ -203,3 +203,103 @@ def test_metric_registrations_carry_help_text():
     assert registrations >= 15, (
         f"only {registrations} registration calls found"
     )
+
+
+#: handler calls that count as "the failure was handled, not swallowed":
+#: resolving a request future, recording it on the breaker/metrics, or
+#: handing it to the degrade ladder (which itself settles every future)
+_FAILURE_HANDLERS = {
+    "_fail", "fail", "_settle", "set_exception", "record_failure",
+    "_recover", "record_degrade",
+}
+
+#: deliberately-swallowing sites, each with a local reason:
+#: service._warm — warmup is best-effort, failure is recorded on
+#: _warm_error and /healthz; service._handle_consensus_post — the
+#: handler IS the failure path (it converts to an HTTP 5xx response)
+_SWALLOW_ALLOWLIST = {
+    ("serve/service.py", "_warm"),
+    ("serve/service.py", "_handle_consensus_post"),
+}
+
+
+def test_no_silent_exception_swallow_in_serve_or_resilience():
+    """Every `except Exception` / `except BaseException` in the serving
+    and resilience layers must re-raise, resolve a future, or record the
+    failure — a handler that does none of those is exactly how an
+    admitted request gets silently lost (the invariant the chaos suite
+    enforces dynamically; this guard catches the sites tests never
+    reach)."""
+
+    def names_in(node) -> set:
+        out = set()
+        for n in ast.walk(node):
+            if isinstance(n, ast.Name):
+                out.add(n.id)
+            elif isinstance(n, ast.Attribute):
+                out.add(n.attr)
+        return out
+
+    def catches_broad(handler: ast.ExceptHandler) -> bool:
+        if handler.type is None:  # bare `except:`
+            return True
+        return bool(
+            names_in(handler.type) & {"Exception", "BaseException"}
+        )
+
+    def handles_failure(handler: ast.ExceptHandler) -> bool:
+        for n in ast.walk(handler):
+            if isinstance(n, ast.Raise):
+                return True
+            if isinstance(n, ast.Call):
+                f = n.func
+                name = (
+                    f.attr if isinstance(f, ast.Attribute)
+                    else f.id if isinstance(f, ast.Name) else None
+                )
+                if name in _FAILURE_HANDLERS:
+                    return True
+        return False
+
+    def enclosing_functions(tree):
+        out = {}
+
+        def visit(node, fname):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fname = node.name
+            out[node] = fname
+            for child in ast.iter_child_nodes(node):
+                visit(child, fname)
+
+        visit(tree, "<module>")
+        return out
+
+    offenders = []
+    sites = 0
+    for sub in ("serve", "resilience"):
+        for py in sorted((PKG / sub).rglob("*.py")):
+            rel = str(py.relative_to(PKG)).replace("\\", "/")
+            tree = ast.parse(py.read_text(), filename=str(py))
+            owners = enclosing_functions(tree)
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                if not catches_broad(node):
+                    continue
+                sites += 1
+                key = (rel, owners.get(node, "<module>"))
+                if key in _SWALLOW_ALLOWLIST:
+                    continue
+                if not handles_failure(node):
+                    offenders.append(
+                        f"kindel_tpu/{rel}:{node.lineno} "
+                        f"(in {owners.get(node, '<module>')})"
+                    )
+    assert not offenders, (
+        "broad except that neither re-raises, resolves a future, nor "
+        "records the failure — add handling or extend "
+        "_SWALLOW_ALLOWLIST with a justification:\n" + "\n".join(offenders)
+    )
+    # blindness check: the serve/resilience layers deliberately hold
+    # several isolation boundaries; ~0 means the detector went blind
+    assert sites >= 5, f"only {sites} broad except sites found"
