@@ -136,6 +136,12 @@ def _consensus_parser(sub):
              "resolution order; `kindel tune` measures and persists a "
              "per-host winner)",
     )
+    p.add_argument(
+        "--ingest-workers", type=int, default=None, metavar="N",
+        help="pin the parallel BGZF-inflate worker count (top of the "
+             "explicit > $KINDEL_TPU_INGEST_WORKERS > tune store > "
+             "per-core default order; 1 = the serial inflate path)",
+    )
     _add_backend(p)
 
 
@@ -147,10 +153,12 @@ def cmd_consensus(args) -> int:
         timer = enable_profiling()
         timer.start_trace()
     tuning = None
-    if args.slabs is not None:
+    if args.slabs is not None or args.ingest_workers is not None:
         from kindel_tpu.tune import TuningConfig
 
-        tuning = TuningConfig(n_slabs=args.slabs)
+        tuning = TuningConfig(
+            n_slabs=args.slabs, ingest_workers=args.ingest_workers
+        )
     try:
         res = workloads.bam_to_consensus(
             args.bam_path,
@@ -500,14 +508,20 @@ def _tune_parser(sub):
              "noisy on shared hosts)",
     )
     p.add_argument(
+        "--ingest-budget-s", type=float, default=20.0,
+        help="wall budget for the parallel-ingest worker sweep (streamed "
+             "decode passes over the same BAM); 0 skips it",
+    )
+    p.add_argument(
         "--dry-run", action="store_true",
         help="measure and report, but do not write the tune store",
     )
 
 
 def cmd_tune(args) -> int:
-    """Offline host pre-tune: the bench's budget-bounded slab search,
-    run through the library (kindel_tpu.tune) and persisted."""
+    """Offline host pre-tune: the bench's budget-bounded slab search
+    plus the parallel-ingest worker sweep, run through the library
+    (kindel_tpu.tune) and persisted."""
     import json
     import time as _time
 
@@ -552,6 +566,38 @@ def cmd_tune(args) -> int:
                 "bam_path": str(args.bam_path),
             },
         )
+
+    # parallel-ingest sweep: streamed decode passes with the worker
+    # count explicit (same no-env-mutation contract as the slab search);
+    # the winner persists host-keyed so every streamed entry point —
+    # CLI, serve decode, bench — starts with a measured pool size
+    ingest_chosen, ingest_timings, ingest_persisted = 1, {}, False
+    if args.ingest_budget_s > 0:
+        from kindel_tpu.io.stream import stream_alignment
+
+        def ingest_pass(workers: int) -> float:
+            t = _time.perf_counter()
+            for _batch in stream_alignment(
+                args.bam_path, 16 << 20, ingest_workers=workers
+            ):
+                pass
+            return _time.perf_counter() - t
+
+        ingest_chosen, ingest_timings = tune.search_ingest_workers(
+            ingest_pass, budget_s=args.ingest_budget_s
+        )
+        if not args.dry_run and ingest_timings:
+            ingest_persisted = tune.record(
+                tune.ingest_store_key(),
+                {
+                    "ingest_workers": ingest_chosen,
+                    "timings_s": {
+                        str(k): round(v, 4)
+                        for k, v in ingest_timings.items()
+                    },
+                    "bam_path": str(args.bam_path),
+                },
+            )
     print(
         json.dumps(
             {
@@ -561,6 +607,11 @@ def cmd_tune(args) -> int:
                 "n_slabs": chosen,
                 "timings_s": {str(k): round(v, 4) for k, v in timings.items()},
                 "tune_wall_s": round(wall, 3),
+                "ingest_workers": ingest_chosen,
+                "ingest_timings_s": {
+                    str(k): round(v, 4) for k, v in ingest_timings.items()
+                },
+                "ingest_persisted": ingest_persisted,
                 "persisted": persisted,
                 "store": str(tune.store_path()),
             }

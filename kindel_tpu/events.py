@@ -123,6 +123,21 @@ def _advances(op_code, op_len, op_i):
 
 
 def extract_events(batch: ReadBatch) -> EventSet:
+    """Expand a ReadBatch's CIGAR ops into columnar event streams. The
+    wall goes to `kindel_ingest_expand_seconds_total`: together with the
+    inflate/scan/stall counters (kindel_tpu.io.inflate) it splits a
+    host-bound ingest into its attributable stages (bench `ingest`)."""
+    import time
+
+    from kindel_tpu.obs import runtime as obs_runtime
+
+    t0 = time.perf_counter()
+    out = _extract_events_impl(batch)
+    obs_runtime.ingest_counters().expand_s.inc(time.perf_counter() - t0)
+    return out
+
+
+def _extract_events_impl(batch: ReadBatch) -> EventSet:
     ref_lens = batch.ref_lens
     n_reads = batch.n_reads
 

@@ -26,15 +26,26 @@ def load_alignment_bytes(data: bytes, label: str = "<bytes>") -> ReadBatch:
     serve HTTP endpoint POSTs alignment bytes straight off the socket).
     `label` names the payload in error messages."""
     if bgzf.is_gzipped(data):
-        decompressed = None
-        try:
-            from kindel_tpu.io import native
+        from kindel_tpu import tune
 
-            if native.available():
-                decompressed = native.bgzf_decompress(data)
-        except Exception:
-            decompressed = None
-        data = decompressed if decompressed is not None else bgzf.decompress(data)
+        workers, _src = tune.resolve_ingest_workers()
+        decompressed = None
+        if workers <= 1:
+            # native one-pass inflate wins only when there is no
+            # parallelism to spend; with workers the shared pool
+            # (kindel_tpu.io.inflate) overlaps member inflation instead
+            try:
+                from kindel_tpu.io import native
+
+                if native.available():
+                    decompressed = native.bgzf_decompress(data)
+            except Exception:
+                decompressed = None
+        data = (
+            decompressed
+            if decompressed is not None
+            else bgzf.decompress(data, workers=workers)
+        )
     if data[:4] == b"BAM\x01":
         try:
             from kindel_tpu.io import native

@@ -11,7 +11,6 @@ chunked/streamed and later handed to the native C++ decoder.
 from __future__ import annotations
 
 import struct
-import zlib
 
 from kindel_tpu.io.errors import TruncatedInputError
 
@@ -54,43 +53,20 @@ def _member_bsize(data: bytes, off: int) -> int | None:
     return None
 
 
-def decompress(data: bytes) -> bytes:
+def decompress(data: bytes, workers: int | None = None) -> bytes:
     """Decompress a BGZF (or plain single/multi-member gzip) byte string.
+
+    The inflate itself runs through the single chokepoint
+    (kindel_tpu.io.inflate): member payloads fan out to the shared
+    bounded worker pool and reassemble in order, so the output — and the
+    error surface — is byte-identical for every worker count. `workers`
+    pins the parallelism explicitly; None resolves it through
+    kindel_tpu.tune (explicit > $KINDEL_TPU_INGEST_WORKERS > store >
+    host default).
 
     Malformed input — truncated members, lying BSIZE fields, corrupt
     deflate payloads — raises ValueError (zlib.error is wrapped so callers
     see one clean exception type for any corrupt alignment file)."""
-    out = []
-    off = 0
-    n = len(data)
-    try:
-        while off < n:
-            bsize = _member_bsize(data, off)
-            if bsize is not None:
-                if bsize < 26 or off + bsize > n:
-                    raise TruncatedInputError(
-                        f"corrupt BGZF member (BSIZE={bsize})", offset=off
-                    )
-                # Deflate payload sits between the 18-byte BGZF header and
-                # the 8-byte CRC/ISIZE trailer.
-                payload = data[off + 18 : off + bsize - 8]
-                out.append(zlib.decompress(payload, wbits=-15))
-                off += bsize
-            else:
-                # Generic gzip member: let zlib find the member end.
-                dobj = zlib.decompressobj(wbits=31)
-                out.append(dobj.decompress(data[off:]))
-                out.append(dobj.flush())
-                if not dobj.eof:
-                    # input exhausted mid-member: silent partial output
-                    # would drop trailing reads without a trace
-                    raise TruncatedInputError(
-                        "truncated gzip member", offset=off
-                    )
-                consumed = len(data) - off - len(dobj.unused_data)
-                if consumed <= 0:
-                    break
-                off += consumed
-    except zlib.error as exc:
-        raise ValueError(f"corrupt gzip stream at offset {off}: {exc}") from exc
-    return b"".join(out)
+    from kindel_tpu.io.inflate import resolved_inflater
+
+    return resolved_inflater(workers).decompress(data)

@@ -1,0 +1,529 @@
+"""Pipelined parallel BGZF inflation — the single inflate chokepoint.
+
+Round-5 verdict: both bench runs were host-bound with BGZF inflation on
+the critical path, inflating members strictly serially on the consumer
+thread — even though BGZF members are independent ≤64 KiB gzip units and
+CPython's ``zlib`` releases the GIL for the whole inflate call. This
+module restructures the feed path (SURVEY §7: host ingest must never
+stall device compute):
+
+  read slabs → serial member-boundary scan (cheap header walk,
+  ``bgzf._member_bsize``) → payloads fan out to a bounded shared
+  ThreadPoolExecutor → **in-order** reassembly behind a bounded
+  in-flight-bytes window → decompressed chunks to the caller
+
+Every inflate path in the package funnels through here (pinned by the
+tier-1 AST guard: ``zlib`` may only be touched inside ``kindel_tpu/io/``):
+
+  * ``bgzf.decompress``        — slurp path (``ParallelInflater.decompress``)
+  * ``io.stream._inflate_stream`` — streamed path (``.stream``); record
+    scan + CIGAR event expansion of chunk k overlap inflation of chunk
+    k+1 and the donated device scatter of chunk k−1 (streaming.py)
+  * serve decode               — every request's ``load_alignment_bytes``
+    shares the ONE process pool (``shared_pool``), so concurrent decode
+    threads queue members instead of oversubscribing the host
+
+Invariants:
+
+  * **Ordering** — outputs are reassembled in submission order, so the
+    decompressed byte sequence is byte-identical to the serial path for
+    every worker count, including which bytes precede an error: on a
+    scan failure the pending backlog drains (in order, surfacing any
+    earlier member's inflate error first) before the scan error raises.
+    Downstream chunk indices — and therefore the ``io.read_chunk`` fault
+    hook's deterministic truncation attribution — are unchanged.
+  * **Bounded RSS** — at most ``max_inflight_bytes`` of decompressed
+    output (estimated from each member's ISIZE trailer) plus a hard
+    ``_MAX_PENDING`` member cap is in flight, so the streamed decode's
+    documented O(chunk) bound survives (``benchmarks/rss_stream.py``).
+  * **Serial fast path** — ``workers <= 1`` inflates inline with no
+    futures, no queue, and no pool: the overhead vs the seed is one
+    ``perf_counter`` pair per member.
+  * **No jax in workers** — pool threads execute only ``_inflate_member``
+    (pure ``zlib``); the tier-1 guard additionally pins that nothing
+    under ``kindel_tpu/io/`` imports jax, so an inflate worker can never
+    trip a backend initialization mid-stream.
+
+Generic (non-BGZF) gzip members carry no BSIZE and zlib must find their
+end, which is inherently serial: the pending backlog drains, the member
+inflates via a ``max_length``-bounded decompressobj loop (a pathological
+member can never materialize GBs in one allocation), and parallel
+scanning resumes at its ``unused_data``.
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+import time
+import zlib
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Iterator
+
+from kindel_tpu.io import bgzf
+from kindel_tpu.io.errors import TruncatedInputError
+
+#: compressed-side read size (one copy — io.stream re-exports it)
+SLAB_BYTES = 8 << 20
+
+#: inflate output cap per decompressobj step on the generic-gzip path —
+#: text SAM compresses 100-1000×, so an unbounded decompress of one
+#: member could materialize GBs in a single allocation
+MAX_INFLATE_STEP = 32 << 20
+
+#: default decompressed-bytes window queued ahead of the consumer; the
+#: tuned knob is ingest prefetch (kindel_tpu.tune.resolve_ingest_prefetch_mb)
+DEFAULT_PREFETCH_BYTES = 8 << 20
+
+#: hard cap on queued members whatever the byte window says (a stream of
+#: empty/tiny members must not grow the deque without bound)
+_MAX_PENDING = 512
+
+#: BGZF per-member framing overhead: 18-byte header + 8-byte trailer
+_MEMBER_OVERHEAD = 26
+
+
+def _inflate_member(payload: bytes):
+    """Pool worker: one raw-deflate member payload → (bytes, wall_s).
+    Touches only zlib — never jax (zlib releases the GIL, so W workers
+    genuinely inflate W members concurrently)."""
+    t0 = time.perf_counter()
+    out = zlib.decompress(payload, wbits=-15)
+    return out, time.perf_counter() - t0
+
+
+# ------------------------------------------------------------ shared pool
+
+_POOL: ThreadPoolExecutor | None = None
+_POOL_WORKERS = 0
+_POOL_LOCK = threading.Lock()
+
+
+def shared_pool(workers: int) -> ThreadPoolExecutor:
+    """The ONE process-wide inflate pool (grown, never shrunk): the CLI
+    stream, slurp decodes, and every serve decode thread share it, so
+    concurrent requests queue members instead of multiplying threads."""
+    global _POOL, _POOL_WORKERS
+    workers = max(1, int(workers))
+    with _POOL_LOCK:
+        if _POOL is None or _POOL_WORKERS < workers:
+            # the old pool (if any) finishes its queued members and is
+            # collected; in-flight futures stay valid
+            _POOL = ThreadPoolExecutor(
+                max_workers=workers, thread_name_prefix="kindel-ingest"
+            )
+            _POOL_WORKERS = workers
+        return _POOL
+
+
+def pool_workers() -> int:
+    """Current shared-pool size (0 before first use) — bench provenance."""
+    return _POOL_WORKERS
+
+
+class IngestStats:
+    """Per-run accumulator flushed once into the process counters (the
+    per-member hot path pays local attribute adds, not registry locks)."""
+
+    __slots__ = (
+        "workers", "members", "generic", "bytes_in", "bytes_out",
+        "inflate_s", "inline_s", "stall_s", "read_s", "scan_s",
+    )
+
+    def __init__(self, workers: int):
+        self.workers = workers
+        self.members = 0
+        self.generic = 0
+        self.bytes_in = 0
+        self.bytes_out = 0
+        self.inflate_s = 0.0  # summed inflate wall (pool + inline)
+        self.inline_s = 0.0  # the inline (consumer-thread) share of it
+        self.stall_s = 0.0  # consumer blocked on the head-of-line future
+        self.read_s = 0.0  # fh.read wall
+        self.scan_s = 0.0  # serial scan/reassembly (derived at flush)
+
+    def flush(self, span, producer_s: float | None = None) -> None:
+        """Fold this run into the process-global ingest counters and the
+        (optional) span; `producer_s` is the total consumer-thread wall,
+        from which the serial-scan share is derived."""
+        if producer_s is not None:
+            self.scan_s = max(
+                0.0,
+                producer_s - self.read_s - self.stall_s - self.inline_s,
+            )
+        from kindel_tpu.obs import runtime as obs_runtime
+        from kindel_tpu.obs import trace as obs_trace
+
+        c = obs_runtime.ingest_counters()
+        c.members.inc(self.members)
+        c.bytes_in.inc(self.bytes_in)
+        c.bytes_out.inc(self.bytes_out)
+        c.inflate_s.inc(self.inflate_s)
+        c.scan_s.inc(self.scan_s)
+        c.stall_s.inc(self.stall_s)
+        c.read_s.inc(self.read_s)
+        c.workers.set(self.workers)
+        if span is not None and span is not obs_trace.NOOP_SPAN:
+            span.set_attribute(
+                workers=self.workers,
+                members=self.members,
+                generic_members=self.generic,
+                bytes_in=self.bytes_in,
+                bytes_out=self.bytes_out,
+                inflate_s=round(self.inflate_s, 4),
+                scan_s=round(self.scan_s, 4),
+                stall_s=round(self.stall_s, 4),
+            )
+
+
+class ParallelInflater:
+    """Ordered parallel inflation of a BGZF member sequence.
+
+    One instance drives one stream or one slurp call; the thread pool
+    behind it is process-shared (``shared_pool``). ``workers <= 1`` is
+    the serial fast path: no futures, no pool, inline inflate.
+    """
+
+    def __init__(self, workers: int = 1,
+                 max_inflight_bytes: int = DEFAULT_PREFETCH_BYTES):
+        self.workers = max(1, int(workers))
+        self.max_inflight_bytes = max(int(max_inflight_bytes), 1 << 16)
+        self._inflight = 0  # estimated decompressed bytes queued
+
+    # ------------------------------------------------------ queue plumbing
+
+    def _submit(self, pending: deque, payload: bytes, isize: int,
+                st: IngestStats, err_off: int | None = None) -> None:
+        """Queue one member payload on the shared pool. `err_off` is the
+        member's byte offset for slurp-path error wrapping (None on the
+        streamed path, which propagates zlib.error raw, as the serial
+        code did)."""
+        cost = max(isize, len(payload), 1)
+        fut = shared_pool(self.workers).submit(_inflate_member, payload)
+        self._inflight += cost
+        pending.append((fut, cost, err_off))
+
+    def _pop(self, pending: deque, st: IngestStats) -> bytes:
+        """Blocking in-order pop of the head member's output."""
+        fut, cost, err_off = pending.popleft()
+        self._inflight -= cost
+        t0 = time.perf_counter()
+        try:
+            out, wall = fut.result()
+        except zlib.error as exc:
+            if err_off is None:
+                raise
+            raise ValueError(
+                f"corrupt gzip stream at offset {err_off}: {exc}"
+            ) from exc
+        st.stall_s += time.perf_counter() - t0
+        st.inflate_s += wall
+        st.bytes_out += len(out)
+        return out
+
+    def _inline(self, payload: bytes, st: IngestStats,
+                err_off: int | None = None) -> bytes:
+        """Serial fast path: inflate on the consumer thread."""
+        t0 = time.perf_counter()
+        try:
+            out = zlib.decompress(payload, wbits=-15)
+        except zlib.error as exc:
+            if err_off is None:
+                raise
+            raise ValueError(
+                f"corrupt gzip stream at offset {err_off}: {exc}"
+            ) from exc
+        wall = time.perf_counter() - t0
+        st.inflate_s += wall
+        st.inline_s += wall
+        st.bytes_out += len(out)
+        return out
+
+    def _read(self, fh, st: IngestStats) -> bytes:
+        t0 = time.perf_counter()
+        out = fh.read(SLAB_BYTES)
+        st.read_s += time.perf_counter() - t0
+        return out
+
+    # ---------------------------------------------------------- streamed
+
+    def stream(self, fh) -> Iterator[bytes]:
+        """Yield decompressed byte chunks from a BGZF / gzip / plain
+        stream — the parallel replacement for the serial member walk in
+        ``io.stream._inflate_stream`` (byte-identical output for every
+        worker count). One ``ingest.inflate`` span covers the run."""
+        from kindel_tpu.obs import trace as obs_trace
+
+        st = IngestStats(self.workers)
+        sp = obs_trace.start_span("ingest.inflate")
+        gen = self._stream_impl(fh, st)
+        producer_s = 0.0
+        try:
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    chunk = next(gen)
+                except StopIteration:
+                    producer_s += time.perf_counter() - t0
+                    return
+                producer_s += time.perf_counter() - t0
+                yield chunk
+        finally:
+            st.flush(sp, producer_s)
+            if sp is not obs_trace.NOOP_SPAN:
+                sp.finish()
+
+    def _drain(self, pending: deque, st: IngestStats) -> Iterator[bytes]:
+        while pending:
+            yield self._pop(pending, st)
+
+    def _stream_impl(self, fh, st: IngestStats) -> Iterator[bytes]:
+        # sniffing needs two bytes: a pipe-like fh whose first read
+        # returns a single byte must not route a gzip stream down the
+        # plain-text path — loop until >=2 bytes or EOF before deciding
+        buf = bytearray()
+        while len(buf) < 2:
+            more = self._read(fh, st)
+            if not more:
+                break
+            buf += more
+        if not bgzf.is_gzipped(bytes(buf[:2])):
+            while buf:
+                yield bytes(buf)
+                buf = bytearray(self._read(fh, st))
+            return
+
+        parallel = self.workers > 1
+        pending: deque = deque()
+        dobj = None  # active generic-gzip decompressor, if any
+        eof = False
+        while True:
+            # keep the queued-output window bounded: pop (in order) when
+            # the estimated decompressed backlog or member count tops out
+            while pending and (
+                self._inflight >= self.max_inflight_bytes
+                or len(pending) >= _MAX_PENDING
+            ):
+                yield self._pop(pending, st)
+
+            if dobj is not None:
+                # generic gzip member: strictly serial (pending already
+                # drained before entering this mode)
+                if not buf:
+                    more = self._read(fh, st)
+                    if not more:
+                        # input exhausted mid-member (dobj is only live
+                        # here while eof is False): flushing the partial
+                        # output would silently drop every trailing read,
+                        # same contract as the slurp path
+                        raise ValueError(
+                            "truncated gzip member at end of stream"
+                        )
+                    buf = bytearray(more)
+                fed = len(buf)
+                t0 = time.perf_counter()
+                out = dobj.decompress(bytes(buf), MAX_INFLATE_STEP)
+                chunks = [out] if out else []
+                while dobj.unconsumed_tail and not dobj.eof:
+                    out = dobj.decompress(
+                        dobj.unconsumed_tail, MAX_INFLATE_STEP
+                    )
+                    if out:
+                        chunks.append(out)
+                wall = time.perf_counter() - t0
+                st.inflate_s += wall
+                st.inline_s += wall
+                for out in chunks:
+                    st.bytes_out += len(out)
+                    yield out
+                if dobj.eof:
+                    st.bytes_in += fed - len(dobj.unused_data)
+                    buf = bytearray(dobj.unused_data)
+                    dobj = None
+                else:
+                    st.bytes_in += fed
+                    buf = bytearray()
+                continue
+
+            if len(buf) < 18:
+                if eof:
+                    if buf:
+                        yield from self._drain(pending, st)
+                        raise TruncatedInputError(
+                            f"truncated gzip stream ({len(buf)} "
+                            "trailing bytes)"
+                        )
+                    break
+                more = self._read(fh, st)
+                if not more:
+                    eof = True
+                else:
+                    buf += more
+                continue
+
+            # buffer the whole FEXTRA area before probing for the BC
+            # subfield — a conforming gzip member may carry extra fields
+            # past byte 18
+            if buf[3] & 4:
+                xlen = struct.unpack_from("<H", buf, 10)[0]
+                while len(buf) < 12 + xlen:
+                    more = self._read(fh, st)
+                    if not more:
+                        yield from self._drain(pending, st)
+                        raise TruncatedInputError(
+                            "truncated gzip FEXTRA field at end of stream"
+                        )
+                    buf += more
+                header = bytes(buf[: 12 + xlen])
+            else:
+                header = bytes(buf[:18])
+            bsize = bgzf._member_bsize(header, 0)
+            if bsize is None:
+                # ordering invariant: everything queued must come out
+                # before this member's output
+                yield from self._drain(pending, st)
+                st.generic += 1
+                dobj = zlib.decompressobj(wbits=31)
+                continue
+            while len(buf) < bsize:
+                more = self._read(fh, st)
+                if not more:
+                    yield from self._drain(pending, st)
+                    raise TruncatedInputError(
+                        f"truncated BGZF member (have {len(buf)} of "
+                        f"{bsize} bytes)"
+                    )
+                buf += more
+            payload = bytes(buf[18: bsize - 8])
+            isize = struct.unpack_from("<I", buf, bsize - 4)[0]
+            del buf[:bsize]
+            st.members += 1
+            st.bytes_in += len(payload) + _MEMBER_OVERHEAD
+            if parallel:
+                self._submit(pending, payload, isize, st)
+            else:
+                yield self._inline(payload, st)
+        yield from self._drain(pending, st)
+
+    # --------------------------------------------------------------- slurp
+
+    def decompress(self, data: bytes) -> bytes:
+        """Decompress a whole BGZF (or plain single/multi-member gzip)
+        byte string — the parallel engine behind ``bgzf.decompress``.
+        Error surface is identical to the serial walk: malformed input
+        raises ValueError/TruncatedInputError, zlib errors are wrapped
+        with the failing member's offset, and an earlier member's
+        inflate error always wins over a later scan error (the backlog
+        drains before a scan failure propagates)."""
+        from kindel_tpu.obs import trace as obs_trace
+
+        st = IngestStats(self.workers)
+        sp = obs_trace.start_span("ingest.decompress")
+        t_start = time.perf_counter()
+        parallel = self.workers > 1
+        out: list[bytes] = []
+        pending: deque = deque()
+
+        def drain() -> None:
+            while pending:
+                out.append(self._pop(pending, st))
+
+        try:
+            off = 0
+            n = len(data)
+            while off < n:
+                while pending and (
+                    self._inflight >= self.max_inflight_bytes
+                    or len(pending) >= _MAX_PENDING
+                ):
+                    out.append(self._pop(pending, st))
+                try:
+                    bsize = bgzf._member_bsize(data, off)
+                except Exception:
+                    drain()  # an earlier member's inflate error wins
+                    raise
+                if bsize is not None:
+                    if bsize < 26 or off + bsize > n:
+                        drain()
+                        raise TruncatedInputError(
+                            f"corrupt BGZF member (BSIZE={bsize})",
+                            offset=off,
+                        )
+                    # deflate payload sits between the 18-byte BGZF
+                    # header and the 8-byte CRC/ISIZE trailer
+                    payload = data[off + 18: off + bsize - 8]
+                    isize = struct.unpack_from("<I", data, off + bsize - 4)[0]
+                    st.members += 1
+                    st.bytes_in += bsize
+                    if parallel:
+                        self._submit(pending, payload, isize, st,
+                                     err_off=off)
+                    else:
+                        out.append(self._inline(payload, st, err_off=off))
+                    off += bsize
+                else:
+                    # generic gzip member: zlib finds the member end;
+                    # inherently serial, and bounded per step so one
+                    # member cannot materialize GBs in one allocation
+                    drain()
+                    st.generic += 1
+                    try:
+                        dobj = zlib.decompressobj(wbits=31)
+                        t0 = time.perf_counter()
+                        chunk = dobj.decompress(
+                            data[off:], MAX_INFLATE_STEP
+                        )
+                        if chunk:
+                            out.append(chunk)
+                        while dobj.unconsumed_tail and not dobj.eof:
+                            chunk = dobj.decompress(
+                                dobj.unconsumed_tail, MAX_INFLATE_STEP
+                            )
+                            if chunk:
+                                out.append(chunk)
+                        chunk = dobj.flush()
+                        if chunk:
+                            out.append(chunk)
+                        wall = time.perf_counter() - t0
+                        st.inflate_s += wall
+                        st.inline_s += wall
+                    except zlib.error as exc:
+                        raise ValueError(
+                            f"corrupt gzip stream at offset {off}: {exc}"
+                        ) from exc
+                    if not dobj.eof:
+                        # input exhausted mid-member: silent partial
+                        # output would drop trailing reads
+                        raise TruncatedInputError(
+                            "truncated gzip member", offset=off
+                        )
+                    consumed = n - off - len(dobj.unused_data)
+                    if consumed <= 0:
+                        break
+                    st.bytes_in += consumed
+                    off += consumed
+            drain()
+            result = b"".join(out)
+            st.bytes_out = len(result)
+            return result
+        finally:
+            st.flush(sp, time.perf_counter() - t_start)
+            if sp is not obs_trace.NOOP_SPAN:
+                sp.finish()
+
+
+# --------------------------------------------------------- resolved entry
+
+def resolved_inflater(workers: int | None = None) -> ParallelInflater:
+    """ParallelInflater with its knobs resolved through kindel_tpu.tune:
+    explicit arg > KINDEL_TPU_INGEST_WORKERS > tune store > default (one
+    resolution rule, applied at the ingest entry points — never per
+    member)."""
+    from kindel_tpu import tune
+
+    w, _src = tune.resolve_ingest_workers(workers)
+    prefetch_mb, _src2 = tune.resolve_ingest_prefetch_mb()
+    return ParallelInflater(
+        workers=w, max_inflight_bytes=int(prefetch_mb * (1 << 20))
+    )

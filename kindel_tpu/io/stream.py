@@ -5,7 +5,8 @@ The reference materializes the whole file before accumulating
 kept that posture. Here one large SAM/BAM streams as a sequence of columnar
 ReadBatch chunks:
 
-  compressed file → slab reads (8 MB) → incremental BGZF member inflate →
+  compressed file → slab reads (8 MB) → serial member-boundary scan →
+  parallel pool inflate + ordered reassembly (io.inflate) →
   decompressed buffer → complete-record scan (tail carried to the next
   chunk) → vectorized field extraction (io.bam._fields_from_offsets)
 
@@ -20,7 +21,6 @@ falls out of jax's async dispatch).
 from __future__ import annotations
 
 import struct
-import zlib
 from pathlib import Path
 from typing import Iterator
 
@@ -29,101 +29,24 @@ import numpy as np
 from kindel_tpu.io import bgzf
 from kindel_tpu.io.bam import _fields_from_offsets
 from kindel_tpu.io.errors import TruncatedInputError
+from kindel_tpu.io.inflate import resolved_inflater
 from kindel_tpu.io.records import ReadBatch
 from kindel_tpu.io.sam import parse_sam_bytes
 from kindel_tpu.resilience import faults as _faults
 
-_SLAB = 8 << 20  # compressed-side read size
 DEFAULT_CHUNK_BYTES = 64 << 20  # decompressed bytes per yielded batch
-#: inflate output cap per yielded chunk on the generic-gzip path — text
-#: SAM compresses 100-1000×, so an uncapped decompress of one slab could
-#: materialize GBs and break the O(chunk) RSS bound
-_MAX_INFLATE = 32 << 20
 
 
-def _inflate_stream(fh) -> Iterator[bytes]:
-    """Yield decompressed byte chunks from a BGZF / gzip / plain stream.
-
-    BGZF members inflate individually (raw deflate between the 18-byte
-    header and 8-byte trailer); generic gzip members fall back to a
-    streaming decompressobj. Plain (uncompressed) input passes through.
-    """
-    buf = bytearray(fh.read(_SLAB))
-    if not bgzf.is_gzipped(bytes(buf[:2])):
-        while buf:
-            yield bytes(buf)
-            buf = bytearray(fh.read(_SLAB))
-        return
-
-    dobj = None  # active generic-gzip decompressor, if any
-    while buf or dobj is not None:
-        if dobj is not None:
-            if not buf:
-                more = fh.read(_SLAB)
-                if not more:
-                    # input exhausted mid-member (dobj is only live here
-                    # while eof is False — a finished member clears it to
-                    # None below): flushing the partial output would
-                    # silently drop every trailing read, same contract as
-                    # bgzf.decompress on the slurp path
-                    raise ValueError(
-                        "truncated gzip member at end of stream"
-                    )
-                buf = bytearray(more)
-            out = dobj.decompress(bytes(buf), _MAX_INFLATE)
-            if out:
-                yield out
-            while dobj.unconsumed_tail and not dobj.eof:
-                out = dobj.decompress(dobj.unconsumed_tail, _MAX_INFLATE)
-                if out:
-                    yield out
-            if dobj.eof:
-                buf = bytearray(dobj.unused_data)
-                dobj = None
-            else:
-                buf = bytearray()
-            continue
-
-        if len(buf) < 18:
-            more = fh.read(_SLAB)
-            if not more:
-                if buf:
-                    raise TruncatedInputError(
-                        f"truncated gzip stream ({len(buf)} trailing bytes)"
-                    )
-                return
-            buf += more
-            continue
-
-        # buffer the whole FEXTRA area before probing for the BC subfield —
-        # a conforming gzip member may carry extra fields past byte 18
-        if buf[3] & 4:
-            xlen = struct.unpack_from("<H", buf, 10)[0]
-            while len(buf) < 12 + xlen:
-                more = fh.read(_SLAB)
-                if not more:
-                    raise TruncatedInputError(
-                        "truncated gzip FEXTRA field at end of stream"
-                    )
-                buf += more
-            header = bytes(buf[: 12 + xlen])
-        else:
-            header = bytes(buf[:18])
-        bsize = bgzf._member_bsize(header, 0)
-        if bsize is None:
-            dobj = zlib.decompressobj(wbits=31)
-            continue
-        while len(buf) < bsize:
-            more = fh.read(_SLAB)
-            if not more:
-                raise TruncatedInputError(
-                    f"truncated BGZF member (have {len(buf)} of "
-                    f"{bsize} bytes)"
-                )
-            buf += more
-        payload = bytes(buf[18 : bsize - 8])
-        yield zlib.decompress(payload, wbits=-15)
-        del buf[:bsize]
+def _inflate_stream(fh, ingest_workers: int | None = None) -> Iterator[bytes]:
+    """Yield decompressed byte chunks from a BGZF / gzip / plain stream
+    through the single inflate chokepoint (kindel_tpu.io.inflate): BGZF
+    members fan out to the shared bounded worker pool and reassemble in
+    order (byte-identical to a serial walk for every worker count);
+    generic gzip members fall back to a bounded streaming decompressobj;
+    plain (uncompressed) input passes through. `ingest_workers=None`
+    resolves through kindel_tpu.tune (explicit arg > env pin > store >
+    host default)."""
+    yield from resolved_inflater(ingest_workers).stream(fh)
 
 
 class _Prefetcher:
@@ -249,14 +172,16 @@ def _scan_complete_records(data: bytes) -> tuple[np.ndarray, int]:
 
 
 def stream_alignment(
-    path, chunk_bytes: int = DEFAULT_CHUNK_BYTES
+    path, chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+    ingest_workers: int | None = None,
 ) -> Iterator[ReadBatch]:
     """Yield ReadBatch chunks of ~chunk_bytes decompressed payload each.
 
     SAM text streams by line groups; BAM streams by complete records.
     Every yielded batch shares the file's ref_names/ref_lens, so
     per-chunk event extraction + additive reduction reproduces the
-    slurped result exactly.
+    slurped result exactly — for every `ingest_workers` count (the
+    parallel inflater reassembles members in order).
 
     Progress (opt-in, kindel_tpu.utils.progress): one stderr counter of
     chunks + reads covers every streamed path, mirroring the reference's
@@ -273,7 +198,7 @@ def stream_alignment(
         prog.update(extra=f"({total_reads} reads)")
         return batch
 
-    gen = _stream_alignment_impl(path, chunk_bytes)
+    gen = _stream_alignment_impl(path, chunk_bytes, ingest_workers)
     try:
         for batch in gen:
             yield tick(batch)
@@ -282,7 +207,8 @@ def stream_alignment(
 
 
 def _stream_alignment_impl(
-    path, chunk_bytes: int = DEFAULT_CHUNK_BYTES
+    path, chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+    ingest_workers: int | None = None,
 ) -> Iterator[ReadBatch]:
     path = Path(path)
     with open(path, "rb") as fh:
@@ -292,7 +218,7 @@ def _stream_alignment_impl(
         if not compressed and head[:4] != b"BAM\x01":
             yield from _stream_sam(fh, chunk_bytes, label=path)
             return
-        pf = _Prefetcher(_inflate_stream(fh))
+        pf = _Prefetcher(_inflate_stream(fh, ingest_workers))
         if compressed and pf.peek(4) != b"BAM\x01":
             # gzip-compressed SAM text (the eager loader decompresses
             # then sniffs, ADVICE r2): feed the inflated stream through

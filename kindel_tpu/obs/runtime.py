@@ -145,6 +145,73 @@ def transfer_counters(registry=None):
     )
 
 
+_INGEST: "_IngestCounters | None" = None
+
+
+class _IngestCounters:
+    """The host-ingest counter family fed by kindel_tpu.io.inflate —
+    cached like the transfer counters (the inflate path flushes per
+    stream/slurp call and must not pay registry lookups there)."""
+
+    __slots__ = (
+        "members", "bytes_in", "bytes_out", "inflate_s", "scan_s",
+        "stall_s", "read_s", "expand_s", "workers",
+    )
+
+    def __init__(self, registry):
+        self.members = registry.counter(
+            "kindel_ingest_members_total",
+            "BGZF members inflated by the parallel ingest path",
+        )
+        self.bytes_in = registry.counter(
+            "kindel_ingest_bytes_in_total",
+            "compressed bytes consumed by the inflate chokepoint",
+        )
+        self.bytes_out = registry.counter(
+            "kindel_ingest_bytes_out_total",
+            "decompressed bytes produced by the inflate chokepoint",
+        )
+        self.inflate_s = registry.counter(
+            "kindel_ingest_inflate_seconds_total",
+            "summed zlib inflate wall across pool workers and inline "
+            "members (exceeds elapsed wall when workers overlap)",
+        )
+        self.scan_s = registry.counter(
+            "kindel_ingest_scan_seconds_total",
+            "serial member-boundary scan + reassembly wall on the "
+            "consumer thread",
+        )
+        self.stall_s = registry.counter(
+            "kindel_ingest_stall_seconds_total",
+            "consumer wall spent blocked on the head-of-line inflate "
+            "future (0 when the pool keeps ahead of the decoder)",
+        )
+        self.read_s = registry.counter(
+            "kindel_ingest_read_seconds_total",
+            "wall spent in compressed-side file reads on the ingest path",
+        )
+        self.expand_s = registry.counter(
+            "kindel_ingest_expand_seconds_total",
+            "wall spent expanding CIGAR ops into event streams "
+            "(events.extract_events)",
+        )
+        self.workers = registry.gauge(
+            "kindel_ingest_pool_workers",
+            "resolved inflate worker count of the most recent ingest run",
+        )
+
+
+def ingest_counters(registry=None) -> _IngestCounters:
+    """The ingest counter family (host-side counterpart of
+    transfer_counters); the default-registry instance is cached."""
+    global _INGEST
+    if registry is None:
+        if _INGEST is None:
+            _INGEST = _IngestCounters(default_registry())
+        return _INGEST
+    return _IngestCounters(registry)
+
+
 def device_memory_stats() -> dict | None:
     """First device's memory_stats() (None on backends without it —
     CPU — or before jax initialized)."""

@@ -11,7 +11,11 @@ hits, with what probability), and the hot paths call `hook(site)` /
   device.dispatch   cohort / slab kernel launch (batch.py, pipeline.py,
                     and every serve flush through launch_cohort_kernel)
   device.compile    AOT warmup compile of one lane shape (serve/warmup)
-  io.read_chunk     one streamed decode chunk (io/stream.py)
+  io.read_chunk     one streamed decode chunk (io/stream.py). Sits
+                    DOWNSTREAM of the parallel inflater's in-order
+                    reassembly (io/inflate.py), so chunk boundaries —
+                    and therefore this hook's hit/chunk-index sequence —
+                    are deterministic for every ingest_workers count
   serve.flush       one micro-batch flush execution (serve/worker.py)
   serve.worker      top of the intake / dispatch loop (serve/worker.py)
 

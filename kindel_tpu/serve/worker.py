@@ -9,7 +9,10 @@ of by a file list:
   decode    per-request: payload → ReadBatch → EventSet → CallUnits,
             then into the micro-batcher. A malformed payload fails ONLY
             its own future here — the batch a request would have joined
-            never sees it.
+            never sees it. BGZF payloads inflate through the ONE
+            process-wide worker pool (kindel_tpu.io.inflate.shared_pool,
+            pre-sized in start()), so concurrent decodes queue members
+            on a bounded pool instead of oversubscribing the host.
   dispatch  one thread drives MicroBatcher.poll; each flush packs into
             the lane's pinned pad shapes (kindel_tpu.batch.pack_cohort),
             launches ONE batched device program, assembles every
@@ -286,6 +289,15 @@ class ServeWorker:
         t.start()
 
     def start(self) -> "ServeWorker":
+        # pre-size the shared inflate pool (resolved here, not in
+        # __init__ — env pins exported before start must win) so the
+        # first request's decode never pays pool construction
+        from kindel_tpu import tune
+        from kindel_tpu.io import inflate
+
+        workers, _src = tune.resolve_ingest_workers()
+        if workers > 1:
+            inflate.shared_pool(workers)
         self._start_loop("intake")
         self._start_loop("dispatch")
         if self.supervise:

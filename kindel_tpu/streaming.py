@@ -36,12 +36,16 @@ from kindel_tpu.pileup import (
 )
 
 
-def _stream_reduce(acc, path, chunk_bytes) -> None:
+def _stream_reduce(acc, path, chunk_bytes, ingest_workers=None) -> None:
     """Drive the chunked decode→reduce loop under one span, counting
     chunks into the process-global registry (the serve/bench exposition
-    sees streamed work too). A truncated/corrupt input dies with the
-    typed TruncatedInputError naming which chunk of which file — the
-    span and a counter record the casualty."""
+    sees streamed work too). With ingest_workers > 1 the BGZF inflate of
+    chunk k+1 runs on the shared pool (kindel_tpu.io.inflate) while this
+    thread scans records and expands CIGAR events of chunk k and jax's
+    async dispatch reduces chunk k−1 on device — the three-stage overlap
+    SURVEY §7 prescribes. A truncated/corrupt input dies with the typed
+    TruncatedInputError naming which chunk of which file — the span and
+    a counter record the casualty."""
     from kindel_tpu.io.errors import TruncatedInputError
 
     chunks = default_registry().counter(
@@ -51,7 +55,7 @@ def _stream_reduce(acc, path, chunk_bytes) -> None:
     with obs_trace.span("stream.reduce") as sp:
         n = 0
         try:
-            for batch in stream_alignment(path, chunk_bytes):
+            for batch in stream_alignment(path, chunk_bytes, ingest_workers):
                 acc.add_batch(batch)
                 n += 1
         except TruncatedInputError as e:
@@ -304,21 +308,35 @@ def _resolve_chunk_bytes(chunk_bytes, tuning, bam_path) -> int:
     return DEFAULT_CHUNK_BYTES
 
 
+def _resolve_ingest_workers(ingest_workers, tuning):
+    """Caller's explicit count wins; otherwise the tuning config's pin
+    flows down as the explicit arg of the one resolution rule
+    (kindel_tpu.tune.resolve_ingest_workers handles env/store/default
+    at the ingest entry point)."""
+    if ingest_workers is not None:
+        return ingest_workers
+    return getattr(tuning, "ingest_workers", None)
+
+
 def stream_pileups(
     path,
     chunk_bytes: int | None = DEFAULT_CHUNK_BYTES,
     backend: str = "numpy",
     clip_weights: bool = True,
     tuning=None,
+    ingest_workers: int | None = None,
 ) -> dict[str, Pileup]:
     """Bounded-RSS replacement for build_pileups(extract_events(load…)):
     same output, O(chunk + L) host memory. chunk_bytes=None resolves the
-    chunk size through kindel_tpu.tune (`tuning` > env > store > default)."""
+    chunk size through kindel_tpu.tune (`tuning` > env > store > default);
+    ingest_workers resolves the same way."""
     chunk_bytes = _resolve_chunk_bytes(chunk_bytes, tuning, path)
     acc = StreamAccumulator(
         backend=backend, full=True, clip_weights=clip_weights
     )
-    _stream_reduce(acc, path, chunk_bytes)
+    _stream_reduce(
+        acc, path, chunk_bytes, _resolve_ingest_workers(ingest_workers, tuning)
+    )
     return {acc.ref_names[rid]: acc.pileup(rid) for rid in acc.present}
 
 
@@ -336,15 +354,18 @@ def streamed_consensus(
     cdr_gap: int = 0,
     fix_clip_artifacts: bool = False,
     tuning=None,
+    ingest_workers: int | None = None,
 ):
     """bam_to_consensus over a streamed decode — identical output, host
     RSS bounded by O(chunk + reference length).
 
     Returns the same result namedtuple as workloads.bam_to_consensus.
     chunk_bytes=None resolves the chunk size through kindel_tpu.tune
-    (`tuning` arg > env pin > persisted store > default).
+    (`tuning` arg > env pin > persisted store > default); ingest_workers
+    (the parallel-inflate pool size) resolves identically.
     """
     chunk_bytes = _resolve_chunk_bytes(chunk_bytes, tuning, bam_path)
+    ingest_workers = _resolve_ingest_workers(ingest_workers, tuning)
     from kindel_tpu.call import _insertion_calls, assemble, call_consensus
     from kindel_tpu.io.fasta import Sequence
     from kindel_tpu.realign import cdrp_consensuses, merge_cdrps
@@ -367,13 +388,14 @@ def streamed_consensus(
             clip_decay_threshold, mask_ends, trim_ends, uppercase,
             chunk_bytes, mesh, cdr_gap=cdr_gap,
             fix_clip_artifacts=fix_clip_artifacts,
+            ingest_workers=ingest_workers,
         )
 
     # realign (or the numpy oracle) consumes host pileups; the plain jax
     # path keeps everything on device until the packed wire download
     full = realign or backend != "jax"
     acc = StreamAccumulator(backend=backend, full=full)
-    _stream_reduce(acc, bam_path, chunk_bytes)
+    _stream_reduce(acc, bam_path, chunk_bytes, ingest_workers)
 
     consensuses, refs_changes, refs_reports = [], {}, {}
     for rid in acc.present:
@@ -443,6 +465,7 @@ def _streamed_sharded_consensus(
     bam_path, realign, min_depth, min_overlap, clip_decay_threshold,
     mask_ends, trim_ends, uppercase, chunk_bytes, mesh=None,
     cdr_gap: int = 0, fix_clip_artifacts: bool = False,
+    ingest_workers: int | None = None,
 ):
     """Streamed decode reduced into position-sharded device state; the
     closing call + (optional) lazy CDR walk run through the product
@@ -453,7 +476,7 @@ def _streamed_sharded_consensus(
     from kindel_tpu.workloads import build_report, result
 
     acc = ShardedStreamAccumulator(mesh=mesh, full=realign)
-    _stream_reduce(acc, bam_path, chunk_bytes)
+    _stream_reduce(acc, bam_path, chunk_bytes, ingest_workers)
 
     consensuses, refs_changes, refs_reports = [], {}, {}
     for rid in acc.present:

@@ -61,6 +61,16 @@ MIN_SLAB_POSITIONS = 65536
 #: KINDEL_TPU_COHORT_BUDGET_MB
 COHORT_BUDGET_MB_DEFAULT = 512
 
+#: cap of the host-derived ingest-worker default: past ~8 inflate
+#: threads the serial member scan / record decode thread is the
+#: bottleneck, so extra workers only add contention
+INGEST_WORKERS_MAX_DEFAULT = 8
+
+#: decompressed MB the parallel inflater may queue ahead of the
+#: consumer (kindel_tpu.io.inflate bounded reassembly window); the env
+#: pin is KINDEL_TPU_INGEST_PREFETCH_MB
+INGEST_PREFETCH_MB_DEFAULT = 8
+
 STORE_VERSION = 1
 
 
@@ -88,6 +98,7 @@ class TuningConfig:
     n_slabs: int | None = None
     stream_chunk_mb: float | None = None
     cohort_budget_mb: int | None = None
+    ingest_workers: int | None = None
     sources: tuple = ()
 
 
@@ -366,6 +377,98 @@ def resolve_stream_chunk_mb(explicit: float | None = None,
     return None, "default"
 
 
+def default_ingest_workers() -> int:
+    """Host-derived default inflate parallelism: one worker per core
+    this process may schedule on, capped (INGEST_WORKERS_MAX_DEFAULT).
+    1 on a 1-core host — the inflater's serial fast path, so a
+    single-core run pays no pool/future overhead."""
+    try:
+        n = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        n = os.cpu_count() or 1
+    return max(1, min(n, INGEST_WORKERS_MAX_DEFAULT))
+
+
+def ingest_store_key() -> str:
+    """Ingest knobs are a property of the host's cores/memory bus alone
+    (no backend / contig scale in the key — inflate never touches the
+    device), same shape as the stream-chunk entry."""
+    return "ingest|" + host_fingerprint()
+
+
+def resolve_ingest_workers(explicit: int | None = None) -> tuple[int, str]:
+    """The inflate-parallelism knob (kindel_tpu.io.inflate pool size):
+    explicit arg > KINDEL_TPU_INGEST_WORKERS > tune store > host-derived
+    default. Returns (workers, source), source ∈ {"explicit", "env",
+    "cache", "default"}."""
+    if explicit is not None:
+        return max(1, int(explicit)), "explicit"
+    pin, present = _env_int("KINDEL_TPU_INGEST_WORKERS")
+    if pin is not None:
+        return max(1, pin), "env"
+    if present:  # malformed pin — explicit operator intent to override
+        return default_ingest_workers(), "default"
+    entry = lookup(ingest_store_key())
+    if entry and isinstance(entry.get("ingest_workers"), int):
+        return max(1, entry["ingest_workers"]), "cache"
+    return default_ingest_workers(), "default"
+
+
+def resolve_ingest_prefetch_mb(
+    explicit: float | None = None,
+) -> tuple[float, str]:
+    """The ingest prefetch window (decompressed MB the inflater may
+    queue ahead of the consumer): explicit arg >
+    KINDEL_TPU_INGEST_PREFETCH_MB > tune store > default (8 MB). The
+    window is what keeps the parallel path inside the streamed decode's
+    O(chunk) RSS bound, so it is a capacity knob, not a latency one."""
+    if explicit is not None and float(explicit) > 0:
+        return float(explicit), "explicit"
+    env = os.environ.get("KINDEL_TPU_INGEST_PREFETCH_MB")
+    if env:
+        try:
+            v = float(env)
+            if v > 0:
+                return v, "env"
+        except ValueError:
+            pass  # malformed pin: fall through to store/default
+    entry = lookup(ingest_store_key())
+    v = entry.get("ingest_prefetch_mb") if entry else None
+    if isinstance(v, (int, float)) and v > 0:
+        return float(v), "cache"
+    return float(INGEST_PREFETCH_MB_DEFAULT), "default"
+
+
+def search_ingest_workers(measure, max_workers: int | None = None,
+                          budget_s: float = 20.0,
+                          clock=time.perf_counter):
+    """Budget-bounded doubling search over the inflate worker count:
+    probes 1, 2, 4, … ≤ max_workers while the wall budget lasts and
+    returns (chosen, {workers: seconds}). `measure(workers) -> wall
+    seconds` receives the count EXPLICITLY (no env mutation), same
+    contract as search_slabs; `kindel tune` persists the winner under
+    ingest_store_key()."""
+    if max_workers is None:
+        max_workers = default_ingest_workers()
+    if max_workers <= 1:
+        return 1, {}
+    from kindel_tpu.obs import trace as obs_trace
+
+    timings: dict[int, float] = {}
+    t0 = clock()
+    w = 1
+    while w <= max_workers:
+        with obs_trace.span("tune.ingest_probe") as sp:
+            wall = measure(w)
+            if sp is not obs_trace.NOOP_SPAN:
+                sp.set_attribute(workers=w, wall_s=round(wall, 4))
+        timings[w] = wall
+        if clock() - t0 > budget_s:
+            break
+        w = max_workers if w < max_workers < w * 2 else w * 2
+    return min(timings, key=timings.get), timings
+
+
 def resolve_cohort_budget_mb(explicit: int | None = None) -> tuple[int, str]:
     """The cohort device-footprint budget: explicit arg >
     KINDEL_TPU_COHORT_BUDGET_MB > default (512 MB). Not measured — it is
@@ -387,6 +490,7 @@ def resolve(explicit: TuningConfig | None = None, backend: str = "cpu",
     n_slabs, s1 = resolve_slabs(e.n_slabs, backend, max_contig)
     chunk, s2 = resolve_stream_chunk_mb(e.stream_chunk_mb, bam_path)
     budget, s3 = resolve_cohort_budget_mb(e.cohort_budget_mb)
+    ingest, s4 = resolve_ingest_workers(e.ingest_workers)
     # knob provenance into the shared exposition: one Info sample per
     # (knob, source, value) — the serve /metrics and bench snapshots show
     # WHERE each performance knob came from, not just its value
@@ -399,10 +503,12 @@ def resolve(explicit: TuningConfig | None = None, backend: str = "cpu",
     info.set(knob="n_slabs", source=s1, value=str(n_slabs))
     info.set(knob="stream_chunk_mb", source=s2, value=str(chunk))
     info.set(knob="cohort_budget_mb", source=s3, value=str(budget))
+    info.set(knob="ingest_workers", source=s4, value=str(ingest))
     return TuningConfig(
         n_slabs=n_slabs, stream_chunk_mb=chunk, cohort_budget_mb=budget,
+        ingest_workers=ingest,
         sources=(("n_slabs", s1), ("stream_chunk_mb", s2),
-                 ("cohort_budget_mb", s3)),
+                 ("cohort_budget_mb", s3), ("ingest_workers", s4)),
     )
 
 

@@ -109,7 +109,7 @@ def _load_pileups(bam_path, backend: str,
 
         return stream_pileups(
             bam_path, chunk_bytes=int(chunk_mb * (1 << 20)), backend=backend,
-            clip_weights=clip_weights,
+            clip_weights=clip_weights, tuning=tuning,
         )
     batch = load_alignment(bam_path)
     if sharded:

@@ -13,7 +13,16 @@ state and expensive to debug when broken:
      explicit timestamp allowlist;
   4. every metric registered through an obs registry carries help text
      (also enforced at runtime by MetricsRegistry, but the static guard
-     catches sites the tests never execute).
+     catches sites the tests never execute);
+  5. zlib is a single-chokepoint dependency — `zlib.decompress` /
+     `zlib.decompressobj` (and `import zlib` itself) may only appear
+     inside `kindel_tpu/io/`, so every inflate goes through the
+     parallel-ingest path (kindel_tpu/io/inflate.py) and its metrics /
+     ordering / RSS-bound invariants;
+  6. nothing under `kindel_tpu/io/` imports jax — inflate pool workers
+     execute only io/ code, and a worker thread tripping a lazy backend
+     initialization mid-stream would deadlock or double-init the
+     runtime.
 
 An env read inside a traced body is doubly wrong: it only runs at trace
 time (so the knob silently stops responding once the kernel is cached),
@@ -203,6 +212,83 @@ def test_metric_registrations_carry_help_text():
     assert registrations >= 15, (
         f"only {registrations} registration calls found"
     )
+
+
+def test_zlib_only_inside_io_package():
+    """The inflate chokepoint invariant: any `import zlib` (or direct
+    `zlib.decompress` / `zlib.decompressobj` call) outside kindel_tpu/io/
+    bypasses the parallel inflater — its ordering guarantee, its bounded
+    in-flight window, and its ingest metrics. New decompression sites
+    must route through kindel_tpu.io.inflate / kindel_tpu.io.bgzf."""
+    offenders = []
+    io_sites = 0
+    for py in sorted(PKG.rglob("*.py")):
+        inside_io = "io" in py.relative_to(PKG).parts[:1]
+        tree = ast.parse(py.read_text(), filename=str(py))
+        for node in ast.walk(tree):
+            hit = None
+            if isinstance(node, ast.Import):
+                if any(a.name.split(".")[0] == "zlib" for a in node.names):
+                    hit = "import zlib"
+            elif isinstance(node, ast.ImportFrom):
+                if (node.module or "").split(".")[0] == "zlib":
+                    hit = "from zlib import"
+            elif isinstance(node, ast.Call):
+                f = node.func
+                if (
+                    isinstance(f, ast.Attribute)
+                    and f.attr in ("decompress", "decompressobj")
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "zlib"
+                ):
+                    hit = f"zlib.{f.attr}"
+            if hit is None:
+                continue
+            if inside_io:
+                io_sites += 1
+            else:
+                offenders.append(
+                    f"{py.relative_to(PKG.parent)}:{node.lineno} ({hit})"
+                )
+    assert not offenders, (
+        "zlib used outside kindel_tpu/io/ — all inflation must go "
+        "through the single chokepoint (kindel_tpu.io.inflate):\n"
+        + "\n".join(offenders)
+    )
+    # blindness check: the chokepoint itself must be visible
+    assert io_sites >= 3, f"only {io_sites} zlib sites found in io/"
+
+
+def test_io_package_never_imports_jax():
+    """Inflate pool workers (kindel_tpu/io/inflate.py) run arbitrary
+    io/-resident code on non-main threads; an `import jax` reachable
+    from io/ could make a worker thread initialize the backend (slow,
+    non-reentrant, and on a tunneled relay potentially hanging the whole
+    ingest). io/ stays a jax-free layer — L0 by construction."""
+    offenders = []
+    checked = 0
+    for py in sorted((PKG / "io").rglob("*.py")):
+        checked += 1
+        tree = ast.parse(py.read_text(), filename=str(py))
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                names = [a.name for a in node.names]
+            elif isinstance(node, ast.ImportFrom):
+                names = [node.module or ""]
+            else:
+                continue
+            for name in names:
+                if name == "jax" or name.startswith("jax."):
+                    offenders.append(
+                        f"{py.relative_to(PKG.parent)}:{node.lineno} "
+                        f"(imports {name})"
+                    )
+    assert not offenders, (
+        "jax import inside kindel_tpu/io/ — the ingest layer (and the "
+        "inflate worker threads that execute it) must stay jax-free:\n"
+        + "\n".join(offenders)
+    )
+    assert checked >= 8, f"only {checked} io/ modules found"
 
 
 #: handler calls that count as "the failure was handled, not swallowed":

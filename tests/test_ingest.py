@@ -1,0 +1,482 @@
+"""Parallel ingest (kindel_tpu.io.inflate) — determinism, bounds, faults.
+
+The contract under test: the pipelined parallel inflater is an invisible
+optimization. For EVERY worker count the decompressed byte stream, the
+ReadBatch chunk sequence, the consensus FASTA, the truncation error (and
+its offset / chunk attribution), and the io.read_chunk fault replay are
+byte-identical to the serial path — only the wall clock may differ.
+"""
+
+from __future__ import annotations
+
+import gzip
+import io as _io
+import struct
+import zlib
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from kindel_tpu.io import bgzf, load_alignment
+from kindel_tpu.io.errors import TruncatedInputError
+from kindel_tpu.io.inflate import (
+    DEFAULT_PREFETCH_BYTES,
+    ParallelInflater,
+    shared_pool,
+)
+from kindel_tpu.io.stream import stream_alignment
+from kindel_tpu.resilience import faults as rfaults
+from kindel_tpu.resilience.faults import FaultPlan
+from kindel_tpu.streaming import streamed_consensus
+
+WORKER_COUNTS = (1, 2, 8)
+
+import os
+
+_DATA_ROOT = Path(
+    os.environ.get("KINDEL_TPU_TEST_DATA", "/root/reference/tests")
+)
+
+
+def require_data(*rel) -> Path:
+    path = _DATA_ROOT.joinpath(*rel)
+    if not path.exists():
+        pytest.skip(f"golden corpus not available: {path}")
+    return path
+
+
+@pytest.fixture(autouse=True)
+def _no_faults():
+    yield
+    rfaults.deactivate()
+
+
+# --------------------------------------------------------------- builders
+
+
+def bgzf_member(raw: bytes) -> bytes:
+    """One conforming BGZF member (18-byte header with BC subfield,
+    raw-deflate payload, CRC/ISIZE trailer)."""
+    co = zlib.compressobj(6, zlib.DEFLATED, -15)
+    payload = co.compress(raw) + co.flush()
+    bsize = len(payload) + 26
+    header = (
+        b"\x1f\x8b\x08\x04\x00\x00\x00\x00\x00\xff"
+        + struct.pack("<H", 6) + b"BC" + struct.pack("<H", 2)
+        + struct.pack("<H", bsize - 1)
+    )
+    return header + payload + struct.pack(
+        "<II", zlib.crc32(raw), len(raw) & 0xFFFFFFFF
+    )
+
+
+def bgzf_compress(raw: bytes, member_bytes: int = 8 << 10) -> bytes:
+    """raw → many-member BGZF blob (small members, so even a small test
+    file exercises the pool's submission/reassembly machinery)."""
+    out = [
+        bgzf_member(raw[i: i + member_bytes])
+        for i in range(0, len(raw), member_bytes)
+    ]
+    out.append(bgzf.BGZF_EOF)
+    return b"".join(out)
+
+
+def synth_bam_raw(ref_len: int = 20_000, n_reads: int = 600,
+                  read_len: int = 100, seed: int = 0) -> bytes:
+    """Uncompressed BAM bytes: one reference, n_reads simple 100M reads
+    at random positions (the bench synthesizer's shape, miniaturized)."""
+    rng = np.random.default_rng(seed)
+    name = b"SYNTH1\x00"
+    header_text = f"@SQ\tSN:SYNTH1\tLN:{ref_len}\n".encode()
+    out = [
+        b"BAM\x01" + struct.pack("<i", len(header_text)) + header_text
+        + struct.pack("<i", 1)
+        + struct.pack("<i", len(name)) + name + struct.pack("<i", ref_len)
+    ]
+    code = np.array([1, 2, 4, 8], dtype=np.uint8)
+    for _ in range(n_reads):
+        pos = int(rng.integers(0, ref_len - read_len))
+        nib = code[rng.integers(0, 4, size=read_len)]
+        packed = bytearray()
+        for i in range(0, read_len, 2):
+            hi = int(nib[i]) << 4
+            lo = int(nib[i + 1]) if i + 1 < read_len else 0
+            packed.append(hi | lo)
+        rname = b"r\x00"
+        cigar = struct.pack("<I", (read_len << 4) | 0)
+        body = struct.pack(
+            "<iiBBHHHiiii", 0, pos, len(rname), 60, 0, 1, 0,
+            read_len, -1, -1, 0,
+        )
+        body += rname + cigar + bytes(packed) + b"\xff" * read_len
+        out.append(struct.pack("<i", len(body)) + body)
+    return b"".join(out)
+
+
+@pytest.fixture(scope="module")
+def synth_bam(tmp_path_factory) -> Path:
+    raw = synth_bam_raw()
+    path = tmp_path_factory.mktemp("ingest") / "synth.bam"
+    path.write_bytes(bgzf_compress(raw))
+    return path
+
+
+def batch_tuples(batches):
+    """Hashable per-read projection of a ReadBatch sequence, chunk
+    structure included (chunk boundaries must not move with the worker
+    count)."""
+    out = []
+    for b in batches:
+        reads = []
+        for i in range(b.n_reads):
+            reads.append((
+                int(b.ref_id[i]), int(b.pos[i]), int(b.flag[i]),
+                b.seq[b.seq_off[i]: b.seq_off[i + 1]].tobytes(),
+                tuple(b.cig_len[b.cig_off[i]: b.cig_off[i + 1]]),
+            ))
+        out.append(tuple(reads))
+    return out
+
+
+# ----------------------------------------------------------- determinism
+
+
+def test_stream_bytes_identical_across_workers(synth_bam):
+    blob = synth_bam.read_bytes()
+    want = gzip.decompress(blob)
+    for w in WORKER_COUNTS:
+        got = b"".join(ParallelInflater(w).stream(_io.BytesIO(blob)))
+        assert got == want, f"workers={w}"
+
+
+def test_slurp_decompress_identical_across_workers(synth_bam):
+    blob = synth_bam.read_bytes()
+    want = gzip.decompress(blob)
+    for w in WORKER_COUNTS:
+        assert bgzf.decompress(blob, workers=w) == want, f"workers={w}"
+
+
+def test_chunk_sequence_identical_across_workers(synth_bam):
+    """Identical ReadBatch CHUNKS, not just identical totals: the
+    parallel inflater must not move a chunk boundary."""
+    want = batch_tuples(stream_alignment(synth_bam, 16 << 10,
+                                         ingest_workers=1))
+    assert len(want) > 3  # the file genuinely chunks
+    for w in WORKER_COUNTS[1:]:
+        got = batch_tuples(stream_alignment(synth_bam, 16 << 10,
+                                            ingest_workers=w))
+        assert got == want, f"workers={w}"
+
+
+def test_streamed_consensus_fasta_identical_across_workers(synth_bam):
+    results = {}
+    for w in WORKER_COUNTS:
+        res = streamed_consensus(
+            synth_bam, backend="numpy", chunk_bytes=16 << 10,
+            ingest_workers=w,
+        )
+        results[w] = [(s.name, s.sequence) for s in res.consensuses]
+    assert results[1] == results[2] == results[8]
+    assert results[1][0][1]  # non-empty sequence
+
+
+def test_slurp_matches_load_alignment(synth_bam):
+    """The eager loader (native or python, whatever is active) and the
+    parallel slurp agree on the decoded reads."""
+    eager = load_alignment(synth_bam)
+    batches = list(stream_alignment(synth_bam, 1 << 30, ingest_workers=4))
+    assert sum(b.n_reads for b in batches) == eager.n_reads
+
+
+def test_generic_gzip_members_interleave(synth_bam):
+    """A generic (no-BSIZE) gzip member mid-stream drains the pool and
+    inflates serially — output identical, any worker count."""
+    raw = gzip.decompress(synth_bam.read_bytes())
+    third = len(raw) // 3
+    mix = (
+        bgzf_compress(raw[:third])[: -len(bgzf.BGZF_EOF)]
+        + gzip.compress(raw[third: 2 * third])
+        + bgzf_compress(raw[2 * third:])
+    )
+    for w in (1, 4):
+        assert bgzf.decompress(mix, workers=w) == raw
+        assert b"".join(ParallelInflater(w).stream(_io.BytesIO(mix))) == raw
+
+
+@pytest.mark.parametrize(
+    "rel",
+    [
+        ("data_bwa_mem", "1.1.sub_test.bam"),
+        ("data_minimap2", "1.1.multi.bam"),
+    ],
+)
+def test_refsuite_chunks_identical_across_workers(rel):
+    """Real-corpus pin of the determinism contract: identical ReadBatch
+    chunk sequence for every worker count on the refsuite BAMs."""
+    path = require_data(*rel)
+    want = batch_tuples(stream_alignment(path, 64 << 10, ingest_workers=1))
+    for w in WORKER_COUNTS[1:]:
+        got = batch_tuples(stream_alignment(path, 64 << 10,
+                                            ingest_workers=w))
+        assert got == want, f"workers={w}"
+
+
+@pytest.mark.parametrize(
+    "rel",
+    [
+        ("data_bwa_mem", "1.1.sub_test.bam"),
+        ("data_minimap2", "1.1.multi.bam"),
+    ],
+)
+def test_refsuite_fasta_identical_across_workers(rel):
+    path = require_data(*rel)
+    results = {}
+    for w in WORKER_COUNTS:
+        res = streamed_consensus(
+            path, backend="numpy", chunk_bytes=64 << 10, ingest_workers=w
+        )
+        results[w] = [(s.name, s.sequence) for s in res.consensuses]
+    assert results[1] == results[2] == results[8]
+
+
+# --------------------------------------------------------- failure parity
+
+
+def test_truncation_same_attribution_across_workers(synth_bam, tmp_path):
+    """Mid-member truncation raises the SAME TruncatedInputError —
+    message, path, chunk index — under the pool as serially."""
+    blob = synth_bam.read_bytes()
+    cut = tmp_path / "cut.bam"
+    cut.write_bytes(blob[: int(len(blob) * 0.6)])
+    seen = {}
+    for w in WORKER_COUNTS:
+        with pytest.raises(TruncatedInputError) as exc:
+            for _ in stream_alignment(cut, 16 << 10, ingest_workers=w):
+                pass
+        seen[w] = (str(exc.value), exc.value.chunk_index,
+                   str(exc.value.path))
+    assert seen[1] == seen[2] == seen[8]
+    assert seen[1][2] == str(cut)
+
+
+def test_corrupt_member_same_error_across_workers():
+    """A corrupt deflate payload surfaces the same wrapped ValueError
+    (offset included) whatever the worker count, and an EARLIER member's
+    error always wins over a later scan error."""
+    good = bgzf_member(b"A" * 2000)
+    bad = bgzf_member(b"B" * 2000)
+    # corrupt the second member's payload with bytes no deflate stream
+    # can start with after the stored header
+    bad = bad[:18] + b"\xff\x00\xff\x00\xff\x00" + bad[24:]
+    blob = good + bad + good + b"\x1f\x8b"  # trailing garbage header too
+    errs = []
+    for w in (1, 8):
+        with pytest.raises(ValueError) as exc:
+            bgzf.decompress(blob, workers=w)
+        errs.append(str(exc.value))
+    assert errs[0] == errs[1]
+    assert f"offset {len(good)}" in errs[0]
+
+
+def test_read_chunk_fault_replay_deterministic(synth_bam):
+    """The PR-4 chaos contract: an io.read_chunk truncate fault fires on
+    the same chunk with the same downstream attribution whatever the
+    worker count — and replays identically run to run."""
+    outcomes = []
+    for w in (1, 8, 8):
+        plan = rfaults.activate(
+            FaultPlan.parse("seed=3,io.read_chunk:truncate:after=1")
+        )
+        try:
+            # dropping a chunk's tail half mid-stream surfaces as a
+            # ValueError: either typed truncation or a corrupt-record
+            # scan — both deterministic, and identical across workers
+            with pytest.raises(ValueError) as exc:
+                for _ in stream_alignment(synth_bam, 16 << 10,
+                                          ingest_workers=w):
+                    pass
+            outcomes.append((
+                dict(plan.fired), plan.hits("io.read_chunk"),
+                type(exc.value).__name__,
+                getattr(exc.value, "chunk_index", None), str(exc.value),
+            ))
+        finally:
+            rfaults.deactivate()
+    assert outcomes[0] == outcomes[1] == outcomes[2]
+    assert outcomes[0][0] == {("io.read_chunk", "truncate"): 1}
+
+
+# ------------------------------------------------------- bounds and knobs
+
+
+def test_inflight_window_stays_bounded(synth_bam):
+    """The reassembly queue respects max_inflight_bytes (+ at most one
+    member's estimate of slack) — the O(chunk) RSS bound's load-bearing
+    half."""
+    blob = synth_bam.read_bytes()
+
+    class Spy(ParallelInflater):
+        max_seen = 0
+
+        def _submit(self, *a, **kw):
+            super()._submit(*a, **kw)
+            self.max_seen = max(self.max_seen, self._inflight)
+
+    spy = Spy(workers=4, max_inflight_bytes=1 << 16)
+    out = b"".join(spy.stream(_io.BytesIO(blob)))
+    assert out == gzip.decompress(blob)
+    assert spy.max_seen > 0
+    assert spy.max_seen <= (1 << 16) + (16 << 10)
+
+
+def test_shared_pool_is_shared_and_grows(monkeypatch):
+    from kindel_tpu.io import inflate
+
+    monkeypatch.setattr(inflate, "_POOL", None)
+    monkeypatch.setattr(inflate, "_POOL_WORKERS", 0)
+    p2 = shared_pool(2)
+    assert shared_pool(2) is p2
+    assert shared_pool(1) is p2  # never shrinks
+    p4 = shared_pool(4)
+    assert p4 is not p2
+    assert shared_pool(3) is p4
+    assert inflate.pool_workers() == 4
+
+
+def test_resolve_ingest_workers_precedence(tmp_path, monkeypatch):
+    from kindel_tpu import tune
+
+    store = tmp_path / "tune.json"
+    monkeypatch.setenv("KINDEL_TPU_TUNE_CACHE", str(store))
+    monkeypatch.delenv("KINDEL_TPU_INGEST_WORKERS", raising=False)
+
+    # default (host-derived, >= 1)
+    n, src = tune.resolve_ingest_workers()
+    assert n >= 1 and src == "default"
+    # store beats default
+    assert tune.record(tune.ingest_store_key(), {"ingest_workers": 3})
+    assert tune.resolve_ingest_workers() == (3, "cache")
+    # env pin beats store
+    monkeypatch.setenv("KINDEL_TPU_INGEST_WORKERS", "5")
+    assert tune.resolve_ingest_workers() == (5, "env")
+    # explicit beats env
+    assert tune.resolve_ingest_workers(2) == (2, "explicit")
+    # malformed pin falls back to the default, never the store
+    monkeypatch.setenv("KINDEL_TPU_INGEST_WORKERS", "banana")
+    n, src = tune.resolve_ingest_workers()
+    assert src == "default"
+    # prefetch knob: env pin then default
+    monkeypatch.setenv("KINDEL_TPU_INGEST_PREFETCH_MB", "2.5")
+    assert tune.resolve_ingest_prefetch_mb() == (2.5, "env")
+    monkeypatch.delenv("KINDEL_TPU_INGEST_PREFETCH_MB")
+    v, src = tune.resolve_ingest_prefetch_mb()
+    assert v == tune.INGEST_PREFETCH_MB_DEFAULT and src == "default"
+    assert DEFAULT_PREFETCH_BYTES == tune.INGEST_PREFETCH_MB_DEFAULT << 20
+
+
+def test_tuning_config_threads_ingest_workers(synth_bam, monkeypatch):
+    """TuningConfig(ingest_workers=) reaches the inflater: the resolved
+    worker gauge reflects the pinned count after a streamed run."""
+    from kindel_tpu.obs.metrics import default_registry
+    from kindel_tpu.tune import TuningConfig
+
+    res = streamed_consensus(
+        synth_bam, backend="numpy", chunk_bytes=16 << 10,
+        tuning=TuningConfig(ingest_workers=2),
+    )
+    assert res.consensuses
+    snap = default_registry().snapshot()
+    assert snap.get("kindel_ingest_pool_workers") == 2
+
+
+def test_search_ingest_workers_budget_and_pick():
+    from kindel_tpu import tune
+
+    walls = {1: 4.0, 2: 2.5, 4: 1.9, 8: 2.2}
+    probed = []
+
+    def measure(w):
+        probed.append(w)
+        return walls[w]
+
+    t = [0.0]
+
+    def clock():
+        t[0] += 1.0
+        return t[0]
+
+    chosen, timings = tune.search_ingest_workers(
+        measure, max_workers=8, budget_s=100.0, clock=clock
+    )
+    assert probed == [1, 2, 4, 8]
+    assert chosen == 4 and timings == walls
+    # 1-core host: no search at all
+    assert tune.search_ingest_workers(measure, max_workers=1) == (1, {})
+
+
+def test_ingest_metrics_accumulate(synth_bam):
+    from kindel_tpu.obs.metrics import default_registry
+
+    from kindel_tpu.events import extract_events
+
+    # earlier raises-tests keep suspended stream generators alive via
+    # captured tracebacks; their close-time stats flush must not land
+    # inside this test's measurement window
+    import gc
+
+    gc.collect()
+    before = default_registry().snapshot()
+    for batch in stream_alignment(synth_bam, 16 << 10, ingest_workers=2):
+        extract_events(batch)
+    after = default_registry().snapshot()
+
+    def delta(name):
+        return after.get(name, 0) - before.get(name, 0)
+
+    raw_len = len(gzip.decompress(synth_bam.read_bytes()))
+    assert delta("kindel_ingest_members_total") >= raw_len // (8 << 10)
+    assert delta("kindel_ingest_bytes_out_total") == raw_len
+    assert delta("kindel_ingest_bytes_in_total") > 0
+    assert delta("kindel_ingest_inflate_seconds_total") > 0
+    assert delta("kindel_ingest_expand_seconds_total") > 0
+
+
+# -------------------------------------------------------- sniffing fixes
+
+
+class Trickle:
+    """A pipe-like fh: the FIRST read returns a single byte (the
+    short-first-read misrouting reproduction), later reads behave."""
+
+    def __init__(self, data: bytes):
+        self.data = data
+        self.first = True
+
+    def read(self, n: int) -> bytes:
+        take = 1 if self.first else n
+        self.first = False
+        out = self.data[:take]
+        self.data = self.data[take:]
+        return out
+
+
+def test_short_first_read_still_detects_gzip(synth_bam):
+    """A 1-byte first read must not send a gzip stream down the
+    plain-text path (io/stream satellite fix)."""
+    blob = synth_bam.read_bytes()
+    want = gzip.decompress(blob)
+    for w in (1, 4):
+        got = b"".join(ParallelInflater(w).stream(Trickle(blob)))
+        assert got == want
+
+
+def test_short_first_read_plain_passthrough():
+    data = b"@HD\tVN:1.6\nplain text, not gzip\n"
+    got = b"".join(ParallelInflater(2).stream(Trickle(data)))
+    assert got == data
+
+
+def test_single_byte_stream_is_plain():
+    assert b"".join(ParallelInflater(2).stream(Trickle(b"\x1f"))) == b"\x1f"
+    assert b"".join(ParallelInflater(2).stream(_io.BytesIO(b""))) == b""
