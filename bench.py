@@ -314,6 +314,9 @@ def _run_benchmark() -> dict:
         "bytes_out": int(ingest_delta("bytes_out_total")),
     }
 
+    from kindel_tpu import aot as aotlib
+
+    metrics_snapshot = default_registry().snapshot()
     mbases_per_s = total_bases / min(walls) / 1e6
     result = {
         "metric": "consensus_throughput_bacterial",
@@ -324,6 +327,22 @@ def _run_benchmark() -> dict:
         "slabs": chosen,
         "tune_source": tune_source,
         "tune_wall_s": round(tune_wall, 3),
+        # AOT executable provenance (kindel_tpu.aot), mirroring
+        # tune_source: did the device programs this run dispatched load
+        # from the serialized-executable store, compile fresh, or run
+        # with the store disabled? A perf claim that ran warm must say so.
+        "aot": aotlib.provenance(),
+        # fat-dispatch posture: resolved lane-coalescing width + how many
+        # ready flushes actually merged (nonzero only under serve load)
+        "dispatch": {
+            "lane_coalesce": tunelib.resolve_lane_coalesce()[0],
+            "coalesced_flushes": int(metrics_snapshot.get(
+                "kindel_dispatch_coalesced_flushes_total", 0
+            )),
+            "coalesced_launches": int(metrics_snapshot.get(
+                "kindel_dispatch_coalesced_launches_total", 0
+            )),
+        },
         # host-ingest posture (kindel_tpu.io.inflate): wall split +
         # worker-count provenance, mirroring tune_source for slabs
         "ingest": ingest,

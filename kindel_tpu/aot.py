@@ -1,0 +1,569 @@
+"""kindel_tpu.aot — ahead-of-time executables: export, persist, reload.
+
+The jit compile wall is the TPU path's largest fixed cost: the live-TPU
+bench loses to its own cpu-fallback on compiles, transfers, and small
+dispatches (`BENCH_tpu_live.json` vs `BENCH_r05.json`). The persistent
+XLA source cache (utils/jax_cache.py) amortizes compiles *per program
+text*; this module goes one step further and amortizes them per
+*executable*: `jit(...).lower().compile()` once, serialize the PjRt
+executable, persist it in the tune store, and let every later process —
+most importantly a fresh serve replica — **load** the device program
+instead of compiling it. With a warm store a replica starts with zero
+jit compiles; pre-baking a fleet host is a file copy (`kindel tune
+--export-aot`).
+
+Design rules:
+
+  * **One AOT surface.** Every `.lower()`/`.compile()` chain and every
+    executable (de)serialization in the codebase lives HERE (pinned by
+    tests/test_env_guard.py). Dispatch sites (`batch.launch_cohort_kernel`,
+    `call_jax.device_call`) only consult the process registry below.
+  * **Keyed like the tune store, plus the runtime.** An executable is
+    valid for exactly (backend, device kind, device count, jax+jaxlib
+    versions, package version, kernel kind, static shape signature).
+    Any mismatch is a clean miss — the store must never hand a v5e
+    program to a v4, or a jaxlib-0.4.36 image to a 0.4.38 one.
+  * **Fail open, loudly, once.** A corrupt blob, a foreign version, a
+    backend that cannot deserialize (XLA:CPU cannot reload executables
+    cross-process — observed "Symbols not found"; a real TPU PjRt
+    client can): warn once per reason, fall back to plain JIT, never
+    crash, never serve a result the jit path would not have produced.
+    Export parity-checks the fresh executable against the jit kernel
+    byte-for-byte before persisting, and a loaded executable validates
+    its input avals on every call (a drifted signature raises instead
+    of silently computing the wrong program).
+  * **Bounded on disk.** Blobs live beside the tune store
+    (`~/.cache/kindel_tpu/aot/`), indexed by `aot|…` entries in
+    tune.json; `gc_store()` evicts entries whose (jaxlib, device kind)
+    no longer match this runtime and bounds total bytes
+    (KINDEL_TPU_AOT_CACHE_MB, default 512), atomically, oldest first.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import threading
+import warnings
+from pathlib import Path
+
+from kindel_tpu import tune
+from kindel_tpu.obs.metrics import default_registry
+
+#: tune-store key prefix of AOT index entries (the blobs' metadata rides
+#: the existing versioned/atomic store; the bytes live in files beside it)
+INDEX_PREFIX = "aot|"
+
+#: default bound on total serialized-executable bytes on disk
+AOT_CACHE_MB_DEFAULT = 512
+
+#: process-local registry: sig -> loaded/compiled jax.stages.Compiled.
+#: Dispatch sites look up here; (de)serialization fills it.
+_REGISTRY: dict[tuple, object] = {}
+_REGISTRY_LOCK = threading.Lock()
+
+#: sigs that already failed to load/call this process — one warning per
+#: reason, then permanent JIT fallback (no retry storm on a hot path)
+_FAILED: set = set()
+
+_WARNED: set = set()
+
+#: provenance tallies behind provenance() — kept separate from the
+#: monotonic exposition counters so clear_registry() (tests) can reset
+#: them alongside the registry they describe
+_STATS = {"loaded": 0, "compiled": 0}
+
+
+def _warn_once(reason: str, detail: str) -> None:
+    if reason in _WARNED:
+        return
+    _WARNED.add(reason)
+    warnings.warn(
+        f"kindel-tpu aot: {detail} — falling back to plain JIT "
+        "(correctness unaffected; this warning prints once)",
+        RuntimeWarning,
+        stacklevel=3,
+    )
+
+
+class _Counters:
+    """AOT provenance counters on the process-global registry, so the
+    serve /metrics exposition and bench.py's JSON line both see them."""
+
+    __slots__ = ("loaded", "compiled", "load_failures", "dispatches")
+
+    def __init__(self, registry):
+        self.loaded = registry.counter(
+            "kindel_aot_loaded_total",
+            "serialized executables loaded from the AOT store",
+        )
+        self.compiled = registry.counter(
+            "kindel_aot_compiled_total",
+            "executables compiled fresh (store miss) by the AOT surface",
+        )
+        self.load_failures = registry.counter(
+            "kindel_aot_load_failures_total",
+            "AOT store entries that failed to deserialize/validate and "
+            "fell back to plain JIT",
+        )
+        self.dispatches = registry.counter(
+            "kindel_aot_dispatches_total",
+            "kernel launches served by a registry executable instead of "
+            "the jit cache",
+        )
+
+
+_COUNTERS: _Counters | None = None
+
+
+def counters(registry=None) -> _Counters:
+    global _COUNTERS
+    if registry is None:
+        if _COUNTERS is None:
+            _COUNTERS = _Counters(default_registry())
+        return _COUNTERS
+    return _Counters(registry)
+
+
+# ----------------------------------------------------------------- keying
+
+def runtime_identity() -> dict:
+    """The environment an executable is valid for. Best-effort on hosts
+    where the backend cannot initialize (returns a sentinel identity
+    that never matches a stored entry)."""
+    try:
+        import jax
+        import jaxlib
+
+        dev = jax.devices()[0]
+        return {
+            "backend": jax.default_backend(),
+            "device_kind": dev.device_kind.replace(" ", "_"),
+            "n_devices": len(jax.devices()),
+            "jax": jax.__version__,
+            "jaxlib": jaxlib.__version__,
+            "package": _package_version(),
+        }
+    except Exception:
+        return {"backend": "uninitialized"}
+
+
+def _package_version() -> str:
+    from kindel_tpu import __version__
+
+    return __version__
+
+
+def cohort_sig(n_rows: int, shapes: tuple, length: int, realign: bool,
+               want_masks: bool) -> tuple:
+    """Static signature of one batched-cohort executable: the lane key
+    (pad shapes) + padded row count + the two compile-time switches."""
+    return ("cohort", int(n_rows), tuple(shapes), int(length),
+            bool(realign), bool(want_masks))
+
+
+def fused_sig(pads: tuple, length: int, want_masks: bool,
+              c_pad: int | None) -> tuple:
+    """Static signature of one fused single-sample executable
+    (call_jax.fused_call_kernel_packed)."""
+    return ("fused", tuple(pads), int(length), bool(want_masks), c_pad)
+
+
+def store_digest(sig: tuple) -> str:
+    """Stable digest of (runtime identity, kernel signature) — the blob
+    filename and the tune-store index key suffix."""
+    ident = runtime_identity()
+    raw = repr((sorted(ident.items()), sig))
+    return hashlib.sha1(raw.encode()).hexdigest()[:20]
+
+
+def index_key(sig: tuple) -> str:
+    return INDEX_PREFIX + store_digest(sig)
+
+
+def blob_dir() -> Path | None:
+    """Directory of serialized executables; None when the tune store is
+    disabled (KINDEL_TPU_TUNE_CACHE=off disables AOT persistence too)."""
+    store = tune.store_path()
+    if store is None:
+        return None
+    return store.parent / "aot"
+
+
+def enabled() -> bool:
+    return blob_dir() is not None
+
+
+# --------------------------------------------------------------- registry
+
+def lookup(sig: tuple):
+    """The registered executable for `sig`, or None. Cheap: one dict get
+    under a lock — sits on the per-flush dispatch path."""
+    with _REGISTRY_LOCK:
+        return _REGISTRY.get(sig)
+
+
+def register(sig: tuple, compiled) -> None:
+    with _REGISTRY_LOCK:
+        _REGISTRY[sig] = compiled
+
+
+def invalidate(sig: tuple) -> None:
+    """Drop a registry entry that failed at call time (the dispatch site
+    falls back to JIT for good — no retry storm on a hot path)."""
+    with _REGISTRY_LOCK:
+        _REGISTRY.pop(sig, None)
+    _FAILED.add(sig)
+
+
+def clear_registry() -> None:
+    """Tests only: forget every loaded executable, failure marker, and
+    provenance tally (the exposition counters stay monotonic)."""
+    with _REGISTRY_LOCK:
+        _REGISTRY.clear()
+    _FAILED.clear()
+    _WARNED.clear()
+    _STATS["loaded"] = _STATS["compiled"] = 0
+
+
+def failed(sig: tuple) -> bool:
+    return sig in _FAILED
+
+
+# ------------------------------------------------------- (de)serialization
+
+def _serialize_compiled(compiled) -> bytes:
+    """jax.stages.Compiled → one opaque byte string (executable blob +
+    pickled arg/out trees). The ONLY serialization site."""
+    from jax.experimental import serialize_executable as se
+
+    blob, in_tree, out_tree = se.serialize(compiled)
+    return pickle.dumps(
+        {"v": 1, "exec": blob, "in_tree": in_tree, "out_tree": out_tree}
+    )
+
+
+def _deserialize_compiled(data: bytes):
+    """Inverse of _serialize_compiled. Raises on any corruption or
+    backend refusal — the caller turns that into a warn-once JIT
+    fallback. The ONLY deserialization site."""
+    from jax.experimental import serialize_executable as se
+
+    doc = pickle.loads(data)
+    if not isinstance(doc, dict) or doc.get("v") != 1:
+        raise ValueError("unrecognized AOT blob envelope")
+    return se.deserialize_and_load(
+        doc["exec"], doc["in_tree"], doc["out_tree"]
+    )
+
+
+# ----------------------------------------------------------------- export
+
+def export_executable(jit_fn, args: tuple, static_kwargs: dict,
+                      sig: tuple, verify: bool = True) -> bool:
+    """AOT-compile `jit_fn` for `args` (+static kwargs), register the
+    executable for this process, and persist it to the store.
+
+    `verify=True` (default) parity-checks the fresh executable against
+    the jit path on `args` before persisting — a store must never hold
+    a program whose output the jit kernel would not have produced. With
+    the persistent XLA source cache the extra jit compile is a cache
+    hit, not a second compile wall. Returns True when the executable
+    was persisted (registration happens regardless)."""
+    import numpy as np
+
+    c = counters()
+    compiled = jit_fn.lower(*args, **static_kwargs).compile()
+    c.compiled.inc()
+    _STATS["compiled"] += 1
+    if verify:
+        want = jit_fn(*args, **static_kwargs)
+        got = compiled(*args)
+        w_leaves = _leaves(want)
+        g_leaves = _leaves(got)
+        ok = len(w_leaves) == len(g_leaves) and all(
+            np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(w_leaves, g_leaves)
+        )
+        if not ok:
+            _warn_once(
+                f"parity:{sig[0]}",
+                f"AOT executable for {sig[0]} kernel diverged from the "
+                "jit path at export",
+            )
+            return False
+    register(sig, compiled)
+    return _persist(sig, compiled)
+
+
+def _leaves(out) -> list:
+    import jax
+
+    return jax.tree_util.tree_leaves(out)
+
+
+def _persist(sig: tuple, compiled) -> bool:
+    """Serialize + write blob + index entry (atomic via tune.record);
+    then bound the store. Persisting is an optimization — any failure
+    returns False, never raises."""
+    d = blob_dir()
+    if d is None:
+        return False
+    try:
+        data = _serialize_compiled(compiled)
+    except Exception as e:  # backend without serialization support
+        _warn_once(
+            "serialize", f"executable serialization unavailable ({e!r})"
+        )
+        return False
+    digest = store_digest(sig)
+    ident = runtime_identity()
+    try:
+        d.mkdir(parents=True, exist_ok=True)
+        tmp = d / f".{digest}.tmp"
+        tmp.write_bytes(data)
+        os.replace(tmp, d / f"{digest}.exe")
+        ok = tune.record(
+            INDEX_PREFIX + digest,
+            {
+                "sig": repr(sig),
+                "kind": sig[0],
+                "blob": f"{digest}.exe",
+                "bytes": len(data),
+                **ident,
+            },
+        )
+    except OSError:
+        return False
+    gc_store()
+    return ok
+
+
+# ------------------------------------------------------------------- load
+
+def load_executable(sig: tuple):
+    """Load the stored executable for `sig` into the registry. Returns
+    the compiled object, or None on a clean miss OR any failure (warned
+    once). Zero jit compiles on success — that is the point."""
+    hit = lookup(sig)
+    if hit is not None:
+        return hit
+    if failed(sig):
+        return None
+    d = blob_dir()
+    if d is None:
+        return None
+    entry = tune.lookup(index_key(sig))
+    if entry is None:
+        return None
+    if not _entry_matches_runtime(entry):
+        # a foreign (backend/device/jaxlib) entry is a clean miss for
+        # THIS runtime; gc_store() is what actually evicts it
+        _warn_once(
+            "runtime-mismatch",
+            "AOT store entry exists for a different runtime "
+            f"({entry.get('device_kind')}/jaxlib {entry.get('jaxlib')})",
+        )
+        return None
+    try:
+        data = (d / str(entry.get("blob"))).read_bytes()
+        expect = entry.get("bytes")
+        if isinstance(expect, int) and len(data) != expect:
+            raise ValueError(
+                f"blob truncated ({len(data)} of {expect} bytes)"
+            )
+        compiled = _deserialize_compiled(data)
+    except Exception as e:
+        counters().load_failures.inc()
+        _FAILED.add(sig)
+        _warn_once(
+            "deserialize",
+            f"AOT executable failed to load ({type(e).__name__}: {e})",
+        )
+        return None
+    register(sig, compiled)
+    counters().loaded.inc()
+    _STATS["loaded"] += 1
+    return compiled
+
+
+def _entry_matches_runtime(entry: dict) -> bool:
+    ident = runtime_identity()
+    return all(
+        entry.get(k) == ident.get(k)
+        for k in ("backend", "device_kind", "n_devices", "jax", "jaxlib",
+                  "package")
+    )
+
+
+# --------------------------------------------------------------- dispatch
+
+def call(sig: tuple, args: tuple):
+    """Run the registered executable for `sig` on `args`. Returns the
+    outputs, or None when no executable is registered or the call
+    failed (in which case the sig is invalidated and the caller runs
+    the jit path — outputs are never silently wrong: a Compiled
+    validates its input avals and raises on drift)."""
+    compiled = lookup(sig)
+    if compiled is None:
+        return None
+    try:
+        out = compiled(*args)
+    except Exception as e:
+        invalidate(sig)
+        counters().load_failures.inc()
+        _warn_once(
+            "call",
+            f"AOT executable rejected a dispatch ({type(e).__name__}: "
+            f"{e})",
+        )
+        return None
+    counters().dispatches.inc()
+    return out
+
+
+# ------------------------------------------------------------- provenance
+
+def provenance() -> dict:
+    """The `aot` object /healthz and bench.py carry: how many
+    executables this process loaded vs compiled, and where the serving
+    programs came from — mirrors the `tune_source` convention so every
+    perf claim states whether it ran warm."""
+    if not enabled():
+        return {"loaded": 0, "compiled": 0, "source": "disabled"}
+    loaded = _STATS["loaded"]
+    compiled = _STATS["compiled"]
+    return {
+        "loaded": loaded,
+        "compiled": compiled,
+        "source": "store" if loaded > 0 else "fresh",
+    }
+
+
+# --------------------------------------------------------------------- GC
+
+def _cache_cap_bytes() -> int:
+    raw = os.environ.get("KINDEL_TPU_AOT_CACHE_MB", "")
+    try:
+        mb = int(raw) if raw else AOT_CACHE_MB_DEFAULT
+    except ValueError:
+        mb = AOT_CACHE_MB_DEFAULT
+    return max(1, mb) << 20
+
+
+def gc_store(cap_bytes: int | None = None) -> dict:
+    """Bound the AOT store: drop index entries whose (backend, device
+    kind, jax/jaxlib, package) no longer match this runtime, drop
+    entries whose blob vanished, delete orphan blobs, then evict oldest
+    entries until total bytes fit the cap. Index mutations go through
+    tune.delete (tmp + os.replace — atomic as the store always was).
+    Returns {"evicted": n, "kept": n, "bytes": total} for tests/obs."""
+    d = blob_dir()
+    if d is None:
+        return {"evicted": 0, "kept": 0, "bytes": 0}
+    cap = _cache_cap_bytes() if cap_bytes is None else cap_bytes
+    entries = {
+        k: v for k, v in tune.load_store().items()
+        if k.startswith(INDEX_PREFIX) and isinstance(v, dict)
+    }
+    doomed: list[str] = []
+    live: list[tuple[float, str, dict]] = []
+    for key, entry in entries.items():
+        blob = d / str(entry.get("blob"))
+        if not _entry_matches_runtime(entry) or not blob.is_file():
+            doomed.append(key)
+            continue
+        live.append((float(entry.get("recorded_at") or 0.0), key, entry))
+    # oldest-first eviction down to the byte cap
+    live.sort()
+    total = sum(int(e.get("bytes") or 0) for _, _, e in live)
+    while live and total > cap:
+        _, key, entry = live.pop(0)
+        total -= int(entry.get("bytes") or 0)
+        doomed.append(key)
+    for key in doomed:
+        entry = entries[key]
+        try:
+            (d / str(entry.get("blob"))).unlink(missing_ok=True)
+        except OSError:
+            pass
+    if doomed:
+        tune.delete(doomed)
+    # orphan blobs: files no surviving index entry points at
+    kept_blobs = {str(e.get("blob")) for _, _, e in live}
+    try:
+        for f in d.glob("*.exe"):
+            if f.name not in kept_blobs:
+                f.unlink(missing_ok=True)
+    except OSError:
+        pass
+    return {"evicted": len(doomed), "kept": len(live), "bytes": total}
+
+
+# ------------------------------------------------- cohort/fused frontends
+
+def cohort_sig_for(arrays, length: int, opts) -> tuple:
+    """The cohort signature of one packed flush (what the dispatch site
+    and the warmup both key on)."""
+    return cohort_sig(
+        int(arrays[0].shape[0]),
+        tuple(int(a.shape[1]) for a in arrays if a.ndim == 2),
+        length, bool(opts.realign), bool(opts.want_masks),
+    )
+
+
+def cohort_args(arrays, opts) -> tuple:
+    """Device args exactly as batch.launch_cohort_kernel builds them —
+    lowering, export parity, and dispatch must agree on avals or the
+    loaded executable rejects its own traffic."""
+    import jax.numpy as jnp
+
+    return tuple(jnp.asarray(a) for a in arrays) + (
+        jnp.int32(opts.min_depth),
+        jnp.int32(1 if opts.fix_clip_artifacts else 0),
+    )
+
+
+def export_cohort(arrays, meta, opts, verify: bool = True) -> bool:
+    """AOT-export the batched cohort kernel for one packed flush's
+    shapes (serve warmup miss path; `kindel tune --export-aot`)."""
+    from kindel_tpu.call_jax import (
+        batched_call_kernel,
+        batched_realign_call_kernel,
+    )
+
+    L = meta[0]
+    sig = cohort_sig_for(arrays, L, opts)
+    kernel = (
+        batched_realign_call_kernel if opts.realign else batched_call_kernel
+    )
+    return export_executable(
+        kernel, cohort_args(arrays, opts),
+        {"length": L, "want_masks": opts.want_masks}, sig, verify=verify,
+    )
+
+
+def load_cohort(arrays, meta, opts):
+    """Load (or fetch from the registry) the executable for one packed
+    flush's shapes; None → caller runs the jit kernel."""
+    return load_executable(cohort_sig_for(arrays, meta[0], opts))
+
+
+def export_fused(buf, pads: tuple, length: int, want_masks: bool,
+                 c_pad: int | None, verify: bool = True) -> bool:
+    """AOT-export the fused single-sample kernel for one upload-buffer
+    geometry (`kindel tune --export-aot` on the representative BAM)."""
+    import jax.numpy as jnp
+
+    from kindel_tpu.call_jax import fused_call_kernel_packed
+
+    o_pad, b_pad, nn_pad, d_pad, i_pad = pads
+    sig = fused_sig(pads, length, want_masks, c_pad)
+    return export_executable(
+        fused_call_kernel_packed, (jnp.asarray(buf),),
+        dict(o_pad=o_pad, b_pad=b_pad, nn_pad=nn_pad, d_pad=d_pad,
+             i_pad=i_pad, length=length, want_masks=want_masks,
+             c_pad=c_pad),
+        sig, verify=verify,
+    )

@@ -334,34 +334,50 @@ def pack_cohort(units, opts: BatchOptions, n_rows: int | None = None,
 def launch_cohort_kernel(arrays, meta, opts: BatchOptions, sharding=None):
     """Upload packed cohort arrays and launch the batched kernel
     (asynchronously — jax dispatch returns before the device finishes).
-    Returns the (out, meta) pair _assemble_outputs consumes."""
+    Returns the (out, meta) pair _assemble_outputs consumes.
+
+    When the AOT registry (kindel_tpu.aot) holds an executable for this
+    flush's shape signature — loaded from the store by the serve warmup,
+    or exported by `kindel tune --export-aot` — the launch runs it
+    directly and the jit cache is never consulted; any registry failure
+    falls back to the jit kernel transparently (warned once, output
+    identical). Sharded multi-device launches always take the jit path
+    (AOT executables are single-device programs)."""
     import jax
+
+    from kindel_tpu import aot
 
     rfaults.hook("device.dispatch")
     L, _d_pad, _i_pad = meta
     h2d_bytes = sum(int(a.nbytes) for a in arrays)
     obs_runtime.transfer_counters()[0].inc(h2d_bytes)
     with obs_trace.span("cohort.launch") as sp:
+        out = None
+        aot_hit = False
         if sharding is None:
-            dev_arrays = tuple(jnp.asarray(a) for a in arrays)
+            dev_arrays = aot.cohort_args(arrays, opts)
+            out = aot.call(aot.cohort_sig_for(arrays, L, opts), dev_arrays)
+            aot_hit = out is not None
         else:
             dev_arrays = tuple(
                 jax.device_put(a, sharding(a.ndim)) for a in arrays
+            ) + (
+                jnp.int32(opts.min_depth),
+                jnp.int32(1 if opts.fix_clip_artifacts else 0),
             )
-        kernel = (
-            batched_realign_call_kernel if opts.realign
-            else batched_call_kernel
-        )
-        out = kernel(
-            *dev_arrays, jnp.int32(opts.min_depth),
-            jnp.int32(1 if opts.fix_clip_artifacts else 0), length=L,
-            want_masks=opts.want_masks,
-        )
+        if out is None:
+            kernel = (
+                batched_realign_call_kernel if opts.realign
+                else batched_call_kernel
+            )
+            out = kernel(
+                *dev_arrays, length=L, want_masks=opts.want_masks,
+            )
         if sp is not obs_trace.NOOP_SPAN:
             # span covers upload + async dispatch, not device completion
             sp.set_attribute(
                 rows=int(arrays[0].shape[0]), L=L,
-                realign=opts.realign, h2d_bytes=h2d_bytes,
+                realign=opts.realign, h2d_bytes=h2d_bytes, aot=aot_hit,
             )
     # meta the host decoder needs to slice each row's packed wire
     return out, meta

@@ -830,6 +830,8 @@ def device_call(ev: EventSet, rid: int, min_depth: int = 1,
     emit_codes is uint8[L] (0=skip, 1..5=ATGCN) and masks carries the
     dense decision masks; on the fast path emit_codes is None and masks
     is rebuilt from the 2-bit wire format (see decode_fast)."""
+    from kindel_tpu import aot
+
     u = CallUnit(ev, rid)
     L, ip = u.L, u.ins_pos
     up, (o_pad, b_pad, nn_pad, d_pad, i_pad) = pack_kernel_args(
@@ -840,11 +842,17 @@ def device_call(ev: EventSet, rid: int, min_depth: int = 1,
     if not want_masks and _use_compact_wire():
         covered_idx = covered_index(u.op_r_start, u.op_lens())
         c_pad = _compact_bucket(len(covered_idx))
-    buf = fused_call_kernel_packed(
-        jnp.asarray(up), o_pad=o_pad, b_pad=b_pad, nn_pad=nn_pad,
-        d_pad=d_pad, i_pad=i_pad, length=L, want_masks=want_masks,
-        c_pad=c_pad,
-    )
+    pads = (o_pad, b_pad, nn_pad, d_pad, i_pad)
+    up_dev = jnp.asarray(up)
+    # AOT registry first (kindel tune --export-aot pre-baked this host);
+    # a miss or a rejected call runs the jit kernel — identical output
+    buf = aot.call(aot.fused_sig(pads, L, want_masks, c_pad), (up_dev,))
+    if buf is None:
+        buf = fused_call_kernel_packed(
+            up_dev, o_pad=o_pad, b_pad=b_pad, nn_pad=nn_pad,
+            d_pad=d_pad, i_pad=i_pad, length=L, want_masks=want_masks,
+            c_pad=c_pad,
+        )
     main_out, parts, dmin, dmax = unpack_wire(
         buf, L, d_pad, i_pad, want_masks, c_pad=c_pad
     )

@@ -427,14 +427,24 @@ def _serve_parser(sub):
     )
     p.add_argument(
         "--no-warmup", action="store_true",
-        help="skip the startup AOT compile warmup (first request on each "
-             "lane shape then pays its own compile)",
+        help="skip the startup AOT warmup (first request on each "
+             "lane shape then pays its own load/compile)",
     )
     p.add_argument(
         "--warm", action="append", default=[], metavar="PATH",
         help="representative SAM/BAM payload(s) whose lane shapes are "
-             "precompiled at startup (repeatable); the minimal synthetic "
-             "lane is always warmed unless --no-warmup",
+             "readied at startup (repeatable); the minimal synthetic "
+             "lane is always warmed unless --no-warmup. With a warm AOT "
+             "store the shapes LOAD instead of compiling — zero-compile "
+             "startup (see `kindel tune --export-aot`)",
+    )
+    p.add_argument(
+        "--lane-coalesce", type=int, default=None, metavar="N",
+        help="merge up to N ready micro-batcher flushes of one lane "
+             "into a single fat device launch (top of the explicit > "
+             "$KINDEL_TPU_LANE_COALESCE > default-4 order; 1 disables). "
+             "Byte-identical to per-flush launches — it only cuts "
+             "per-dispatch upload/launch overhead",
     )
 
 
@@ -444,7 +454,13 @@ def cmd_serve(args) -> int:
 
     from kindel_tpu.serve import ConsensusService
 
+    tuning = None
+    if args.lane_coalesce is not None:
+        from kindel_tpu.tune import TuningConfig
+
+        tuning = TuningConfig(lane_coalesce=args.lane_coalesce)
     service = ConsensusService(
+        tuning=tuning,
         max_batch_rows=args.max_batch_rows,
         max_wait_s=args.max_wait_ms / 1e3,
         max_depth=args.max_depth,
@@ -515,6 +531,16 @@ def _tune_parser(sub):
     p.add_argument(
         "--dry-run", action="store_true",
         help="measure and report, but do not write the tune store",
+    )
+    p.add_argument(
+        "--export-aot", action="store_true",
+        help="also AOT-compile, parity-check, and serialize the device "
+             "executables this host will serve — the batched cohort "
+             "kernel for every startup-derivable lane shape (synthetic "
+             "+ this BAM's), and the fused single-sample kernel for "
+             "this BAM's upload geometry — into the tune store's aot/ "
+             "directory, so a fresh `kindel serve` replica (or any "
+             "host this cache is copied to) starts with ZERO compiles",
     )
 
 
@@ -598,26 +624,76 @@ def cmd_tune(args) -> int:
                     "bam_path": str(args.bam_path),
                 },
             )
-    print(
-        json.dumps(
-            {
-                "backend": backend,
-                "key": key,
-                "clamp": clamp,
-                "n_slabs": chosen,
-                "timings_s": {str(k): round(v, 4) for k, v in timings.items()},
-                "tune_wall_s": round(wall, 3),
-                "ingest_workers": ingest_chosen,
-                "ingest_timings_s": {
-                    str(k): round(v, 4) for k, v in ingest_timings.items()
-                },
-                "ingest_persisted": ingest_persisted,
-                "persisted": persisted,
-                "store": str(tune.store_path()),
-            }
-        )
-    )
+    aot_report = None
+    if args.export_aot:
+        aot_report = _export_aot(args.bam_path, ev, dry_run=args.dry_run)
+
+    doc = {
+        "backend": backend,
+        "key": key,
+        "clamp": clamp,
+        "n_slabs": chosen,
+        "timings_s": {str(k): round(v, 4) for k, v in timings.items()},
+        "tune_wall_s": round(wall, 3),
+        "ingest_workers": ingest_chosen,
+        "ingest_timings_s": {
+            str(k): round(v, 4) for k, v in ingest_timings.items()
+        },
+        "ingest_persisted": ingest_persisted,
+        "persisted": persisted,
+        "store": str(tune.store_path()),
+    }
+    if aot_report is not None:
+        doc["aot"] = aot_report
+    print(json.dumps(doc))
     return 0
+
+
+def _export_aot(bam_path: str, ev, dry_run: bool = False) -> dict:
+    """Pre-bake this host's AOT executable store (kindel_tpu.aot): the
+    cohort kernel for every lane shape `kindel serve --warm <bam>`
+    would derive, plus the fused single-sample kernel for the BAM's
+    exact upload geometry. Each export is parity-checked against the
+    jit path before it persists; fleet cold-start then = copying
+    ~/.cache/kindel_tpu/ to the target hosts."""
+    from kindel_tpu import aot
+    from kindel_tpu.batch import BatchOptions
+    from kindel_tpu.call_jax import (
+        CallUnit,
+        _compact_bucket,
+        _use_compact_wire,
+        covered_index,
+        pack_kernel_args,
+    )
+    from kindel_tpu.serve import warmup as serve_warmup
+
+    if not aot.enabled():
+        return {"enabled": False,
+                "note": "tune store disabled (KINDEL_TPU_TUNE_CACHE=off)"}
+    if dry_run:
+        return {"enabled": True, "note": "skipped (--dry-run)"}
+    shapes = serve_warmup.warm_shapes(
+        BatchOptions(), payloads=[bam_path]
+    )
+    fused = 0
+    for rid in ev.present_ref_ids:
+        u = CallUnit(ev, rid)
+        buf, pads = pack_kernel_args(u, 1)
+        c_pad = None
+        if _use_compact_wire():
+            c_pad = _compact_bucket(
+                len(covered_index(u.op_r_start, u.op_lens()))
+            )
+        if aot.export_fused(buf, pads, u.L, False, c_pad):
+            fused += 1
+    return {
+        "enabled": True,
+        "cohort_shapes": {
+            label: t.get("source") for label, t in shapes.items()
+        },
+        "fused_exported": fused,
+        **aot.provenance(),
+    }
 
 
 def build_parser() -> argparse.ArgumentParser:

@@ -259,6 +259,12 @@ def runtime_snapshot() -> dict:
     count, wall = compile_totals()
     snap["compiles"] = count
     snap["compile_wall_s"] = round(wall, 3)
+    try:
+        from kindel_tpu import aot
+
+        snap["aot"] = aot.provenance()
+    except Exception:
+        pass  # probe stays best-effort: no AOT data beats no snapshot
     mem = device_memory_stats()
     if mem is not None:
         snap["device_memory"] = {

@@ -40,6 +40,7 @@ class Flush:
     shapes: tuple  # cohort pad shapes every entry buckets to
     entries: list  # [(ServeRequest, [CallUnit, ...]), ...]
     opened_at: float  # when the lane's first entry arrived
+    coalesced: int = 0  # extra sealed flushes merged in (fat dispatch)
 
     @property
     def n_rows(self) -> int:
@@ -150,6 +151,29 @@ class MicroBatcher:
                         return None
                     waits.append(remaining)
                 self._cond.wait(min(waits) if waits else None)
+
+    def take_ready(self, like: Flush, limit: int) -> list[Flush]:
+        """Pop up to `limit` ALREADY-SEALED flushes compatible with
+        `like` (same call options, same lane pad shapes) — the fat-
+        dispatch feeder: under load, full lanes seal faster than the
+        dispatch loop drains them, and every compatible sealed flush
+        merged into one launch is one device round trip saved. Only the
+        `_ready` queue is consulted; open lanes keep aging toward their
+        own max-wait flush (merging them here would re-order traffic
+        and starve the age trigger)."""
+        if limit <= 0:
+            return []
+        key = (opts_key(like.opts), like.shapes)
+        out: list[Flush] = []
+        with self._cond:
+            keep: list[Flush] = []
+            for f in self._ready:
+                if len(out) < limit and (opts_key(f.opts), f.shapes) == key:
+                    out.append(f)
+                else:
+                    keep.append(f)
+            self._ready = keep
+        return out
 
     def flush_all(self) -> list[Flush]:
         """Seal and return everything pending (drain path)."""

@@ -41,6 +41,17 @@ from kindel_tpu.serve.queue import (
 from kindel_tpu.serve.worker import ServeWorker
 
 
+def _aot_provenance() -> dict:
+    """kindel_tpu.aot.provenance(), tolerant of a broken AOT layer —
+    /healthz must answer even when the store is unreadable."""
+    try:
+        from kindel_tpu import aot
+
+        return aot.provenance()
+    except Exception:  # noqa: BLE001 — health probe, never raise
+        return {"loaded": 0, "compiled": 0, "source": "disabled"}
+
+
 class ConsensusService:
     """Online consensus calling over the batched cohort kernel."""
 
@@ -128,6 +139,10 @@ class ConsensusService:
             self.default_opts.cohort_budget_mb
         )
         self._m_tune_source.set(knob="cohort_budget_mb", source=src)
+        lane_coalesce, lc_src = tune.resolve_lane_coalesce(
+            getattr(tuning, "lane_coalesce", None)
+        )
+        self._m_tune_source.set(knob="lane_coalesce", source=lc_src)
         self.queue = RequestQueue(
             max_depth=max_depth, high_watermark=high_watermark,
             metrics=self.metrics,
@@ -147,7 +162,7 @@ class ConsensusService:
             self.queue, self.batcher, metrics=self.metrics,
             decode_workers=decode_workers, row_bucket=row_bucket,
             breaker=self.breaker, retry=retry, watchdog_s=watchdog_s,
-            numpy_fallback=numpy_fallback,
+            numpy_fallback=numpy_fallback, lane_coalesce=lane_coalesce,
         )
         self._http: ServeHTTPServer | None = None
         self._http_host = http_host
@@ -214,10 +229,21 @@ class ConsensusService:
                 payloads=self._warm_payloads,
             )
             self._m_warm_shapes.inc(len(timings))
-            for label, seconds in timings.items():
-                self._m_warm_shape_info.set(
-                    shape=label, seconds=round(seconds, 3)
-                )
+            for label, t in timings.items():
+                if isinstance(t, dict):
+                    # compile/execute split + AOT provenance per shape
+                    # (plain floats still accepted: stand-in warmers)
+                    self._m_warm_shape_info.set(
+                        shape=label,
+                        seconds=round(t.get("total_s", 0.0), 3),
+                        compile_s=round(t.get("compile_s", 0.0), 3),
+                        execute_s=round(t.get("execute_s", 0.0), 3),
+                        source=t.get("source", "fresh"),
+                    )
+                else:
+                    self._m_warm_shape_info.set(
+                        shape=label, seconds=round(t, 3)
+                    )
         except Exception as e:  # noqa: BLE001 — warmup is best-effort
             self._warm_error = repr(e)
             print(f"kindel-serve warmup failed: {e!r}", file=sys.stderr)
@@ -262,6 +288,10 @@ class ConsensusService:
             "watermark": self.queue.high_watermark,
             "warmup": self._warm_state,
             "warmup_s": self._m_warm_seconds.value,
+            # AOT provenance, mirroring the tune_source convention: did
+            # this replica's device programs load from the store or
+            # compile fresh? (kindel_tpu.aot; "disabled" = store off)
+            "aot": _aot_provenance(),
         }
         if self._warm_error is not None:
             doc["warmup_error"] = self._warm_error

@@ -1,23 +1,31 @@
-"""AOT compile warmup: pay every lane shape's jit compile at startup.
+"""Zero-compile startup: load AOT executables, compile only on store miss.
 
 The micro-batcher keys coalescing lanes on (call options,
 `cohort_pad_shapes`) and pads row counts to a power-of-two bucket, so
-the set of device programs a serving process runs is small and known —
-but before this module the FIRST request to open each lane paid the
-compile (seconds to minutes on a tunneled accelerator) inside its own
-latency budget. `warm_shapes` walks exactly the dispatch path the
-worker runs (`pack_cohort` → `launch_cohort_kernel` → block on the
-wire) for every lane shape derivable at startup:
+the set of device programs a serving process runs is small and known.
+Before PR 2 the FIRST request to open each lane paid the compile
+(seconds to minutes on a tunneled accelerator) inside its own latency
+budget; the warmup moved that wall to startup. This revision removes it
+entirely on a warm host: for every startup-derivable lane shape,
+`warm_shapes` first asks the AOT store (kindel_tpu.aot) for a
+serialized executable and **loads** it — zero jit compiles, `/healthz`
+flips to ok in however long a file read and one verification batch
+take. Only on a store miss does it AOT-compile (parity-checked against
+the jit path, then persisted), so the NEXT replica on this host — or
+any host the store directory is copied to (`kindel tune --export-aot`)
+— starts compile-free.
+
+Shapes warmed:
 
   * a minimal synthetic cohort (the smallest bucket lane — every
     "tiny request" lands there), and
   * operator-supplied representative payloads (`kindel serve --warm
     sample.bam`), which warm the exact shapes production traffic hits.
 
-With the persistent XLA cache (utils/jax_cache.py) the warmup is
-near-free on a host that has served before; on a cold host it moves the
-compile wall from the first request's p99 to process startup, where
-`/healthz` reports `warming` so load balancers hold traffic.
+Each shape's timing is split compile-wall vs execute via the
+`jax.monitoring` listener (obs.runtime), so the warmup Info metric
+attributes exactly what AOT saved — a conflated single wall would make
+the zero-compile claim unverifiable from the exposition.
 """
 
 from __future__ import annotations
@@ -50,23 +58,36 @@ def shape_label(shapes: tuple, n_rows: int) -> str:
 
 
 def warm_shapes(opts, row_bucket: int = 8, payloads=(),
-                include_synthetic: bool = True) -> dict[str, float]:
-    """Precompile the batched cohort kernel for every lane shape the
-    given payloads (plus the minimal synthetic cohort) land in.
+                include_synthetic: bool = True) -> dict[str, dict]:
+    """Ready the batched cohort kernel for every lane shape the given
+    payloads (plus the minimal synthetic cohort) land in — by loading a
+    stored AOT executable when the store is warm, by compiling (and
+    then exporting) when it is not.
 
-    Returns {shape_label: warmup_seconds} — one entry per UNIQUE
-    (pad shapes, row bucket) pair; a timing includes pack + compile +
-    one executed batch (blocked on, because jax dispatch is async and a
-    "warm" kernel that is still compiling would defeat the point)."""
+    Returns {shape_label: {"total_s", "compile_s", "execute_s",
+    "source"}} — one entry per UNIQUE (pad shapes, row bucket) pair.
+    `source` is "store" (loaded, zero compiles), "fresh" (compiled this
+    startup, exported for the next), or "disabled" (AOT store off —
+    plain jit warmup, exactly the pre-AOT behavior). A timing includes
+    pack + load-or-compile + one executed batch (blocked on, because
+    jax dispatch is async and a "warm" kernel that is still compiling
+    would defeat the point); compile_s comes from the jax.monitoring
+    compile-wall listener, so AOT savings are attributable."""
     import numpy as np
 
+    from kindel_tpu import aot
     from kindel_tpu.batch import (
         cohort_pad_shapes,
         launch_cohort_kernel,
         pack_cohort,
     )
+    from kindel_tpu.obs import runtime as obs_runtime
     from kindel_tpu.pileup_jax import _bucket
     from kindel_tpu.resilience import faults as rfaults
+
+    # best-effort: without the listener compile_s reads 0 and the split
+    # degrades to execute-only attribution, never to a failed warmup
+    obs_runtime.install()
 
     cohorts: list = []
     if include_synthetic:
@@ -74,7 +95,7 @@ def warm_shapes(opts, row_bucket: int = 8, payloads=(),
     for p in payloads:
         cohorts.append(decode_payload(p, opts))
 
-    timings: dict[str, float] = {}
+    timings: dict[str, dict] = {}
     for units in cohorts:
         if not units:
             continue
@@ -85,9 +106,31 @@ def warm_shapes(opts, row_bucket: int = 8, payloads=(),
             continue
         rfaults.hook("device.compile")
         t0 = time.monotonic()
+        _c0, compile_wall0 = obs_runtime.compile_totals()
         arrays, meta = pack_cohort(units, opts, n_rows=n_rows, shapes=shapes)
+        if aot.enabled():
+            loaded = aot.load_cohort(arrays, meta, opts)
+            if loaded is not None:
+                source = "store"
+            else:
+                # miss (or undeserializable entry, already warned once):
+                # AOT-compile + parity-verify + persist; the executable
+                # registers either way, so dispatch below — and every
+                # later flush of this lane — skips the jit cache
+                source = "fresh"
+                aot.export_cohort(arrays, meta, opts)
+        else:
+            source = "disabled"
         out, _meta = launch_cohort_kernel(arrays, meta, opts)
         wire = out[0] if opts.realign else out
-        np.asarray(wire)  # block: compile + execute must have finished
-        timings[label] = time.monotonic() - t0
+        np.asarray(wire)  # block: load/compile + execute must be done
+        total = time.monotonic() - t0
+        _c1, compile_wall1 = obs_runtime.compile_totals()
+        compile_s = max(0.0, compile_wall1 - compile_wall0)
+        timings[label] = {
+            "total_s": total,
+            "compile_s": compile_s,
+            "execute_s": max(0.0, total - compile_s),
+            "source": source,
+        }
     return timings

@@ -55,6 +55,34 @@ from kindel_tpu.serve.batcher import Flush, MicroBatcher
 from kindel_tpu.serve.queue import RequestQueue, ServeRequest
 
 
+_COALESCE_COUNTERS: tuple | None = None
+
+
+def _coalesce_counters() -> tuple:
+    """(flushes-merged, fat-launches) counters on the PROCESS-GLOBAL
+    registry — the serve /metrics exposition includes it via
+    MultiRegistry, and bench.py's JSON line reports dispatch coalescing
+    from the same place it reports transfer bytes."""
+    global _COALESCE_COUNTERS
+    if _COALESCE_COUNTERS is None:
+        from kindel_tpu.obs.metrics import default_registry
+
+        reg = default_registry()
+        _COALESCE_COUNTERS = (
+            reg.counter(
+                "kindel_dispatch_coalesced_flushes_total",
+                "ready micro-batcher flushes merged into a fat device "
+                "launch instead of dispatching alone",
+            ),
+            reg.counter(
+                "kindel_dispatch_coalesced_launches_total",
+                "device launches that carried more than one coalesced "
+                "flush",
+            ),
+        )
+    return _COALESCE_COUNTERS
+
+
 def _payload_label(payload) -> str:
     return "<bytes>" if isinstance(payload, (bytes, bytearray)) else str(
         payload
@@ -175,13 +203,20 @@ class ServeWorker:
                  breaker=None, retry: rpolicy.RetryPolicy | None = None,
                  watchdog_s: float | None = None,
                  numpy_fallback: bool = True, supervise: bool = True,
-                 supervise_interval_s: float = 0.1):
+                 supervise_interval_s: float = 0.1,
+                 lane_coalesce: int = 1):
         self.queue = queue
         self.batcher = batcher
         self._clock = clock
         #: rows pad to this power-of-two bucket so repeat flushes of a
         #: lane reuse one compiled kernel shape even as occupancy varies
         self.row_bucket = row_bucket
+        #: fat dispatch: up to this many ready flushes of one lane merge
+        #: into a single device launch (kindel_tpu.tune resolves the
+        #: knob; 1 = off). Rows are independent under vmap, so merged
+        #: output is byte-identical — the launch just pays pack + upload
+        #: + dispatch once instead of per flush.
+        self.lane_coalesce = max(1, int(lane_coalesce))
         #: resilience wiring (DESIGN.md §13): dispatch retry policy,
         #: device circuit breaker fed flush outcomes, hung-flush watchdog
         #: deadline, and the last-resort host fallback switch
@@ -289,6 +324,9 @@ class ServeWorker:
         t.start()
 
     def start(self) -> "ServeWorker":
+        # pre-register the fat-dispatch counters so the /metrics series
+        # exist (at 0) from boot, not from the first merge
+        _coalesce_counters()
         # pre-size the shared inflate pool (resolved here, not in
         # __init__ — env pins exported before start must win) so the
         # first request's decode never pays pool construction
@@ -468,6 +506,7 @@ class ServeWorker:
                 if self.batcher.closed and self.batcher.pending_rows == 0:
                     return
                 continue
+            flush = self._coalesce(flush)
             try:
                 self._execute(flush)
             except BaseException as e:  # noqa: BLE001
@@ -481,6 +520,29 @@ class ServeWorker:
                 raise
             if self._m_pending_rows is not None:
                 self._m_pending_rows.set(self.batcher.pending_rows)
+
+    def _coalesce(self, flush: Flush) -> Flush:
+        """Fat dispatch: merge compatible ready flushes into this one
+        (entries concatenate; row padding re-buckets at pack time).
+        Byte-identity with per-flush launches is pinned by tests — vmap
+        rows are independent and lane shapes are shared by construction."""
+        if self.lane_coalesce <= 1:
+            return flush
+        extra = self.batcher.take_ready(flush, self.lane_coalesce - 1)
+        if not extra:
+            return flush
+        entries = list(flush.entries)
+        for f in extra:
+            entries.extend(f.entries)
+        merged = Flush(
+            flush.opts, flush.shapes, entries,
+            min(f.opened_at for f in (flush, *extra)),
+            coalesced=len(extra),
+        )
+        c_flushes, c_launches = _coalesce_counters()
+        c_flushes.inc(len(extra))
+        c_launches.inc()
+        return merged
 
     def _execute(self, flush: Flush) -> None:
         self._flush_seq += 1
@@ -584,6 +646,7 @@ class ServeWorker:
                 "serve.batch_dispatch", req.span, t0, t1,
                 flush_id=flush_id, occupancy=occupancy,
                 rows=flush.n_rows, lane_shape=shape, isolated=isolated,
+                coalesced=flush.coalesced,
             )
             trace.record_span(
                 "serve.device_launch", dsp,

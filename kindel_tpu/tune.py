@@ -71,6 +71,13 @@ INGEST_WORKERS_MAX_DEFAULT = 8
 #: pin is KINDEL_TPU_INGEST_PREFETCH_MB
 INGEST_PREFETCH_MB_DEFAULT = 8
 
+#: how many ready micro-batcher flushes of one lane the serve dispatch
+#: loop may coalesce into a single fat device launch (1 = off); the env
+#: pin is KINDEL_TPU_LANE_COALESCE. Rows are independent under vmap, so
+#: a coalesced launch is byte-identical to per-flush launches — it just
+#: pays pack + upload + dispatch once instead of N times.
+LANE_COALESCE_DEFAULT = 4
+
 STORE_VERSION = 1
 
 
@@ -99,6 +106,7 @@ class TuningConfig:
     stream_chunk_mb: float | None = None
     cohort_budget_mb: int | None = None
     ingest_workers: int | None = None
+    lane_coalesce: int | None = None
     sources: tuple = ()
 
 
@@ -229,6 +237,34 @@ def record(key: str, entry: dict, path: Path | None = None) -> bool:
         merged.update(entry)
         merged["recorded_at"] = time.time()
         entries[key] = merged
+        tmp = path.with_suffix(".json.tmp")
+        tmp.write_text(
+            json.dumps({"version": STORE_VERSION, "entries": entries},
+                       indent=1, sort_keys=True)
+        )
+        os.replace(tmp, path)
+        _STORE_CACHE = None
+        return True
+    except OSError:
+        return False
+
+
+def delete(keys, path: Path | None = None) -> bool:
+    """Remove entries from the store atomically (tmp + os.replace, same
+    discipline as record) — the AOT blob GC's index-side half. Returns
+    False when the store is disabled/unwritable or nothing matched."""
+    global _STORE_CACHE
+    if path is None:
+        path = store_path()
+    if path is None:
+        return False
+    try:
+        entries = dict(load_store(path))
+        doomed = [k for k in keys if k in entries]
+        if not doomed:
+            return False
+        for k in doomed:
+            del entries[k]
         tmp = path.with_suffix(".json.tmp")
         tmp.write_text(
             json.dumps({"version": STORE_VERSION, "entries": entries},
@@ -481,6 +517,20 @@ def resolve_cohort_budget_mb(explicit: int | None = None) -> tuple[int, str]:
     return COHORT_BUDGET_MB_DEFAULT, "default"
 
 
+def resolve_lane_coalesce(explicit: int | None = None) -> tuple[int, str]:
+    """The serve fat-dispatch width (ready flushes of one lane merged
+    into a single device launch): explicit arg > KINDEL_TPU_LANE_COALESCE
+    > default (4). Not measured — coalescing is byte-identical work
+    packing, so more is strictly fewer dispatches until the row bucket
+    grows past the warmed shapes; 1 disables."""
+    if explicit is not None and int(explicit) > 0:
+        return int(explicit), "explicit"
+    pin, _present = _env_int("KINDEL_TPU_LANE_COALESCE")
+    if pin is not None and pin > 0:
+        return pin, "env"
+    return LANE_COALESCE_DEFAULT, "default"
+
+
 def resolve(explicit: TuningConfig | None = None, backend: str = "cpu",
             max_contig: int | None = None,
             bam_path=None) -> TuningConfig:
@@ -491,6 +541,7 @@ def resolve(explicit: TuningConfig | None = None, backend: str = "cpu",
     chunk, s2 = resolve_stream_chunk_mb(e.stream_chunk_mb, bam_path)
     budget, s3 = resolve_cohort_budget_mb(e.cohort_budget_mb)
     ingest, s4 = resolve_ingest_workers(e.ingest_workers)
+    coalesce, s5 = resolve_lane_coalesce(e.lane_coalesce)
     # knob provenance into the shared exposition: one Info sample per
     # (knob, source, value) — the serve /metrics and bench snapshots show
     # WHERE each performance knob came from, not just its value
@@ -504,11 +555,13 @@ def resolve(explicit: TuningConfig | None = None, backend: str = "cpu",
     info.set(knob="stream_chunk_mb", source=s2, value=str(chunk))
     info.set(knob="cohort_budget_mb", source=s3, value=str(budget))
     info.set(knob="ingest_workers", source=s4, value=str(ingest))
+    info.set(knob="lane_coalesce", source=s5, value=str(coalesce))
     return TuningConfig(
         n_slabs=n_slabs, stream_chunk_mb=chunk, cohort_budget_mb=budget,
-        ingest_workers=ingest,
+        ingest_workers=ingest, lane_coalesce=coalesce,
         sources=(("n_slabs", s1), ("stream_chunk_mb", s2),
-                 ("cohort_budget_mb", s3), ("ingest_workers", s4)),
+                 ("cohort_budget_mb", s3), ("ingest_workers", s4),
+                 ("lane_coalesce", s5)),
     )
 
 

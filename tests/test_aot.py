@@ -1,0 +1,374 @@
+"""kindel_tpu.aot — AOT executable export/load, fallback, GC, and the
+zero-compile replica-start acceptance property.
+
+The XLA:CPU PjRt client cannot reload serialized executables across
+processes on this jaxlib (observed "Symbols not found"), which makes
+the CPU suite the natural fixture for the FALLBACK half of the design:
+every load failure must warn once, fall back to plain JIT, and produce
+byte-identical output. The LOAD half (a real TPU replica starting with
+zero compiles) is pinned by stubbing only the (de)serialization
+boundary — jax's own tested API — while everything else (store keying,
+index, warmup, dispatch-site registry consultation, the serve stack)
+runs for real.
+"""
+
+import warnings
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from kindel_tpu import aot, tune
+from kindel_tpu.batch import (
+    BatchOptions,
+    cohort_pad_shapes,
+    launch_cohort_kernel,
+    pack_cohort,
+)
+from kindel_tpu.serve.warmup import _SYNTH_SAM, decode_payload
+
+
+@pytest.fixture(autouse=True)
+def _isolated_store(tmp_path, monkeypatch):
+    """Every test gets its own tune/AOT store and a clean registry."""
+    monkeypatch.setenv(
+        "KINDEL_TPU_TUNE_CACHE", str(tmp_path / "tune.json")
+    )
+    aot.clear_registry()
+    yield
+    aot.clear_registry()
+
+
+def _warm_flush(opts=None, n_rows: int = 8):
+    """One packed synthetic-lane flush (the smallest serve lane)."""
+    opts = opts or BatchOptions()
+    units = decode_payload(_SYNTH_SAM, opts)
+    shapes = cohort_pad_shapes(units, opts)
+    arrays, meta = pack_cohort(units, opts, n_rows=n_rows, shapes=shapes)
+    return units, arrays, meta, opts
+
+
+def _jit_wire(arrays, meta, opts):
+    """The jit-path oracle for one flush (registry bypassed)."""
+    from kindel_tpu.call_jax import batched_call_kernel
+
+    args = aot.cohort_args(arrays, opts)
+    return np.asarray(
+        batched_call_kernel(
+            *args, length=meta[0], want_masks=opts.want_masks
+        )
+    )
+
+
+# ----------------------------------------------------------------- export
+
+
+def test_export_registers_and_dispatch_is_byte_identical():
+    _units, arrays, meta, opts = _warm_flush()
+    want = _jit_wire(arrays, meta, opts)
+    assert aot.export_cohort(arrays, meta, opts), "export did not persist"
+    # the dispatch site must now serve from the registry…
+    before = int(aot.counters().dispatches.value)
+    out, _ = launch_cohort_kernel(arrays, meta, opts)
+    assert int(aot.counters().dispatches.value) == before + 1
+    # …and byte-identically to the jit path
+    assert np.array_equal(np.asarray(out), want)
+    # the store holds exactly one indexed blob for this signature
+    entries = {
+        k: v for k, v in tune.load_store().items()
+        if k.startswith(aot.INDEX_PREFIX)
+    }
+    assert len(entries) == 1
+    (entry,) = entries.values()
+    blob = aot.blob_dir() / entry["blob"]
+    assert blob.is_file() and blob.stat().st_size == entry["bytes"]
+
+
+def test_store_disabled_is_clean_noop(monkeypatch):
+    monkeypatch.setenv("KINDEL_TPU_TUNE_CACHE", "off")
+    _units, arrays, meta, opts = _warm_flush()
+    assert not aot.enabled()
+    assert aot.provenance() == {
+        "loaded": 0, "compiled": 0, "source": "disabled",
+    }
+    # dispatch works exactly as before AOT existed
+    out, _ = launch_cohort_kernel(arrays, meta, opts)
+    assert np.asarray(out).shape[0] == 8
+
+
+# --------------------------------------------------------------- fallback
+
+
+def test_corrupt_blob_warns_once_and_falls_back():
+    _units, arrays, meta, opts = _warm_flush()
+    want = _jit_wire(arrays, meta, opts)
+    assert aot.export_cohort(arrays, meta, opts)
+    # corrupt the blob on disk, then forget the in-process executable
+    (entry,) = (
+        v for k, v in tune.load_store().items()
+        if k.startswith(aot.INDEX_PREFIX)
+    )
+    blob = aot.blob_dir() / entry["blob"]
+    blob.write_bytes(b"\x00garbage" * 64)
+    aot.clear_registry()
+
+    fail_before = int(aot.counters().load_failures.value)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert aot.load_cohort(arrays, meta, opts) is None
+        assert aot.load_cohort(arrays, meta, opts) is None  # cached fail
+    msgs = [str(x.message) for x in w if "aot" in str(x.message)]
+    assert len(msgs) == 1, f"expected ONE aot warning, got {msgs}"
+    assert int(aot.counters().load_failures.value) == fail_before + 1
+    # the dispatch site still serves, byte-identically, via JIT
+    out, _ = launch_cohort_kernel(arrays, meta, opts)
+    assert np.array_equal(np.asarray(out), want)
+    assert aot.provenance()["source"] == "fresh"
+
+
+def test_truncated_blob_detected_by_size_check():
+    _units, arrays, meta, opts = _warm_flush()
+    assert aot.export_cohort(arrays, meta, opts)
+    (entry,) = (
+        v for k, v in tune.load_store().items()
+        if k.startswith(aot.INDEX_PREFIX)
+    )
+    blob = aot.blob_dir() / entry["blob"]
+    blob.write_bytes(blob.read_bytes()[: entry["bytes"] // 2])
+    aot.clear_registry()
+    with warnings.catch_warnings(record=True):
+        warnings.simplefilter("always")
+        assert aot.load_cohort(arrays, meta, opts) is None
+    out, _ = launch_cohort_kernel(arrays, meta, opts)
+    assert np.asarray(out).shape[0] == 8  # served by JIT, no crash
+
+
+def test_jaxlib_version_mismatch_is_clean_miss():
+    """An entry recorded under a different jaxlib must be ignored
+    without even touching the blob — version skew is a MISS, not an
+    error path."""
+    _units, arrays, meta, opts = _warm_flush()
+    assert aot.export_cohort(arrays, meta, opts)
+    (key,) = (
+        k for k in tune.load_store() if k.startswith(aot.INDEX_PREFIX)
+    )
+    tune.record(key, {"jaxlib": "0.0.0-foreign"})
+    aot.clear_registry()
+    fail_before = int(aot.counters().load_failures.value)
+    assert aot.load_cohort(arrays, meta, opts) is None
+    # a mismatch is not a load FAILURE (nothing was deserialized)
+    assert int(aot.counters().load_failures.value) == fail_before
+    out, _ = launch_cohort_kernel(arrays, meta, opts)
+    assert np.asarray(out).shape[0] == 8
+
+
+def test_broken_registry_executable_never_serves_wrong_results():
+    """A registered executable that rejects its dispatch (aval drift,
+    dead device) must be invalidated and the flush re-run on JIT —
+    identical bytes, one warning, no crash."""
+    _units, arrays, meta, opts = _warm_flush()
+    want = _jit_wire(arrays, meta, opts)
+    sig = aot.cohort_sig_for(arrays, meta[0], opts)
+
+    class _Broken:
+        def __call__(self, *a):
+            raise TypeError("Argument types differ from compiled types")
+
+    aot.register(sig, _Broken())
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        out, _ = launch_cohort_kernel(arrays, meta, opts)
+    assert np.array_equal(np.asarray(out), want)
+    assert any("rejected a dispatch" in str(x.message) for x in w)
+    assert aot.lookup(sig) is None, "broken executable must be evicted"
+    # and it stays evicted: the next flush goes straight to JIT
+    out2, _ = launch_cohort_kernel(arrays, meta, opts)
+    assert np.array_equal(np.asarray(out2), want)
+
+
+def test_real_roundtrip_loads_or_falls_back_gracefully():
+    """The unstubbed serialize→deserialize path: on a backend whose
+    PjRt client supports executable reload (TPU; some CPU builds) the
+    loaded executable must be byte-identical to JIT — on one that does
+    not (this CPU jaxlib) the load must be a warned, counted fallback.
+    Either branch is a pass; crashing or diverging is the only fail."""
+    _units, arrays, meta, opts = _warm_flush()
+    want = _jit_wire(arrays, meta, opts)
+    assert aot.export_cohort(arrays, meta, opts)
+    aot.clear_registry()
+    with warnings.catch_warnings(record=True):
+        warnings.simplefilter("always")
+        loaded = aot.load_cohort(arrays, meta, opts)
+    if loaded is not None:
+        got = loaded(*aot.cohort_args(arrays, opts))
+        assert np.array_equal(np.asarray(got), want)
+        assert aot.provenance()["source"] == "store"
+    else:
+        assert aot.provenance()["source"] == "fresh"
+    out, _ = launch_cohort_kernel(arrays, meta, opts)
+    assert np.array_equal(np.asarray(out), want)
+
+
+# --------------------------------------------------------------------- GC
+
+
+def test_gc_evicts_runtime_mismatched_entries_and_orphans():
+    _units, arrays, meta, opts = _warm_flush()
+    assert aot.export_cohort(arrays, meta, opts)
+    (key,) = (
+        k for k in tune.load_store() if k.startswith(aot.INDEX_PREFIX)
+    )
+    tune.record(key, {"device_kind": "TPU_v9_imaginary"})
+    (aot.blob_dir() / "orphan.exe").write_bytes(b"stray")
+    stats = aot.gc_store()
+    assert stats["evicted"] == 1 and stats["kept"] == 0
+    assert not list(aot.blob_dir().glob("*.exe")), "blobs must be gone"
+    assert not any(
+        k.startswith(aot.INDEX_PREFIX) for k in tune.load_store()
+    )
+    # the non-AOT half of the tune store must survive the GC untouched
+    tune.record("slabs|test", {"n_slabs": 4})
+    aot.gc_store()
+    assert tune.lookup("slabs|test")["n_slabs"] == 4
+
+
+def test_gc_bounds_total_bytes_oldest_first():
+    _u, arrays8, meta8, opts = _warm_flush(n_rows=8)
+    assert aot.export_cohort(arrays8, meta8, opts)
+    _u, arrays16, meta16, _o = _warm_flush(n_rows=16)
+    assert aot.export_cohort(arrays16, meta16, opts)
+    entries = {
+        k: v for k, v in tune.load_store().items()
+        if k.startswith(aot.INDEX_PREFIX)
+    }
+    assert len(entries) == 2
+    total = sum(e["bytes"] for e in entries.values())
+    biggest = max(e["bytes"] for e in entries.values())
+    # cap below the pair but above the bigger single entry: exactly one
+    # (the older) must go
+    stats = aot.gc_store(cap_bytes=(total + biggest) // 2 + 1)
+    assert stats["kept"] == 1 and stats["evicted"] == 1
+    assert len(list(aot.blob_dir().glob("*.exe"))) == 1
+
+
+# -------------------------------------------- zero-compile replica start
+
+
+def _stub_serialization(monkeypatch):
+    """Stub ONLY the jax (de)serialization boundary with an in-memory
+    blob store, so the zero-compile property is testable on a CPU
+    backend whose PjRt client cannot reload executables. Everything
+    else — keying, index, blob files, warmup, registry dispatch — runs
+    for real."""
+    blobs: dict[bytes, object] = {}
+
+    def fake_serialize(compiled):
+        token = f"stub-blob-{len(blobs)}".encode()
+        blobs[token] = compiled
+        return token
+
+    def fake_deserialize(data):
+        return blobs[bytes(data)]
+
+    monkeypatch.setattr(aot, "_serialize_compiled", fake_serialize)
+    monkeypatch.setattr(aot, "_deserialize_compiled", fake_deserialize)
+    return blobs
+
+
+def _clear_tracked_jit_caches():
+    import kindel_tpu.call_jax as cj
+
+    for fn in (cj.batched_call_kernel, cj.batched_realign_call_kernel,
+               cj.counts_call_kernel, cj.fused_call_kernel_slab):
+        fn.clear_cache()
+
+
+def test_zero_compile_replica_start(tmp_path, monkeypatch):
+    """The acceptance property: with a warm AOT store, a fresh serve
+    replica performs ZERO jit compiles through warmup AND its first
+    request (pinned via the jit cache-entry counter), and the first
+    response is byte-identical to the bam_to_consensus oracle."""
+    from test_serve import make_sam
+
+    from kindel_tpu.obs import runtime as obs_runtime
+    from kindel_tpu.serve import ConsensusClient, ConsensusService
+    from kindel_tpu.serve.warmup import warm_shapes
+    from kindel_tpu.workloads import bam_to_consensus
+
+    _stub_serialization(monkeypatch)
+    sam = make_sam(tmp_path / "zero.sam", seed=21)
+    want = bam_to_consensus(str(sam)).consensuses
+
+    # -- replica 0: cold host. Warmup compiles (via the AOT surface,
+    # parity-checked) and bakes the store — `kindel tune --export-aot`
+    # in miniature.
+    baked = warm_shapes(BatchOptions(), payloads=[str(sam)])
+    assert baked and all(t["source"] == "fresh" for t in baked.values())
+
+    # -- replica 1: fresh process stand-in — empty registry, empty jit
+    # caches, warm store.
+    aot.clear_registry()
+    _clear_tracked_jit_caches()
+    assert obs_runtime.jit_cache_entries() == 0
+
+    with ConsensusService(
+        max_wait_s=0.01, warm_payloads=[str(sam)]
+    ) as svc:
+        assert svc.wait_warm(timeout=300), "warmup never finished"
+        assert obs_runtime.jit_cache_entries() == 0, (
+            "warm-store warmup must LOAD executables, not compile"
+        )
+        health = svc.healthz()
+        assert health["status"] == "ok"
+        assert health["aot"]["source"] == "store"
+        assert health["aot"]["loaded"] >= 2  # synthetic + payload lane
+        got = ConsensusClient(svc).consensus(str(sam), timeout=120)
+        assert obs_runtime.jit_cache_entries() == 0, (
+            "first request on a warm replica compiled a kernel"
+        )
+        snap = svc.metrics.snapshot()
+    assert [(r.name, r.sequence) for r in got] == [
+        (r.name, r.sequence) for r in want
+    ]
+    # the warmup Info metric carries the compile/execute split and the
+    # store provenance per shape (satellite: attributable AOT savings)
+    shapes_info = snap["kindel_serve_warmup_shape"]
+    assert shapes_info and all(
+        s["source"] == "store"
+        and "compile_s" in s and "execute_s" in s
+        for s in shapes_info
+    )
+    assert all(float(s["compile_s"]) == 0.0 for s in shapes_info), (
+        "a store-loaded shape must not have paid any compile wall"
+    )
+
+
+def test_store_miss_warmup_matches_pre_aot_behavior(tmp_path):
+    """On a cold store the warmup compiles exactly as before this PR:
+    shapes ready, sources 'fresh', first request compiles nothing new —
+    today's behavior, plus a baked store as a side effect."""
+    from test_serve import make_sam
+
+    from kindel_tpu.obs import runtime as obs_runtime
+    from kindel_tpu.serve import ConsensusClient, ConsensusService
+    from kindel_tpu.workloads import bam_to_consensus
+
+    sam = make_sam(tmp_path / "miss.sam", seed=22)
+    want = bam_to_consensus(str(sam)).consensuses
+    with ConsensusService(
+        max_wait_s=0.01, warm_payloads=[str(sam)]
+    ) as svc:
+        assert svc.wait_warm(timeout=300)
+        assert svc.healthz()["aot"]["source"] == "fresh"
+        entries_after_warm = obs_runtime.jit_cache_entries()
+        got = ConsensusClient(svc).consensus(str(sam), timeout=120)
+        assert obs_runtime.jit_cache_entries() == entries_after_warm, (
+            "first post-warmup request compiled a new kernel shape"
+        )
+    assert [(r.name, r.sequence) for r in got] == [
+        (r.name, r.sequence) for r in want
+    ]
+    # the miss-path warmup baked the store for the next replica
+    assert any(
+        k.startswith(aot.INDEX_PREFIX) for k in tune.load_store()
+    )

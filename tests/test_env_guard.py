@@ -302,11 +302,76 @@ _FAILURE_HANDLERS = {
 #: deliberately-swallowing sites, each with a local reason:
 #: service._warm — warmup is best-effort, failure is recorded on
 #: _warm_error and /healthz; service._handle_consensus_post — the
-#: handler IS the failure path (it converts to an HTTP 5xx response)
+#: handler IS the failure path (it converts to an HTTP 5xx response);
+#: service._aot_provenance — a health probe that must answer even when
+#: the AOT store layer is broken (degrades to "disabled", loses no
+#: request)
 _SWALLOW_ALLOWLIST = {
     ("serve/service.py", "_warm"),
     ("serve/service.py", "_handle_consensus_post"),
+    ("serve/service.py", "_aot_provenance"),
 }
+
+
+def test_aot_compile_surface_confined_to_aot_module():
+    """One AOT surface: `.lower(...).compile(...)` chains and PjRt
+    executable (de)serialization may only appear in kindel_tpu/aot.py.
+    A second lowering/deserialization site would fork the store keying,
+    the parity discipline, and the warn-once fallback — exactly the
+    kind of drift that ends with a replica silently serving a kernel
+    the store never verified. Dispatch sites consult the aot registry;
+    they never compile or deserialize themselves."""
+    _AOT_ATTRS = {
+        "deserialize_and_load",
+        "deserialize_executable",
+        "serialize_executable",
+        "runtime_executable",
+    }
+    offenders = []
+    aot_sites = 0
+    for py in sorted(PKG.rglob("*.py")):
+        is_aot = py.relative_to(PKG).as_posix() == "aot.py"
+        tree = ast.parse(py.read_text(), filename=str(py))
+        for node in ast.walk(tree):
+            hit = None
+            if isinstance(node, ast.Call):
+                f = node.func
+                if (
+                    isinstance(f, ast.Attribute)
+                    and f.attr == "compile"
+                    and isinstance(f.value, ast.Call)
+                    and isinstance(f.value.func, ast.Attribute)
+                    and f.value.func.attr == "lower"
+                ):
+                    hit = ".lower().compile()"
+                elif isinstance(f, ast.Attribute) and f.attr in _AOT_ATTRS:
+                    hit = f".{f.attr}()"
+            elif isinstance(node, ast.Import):
+                if any(
+                    "serialize_executable" in a.name for a in node.names
+                ):
+                    hit = "import serialize_executable"
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if "serialize_executable" in mod or any(
+                    a.name == "serialize_executable" for a in node.names
+                ):
+                    hit = "import serialize_executable"
+            if hit is None:
+                continue
+            if is_aot:
+                aot_sites += 1
+            else:
+                offenders.append(
+                    f"{py.relative_to(PKG.parent)}:{node.lineno} ({hit})"
+                )
+    assert not offenders, (
+        "AOT lowering/executable-(de)serialization outside "
+        "kindel_tpu/aot.py — route it through the one AOT surface:\n"
+        + "\n".join(offenders)
+    )
+    # blindness check: the surface itself must be visible
+    assert aot_sites >= 3, f"only {aot_sites} AOT sites found in aot.py"
 
 
 def test_no_silent_exception_swallow_in_serve_or_resilience():
