@@ -382,6 +382,29 @@ def _run_benchmark() -> dict:
     if tune:
         result["tune_s"] = {str(k): round(v, 3) for k, v in tune.items()}
 
+    # Shape-diverse serve scenario (kindel_tpu.ragged): the ROADMAP's
+    # multi-sample regime — mixed contig/read lengths, some multi-ref
+    # payloads — run through BOTH batch modes; the `ragged` object
+    # reports per-mode occupancy, pad waste, superbatch count, and
+    # jit-cache entries, with byte-identity asserted between modes.
+    # Default-on for CPU children (seconds of wall); on an accelerator
+    # the mode-pair's compile set competes with the relay watchdog, so
+    # it needs the explicit KINDEL_TPU_BENCH_RAGGED=1 opt-in
+    # (KINDEL_TPU_BENCH_RAGGED=0 disables everywhere). Failure never
+    # voids the headline metric.
+    ragged_pin = os.environ.get("KINDEL_TPU_BENCH_RAGGED")
+    want_ragged = (
+        jax.default_backend() == "cpu" if ragged_pin is None
+        else ragged_pin not in ("", "0")
+    )
+    if want_ragged:
+        try:
+            from benchmarks.ragged_load import run_shape_diverse
+
+            result["ragged"] = run_shape_diverse(requests=10)
+        except Exception as e:  # noqa: BLE001
+            result["ragged"] = {"error": repr(e)}
+
     # Optional serving metrics (KINDEL_TPU_BENCH_SERVE=1): a small
     # closed-loop load run against the in-process service, so rounds can
     # track online throughput / p99 latency / batch occupancy alongside

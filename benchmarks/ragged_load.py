@@ -1,0 +1,176 @@
+"""Shape-diverse serve scenario: ragged superbatching vs shape-keyed lanes.
+
+The headline bench's serve load (`serve_load.py`) replays ONE payload —
+exactly the regime the shape-keyed micro-batcher is best at, and exactly
+what production traffic is not. This scenario generates the ROADMAP's
+multi-sample regime instead: many small contigs, mixed reference and
+read lengths, some multi-reference (metagenomic-style) payloads — and
+runs the identical request set through BOTH batch modes, reporting for
+each: pad-slot occupancy (payload/padded bases), pad waste, superbatch
+and dispatch counts, and the jit-cache entries the load cost. `bench.py`
+attaches the report as its `ragged` object; byte-identity between modes
+is asserted on every run (a perf scenario that silently changed the
+answer would be worse than no scenario).
+
+Standalone:
+
+    python -m benchmarks.ragged_load --requests 12
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import tempfile
+import threading
+from pathlib import Path
+
+
+def make_mixed_sams(out_dir: Path, n: int = 12, seed: int = 0) -> list:
+    """Shape-diverse synthetic payloads: reference lengths spread over
+    ~2 decades, varied read lengths/coverage, every third payload
+    multi-reference (2-3 contigs — the metagenomic cohort shape)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    paths = []
+    for i in range(n):
+        n_refs = 1 if i % 3 else int(rng.integers(2, 4))
+        lines = ["@HD\tVN:1.6"]
+        specs = []
+        for r in range(n_refs):
+            L = int(rng.integers(256, 6000))
+            specs.append((f"q{i}r{r}", L))
+            lines.append(f"@SQ\tSN:q{i}r{r}\tLN:{L}")
+        for ref, L in specs:
+            read_len = int(rng.integers(40, 120))
+            n_reads = int(rng.integers(10, 60))
+            for j in range(n_reads):
+                pos = int(rng.integers(0, max(1, L - read_len)))
+                seq = "".join(
+                    "ACGT"[b] for b in rng.integers(0, 4, size=read_len)
+                )
+                half = read_len // 2
+                cigar = (
+                    f"{read_len}M",
+                    f"{half}M2D{read_len - half}M",
+                    f"{half}M2I{read_len - half - 2}M",
+                )[j % 3]
+                lines.append(
+                    f"{ref}.{j}\t0\t{ref}\t{pos + 1}\t60\t{cigar}"
+                    f"\t*\t0\t0\t{seq}\t*"
+                )
+        p = out_dir / f"mix{i}.sam"
+        p.write_text("\n".join(lines) + "\n")
+        paths.append(p)
+    return paths
+
+
+def _counter_totals(snapshot: dict, prefix: str) -> int:
+    return sum(
+        int(v) for k, v in snapshot.items()
+        if (k == prefix or k.startswith(prefix + "{"))
+        and not isinstance(v, dict)
+    )
+
+
+def _global_snapshot() -> dict:
+    from kindel_tpu.obs.metrics import default_registry
+
+    return default_registry().snapshot()
+
+
+def run_shape_diverse(requests: int = 12, seed: int = 0,
+                      max_wait_s: float = 0.15) -> dict:
+    """Run the mixed-shape request set through lanes then ragged mode;
+    returns the comparison report (see module docstring)."""
+    from kindel_tpu.obs import runtime as obs_runtime
+    from kindel_tpu.serve import ConsensusClient, ConsensusService
+    from kindel_tpu.tune import TuningConfig
+
+    tmp = tempfile.TemporaryDirectory(prefix="kindel_ragged_load_")
+    try:
+        payloads = [
+            p.read_bytes()
+            for p in make_mixed_sams(Path(tmp.name), requests, seed)
+        ]
+
+        def run_mode(mode: str):
+            snap0 = _global_snapshot()
+            cache0 = obs_runtime.jit_cache_sizes()
+            results: list = [None] * len(payloads)
+            errors: list = []
+            with ConsensusService(
+                tuning=TuningConfig(batch_mode=mode),
+                max_wait_s=max_wait_s, decode_workers=4,
+            ) as svc:
+                client = ConsensusClient(svc)
+
+                def one(i):
+                    try:
+                        results[i] = client.fasta(payloads[i], timeout=600)
+                    except Exception as e:  # noqa: BLE001
+                        errors.append(repr(e))
+
+                threads = [
+                    threading.Thread(target=one, args=(i,))
+                    for i in range(len(payloads))
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                svc_snap = svc.metrics.snapshot()
+            snap1 = _global_snapshot()
+            cache1 = obs_runtime.jit_cache_sizes()
+
+            def delta(prefix):
+                return _counter_totals(snap1, prefix) - _counter_totals(
+                    snap0, prefix
+                )
+
+            payload = delta("kindel_dispatch_payload_bases_total")
+            padded = delta("kindel_dispatch_padded_bases_total")
+            report = {
+                "errors": len(errors),
+                "dispatches": int(
+                    svc_snap.get("kindel_serve_device_dispatches_total", 0)
+                ),
+                "superbatches": delta("kindel_ragged_superbatches_total"),
+                "lane_fallbacks": delta("kindel_ragged_fallback_total"),
+                "payload_bases": payload,
+                "padded_bases": padded,
+                "occupancy": round(payload / padded, 4) if padded else 0.0,
+                "pad_waste_bases": padded - payload,
+                "jit_cache_entries": sum(cache1.values())
+                - sum(cache0.values()),
+            }
+            return results, report
+
+        lanes_results, lanes = run_mode("lanes")
+        ragged_results, ragged = run_mode("ragged")
+        return {
+            "requests": requests,
+            "identical": lanes_results == ragged_results,
+            "lanes": lanes,
+            "ragged": ragged,
+        }
+    finally:
+        tmp.cleanup()
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--requests", type=int, default=12)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+    report = run_shape_diverse(requests=args.requests, seed=args.seed)
+    print(json.dumps(report))
+    return 0 if report["identical"] else 1
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    sys.exit(main())

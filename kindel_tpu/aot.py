@@ -550,6 +550,53 @@ def load_cohort(arrays, meta, opts):
     return load_executable(cohort_sig_for(arrays, meta[0], opts))
 
 
+def ragged_sig(class_key: tuple, want_masks: bool) -> tuple:
+    """Static signature of one ragged superbatch executable: the page
+    class's geometry key (kindel_tpu.ragged.pack.PageClass.key()) + the
+    wire variant. ONE executable per (class, variant) serves every
+    request shape the class admits — that is the point of the ragged
+    tier (DESIGN.md §16)."""
+    return ("ragged", tuple(class_key), bool(want_masks))
+
+
+def ragged_args(arrays, opts) -> tuple:
+    """Device args exactly as ragged.kernel.launch_ragged builds them —
+    same aval-agreement contract as cohort_args."""
+    import jax.numpy as jnp
+
+    return tuple(jnp.asarray(a) for a in arrays) + (
+        jnp.int32(opts.min_depth),
+        jnp.int32(1 if opts.fix_clip_artifacts else 0),
+    )
+
+
+def export_ragged(arrays, page_class, opts, verify: bool = True) -> bool:
+    """AOT-export the ragged superbatch kernel for one page class
+    (serve warmup miss path under --batch-mode ragged)."""
+    from kindel_tpu.ragged.kernel import (
+        ragged_call_kernel,
+        use_pallas_segments,
+    )
+
+    sig = ragged_sig(page_class.key(), opts.want_masks)
+    return export_executable(
+        ragged_call_kernel, ragged_args(arrays, opts),
+        {
+            "n_slots": page_class.n_slots,
+            "s_pad": page_class.s_pad,
+            "want_masks": opts.want_masks,
+            "pallas_segments": use_pallas_segments(),
+        },
+        sig, verify=verify,
+    )
+
+
+def load_ragged(page_class, opts):
+    """Load (or fetch from the registry) the executable for one page
+    class; None → caller runs the jit kernel."""
+    return load_executable(ragged_sig(page_class.key(), opts.want_masks))
+
+
 def export_fused(buf, pads: tuple, length: int, want_masks: bool,
                  c_pad: int | None, verify: bool = True) -> bool:
     """AOT-export the fused single-sample kernel for one upload-buffer

@@ -446,6 +446,23 @@ def _serve_parser(sub):
              "Byte-identical to per-flush launches — it only cuts "
              "per-dispatch upload/launch overhead",
     )
+    p.add_argument(
+        "--batch-mode", choices=["lanes", "ragged"], default=None,
+        help="admission→dispatch batching: 'lanes' keys coalescing on "
+             "padded lane shapes (one compiled kernel per shape), "
+             "'ragged' packs variable-length requests into fixed "
+             "page-class superbatches with a segment table (one "
+             "compiled/AOT executable per page class serves ALL "
+             "shapes — DESIGN.md §16). Top of the explicit > "
+             "$KINDEL_TPU_BATCH_MODE > default-lanes order",
+    )
+    p.add_argument(
+        "--ragged-classes", default=None, metavar="SPEC",
+        help="page-class geometry under --batch-mode ragged, e.g. "
+             "'small:64x2048,medium:32x16384,large:8x131072' "
+             "(name:ROWSxLENGTH; explicit > $KINDEL_TPU_RAGGED_CLASSES "
+             "> tune store > default)",
+    )
 
 
 def cmd_serve(args) -> int:
@@ -455,10 +472,18 @@ def cmd_serve(args) -> int:
     from kindel_tpu.serve import ConsensusService
 
     tuning = None
-    if args.lane_coalesce is not None:
+    if (
+        args.lane_coalesce is not None
+        or args.batch_mode is not None
+        or args.ragged_classes is not None
+    ):
         from kindel_tpu.tune import TuningConfig
 
-        tuning = TuningConfig(lane_coalesce=args.lane_coalesce)
+        tuning = TuningConfig(
+            lane_coalesce=args.lane_coalesce,
+            batch_mode=args.batch_mode,
+            ragged_classes=args.ragged_classes,
+        )
     service = ConsensusService(
         tuning=tuning,
         max_batch_rows=args.max_batch_rows,
@@ -527,6 +552,14 @@ def _tune_parser(sub):
         "--ingest-budget-s", type=float, default=20.0,
         help="wall budget for the parallel-ingest worker sweep (streamed "
              "decode passes over the same BAM); 0 skips it",
+    )
+    p.add_argument(
+        "--ragged-budget-s", type=float, default=0.0,
+        help="wall budget for the ragged page-class geometry sweep "
+             "(packs this BAM's units into each candidate class set and "
+             "times the segment kernel); the winner persists host-keyed "
+             "so `kindel serve --batch-mode ragged` starts with measured "
+             "geometry. 0 (default) skips it",
     )
     p.add_argument(
         "--dry-run", action="store_true",
@@ -624,6 +657,58 @@ def cmd_tune(args) -> int:
                     "bam_path": str(args.bam_path),
                 },
             )
+    # page-class geometry sweep (kindel_tpu.ragged): pack this BAM's
+    # units into each candidate class set, time one superbatch launch,
+    # persist the winning spec host-keyed
+    ragged_chosen, ragged_timings, ragged_persisted = None, {}, False
+    if args.ragged_budget_s > 0:
+        import numpy as np
+
+        from kindel_tpu.batch import BatchOptions
+        from kindel_tpu.call_jax import CallUnit
+        from kindel_tpu.ragged import (
+            build_segment_table,
+            classify_units,
+            pack_superbatch,
+            parse_classes,
+        )
+        from kindel_tpu.ragged.kernel import launch_ragged
+
+        opts = BatchOptions()
+        units = [
+            CallUnit(ev, rid, with_ins_table=True)
+            for rid in ev.present_ref_ids
+        ]
+
+        def ragged_pass(spec: str) -> float:
+            classes = parse_classes(spec)
+            idx = classify_units(units, classes)
+            if idx is None:  # this BAM cannot superbatch under the spec
+                return 1e9
+            cls = classes[idx]
+            table = build_segment_table(units, cls)
+            arrays = pack_superbatch(units, table)
+            np.asarray(launch_ragged(arrays, cls, opts))  # warm/compile
+            t = _time.perf_counter()
+            np.asarray(launch_ragged(arrays, cls, opts))
+            return _time.perf_counter() - t
+
+        ragged_chosen, ragged_timings = tune.search_ragged_classes(
+            ragged_pass, budget_s=args.ragged_budget_s
+        )
+        measurable = {k: v for k, v in ragged_timings.items() if v < 1e9}
+        if not args.dry_run and measurable:
+            ragged_persisted = tune.record(
+                tune.ragged_store_key(),
+                {
+                    "classes": ragged_chosen,
+                    "timings_s": {
+                        k: round(v, 4) for k, v in measurable.items()
+                    },
+                    "bam_path": str(args.bam_path),
+                },
+            )
+
     aot_report = None
     if args.export_aot:
         aot_report = _export_aot(args.bam_path, ev, dry_run=args.dry_run)
@@ -643,6 +728,12 @@ def cmd_tune(args) -> int:
         "persisted": persisted,
         "store": str(tune.store_path()),
     }
+    if ragged_chosen is not None:
+        doc["ragged_classes"] = ragged_chosen
+        doc["ragged_timings_s"] = {
+            k: round(v, 4) for k, v in ragged_timings.items() if v < 1e9
+        }
+        doc["ragged_persisted"] = ragged_persisted
     if aot_report is not None:
         doc["aot"] = aot_report
     print(json.dumps(doc))

@@ -354,6 +354,44 @@ class Info(_Metric):
         return lines
 
 
+#: default bound on the distinct values one label family may carry —
+#: room for every page class and a healthy set of warmed lane shapes,
+#: far below what a scrape pipeline starts choking on
+DEFAULT_LABEL_CAP = 24
+
+#: the overflow value every post-cap label collapses into
+LABEL_OTHER = "other"
+
+
+class LabelCapper:
+    """Bound one label family's cardinality: the first `cap` distinct
+    values pass through verbatim, every later NEW value maps to
+    `other`. Metric label sets must be small and bounded (the families
+    here have no eviction), but a label derived from traffic — a lane
+    pad shape under shape-diverse load — is unbounded by nature; this
+    is the chokepoint that keeps such a family scrapeable. Values
+    already admitted keep reporting under their own name forever, so
+    dashboards stay stable; only the long tail collapses."""
+
+    def __init__(self, cap: int = DEFAULT_LABEL_CAP, other: str = LABEL_OTHER):
+        if cap < 1:
+            raise ValueError("label cap must be >= 1")
+        self.cap = cap
+        self.other = other
+        self._seen: set = set()
+        self._lock = threading.Lock()
+
+    def see(self, value) -> str:
+        v = str(value)
+        with self._lock:
+            if v in self._seen:
+                return v
+            if len(self._seen) < self.cap:
+                self._seen.add(v)
+                return v
+        return self.other
+
+
 class MetricsRegistry:
     """Get-or-create metric registry; render order is creation order.
     Names must match the exposition grammar and carry non-empty help."""

@@ -1,0 +1,41 @@
+"""kindel_tpu.ragged — segment-table superbatching for the serve tier.
+
+The shape-keyed micro-batcher (kindel_tpu/serve/batcher.py) keys its
+coalescing lanes on per-flush pad shapes, so shape-diverse traffic
+fragments into low-occupancy lanes and one compiled kernel per shape.
+This package replaces that with pack-don't-pad superbatching in the
+style of ragged paged attention (PAPERS.md): variable-length request
+units pack end-to-end into ONE fixed-geometry slot axis with a segment
+table, and a segment-aware call kernel whose jit signature depends only
+on the superbatch geometry serves *all* request shapes — a handful of
+tuned page classes, a handful of compiled (and AOT-exportable)
+executables, arbitrary traffic.
+
+    pack.py     page classes, segment table, vectorized superbatch packer
+    kernel.py   segment-aware flat call kernel (+ gated Pallas reduction)
+    unpack.py   per-request extraction, byte-identical to the lanes path
+    batcher.py  RaggedBatcher — the MicroBatcher flush contract, superbatched
+"""
+
+from kindel_tpu.ragged.batcher import RaggedBatcher, RaggedFlush
+from kindel_tpu.ragged.pack import (
+    PageClass,
+    RaggedCapacityError,
+    SegmentTable,
+    build_segment_table,
+    classify_units,
+    pack_superbatch,
+    parse_classes,
+)
+
+__all__ = [
+    "PageClass",
+    "RaggedBatcher",
+    "RaggedCapacityError",
+    "RaggedFlush",
+    "SegmentTable",
+    "build_segment_table",
+    "classify_units",
+    "pack_superbatch",
+    "parse_classes",
+]

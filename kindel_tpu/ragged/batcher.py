@@ -1,0 +1,232 @@
+"""RaggedBatcher — the MicroBatcher flush contract, superbatched.
+
+Drop-in replacement for the serve tier's shape-keyed micro-batcher
+(`--batch-mode ragged`): instead of keying coalescing lanes on per-flush
+pad shapes, requests accumulate into **page-class lanes** keyed only by
+(call options, page class). A lane seals when its next admission would
+overflow any of the class's fixed capacities, when it reaches the
+segment bound, or when its oldest entry ages past max-wait — the same
+batch-full / max-wait / drain semantics the worker's dispatch loop
+already drives through `poll`, so the worker, watchdog, supervisor, and
+admission watermarks are untouched.
+
+Two request kinds cannot ride a superbatch and fall through to the
+inherited shape-keyed lanes (still one batcher, one poll loop, one
+dispatch thread): requests whose options carry `realign` (the CDR walk
+needs the row-structured dense channels of the cohort kernel), and
+oversize requests no page class admits. Both are counted on the
+process-global registry so the fallback volume is visible.
+
+Fat-dispatch coalescing (`take_ready`) degrades to "already one batch"
+for superbatch flushes: merging two sealed superbatches would overflow
+the class geometry, and a superbatch is already the fattest dispatch
+the class allows. Sealed shape-keyed flushes keep the inherited
+behavior.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from kindel_tpu.obs import trace as obs_trace
+from kindel_tpu.ragged import pack as rpack
+from kindel_tpu.serve.batcher import Flush, MicroBatcher, opts_key
+
+_FALLBACK_COUNTER = None
+
+
+def _fallback_counter():
+    """Requests routed to the shape-keyed lanes path instead of a
+    superbatch, labeled by reason (process-global registry)."""
+    global _FALLBACK_COUNTER
+    if _FALLBACK_COUNTER is None:
+        from kindel_tpu.obs.metrics import default_registry
+
+        _FALLBACK_COUNTER = default_registry().counter(
+            "kindel_ragged_fallback_total",
+            "requests routed to the shape-keyed lanes path instead of a "
+            "superbatch (reason label: realign/oversize)",
+        )
+    return _FALLBACK_COUNTER
+
+
+@dataclass
+class RaggedFlush(Flush):
+    """One sealed superbatch. `shapes` carries the page-class geometry
+    key (so span/metric labels and flush identity stay well-defined);
+    `page_class` is what the worker's ragged dispatch packs against."""
+
+    page_class: object = None
+
+
+class _RaggedLane:
+    __slots__ = ("opts", "cls_idx", "entries", "opened_at", "segments",
+                 "slots", "spans", "events", "dels", "inss")
+
+    def __init__(self, opts, cls_idx, now):
+        self.opts = opts
+        self.cls_idx = cls_idx
+        self.entries: list = []
+        self.opened_at = now
+        self.segments = 0
+        self.slots = 0
+        self.spans = 0
+        self.events = 0
+        self.dels = 0
+        self.inss = 0
+
+    def admits(self, need: rpack.Consumption, cls: rpack.PageClass,
+               seg_cap: int) -> bool:
+        return (
+            self.segments + need.segments <= seg_cap
+            and self.slots + need.slots <= cls.n_slots
+            and self.spans + need.spans <= cls.o_cap
+            and self.events + need.events <= cls.e_cap
+            and self.dels + need.dels <= cls.d_cap
+            and self.inss + need.inss <= cls.i_cap
+        )
+
+    def take(self, req, units, need: rpack.Consumption) -> None:
+        self.entries.append((req, units))
+        self.segments += need.segments
+        self.slots += need.slots
+        self.spans += need.spans
+        self.events += need.events
+        self.dels += need.dels
+        self.inss += need.inss
+
+
+class RaggedBatcher(MicroBatcher):
+    """Page-class superbatching with the MicroBatcher flush contract."""
+
+    def __init__(self, classes, max_batch_rows: int = 64,
+                 max_wait_s: float = 0.02, clock=None):
+        import time
+
+        super().__init__(
+            max_batch_rows=max_batch_rows, max_wait_s=max_wait_s,
+            clock=clock if clock is not None else time.monotonic,
+        )
+        self.classes = tuple(classes)
+        if not self.classes:
+            raise ValueError("RaggedBatcher needs at least one page class")
+        self._rlanes: dict[tuple, _RaggedLane] = {}
+
+    # ------------------------------------------------------------ admission
+
+    def _seg_cap(self, cls: rpack.PageClass) -> int:
+        """Segments one superbatch may hold: the class's row bound,
+        further capped by the operator's max_batch_rows knob (segments
+        are the ragged tier's 'rows')."""
+        return min(cls.rows, self.max_batch_rows)
+
+    def add(self, req, units) -> None:
+        if not units:
+            raise ValueError("a request with no units has nothing to batch")
+        cls_idx = None
+        if not req.opts.realign:
+            cls_idx = rpack.classify_units(units, self.classes)
+        if cls_idx is None:
+            # realign/oversize: the inherited shape-keyed lane path
+            _fallback_counter().labels(
+                reason="realign" if req.opts.realign else "oversize"
+            ).inc()
+            super().add(req, units)
+            return
+        need = rpack.consumption(units)
+        okey = opts_key(req.opts)
+        with self._cond:
+            now = self._clock()
+            # occupancy-first placement: join the smallest OPEN lane (of
+            # this class or any larger one) that still admits the
+            # request, before opening a new lane — small traffic fills
+            # an already-committed bigger grid instead of paying for its
+            # own. Dispatch output is per-unit, so which class carries a
+            # unit never changes its bytes.
+            lane = None
+            key = None
+            for c in range(cls_idx, len(self.classes)):
+                cand_key = (okey, c)
+                cand = self._rlanes.get(cand_key)
+                if cand is not None and cand.admits(
+                    need, self.classes[c], self._seg_cap(self.classes[c])
+                ):
+                    lane, key = cand, cand_key
+                    break
+            if lane is None:
+                key = (okey, cls_idx)
+                full = self._rlanes.get(key)
+                if full is not None:
+                    # capacity-full home lane: seal it, open a fresh one
+                    self._ready.append(self._seal_ragged(key, full))
+                lane = self._rlanes[key] = _RaggedLane(req.opts, cls_idx, now)
+            cls = self.classes[lane.cls_idx]
+            lane.take(req, units, need)
+            sealed = lane.segments >= self._seg_cap(cls)
+            if sealed:
+                self._ready.append(self._seal_ragged(key, lane))
+            self._cond.notify_all()
+        span = getattr(req, "span", None)
+        if span is not None and span is not obs_trace.NOOP_SPAN:
+            span.add_event(
+                "batcher.ragged_add",
+                segments=need.segments, slots=need.slots, sealed=sealed,
+                page_class=cls.label(),
+            )
+
+    def _seal_ragged(self, key, lane: _RaggedLane) -> RaggedFlush:
+        del self._rlanes[key]
+        cls = self.classes[lane.cls_idx]
+        return RaggedFlush(
+            lane.opts, cls.key(), lane.entries, lane.opened_at,
+            page_class=cls,
+        )
+
+    # ----------------------------------------------------------- poll hooks
+
+    def _due_locked(self, now: float):
+        flush = super()._due_locked(now)
+        if flush is not None:
+            return flush
+        oldest_key = None
+        oldest = None
+        for key, lane in self._rlanes.items():
+            if oldest is None or lane.opened_at < oldest.opened_at:
+                oldest_key, oldest = key, lane
+        if oldest is not None and now - oldest.opened_at >= self.max_wait_s:
+            return self._seal_ragged(oldest_key, oldest)
+        return None
+
+    def _has_open_locked(self) -> bool:
+        return super()._has_open_locked() or bool(self._rlanes)
+
+    def _oldest_open_locked(self) -> float | None:
+        candidates = [
+            t for t in (super()._oldest_open_locked(),) if t is not None
+        ] + [lane.opened_at for lane in self._rlanes.values()]
+        return min(candidates) if candidates else None
+
+    # -------------------------------------------------------- flush contract
+
+    @property
+    def pending_rows(self) -> int:
+        with self._cond:
+            classic = sum(lane.rows for lane in self._lanes.values())
+            ragged = sum(lane.segments for lane in self._rlanes.values())
+            ready = sum(f.n_rows for f in self._ready)
+            return classic + ragged + ready
+
+    def take_ready(self, like, limit: int) -> list:
+        # a superbatch is already the fattest launch its class allows —
+        # fat-dispatch coalescing degrades to "already one batch"
+        if isinstance(like, RaggedFlush):
+            return []
+        return super().take_ready(like, limit)
+
+    def flush_all(self) -> list:
+        with self._cond:
+            out = [
+                self._seal_ragged(key, self._rlanes[key])
+                for key in list(self._rlanes)
+            ]
+        return out + super().flush_all()

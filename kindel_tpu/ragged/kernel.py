@@ -1,0 +1,235 @@
+"""Segment-aware flat call kernel: one executable per page class.
+
+The ragged kernel is the cohort kernel's scatter run ONCE over the
+superbatch's flat slot axis instead of vmapped over per-sample rows.
+Because `pack.py` pre-offsets every uploaded position by its segment's
+slot start, the span-id reconstruction, the weighted scatter, and every
+per-position call decision in `call_jax._call_core` apply verbatim with
+`length = n_slots` — the decision logic is literally shared, which is
+what makes ragged output byte-identical to the lanes path. The only
+genuinely segment-aware step is the per-request depth report:
+min/max coverage reduce **per segment** via segment_ids built on device
+from the uploaded segment table (`segment_min`/`segment_max` — the
+segment_sum-style reduction PAPERS.md "Ragged Paged Attention" packs
+its pages with), with a Pallas block-tiled reduction as a gated fast
+path on accelerator backends (same gate shape as
+`call_jax._use_compact_wire`; `KINDEL_TPU_RAGGED_PALLAS` overrides,
+interpret mode serves CPU tests).
+
+The jit signature depends only on (page-class geometry, want_masks):
+every request shape a class admits re-dispatches the same compiled —
+and AOT-exportable (`kindel_tpu.aot.export_ragged`) — executable.
+
+Wire layout (single uint8 buffer, one d2h transfer; `wire_sizes` is the
+decoder's source of truth):
+
+  fast path:  [plane n_slots/4 | exc n_slots/8 | del_flags d_cap/8 |
+               ins_flags i_cap/8 | seg_dmin 4·s_pad | seg_dmax 4·s_pad]
+  masks path: [emit n_slots/2 | del n/8 | n n/8 | ins n/8 |
+               seg_dmin 4·s_pad | seg_dmax 4·s_pad]
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+from kindel_tpu.utils.jax_cache import ensure_compilation_cache
+
+ensure_compilation_cache()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kindel_tpu.call_jax import _call_core
+from kindel_tpu.obs import runtime as obs_runtime
+from kindel_tpu.obs import trace as obs_trace
+from kindel_tpu.resilience import faults as rfaults
+
+_INT32_MAX = np.int32(2**31 - 1)
+
+#: slot-block width of the Pallas segment reduction (page-class lengths
+#: are multiples of 1024, so n_slots always divides)
+_PALLAS_BLOCK = 1024
+
+
+def use_pallas_segments() -> bool:
+    """Gate of the Pallas segment-reduction fast path, resolved on the
+    host at launch time (never inside the traced body — tier-1 guard):
+    KINDEL_TPU_RAGGED_PALLAS=1/0 overrides; default on only off-CPU,
+    where the block-tiled reduction beats XLA's generic segment scatter.
+    On CPU the override runs the kernel in interpret mode (tests)."""
+    import os
+
+    override = os.environ.get("KINDEL_TPU_RAGGED_PALLAS")
+    if override is not None:
+        return override not in ("0", "")
+    return jax.default_backend() != "cpu"
+
+
+def _segment_depth_xla(acgt, slot_seg, slot_end, s_pad: int):
+    """Per-segment min/max ACGT depth via jax.ops segment reductions."""
+    n_slots = acgt.shape[0]
+    slot = jnp.arange(n_slots, dtype=jnp.int32)
+    in_ref = slot < slot_end
+    dmin = jax.ops.segment_min(
+        jnp.where(in_ref, acgt, _INT32_MAX), slot_seg, num_segments=s_pad
+    )
+    dmax = jax.ops.segment_max(
+        jnp.where(in_ref, acgt, -1), slot_seg, num_segments=s_pad
+    )
+    # pad segments (no slots at all) take the reduction identities;
+    # clamp the max identity (-2**31) to the -1 the Pallas path's
+    # accumulator init uses, so the two fast paths emit one wire
+    return dmin, jnp.maximum(dmax, -1)
+
+
+def _pallas_seg_kernel(depth_ref, seg_ref, end_ref, dmin_ref, dmax_ref,
+                       *, s_tile: int):
+    """One grid step: fold a slot block's depths into the running
+    per-segment min/max (output block revisited across the sequential
+    TPU grid — init at step 0, accumulate after)."""
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        dmin_ref[...] = jnp.full((1, s_tile), _INT32_MAX, jnp.int32)
+        dmax_ref[...] = jnp.full((1, s_tile), -1, jnp.int32)
+
+    depth = depth_ref[0, :]
+    seg = seg_ref[0, :]
+    base = i * _PALLAS_BLOCK
+    slot = base + jax.lax.broadcasted_iota(
+        jnp.int32, (1, _PALLAS_BLOCK), 1
+    )[0]
+    in_ref = slot < end_ref[0, :]
+    # [BLOCK, S] one-hot segment membership → masked column reductions
+    sid = jax.lax.broadcasted_iota(jnp.int32, (_PALLAS_BLOCK, s_tile), 1)
+    mask = (seg[:, None] == sid) & in_ref[:, None]
+    dmin_ref[...] = jnp.minimum(
+        dmin_ref[...],
+        jnp.where(mask, depth[:, None], _INT32_MAX).min(axis=0)[None, :],
+    )
+    dmax_ref[...] = jnp.maximum(
+        dmax_ref[...],
+        jnp.where(mask, depth[:, None], -1).max(axis=0)[None, :],
+    )
+
+
+def _segment_depth_pallas(acgt, slot_seg, slot_end, s_pad: int):
+    """Pallas fast path of the per-segment depth reduction: grid over
+    slot blocks, [BLOCK, S]-masked min/max per step, running fold into a
+    revisited [1, S] output. Segment axis padded to a lane-friendly
+    multiple of 128; interpret mode on CPU (the gate only reaches here
+    off-CPU or under the env override)."""
+    from jax.experimental import pallas as pl
+
+    n_slots = int(acgt.shape[0])
+    s_tile = max(128, -(-s_pad // 128) * 128)
+    grid = n_slots // _PALLAS_BLOCK
+    interpret = jax.default_backend() == "cpu"
+    # slot_end, per slot, is what the block mask needs; the seg axis is
+    # padded with an id (s_tile - 1 >= s_pad) no real slot carries
+    dmin, dmax = pl.pallas_call(
+        partial(_pallas_seg_kernel, s_tile=s_tile),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((1, _PALLAS_BLOCK), lambda i: (0, i)),
+            pl.BlockSpec((1, _PALLAS_BLOCK), lambda i: (0, i)),
+            pl.BlockSpec((1, _PALLAS_BLOCK), lambda i: (0, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, s_tile), lambda i: (0, 0)),
+            pl.BlockSpec((1, s_tile), lambda i: (0, 0)),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((1, s_tile), jnp.int32)] * 2,
+        interpret=interpret,
+    )(acgt[None, :], slot_seg[None, :], slot_end[None, :])
+    return dmin[0, :s_pad], dmax[0, :s_pad]
+
+
+@partial(
+    jax.jit,
+    static_argnames=("n_slots", "s_pad", "want_masks", "pallas_segments"),
+)
+def ragged_call_kernel(op_r_start, op_off, base_packed, del_pos, ins_pos,
+                       ins_cnt, seg_starts, seg_lens, n_events, min_depth,
+                       flags=0, *, n_slots: int, s_pad: int,
+                       want_masks: bool = False,
+                       pallas_segments: bool = False):
+    """Scatter + call every packed segment of one superbatch; see the
+    module docstring for the wire layout. Static only in the page-class
+    geometry (array shapes + n_slots/s_pad) and the wire variant."""
+    out = _call_core(
+        op_r_start, op_off, base_packed, del_pos, ins_pos, ins_cnt,
+        n_events, min_depth, n_slots, want_masks, keep_dense=True,
+        flags=flags,
+    )
+    (main, parts, _dmin, _dmax), (weights, _deletions) = out[:4], out[4:]
+
+    # segment ids + in-reference bounds from the uploaded segment table:
+    # boundary scatter + prefix sum, the same trick the span-id
+    # reconstruction uses (pad seg_starts carry PAD_POS → dropped)
+    acgt = weights[:, :4].sum(axis=1)
+    marks = jnp.zeros(n_slots, jnp.int32).at[seg_starts].add(1, mode="drop")
+    slot_seg = jnp.clip(jnp.cumsum(marks) - 1, 0, s_pad - 1)
+    slot_end = (seg_starts + seg_lens)[slot_seg]
+    seg_fn = _segment_depth_pallas if pallas_segments else _segment_depth_xla
+    seg_dmin, seg_dmax = seg_fn(acgt, slot_seg, slot_end, s_pad)
+
+    segs = [main]
+    segs.extend(
+        p if p.dtype == jnp.uint8 else jnp.packbits(p) for p in parts
+    )
+    segs.append(
+        jax.lax.bitcast_convert_type(seg_dmin, jnp.uint8).reshape(-1)
+    )
+    segs.append(
+        jax.lax.bitcast_convert_type(seg_dmax, jnp.uint8).reshape(-1)
+    )
+    return jnp.concatenate(segs)
+
+
+def wire_sizes(page_class, want_masks: bool) -> list[int]:
+    """Byte sizes of the ragged wire's segments, in producer order —
+    the single source of truth `unpack.py` slices by."""
+    n = page_class.n_slots
+    if want_masks:
+        sizes = [n // 2, n // 8, n // 8, n // 8]
+    else:
+        sizes = [n // 4, n // 8, -(-page_class.d_cap // 8),
+                 -(-page_class.i_cap // 8)]
+    return sizes + [4 * page_class.s_pad, 4 * page_class.s_pad]
+
+
+def launch_ragged(arrays, page_class, opts):
+    """Upload one packed superbatch and launch the segment kernel
+    (async, like every dispatch site). Consults the AOT registry first
+    (kindel_tpu.aot — serve warmup loads/exports page-class executables
+    exactly as it does lane shapes); a miss or rejected call runs the
+    jit kernel, byte-identically."""
+    from kindel_tpu import aot
+
+    rfaults.hook("device.dispatch")
+    h2d_bytes = sum(int(np.asarray(a).nbytes) for a in arrays)
+    obs_runtime.transfer_counters()[0].inc(h2d_bytes)
+    pallas = use_pallas_segments()
+    with obs_trace.span("ragged.launch") as sp:
+        dev = aot.ragged_args(arrays, opts)
+        out = aot.call(
+            aot.ragged_sig(page_class.key(), opts.want_masks), dev
+        )
+        aot_hit = out is not None
+        if out is None:
+            out = ragged_call_kernel(
+                *dev, n_slots=page_class.n_slots, s_pad=page_class.s_pad,
+                want_masks=opts.want_masks, pallas_segments=pallas,
+            )
+        if sp is not obs_trace.NOOP_SPAN:
+            sp.set_attribute(
+                page_class=page_class.label(), n_slots=page_class.n_slots,
+                h2d_bytes=h2d_bytes, aot=aot_hit, pallas=pallas,
+            )
+    return out

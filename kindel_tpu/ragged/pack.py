@@ -1,0 +1,339 @@
+"""Superbatch packing: variable-length units → one fixed-geometry slab.
+
+A **page class** is a fixed superbatch geometry — `rows` segments ×
+`length` slots plus derived event/span/indel capacities. Every array the
+ragged kernel consumes is padded to the class's capacities, so the jit
+(and AOT-export) signature of a page class never changes no matter what
+traffic packs into it. The serving process runs a small tuned set of
+classes (small/medium/large by default; `kindel_tpu.tune` resolves the
+spec), so the whole shape-diverse serve tier compiles at most
+#classes × #wire-variants kernels — versus one per lane shape before.
+
+Units pack end-to-end on a single flat slot axis. Each unit's segment is
+aligned to an 8-slot granule with at least one empty slot after it:
+
+  * byte alignment — every per-position wire plane (2-bit bases, 4-bit
+    emits, 1-bit masks) slices per-unit on byte boundaries, so unpacking
+    is a couple of numpy slices per request;
+  * the guaranteed zero-depth gap slot reproduces the per-row padding
+    semantics of the lanes kernel exactly (`depth_next` past a unit's
+    last position reads 0), which is what makes ragged output
+    byte-identical to the shape-keyed path.
+
+The **segment table** carries per-segment slot offsets/lengths, flat
+event/deletion/insertion stream offsets, and request back-pointers
+(entry index per segment). It is built with vectorized numpy — the
+tier-1 AST guard (tests/test_env_guard.py) pins `build_segment_table`
+and `pack_superbatch` loop-free: per-request Python work is O(1) array
+bookkeeping (comprehensions feeding concatenate/cumsum), never
+per-event.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from kindel_tpu.pileup_jax import PAD_POS, check_pad_safe_block
+
+#: slot-alignment granule: 8 keeps every bit-packed wire plane sliceable
+#: per segment on byte boundaries (and ≥1 zero gap slot per segment)
+GRANULE = 8
+
+#: derived-capacity model: events per slot the event buffers budget for,
+#: and the slot fraction reserved for sparse deletion/insertion events —
+#: a superbatch that would exceed any capacity simply closes early
+#: (capacity never affects correctness, only occupancy)
+EVENTS_PER_SLOT = 4
+SPANS_PER_ROW = 256
+INDEL_SLOT_FRACTION = 16
+
+
+class RaggedCapacityError(ValueError):
+    """Units exceed the page class's fixed capacities — the caller must
+    split the batch or route it to a larger class / the lanes path."""
+
+
+def stride_for(length: int) -> int:
+    """Slots one unit of reference length L consumes: L rounded up to the
+    granule with at least one empty gap slot after the last position."""
+    return ((int(length) // GRANULE) + 1) * GRANULE
+
+
+@dataclass(frozen=True)
+class PageClass:
+    """One fixed superbatch geometry (see module docstring)."""
+
+    name: str
+    rows: int  # max segments per superbatch
+    length: int  # max slot stride a single admitted unit may have
+
+    def __post_init__(self):
+        if self.rows < 1:
+            raise ValueError(f"page class {self.name!r}: rows must be >= 1")
+        if self.length < 1024 or self.length % 1024:
+            raise ValueError(
+                f"page class {self.name!r}: length must be a positive "
+                "multiple of 1024"
+            )
+        check_pad_safe_block(self.n_slots, f"page class {self.name!r}")
+
+    @property
+    def n_slots(self) -> int:
+        return self.rows * self.length
+
+    @property
+    def s_pad(self) -> int:
+        return self.rows
+
+    @property
+    def o_cap(self) -> int:
+        """Match op-span capacity (flat, all segments)."""
+        return self.rows * SPANS_PER_ROW
+
+    @property
+    def e_cap(self) -> int:
+        """Match event capacity (flat); always even (4-bit pairing)."""
+        return EVENTS_PER_SLOT * self.n_slots
+
+    @property
+    def b_cap(self) -> int:
+        """Packed base-code bytes (2 events per byte)."""
+        return self.e_cap // 2
+
+    @property
+    def d_cap(self) -> int:
+        return max(64, self.n_slots // INDEL_SLOT_FRACTION)
+
+    @property
+    def i_cap(self) -> int:
+        return max(64, self.n_slots // INDEL_SLOT_FRACTION)
+
+    def key(self) -> tuple:
+        """Static geometry identity — the jit/AOT signature component
+        (the leading marker keeps it disjoint from every shape-keyed
+        lane tuple, so flush identities never collide)."""
+        return ("ragged", self.name, self.rows, self.length, self.o_cap,
+                self.b_cap, self.d_cap, self.i_cap)
+
+    def label(self) -> str:
+        return f"{self.name}:r{self.rows}xL{self.length}"
+
+
+def parse_classes(spec: str) -> tuple[PageClass, ...]:
+    """Parse a page-class spec string — ``"small:64x2048,medium:32x16384"``
+    (name:ROWSxLENGTH, comma-separated) — into classes sorted ascending
+    by length (classification picks the first class a unit fits)."""
+    classes = []
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            name, geom = part.split(":")
+            rows_s, length_s = geom.lower().split("x")
+            classes.append(PageClass(name.strip(), int(rows_s), int(length_s)))
+        except (ValueError, TypeError) as e:
+            raise ValueError(
+                f"bad page-class spec segment {part!r} "
+                "(expected name:ROWSxLENGTH)"
+            ) from e
+    if not classes:
+        raise ValueError(f"page-class spec {spec!r} defines no classes")
+    out = tuple(sorted(classes, key=lambda c: (c.length, c.rows)))
+    if len({c.name for c in out}) != len(out):
+        raise ValueError(f"page-class spec {spec!r} repeats a class name")
+    return out
+
+
+@dataclass(frozen=True)
+class Consumption:
+    """What one set of units costs a page class, in capacity units."""
+
+    segments: int
+    slots: int
+    max_stride: int
+    spans: int
+    events: int
+    dels: int
+    inss: int
+
+
+def consumption(units) -> Consumption:
+    strides = [stride_for(u.L) for u in units]
+    return Consumption(
+        segments=len(units),
+        slots=sum(strides),
+        max_stride=max(strides, default=0),
+        spans=sum(len(u.op_r_start) for u in units),
+        events=sum(u.n_events for u in units),
+        dels=sum(len(u.del_pos) for u in units),
+        inss=sum(len(u.ins_pos) for u in units),
+    )
+
+
+def fits(need: Consumption, cls: PageClass,
+         max_segments: int | None = None) -> bool:
+    """Does `need` fit an EMPTY superbatch of `cls`? (The batcher adds
+    lane-occupancy on top before asking.)"""
+    seg_cap = cls.rows if max_segments is None else min(cls.rows, max_segments)
+    return (
+        need.segments <= seg_cap
+        and need.slots <= cls.n_slots
+        and need.max_stride <= cls.length
+        and need.spans <= cls.o_cap
+        and need.events <= cls.e_cap
+        and need.dels <= cls.d_cap
+        and need.inss <= cls.i_cap
+    )
+
+
+def classify_units(units, classes) -> int | None:
+    """Index of the smallest page class one request's units fit, or None
+    when no class admits them (oversize → the shape-keyed lanes path).
+    A request is atomic: all its units ride one superbatch, so routing is
+    by the largest unit's stride plus total capacity."""
+    need = consumption(units)
+    for i, cls in enumerate(classes):
+        if need.max_stride <= cls.length and fits(need, cls):
+            return i
+    return None
+
+
+@dataclass
+class SegmentTable:
+    """Per-segment layout of one packed superbatch (numpy int32 arrays,
+    all length S = number of real segments): slot offsets/lengths, flat
+    stream offsets for events/deletions/insertions, and the request
+    back-pointer every result routes home through."""
+
+    page_class: PageClass
+    entry_idx: np.ndarray  # request (flush-entry) back-pointer per segment
+    seg_start: np.ndarray  # slot offset (GRANULE-aligned)
+    seg_len: np.ndarray  # true reference length
+    ev_off: np.ndarray  # flat match-event stream offset
+    ev_len: np.ndarray
+    del_off: np.ndarray  # flat deletion stream offset
+    del_len: np.ndarray
+    ins_off: np.ndarray  # flat insertion stream offset
+    ins_len: np.ndarray
+
+    @property
+    def n_segments(self) -> int:
+        return len(self.seg_start)
+
+    @property
+    def payload_slots(self) -> int:
+        return int(self.seg_len.sum())
+
+    @property
+    def occupancy(self) -> float:
+        """Payload positions / total superbatch slots — the pad-waste
+        number the obs metrics and bench's ragged object report."""
+        return self.payload_slots / float(self.page_class.n_slots)
+
+
+def build_segment_table(units, page_class: PageClass) -> SegmentTable:
+    """Lay `units` out on the flat slot axis (vectorized; loop-free by
+    tier-1 AST guard). Unit order is segment order; `u.sample_idx` is the
+    request back-pointer the serve worker assigned at flatten time."""
+    n = len(units)
+    if n == 0:
+        raise ValueError("an empty superbatch has nothing to pack")
+    lens = np.fromiter((u.L for u in units), np.int64, count=n)
+    strides = (lens // GRANULE + 1) * GRANULE
+    seg_start = np.concatenate(([0], np.cumsum(strides)[:-1]))
+    ev_len = np.fromiter((u.n_events for u in units), np.int64, count=n)
+    del_len = np.fromiter((len(u.del_pos) for u in units), np.int64, count=n)
+    ins_len = np.fromiter((len(u.ins_pos) for u in units), np.int64, count=n)
+    spans = int(sum(len(u.op_r_start) for u in units))
+    table = SegmentTable(
+        page_class=page_class,
+        entry_idx=np.fromiter(
+            (getattr(u, "sample_idx", 0) or 0 for u in units),
+            np.int64, count=n,
+        ).astype(np.int32),
+        seg_start=seg_start.astype(np.int32),
+        seg_len=lens.astype(np.int32),
+        ev_off=np.concatenate(([0], np.cumsum(ev_len)[:-1])).astype(np.int32),
+        ev_len=ev_len.astype(np.int32),
+        del_off=np.concatenate(([0], np.cumsum(del_len)[:-1])).astype(np.int32),
+        del_len=del_len.astype(np.int32),
+        ins_off=np.concatenate(([0], np.cumsum(ins_len)[:-1])).astype(np.int32),
+        ins_len=ins_len.astype(np.int32),
+    )
+    c = page_class
+    if (
+        n > c.rows
+        or int(strides.sum()) > c.n_slots
+        or int(strides.max()) > c.length
+        or spans > c.o_cap
+        or int(ev_len.sum()) > c.e_cap
+        or int(del_len.sum()) > c.d_cap
+        or int(ins_len.sum()) > c.i_cap
+    ):
+        raise RaggedCapacityError(
+            f"{n} units (slots {int(strides.sum())}, events "
+            f"{int(ev_len.sum())}) exceed page class {c.label()}"
+        )
+    return table
+
+
+def pack_superbatch(units, table: SegmentTable):
+    """Concatenate every unit's event tensors into the page class's
+    fixed-capacity flat arrays (vectorized; loop-free by tier-1 AST
+    guard). Positions are pre-offset by each unit's slot start, so the
+    kernel's span reconstruction lands every event in flat coordinates
+    with no per-event segment gather.
+
+    Returns the kernel's array arguments:
+      (op_r_start[o_cap], op_off[o_cap], base_packed[b_cap],
+       del_pos[d_cap], ins_pos[i_cap], ins_cnt[i_cap],
+       seg_starts[s_pad], seg_lens[s_pad], n_events)
+    """
+    from kindel_tpu.call_jax import unpack_base_codes
+
+    c = table.page_class
+    total_events = int(table.ev_len.sum())
+
+    def flat(parts, cap, fill, dtype=np.int32):
+        out = np.full(cap, fill, dtype=dtype)
+        if parts:
+            arr = np.concatenate(parts)
+            out[: len(arr)] = arr
+        return out
+
+    op_r_start = flat(
+        [u.op_r_start + s for u, s in zip(units, table.seg_start)],
+        c.o_cap, PAD_POS,
+    )
+    # pad spans mark slot `total_events` of the flat event stream — the
+    # same sentinel pack_cohort uses per row, so the masked tail of the
+    # marks/cumsum span-id reconstruction behaves identically
+    op_off = flat(
+        [u.op_off + e for u, e in zip(units, table.ev_off)],
+        c.o_cap, np.int32(total_events),
+    )
+    codes = flat(
+        [unpack_base_codes(u.base_packed, u.n_events) for u in units],
+        c.e_cap, 0, np.uint8,
+    )
+    base_packed = (codes[0::2] << 4) | codes[1::2]
+    del_pos = flat(
+        [u.del_pos + s for u, s in zip(units, table.seg_start)],
+        c.d_cap, PAD_POS,
+    )
+    ins_pos = flat(
+        [u.ins_pos + s for u, s in zip(units, table.seg_start)],
+        c.i_cap, PAD_POS,
+    )
+    ins_cnt = flat([u.ins_cnt for u in units], c.i_cap, 0)
+    seg_starts = np.full(c.s_pad, PAD_POS, np.int32)
+    seg_starts[: table.n_segments] = table.seg_start
+    seg_lens = np.zeros(c.s_pad, np.int32)
+    seg_lens[: table.n_segments] = table.seg_len
+    return (
+        op_r_start, op_off, base_packed, del_pos, ins_pos, ins_cnt,
+        seg_starts, seg_lens, np.int32(total_events),
+    )

@@ -1,0 +1,89 @@
+"""Per-request extraction from one superbatch wire — byte-identical to
+the shape-keyed lanes path (pinned by tests/test_ragged.py's parity
+suite).
+
+Because every segment sits on an 8-slot granule, each unit's share of
+the dense wire planes is a byte-aligned numpy slice; the sparse
+deletion/insertion flag planes slice by the segment table's flat stream
+offsets. From there the decode is the SAME host code the lanes path
+runs (`decode_fast` / `masks_from_wire` / `assemble`), so any divergence
+would have to come from the device math — which `ragged/kernel.py`
+shares with the cohort kernel position-for-position.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from kindel_tpu.call import _insertion_calls, assemble
+from kindel_tpu.call_jax import decode_fast, masks_from_wire
+from kindel_tpu.io.fasta import Sequence
+from kindel_tpu.obs import runtime as obs_runtime
+from kindel_tpu.ragged.kernel import wire_sizes
+
+
+def unpack_superbatch(buf, table, units, opts, pool, paths=None) -> list:
+    """Download one superbatch wire and splice per-unit results (host,
+    thread-parallel) — the ragged counterpart of
+    `batch._assemble_outputs`, returning the same (Sequence,
+    changes|None, report|None) per unit, in unit order."""
+    buf = np.asarray(buf)  # blocks on the device→host copy
+    obs_runtime.transfer_counters()[1].inc(int(buf.nbytes))
+    cls = table.page_class
+    sizes = wire_sizes(cls, opts.want_masks)
+    offs = np.cumsum([0] + sizes)
+    segs = [buf[offs[k]: offs[k + 1]] for k in range(len(sizes))]
+    seg_dmin = np.frombuffer(segs[-2].tobytes(), np.int32)
+    seg_dmax = np.frombuffer(segs[-1].tobytes(), np.int32)
+    if opts.want_masks:
+        emit_w, del_b, n_b, ins_b = segs[:4]
+    else:
+        plane_w, exc_w, del_f, ins_f = segs[:4]
+        # one unpack of the flat sparse-flag planes; per-unit slices
+        # repack for decode_fast (tiny arrays — a few flags per unit)
+        del_bits = np.unpackbits(del_f)
+        ins_bits = np.unpackbits(ins_f)
+
+    def one(i_u):
+        i, u = i_u
+        o = int(table.seg_start[i])
+        L = u.L
+        if opts.want_masks:
+            emit_s = emit_w[o // 2: o // 2 + -(-L // 2)]
+            masks_s = tuple(
+                b[o // 8: o // 8 + -(-L // 8)] for b in (del_b, n_b, ins_b)
+            )
+            _emit, masks = masks_from_wire(emit_s, masks_s, L)
+        else:
+            d0, dn = int(table.del_off[i]), int(table.del_len[i])
+            i0, inn = int(table.ins_off[i]), int(table.ins_len[i])
+            masks = decode_fast(
+                plane_w[o // 4: o // 4 + -(-L // 4)],
+                exc_w[o // 8: o // 8 + -(-L // 8)],
+                np.packbits(del_bits[d0: d0 + dn]),
+                np.packbits(ins_bits[i0: i0 + inn]),
+                L, u.del_pos, u.ins_pos,
+            )
+        ins_calls = (
+            _insertion_calls(u.ins_table) if masks.ins_mask.any() else {}
+        )
+        res = assemble(
+            masks, ins_calls, u.cdr_patches, opts.trim_ends,
+            opts.min_depth, opts.uppercase,
+            build_changes=opts.want_masks,
+        )
+        seq = Sequence(name=f"{u.ref_id}_cns", sequence=res.sequence)
+        changes = res.changes if opts.build_changes else None
+        report = None
+        if opts.build_reports:
+            from kindel_tpu.workloads import build_report
+
+            report = build_report(
+                u.ref_id, int(seg_dmin[i]), int(seg_dmax[i]), res.changes,
+                u.cdr_patches, paths[u.sample_idx], opts.realign,
+                opts.min_depth, opts.min_overlap,
+                opts.clip_decay_threshold, opts.trim_ends, opts.uppercase,
+            )
+        return seq, changes, report
+
+    return list(pool.map(one, enumerate(units)))

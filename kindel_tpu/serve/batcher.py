@@ -124,6 +124,21 @@ class MicroBatcher:
             return self._seal(oldest_key, oldest)
         return None
 
+    # poll() drives these two hooks so a subclass with extra lane kinds
+    # (kindel_tpu.ragged.RaggedBatcher) only overrides lane accounting,
+    # never the wait/close logic itself
+
+    def _has_open_locked(self) -> bool:
+        """Any open (unsealed) lane left? Gates the closed-drain exit."""
+        return bool(self._lanes)
+
+    def _oldest_open_locked(self) -> float | None:
+        """opened_at of the oldest open lane (None when all are sealed)
+        — what poll() sleeps against for the max-wait trigger."""
+        if not self._lanes:
+            return None
+        return min(lane.opened_at for lane in self._lanes.values())
+
     def poll(self, timeout: float | None = None) -> Flush | None:
         """Block until a flush is due (full lane, or oldest lane aged past
         max_wait_s). Returns None on timeout, or when closed with nothing
@@ -135,15 +150,13 @@ class MicroBatcher:
                 flush = self._due_locked(now)
                 if flush is not None:
                     return flush
-                if self._closed and not self._lanes:
+                if self._closed and not self._has_open_locked():
                     return None
                 # sleep until the oldest lane matures or the caller's
                 # deadline, whichever is sooner
                 waits = []
-                if self._lanes:
-                    oldest = min(
-                        lane.opened_at for lane in self._lanes.values()
-                    )
+                oldest = self._oldest_open_locked()
+                if oldest is not None:
                     waits.append(oldest + self.max_wait_s - now)
                 if deadline is not None:
                     remaining = deadline - now

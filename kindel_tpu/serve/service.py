@@ -143,13 +143,35 @@ class ConsensusService:
             getattr(tuning, "lane_coalesce", None)
         )
         self._m_tune_source.set(knob="lane_coalesce", source=lc_src)
+        # batching mode (DESIGN.md §16): "lanes" = shape-keyed
+        # micro-batcher, "ragged" = page-class superbatching — one
+        # compiled (and AOT-exportable) executable per page class serves
+        # every request shape the class admits
+        self.batch_mode, bm_src = tune.resolve_batch_mode(
+            getattr(tuning, "batch_mode", None)
+        )
+        self._m_tune_source.set(knob="batch_mode", source=bm_src)
+        self._ragged_classes: tuple = ()
         self.queue = RequestQueue(
             max_depth=max_depth, high_watermark=high_watermark,
             metrics=self.metrics,
         )
-        self.batcher = MicroBatcher(
-            max_batch_rows=max_batch_rows, max_wait_s=max_wait_s
-        )
+        if self.batch_mode == "ragged":
+            from kindel_tpu.ragged import RaggedBatcher, parse_classes
+
+            spec, rc_src = tune.resolve_ragged_classes(
+                getattr(tuning, "ragged_classes", None)
+            )
+            self._m_tune_source.set(knob="ragged_classes", source=rc_src)
+            self._ragged_classes = parse_classes(spec)
+            self.batcher = RaggedBatcher(
+                self._ragged_classes, max_batch_rows=max_batch_rows,
+                max_wait_s=max_wait_s,
+            )
+        else:
+            self.batcher = MicroBatcher(
+                max_batch_rows=max_batch_rows, max_wait_s=max_wait_s
+            )
         self.breaker = CircuitBreaker(
             failure_threshold=breaker_threshold, reset_s=breaker_reset_s,
             metrics=self.metrics,
@@ -228,6 +250,15 @@ class ConsensusService:
                 self.default_opts, row_bucket=self.worker.row_bucket,
                 payloads=self._warm_payloads,
             )
+            if self.batch_mode == "ragged" and self._ragged_classes:
+                # superbatch geometries are startup-known in FULL — with
+                # a warm AOT store this is the zero-compile startup that
+                # covers arbitrary traffic, not just derivable shapes
+                from kindel_tpu.serve.warmup import warm_ragged
+
+                timings.update(
+                    warm_ragged(self.default_opts, self._ragged_classes)
+                )
             self._m_warm_shapes.inc(len(timings))
             for label, t in timings.items():
                 if isinstance(t, dict):
@@ -235,6 +266,7 @@ class ConsensusService:
                     # (plain floats still accepted: stand-in warmers)
                     self._m_warm_shape_info.set(
                         shape=label,
+                        batch_mode=self.batch_mode,
                         seconds=round(t.get("total_s", 0.0), 3),
                         compile_s=round(t.get("compile_s", 0.0), 3),
                         execute_s=round(t.get("execute_s", 0.0), 3),
@@ -242,7 +274,8 @@ class ConsensusService:
                     )
                 else:
                     self._m_warm_shape_info.set(
-                        shape=label, seconds=round(t, 3)
+                        shape=label, batch_mode=self.batch_mode,
+                        seconds=round(t, 3),
                     )
         except Exception as e:  # noqa: BLE001 — warmup is best-effort
             self._warm_error = repr(e)
@@ -292,7 +325,15 @@ class ConsensusService:
             # this replica's device programs load from the store or
             # compile fresh? (kindel_tpu.aot; "disabled" = store off)
             "aot": _aot_provenance(),
+            # batching provenance, same convention: which admission →
+            # dispatch path this replica runs, and (under ragged) the
+            # page-class geometries its executables are warmed for
+            "batch_mode": self.batch_mode,
         }
+        if self._ragged_classes:
+            doc["ragged"] = {
+                "classes": [c.label() for c in self._ragged_classes],
+            }
         if self._warm_error is not None:
             doc["warmup_error"] = self._warm_error
         return doc

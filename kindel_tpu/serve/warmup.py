@@ -134,3 +134,65 @@ def warm_shapes(opts, row_bucket: int = 8, payloads=(),
             "source": source,
         }
     return timings
+
+
+def warm_ragged(opts, classes) -> dict[str, dict]:
+    """Ready the ragged superbatch kernel for every page class — the
+    `--batch-mode ragged` counterpart of `warm_shapes`, with one
+    decisive difference: a page class's geometry is fixed, so warming
+    (or AOT-loading) it covers EVERY request shape the class will ever
+    admit, not just startup-derivable ones. Both wire variants warm
+    (the fast path and the masks path `build_changes`/`build_reports`
+    requests switch to), so no traffic mix compiles post-startup.
+
+    Returns {label: {"total_s", "compile_s", "execute_s", "source"}},
+    labels `ragged:<class>:r<rows>xL<len>[:masks]`, sources as in
+    warm_shapes ("store" / "fresh" / "disabled")."""
+    from dataclasses import replace
+
+    import numpy as np
+
+    from kindel_tpu import aot
+    from kindel_tpu.obs import runtime as obs_runtime
+    from kindel_tpu.ragged import build_segment_table, pack_superbatch
+    from kindel_tpu.ragged.kernel import launch_ragged
+    from kindel_tpu.resilience import faults as rfaults
+
+    obs_runtime.install()
+    # ragged flushes are always non-realign (the batcher routes realign
+    # to the shape-keyed lanes), so warm the geometry the kernel runs
+    base = replace(opts, realign=False)
+    variants = (
+        ("", replace(base, build_changes=False, build_reports=False)),
+        (":masks", replace(base, build_changes=True)),
+    )
+    units = decode_payload(_SYNTH_SAM, base)
+    timings: dict[str, dict] = {}
+    for cls in classes:
+        table = build_segment_table(units, cls)
+        for suffix, vopts in variants:
+            label = f"ragged:{cls.label()}{suffix}"
+            rfaults.hook("device.compile")
+            t0 = time.monotonic()
+            _c0, compile_wall0 = obs_runtime.compile_totals()
+            arrays = pack_superbatch(units, table)
+            if aot.enabled():
+                if aot.load_ragged(cls, vopts) is not None:
+                    source = "store"
+                else:
+                    source = "fresh"
+                    aot.export_ragged(arrays, cls, vopts)
+            else:
+                source = "disabled"
+            wire = launch_ragged(arrays, cls, vopts)
+            np.asarray(wire)  # block: load/compile + execute must be done
+            total = time.monotonic() - t0
+            _c1, compile_wall1 = obs_runtime.compile_totals()
+            compile_s = max(0.0, compile_wall1 - compile_wall0)
+            timings[label] = {
+                "total_s": total,
+                "compile_s": compile_s,
+                "execute_s": max(0.0, total - compile_s),
+                "source": source,
+            }
+    return timings

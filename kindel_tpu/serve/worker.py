@@ -56,6 +56,62 @@ from kindel_tpu.serve.queue import RequestQueue, ServeRequest
 
 
 _COALESCE_COUNTERS: tuple | None = None
+_PADDING_COUNTERS: tuple | None = None
+_RAGGED_METRICS: tuple | None = None
+
+
+def _padding_counters() -> tuple:
+    """(payload bases, padded bases) counters on the PROCESS-GLOBAL
+    registry, fed by EVERY serve dispatch — lanes and ragged alike — so
+    bench's shape-diverse scenario can compare the two paths' pad waste
+    from one place."""
+    global _PADDING_COUNTERS
+    if _PADDING_COUNTERS is None:
+        from kindel_tpu.obs.metrics import default_registry
+
+        reg = default_registry()
+        _PADDING_COUNTERS = (
+            reg.counter(
+                "kindel_dispatch_payload_bases_total",
+                "true reference positions carried by serve device "
+                "dispatches (the useful fraction of the padded grid)",
+            ),
+            reg.counter(
+                "kindel_dispatch_padded_bases_total",
+                "padded grid positions serve device dispatches "
+                "scattered over (payload / padded = occupancy)",
+            ),
+        )
+    return _PADDING_COUNTERS
+
+
+def _ragged_metrics() -> tuple:
+    """Superbatch occupancy/shape metrics on the process-global registry
+    (kindel_tpu.ragged; DESIGN.md §16)."""
+    global _RAGGED_METRICS
+    if _RAGGED_METRICS is None:
+        from kindel_tpu.obs.metrics import default_registry
+
+        reg = default_registry()
+        _RAGGED_METRICS = (
+            reg.histogram(
+                "kindel_ragged_occupancy",
+                "payload slots / page-class slots per dispatched "
+                "superbatch",
+                buckets=(0.05, 0.1, 0.2, 0.3, 0.5, 0.7, 0.85, 0.95, 1.0),
+            ),
+            reg.histogram(
+                "kindel_ragged_segments",
+                "segments (request units) per dispatched superbatch",
+                buckets=(1, 2, 4, 8, 16, 32, 64, 128),
+            ),
+            reg.counter(
+                "kindel_ragged_superbatches_total",
+                "superbatches dispatched through the segment kernel, "
+                "labeled by page class",
+            ),
+        )
+    return _RAGGED_METRICS
 
 
 def _coalesce_counters() -> tuple:
@@ -246,6 +302,14 @@ class ServeWorker:
         self._inflight: dict[int, tuple] = {}
         self._inflight_lock = threading.Lock()
         self._inflight_seq = 0
+        #: lane-shape label chokepoint for the dispatch histogram: under
+        #: shape-diverse traffic raw pad-shape labels are unbounded; the
+        #: capper admits the first DEFAULT_LABEL_CAP distinct shapes and
+        #: collapses the tail into "other" (ragged page classes are
+        #: bounded by construction and pass through)
+        from kindel_tpu.obs.metrics import LabelCapper
+
+        self._shape_labels = LabelCapper()
         if metrics is not None:
             self._m_requests = metrics.counter(
                 "kindel_serve_requests_total", "requests accepted"
@@ -557,13 +621,22 @@ class ServeWorker:
         settled by the time this returns."""
         t0 = time.perf_counter()
         launch_window: dict = {}
+        # the superbatch geometry rides only the FIRST (whole-flush)
+        # attempt: recovery re-dispatches (shapes=None) run the classic
+        # shape-derived path, which the degrade ladder already knows how
+        # to bisect/isolate — byte-identical either way
+        page_class = (
+            getattr(flush, "page_class", None) if shapes is not None
+            else None
+        )
         wkey = self._watch(entries)
         try:
             with maybe_phase("serve dispatch+assemble"):
                 outputs, units = self.retry.run(
                     "serve.flush",
                     lambda: self._run_entries(
-                        entries, flush.opts, shapes, launch_window
+                        entries, flush.opts, shapes, launch_window,
+                        page_class,
                     ),
                 )
         except Exception as e:
@@ -577,9 +650,13 @@ class ServeWorker:
         if self._m_dispatches is not None:
             self._m_dispatches.inc()
             self._m_occupancy.observe(len(entries))
-            self._m_dispatch_s.labels(
-                shape=_shape_label(flush.shapes)
-            ).observe(t1 - t0)
+            # page-class labels are bounded by construction; lane-shape
+            # labels go through the cardinality chokepoint
+            label = (
+                f"ragged:{page_class.name}" if page_class is not None
+                else self._shape_labels.see(_shape_label(flush.shapes))
+            )
+            self._m_dispatch_s.labels(shape=label).observe(t1 - t0)
         self._record_flush_spans(
             entries, flush, flush_id, t0, t1, launch_window,
             occupancy=len(entries), isolated=depth > 0,
@@ -656,12 +733,17 @@ class ServeWorker:
                 h2d_bytes=launch_window.get("h2d_bytes", 0),
             )
 
-    def _run_entries(self, entries, opts, shapes, launch_window=None):
+    def _run_entries(self, entries, opts, shapes, launch_window=None,
+                     page_class=None):
         """Pack + launch + assemble one coalesced batch. Returns
         (per-unit outputs, flat unit list in row order); `launch_window`
         (when given) receives the pack+launch interval, the jit
         cache-entry delta, and the upload byte count for the dispatch
-        span."""
+        span. With `page_class` set (a RaggedFlush's first attempt) the
+        batch packs into that class's fixed-geometry superbatch and runs
+        the segment kernel (kindel_tpu.ragged) — byte-identical output,
+        one compiled executable per page class instead of one per lane
+        shape."""
         rfaults.hook("serve.flush")
         units = []
         paths = []
@@ -670,11 +752,36 @@ class ServeWorker:
                 u.sample_idx = idx
                 units.append(u)
             paths.append(_payload_label(req.payload))
-        n_rows = _bucket(len(units), self.row_bucket)
         probing = launch_window is not None and trace.active_tracer() is not None
         if probing:
             cache_before = obs_runtime.jit_cache_entries()
             launch_window["t0"] = time.perf_counter()
+        if page_class is not None and not opts.realign:
+            from kindel_tpu.ragged import build_segment_table, pack_superbatch
+            from kindel_tpu.ragged.kernel import launch_ragged
+            from kindel_tpu.ragged.unpack import unpack_superbatch
+
+            table = build_segment_table(units, page_class)
+            arrays = pack_superbatch(units, table)
+            wire = launch_ragged(arrays, page_class, opts)
+            if probing:
+                launch_window["t1"] = time.perf_counter()
+                launch_window["compiled_new"] = (
+                    obs_runtime.jit_cache_entries() - cache_before
+                )
+                launch_window["h2d_bytes"] = sum(a.nbytes for a in arrays)
+            payload, padded = _padding_counters()
+            payload.inc(table.payload_slots)
+            padded.inc(page_class.n_slots)
+            m_occ, m_segs, m_super = _ragged_metrics()
+            m_occ.observe(table.occupancy)
+            m_segs.observe(table.n_segments)
+            m_super.labels(page_class=page_class.name).inc()
+            outputs = unpack_superbatch(
+                wire, table, units, opts, self._assemble_pool, paths
+            )
+            return outputs, units
+        n_rows = _bucket(len(units), self.row_bucket)
         arrays, meta = pack_cohort(units, opts, n_rows=n_rows, shapes=shapes)
         device_out = launch_cohort_kernel(arrays, meta, opts)
         if probing:
@@ -683,6 +790,9 @@ class ServeWorker:
                 obs_runtime.jit_cache_entries() - cache_before
             )
             launch_window["h2d_bytes"] = sum(a.nbytes for a in arrays)
+        payload, padded = _padding_counters()
+        payload.inc(sum(u.L for u in units))
+        padded.inc(int(arrays[0].shape[0]) * int(meta[0]))
         outputs = _assemble_outputs(
             units, device_out, opts, self._assemble_pool, paths
         )

@@ -78,6 +78,27 @@ INGEST_PREFETCH_MB_DEFAULT = 8
 #: pays pack + upload + dispatch once instead of N times.
 LANE_COALESCE_DEFAULT = 4
 
+#: serve batching mode: "lanes" = the shape-keyed micro-batcher (one
+#: compiled kernel per lane shape), "ragged" = page-class superbatching
+#: (kindel_tpu.ragged — one compiled kernel per page class serves all
+#: request shapes); the env pin is KINDEL_TPU_BATCH_MODE
+BATCH_MODE_DEFAULT = "lanes"
+BATCH_MODES = ("lanes", "ragged")
+
+#: default page-class geometry spec (name:ROWSxLENGTH, ascending —
+#: kindel_tpu.ragged.pack.parse_classes is the grammar); the env pin is
+#: KINDEL_TPU_RAGGED_CLASSES, `kindel tune --ragged-budget-s` persists a
+#: measured winner host-keyed
+RAGGED_CLASSES_DEFAULT = "small:32x2048,medium:16x8192,large:8x65536"
+
+#: candidate class sets the geometry search probes (the default plus
+#: narrower/wider row splits of the same length ladder)
+RAGGED_CLASS_CANDIDATES = (
+    RAGGED_CLASSES_DEFAULT,
+    "small:64x1024,medium:16x8192,large:8x131072",
+    "small:32x4096,medium:16x32768,large:4x262144",
+)
+
 STORE_VERSION = 1
 
 
@@ -107,6 +128,8 @@ class TuningConfig:
     cohort_budget_mb: int | None = None
     ingest_workers: int | None = None
     lane_coalesce: int | None = None
+    batch_mode: str | None = None
+    ragged_classes: str | None = None
     sources: tuple = ()
 
 
@@ -531,6 +554,72 @@ def resolve_lane_coalesce(explicit: int | None = None) -> tuple[int, str]:
     return LANE_COALESCE_DEFAULT, "default"
 
 
+def resolve_batch_mode(explicit: str | None = None) -> tuple[str, str]:
+    """The serve batching-mode knob: explicit arg > KINDEL_TPU_BATCH_MODE
+    > default ("lanes"). A malformed value anywhere falls through to the
+    default — an unknown mode must never take a replica down at boot."""
+    if explicit is not None:
+        mode = str(explicit).strip().lower()
+        if mode in BATCH_MODES:
+            return mode, "explicit"
+        raise ValueError(
+            f"unknown batch mode {explicit!r} (expected one of "
+            f"{'/'.join(BATCH_MODES)})"
+        )
+    env = os.environ.get("KINDEL_TPU_BATCH_MODE", "").strip().lower()
+    if env in BATCH_MODES:
+        return env, "env"
+    return BATCH_MODE_DEFAULT, "default"
+
+
+def ragged_store_key() -> str:
+    """Page-class geometry is a property of the host's device/link (how
+    much padded scatter work a superbatch may carry before it beats the
+    dispatch overhead it saves) — host-keyed like the ingest knobs."""
+    return "ragged|" + host_fingerprint()
+
+
+def resolve_ragged_classes(explicit: str | None = None) -> tuple[str, str]:
+    """The page-class geometry spec (kindel_tpu.ragged.pack.parse_classes
+    grammar): explicit arg > KINDEL_TPU_RAGGED_CLASSES > tune store >
+    default. Returns the raw spec string + source; parsing/validation
+    happens at the single consumer (ragged.pack)."""
+    if explicit:
+        return str(explicit), "explicit"
+    env = os.environ.get("KINDEL_TPU_RAGGED_CLASSES", "").strip()
+    if env:
+        return env, "env"
+    entry = lookup(ragged_store_key())
+    if entry and isinstance(entry.get("classes"), str):
+        return entry["classes"], "cache"
+    return RAGGED_CLASSES_DEFAULT, "default"
+
+
+def search_ragged_classes(measure, candidates=RAGGED_CLASS_CANDIDATES,
+                          budget_s: float = 30.0, clock=time.perf_counter):
+    """Budget-bounded page-class geometry search: probe each candidate
+    spec while the wall budget lasts and return (best_spec, {spec:
+    seconds}). `measure(spec) -> wall seconds` receives the spec
+    EXPLICITLY (no env mutation), same contract as every other search
+    here; `kindel tune --ragged-budget-s` persists the winner under
+    ragged_store_key()."""
+    from kindel_tpu.obs import trace as obs_trace
+
+    timings: dict[str, float] = {}
+    t0 = clock()
+    for spec in candidates:
+        with obs_trace.span("tune.ragged_probe") as sp:
+            wall = measure(spec)
+            if sp is not obs_trace.NOOP_SPAN:
+                sp.set_attribute(classes=spec, wall_s=round(wall, 4))
+        timings[spec] = wall
+        if clock() - t0 > budget_s:
+            break
+    if not timings:
+        return candidates[0] if candidates else RAGGED_CLASSES_DEFAULT, {}
+    return min(timings, key=timings.get), timings
+
+
 def resolve(explicit: TuningConfig | None = None, backend: str = "cpu",
             max_contig: int | None = None,
             bam_path=None) -> TuningConfig:
@@ -542,6 +631,8 @@ def resolve(explicit: TuningConfig | None = None, backend: str = "cpu",
     budget, s3 = resolve_cohort_budget_mb(e.cohort_budget_mb)
     ingest, s4 = resolve_ingest_workers(e.ingest_workers)
     coalesce, s5 = resolve_lane_coalesce(e.lane_coalesce)
+    batch_mode, s6 = resolve_batch_mode(e.batch_mode)
+    ragged_classes, s7 = resolve_ragged_classes(e.ragged_classes)
     # knob provenance into the shared exposition: one Info sample per
     # (knob, source, value) — the serve /metrics and bench snapshots show
     # WHERE each performance knob came from, not just its value
@@ -556,12 +647,16 @@ def resolve(explicit: TuningConfig | None = None, backend: str = "cpu",
     info.set(knob="cohort_budget_mb", source=s3, value=str(budget))
     info.set(knob="ingest_workers", source=s4, value=str(ingest))
     info.set(knob="lane_coalesce", source=s5, value=str(coalesce))
+    info.set(knob="batch_mode", source=s6, value=batch_mode)
+    info.set(knob="ragged_classes", source=s7, value=ragged_classes)
     return TuningConfig(
         n_slabs=n_slabs, stream_chunk_mb=chunk, cohort_budget_mb=budget,
         ingest_workers=ingest, lane_coalesce=coalesce,
+        batch_mode=batch_mode, ragged_classes=ragged_classes,
         sources=(("n_slabs", s1), ("stream_chunk_mb", s2),
                  ("cohort_budget_mb", s3), ("ingest_workers", s4),
-                 ("lane_coalesce", s5)),
+                 ("lane_coalesce", s5), ("batch_mode", s6),
+                 ("ragged_classes", s7)),
     )
 
 

@@ -374,6 +374,50 @@ def test_aot_compile_surface_confined_to_aot_module():
     assert aot_sites >= 3, f"only {aot_sites} AOT sites found in aot.py"
 
 
+#: ragged/pack.py functions on the superbatch hot path — they run once
+#: per dispatched flush, so per-request Python cost must stay O(1) array
+#: bookkeeping (comprehensions feeding concatenate/cumsum/fromiter),
+#: never an explicit loop that could hide per-element work
+_RAGGED_HOT_FUNCTIONS = {"build_segment_table", "pack_superbatch"}
+
+
+def test_ragged_pack_hot_path_is_vectorized():
+    """Vectorized-only lint over the ragged packer (same style as the
+    zlib/jax confinement guards): no `for`/`while` statement anywhere
+    inside the hot functions of kindel_tpu/ragged/pack.py — numpy does
+    the per-element work; Python touches each request exactly once via
+    comprehensions. (The `.lower().compile()` confinement guard above
+    already covers ragged/: its kernel consults the aot registry and
+    never lowers anything itself.)"""
+    path = PKG / "ragged" / "pack.py"
+    tree = ast.parse(path.read_text(), filename=str(path))
+    offenders = []
+    found = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name not in _RAGGED_HOT_FUNCTIONS:
+            continue
+        found.add(node.name)
+        for n in ast.walk(node):
+            if isinstance(n, (ast.For, ast.AsyncFor, ast.While)):
+                offenders.append(
+                    f"kindel_tpu/ragged/pack.py:{n.lineno} "
+                    f"({type(n).__name__} inside `{node.name}`)"
+                )
+    assert not offenders, (
+        "explicit loop on the ragged pack hot path — keep it vectorized "
+        "(numpy concatenate/cumsum over per-request comprehensions):\n"
+        + "\n".join(offenders)
+    )
+    # blindness check: renaming a hot function must fail the guard, not
+    # silently skip it
+    assert found == _RAGGED_HOT_FUNCTIONS, (
+        f"hot functions missing from ragged/pack.py: "
+        f"{_RAGGED_HOT_FUNCTIONS - found}"
+    )
+
+
 def test_no_silent_exception_swallow_in_serve_or_resilience():
     """Every `except Exception` / `except BaseException` in the serving
     and resilience layers must re-raise, resolve a future, or record the

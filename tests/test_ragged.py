@@ -1,0 +1,500 @@
+"""kindel_tpu.ragged — segment-table superbatching.
+
+Covers the three subsystem layers directly (page classes / segment
+table / pack, the segment kernel, unpack) and the assembled serve path:
+the flagship property is that `--batch-mode ragged` produces
+BYTE-IDENTICAL FASTA to the shape-keyed lanes path for randomized
+mixed-shape request streams — with decode workers, fat-dispatch
+coalescing, and injected faults on — while the jit-cache counter records
+at most one kernel compile per page geometry instead of one per shape.
+"""
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from kindel_tpu.batch import BatchOptions
+from kindel_tpu.obs import runtime as obs_runtime
+from kindel_tpu.obs.metrics import (
+    DEFAULT_LABEL_CAP,
+    LabelCapper,
+    default_registry,
+)
+from kindel_tpu.ragged import (
+    PageClass,
+    RaggedBatcher,
+    RaggedCapacityError,
+    RaggedFlush,
+    build_segment_table,
+    classify_units,
+    pack_superbatch,
+    parse_classes,
+)
+from kindel_tpu.ragged.kernel import launch_ragged, ragged_call_kernel
+from kindel_tpu.ragged.pack import GRANULE, stride_for
+from kindel_tpu.ragged.unpack import unpack_superbatch
+from kindel_tpu.serve import ConsensusClient, ConsensusService
+from kindel_tpu.serve.queue import ServeRequest
+from kindel_tpu.serve.worker import decode_request
+from kindel_tpu.tune import TuningConfig
+from kindel_tpu.workloads import bam_to_consensus
+
+from tests.test_serve import make_sam
+
+
+def _decode(payload, **opt_kwargs):
+    return decode_request(
+        ServeRequest(payload=payload, opts=BatchOptions(**opt_kwargs))
+    )
+
+
+def _units_for_sams(sams, **opt_kwargs):
+    units = []
+    for i, p in enumerate(sams):
+        for u in _decode(str(p), **opt_kwargs):
+            u.sample_idx = i
+            units.append(u)
+    return units
+
+
+def _mixed_sams(tmp_path, n, seed_base=0, l_lo=260, l_hi=5200):
+    rng = np.random.default_rng(seed_base)
+    return [
+        make_sam(
+            tmp_path / f"mix{i}.sam", ref=f"mref{i}",
+            L=int(rng.integers(l_lo, l_hi)),
+            n_reads=int(rng.integers(10, 45)), seed=seed_base * 100 + i,
+        )
+        for i in range(n)
+    ]
+
+
+CLASSES = parse_classes("small:32x2048,medium:16x8192")
+
+
+# ------------------------------------------------------- pack / page classes
+
+
+def test_parse_classes_validates_and_sorts():
+    classes = parse_classes("big:8x65536, tiny:64x1024")
+    assert [c.name for c in classes] == ["tiny", "big"]  # ascending length
+    with pytest.raises(ValueError):
+        parse_classes("bad:8x1000")  # not a 1024 multiple
+    with pytest.raises(ValueError):
+        parse_classes("")
+    with pytest.raises(ValueError):
+        parse_classes("a:2x2048,a:4x2048")  # duplicate name
+    with pytest.raises(ValueError):
+        parse_classes("nonsense")
+
+
+def test_segment_table_layout_invariants(tmp_path):
+    sams = _mixed_sams(tmp_path, 6, seed_base=3)
+    units = _units_for_sams(sams)
+    cls = CLASSES[classify_units(units, CLASSES)]
+    table = build_segment_table(units, cls)
+    starts, lens = table.seg_start, table.seg_len
+    # granule alignment + at least one gap slot between segments
+    assert (starts % GRANULE == 0).all()
+    ends = starts + lens
+    assert (starts[1:] > ends[:-1]).all(), "segments must not touch"
+    assert int(ends[-1]) < cls.n_slots
+    # back-pointers route every segment to its request
+    assert list(table.entry_idx) == [u.sample_idx for u in units]
+    # flat stream offsets partition exactly
+    assert (np.diff(table.ev_off) == table.ev_len[:-1]).all()
+    assert table.occupancy == pytest.approx(
+        lens.sum() / cls.n_slots
+    )
+
+
+def test_stride_always_leaves_a_gap_slot():
+    for L in (1, 7, 8, 9, 1023, 1024, 4096):
+        s = stride_for(L)
+        assert s % GRANULE == 0 and s > L, (L, s)
+
+
+def test_capacity_overflow_raises(tmp_path):
+    sam = make_sam(tmp_path / "big.sam", ref="big", L=3000, seed=1)
+    units = _units_for_sams([sam] * 40)
+    tiny = PageClass("tiny", 2, 4096)
+    with pytest.raises(RaggedCapacityError):
+        build_segment_table(units, tiny)
+
+
+def test_classify_routes_by_largest_unit_and_oversize(tmp_path):
+    small = _units_for_sams([make_sam(tmp_path / "s.sam", L=400, seed=2)])
+    big = _units_for_sams(
+        [make_sam(tmp_path / "b.sam", ref="b", L=5000, seed=3)]
+    )
+    assert classify_units(small, CLASSES) == 0
+    assert classify_units(big, CLASSES) == 1
+    assert classify_units(small + big, CLASSES) == 1  # request is atomic
+    huge = _units_for_sams(
+        [make_sam(tmp_path / "h.sam", ref="h", L=9000, seed=4)]
+    )
+    assert classify_units(huge, CLASSES) is None  # oversize → lanes path
+
+
+# ----------------------------------------------------------- kernel parity
+
+
+def test_kernel_parity_fast_and_masks_paths(tmp_path):
+    """Direct pack→kernel→unpack round trip vs the bam_to_consensus
+    oracle, both wire variants, on mixed shapes in one superbatch."""
+    sams = _mixed_sams(tmp_path, 5, seed_base=7)
+    pool = ThreadPoolExecutor(4)
+    for opts in (
+        BatchOptions(),
+        BatchOptions(build_changes=True, build_reports=True),
+    ):
+        units = _units_for_sams(sams)
+        cls = CLASSES[classify_units(units, CLASSES)]
+        table = build_segment_table(units, cls)
+        arrays = pack_superbatch(units, table)
+        wire = launch_ragged(arrays, cls, opts)
+        outs = unpack_superbatch(
+            wire, table, units, opts, pool, paths=[str(p) for p in sams]
+        )
+        for i, p in enumerate(sams):
+            want = bam_to_consensus(str(p))
+            seq, changes, report = outs[i]
+            assert seq.name == want.consensuses[0].name
+            assert seq.sequence == want.consensuses[0].sequence
+            if opts.build_changes:
+                ref = seq.name[: -len("_cns")]
+                assert changes == want.refs_changes[ref]
+                assert report == want.refs_reports[ref]
+
+
+def test_pallas_segment_reduction_matches_xla(tmp_path, monkeypatch):
+    """The gated Pallas fast path (interpret mode on CPU) must emit a
+    wire byte-identical to the XLA segment-reduction path."""
+    sams = _mixed_sams(tmp_path, 4, seed_base=11)
+    units = _units_for_sams(sams)
+    opts = BatchOptions()
+    cls = CLASSES[classify_units(units, CLASSES)]
+    arrays = pack_superbatch(units, build_segment_table(units, cls))
+    monkeypatch.setenv("KINDEL_TPU_RAGGED_PALLAS", "0")
+    w_xla = np.asarray(launch_ragged(arrays, cls, opts))
+    monkeypatch.setenv("KINDEL_TPU_RAGGED_PALLAS", "1")
+    w_pl = np.asarray(launch_ragged(arrays, cls, opts))
+    assert np.array_equal(w_xla, w_pl)
+
+
+# --------------------------------------------------------------- batcher
+
+
+def test_ragged_batcher_max_wait_flush(tmp_path):
+    sam = make_sam(tmp_path / "one.sam", seed=21)
+    mb = RaggedBatcher(CLASSES, max_wait_s=0.05)
+    req = ServeRequest(payload=str(sam), opts=BatchOptions())
+    mb.add(req, _decode(str(sam)))
+    flush = mb.poll(timeout=5.0)
+    assert isinstance(flush, RaggedFlush)
+    assert flush.page_class is CLASSES[0]
+    assert [r for r, _ in flush.entries] == [req]
+
+
+def test_ragged_batcher_seals_at_segment_cap(tmp_path):
+    sams = [
+        make_sam(tmp_path / f"c{i}.sam", ref=f"c{i}", L=300, seed=30 + i)
+        for i in range(3)
+    ]
+    mb = RaggedBatcher(CLASSES, max_batch_rows=2, max_wait_s=30.0)
+    for p in sams:
+        mb.add(ServeRequest(payload=str(p), opts=BatchOptions()),
+               _decode(str(p)))
+    flush = mb.poll(timeout=0.5)  # sealed by the segment cap, not age
+    assert isinstance(flush, RaggedFlush) and len(flush.entries) == 2
+    assert mb.pending_rows == 1  # the third stays in an open lane
+
+
+def test_ragged_batcher_joins_open_larger_lane(tmp_path):
+    """Occupancy-first placement: a small-class request arriving while a
+    larger lane is open (same opts) fills that lane instead of opening
+    its own grid."""
+    big = make_sam(tmp_path / "jb.sam", ref="jb", L=5000, seed=41)
+    small = make_sam(tmp_path / "js.sam", ref="js", L=300, seed=42)
+    mb = RaggedBatcher(CLASSES, max_wait_s=30.0)
+    mb.add(ServeRequest(payload=str(big), opts=BatchOptions()),
+           _decode(str(big)))
+    mb.add(ServeRequest(payload=str(small), opts=BatchOptions()),
+           _decode(str(small)))
+    flushes = mb.flush_all()
+    assert len(flushes) == 1 and flushes[0].page_class.name == "medium"
+    assert len(flushes[0].entries) == 2
+
+
+def test_realign_and_oversize_fall_back_to_shape_keyed_lanes(tmp_path):
+    reg = default_registry()
+    before = {
+        k: v for k, v in reg.snapshot().items()
+        if k.startswith("kindel_ragged_fallback_total")
+    }
+    sam = make_sam(tmp_path / "fb.sam", seed=51)
+    huge = make_sam(tmp_path / "fh.sam", ref="fh", L=9000, seed=52)
+    mb = RaggedBatcher(CLASSES, max_wait_s=30.0)
+    mb.add(ServeRequest(payload=str(sam), opts=BatchOptions(realign=True)),
+           _decode(str(sam), realign=True))
+    mb.add(ServeRequest(payload=str(huge), opts=BatchOptions()),
+           _decode(str(huge)))
+    flushes = mb.flush_all()
+    assert len(flushes) == 2
+    assert not any(isinstance(f, RaggedFlush) for f in flushes)
+    snap = reg.snapshot()
+    delta = {
+        reason: snap.get(
+            'kindel_ragged_fallback_total{reason="%s"}' % reason, 0
+        ) - before.get(
+            'kindel_ragged_fallback_total{reason="%s"}' % reason, 0
+        )
+        for reason in ("realign", "oversize")
+    }
+    assert delta == {"realign": 1, "oversize": 1}
+
+
+def test_take_ready_degrades_to_one_batch_for_superbatches(tmp_path):
+    """Fat-dispatch coalescing must not merge sealed superbatches — a
+    superbatch is already the fattest launch its geometry allows."""
+    sams = [
+        make_sam(tmp_path / f"t{i}.sam", ref=f"t{i}", L=300, seed=60 + i)
+        for i in range(4)
+    ]
+    mb = RaggedBatcher(CLASSES, max_batch_rows=1, max_wait_s=30.0)
+    for p in sams:
+        mb.add(ServeRequest(payload=str(p), opts=BatchOptions()),
+               _decode(str(p)))
+    first = mb.poll(timeout=1.0)
+    assert isinstance(first, RaggedFlush)
+    assert mb.take_ready(first, limit=8) == []
+    # the remaining sealed flushes still drain one at a time
+    rest = [mb.poll(timeout=1.0) for _ in range(3)]
+    assert all(isinstance(f, RaggedFlush) for f in rest)
+
+
+# ------------------------------------------------- serve path, end to end
+
+
+def _serve_all(sams, mode, *, lane_coalesce=2, faults=None, **svc_kwargs):
+    """Serve every sam concurrently under `mode`; returns (fasta list in
+    input order, service metrics snapshot, healthz doc)."""
+    results = [None] * len(sams)
+    errors: list = []
+    with ConsensusService(
+        tuning=TuningConfig(batch_mode=mode, lane_coalesce=lane_coalesce),
+        max_wait_s=0.15, decode_workers=4, **svc_kwargs,
+    ) as svc:
+        client = ConsensusClient(svc)
+
+        def one(i):
+            try:
+                results[i] = client.fasta(str(sams[i]), timeout=300)
+            except Exception as e:  # noqa: BLE001
+                errors.append((i, repr(e)))
+
+        threads = [
+            threading.Thread(target=one, args=(i,))
+            for i in range(len(sams))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        snap = svc.metrics.snapshot()
+        health = svc.healthz()
+    assert not errors, errors
+    return results, snap, health
+
+
+def test_mixed_shape_stream_ragged_equals_lanes_byte_identical(tmp_path):
+    """The flagship parity property: randomized mixed-shape request
+    streams produce byte-identical FASTA under ragged and lanes modes
+    (workers + fat-dispatch coalescing on), and the ragged run compiles
+    at most one kernel per page geometry."""
+    sams = _mixed_sams(tmp_path, 10, seed_base=5)
+    lanes, _snap_l, _h = _serve_all(sams, "lanes")
+    cache_before = obs_runtime.jit_cache_sizes().get("ragged_call_kernel", 0)
+    ragged, snap_r, health = _serve_all(sams, "ragged")
+    cache_after = obs_runtime.jit_cache_sizes().get("ragged_call_kernel", 0)
+    assert ragged == lanes, "ragged FASTA diverged from the lanes path"
+    geometries = len({
+        classify_units(_decode(str(p)), CLASSES) for p in sams
+    })
+    assert cache_after - cache_before <= len(CLASSES), (
+        "more ragged kernel compiles than page classes", cache_after,
+    )
+    assert health["batch_mode"] == "ragged"
+    assert geometries >= 2, "stream was not shape-diverse enough"
+
+
+def test_mixed_stream_with_faults_still_byte_identical(tmp_path):
+    """Chaos on: transient flush faults retry/degrade through the
+    resilience ladder and the served bytes still match the lanes path."""
+    from kindel_tpu.resilience import FaultPlan
+    from kindel_tpu.resilience import faults as rfaults
+
+    sams = _mixed_sams(tmp_path, 6, seed_base=9)
+    lanes, _s, _h = _serve_all(sams, "lanes")
+    rfaults.activate(FaultPlan.parse("serve.flush:error:times=2"))
+    try:
+        ragged, _snap, _health = _serve_all(sams, "ragged")
+    finally:
+        rfaults.deactivate()
+    assert ragged == lanes
+
+
+def test_ragged_occupancy_metrics_recorded(tmp_path):
+    reg = default_registry()
+
+    def totals():
+        snap = reg.snapshot()
+        return (
+            sum(
+                int(v) for k, v in snap.items()
+                if k.startswith("kindel_ragged_superbatches_total")
+                and not isinstance(v, dict)
+            ),
+            snap.get("kindel_dispatch_payload_bases_total", 0),
+            snap.get("kindel_dispatch_padded_bases_total", 0),
+        )
+
+    sams = _mixed_sams(tmp_path, 4, seed_base=13)
+    s0, payload0, padded0 = totals()
+    _r, _s, _h = _serve_all(sams, "ragged")
+    s1, payload1, padded1 = totals()
+    assert s1 > s0, "no superbatch counted"
+    payload, padded = payload1 - payload0, padded1 - padded0
+    want_payload = sum(u.L for p in sams for u in _decode(str(p)))
+    assert payload == want_payload
+    assert padded > payload  # occupancy < 1 by construction
+    occ = reg.snapshot().get("kindel_ragged_occupancy", {})
+    assert occ.get("count", 0) > 0 and 0 < occ["mean"] <= 1
+
+
+def test_healthz_reports_batch_mode_and_classes(tmp_path):
+    with ConsensusService(
+        tuning=TuningConfig(batch_mode="ragged"), max_wait_s=0.01
+    ) as svc:
+        health = svc.healthz()
+    assert health["batch_mode"] == "ragged"
+    labels = health["ragged"]["classes"]
+    assert labels and all(":r" in lab for lab in labels)
+    with ConsensusService(max_wait_s=0.01) as svc:
+        health = svc.healthz()
+    assert health["batch_mode"] == "lanes"
+    assert "ragged" not in health
+
+
+def test_ragged_warmup_zero_compile_covers_arbitrary_traffic(
+    tmp_path, monkeypatch
+):
+    """After a ragged warmup, a request of a NEVER-SEEN shape (the
+    zero-compile claim's whole point: arbitrary traffic, not
+    startup-derivable shapes) triggers no new kernel compile."""
+    monkeypatch.setenv(
+        "KINDEL_TPU_TUNE_CACHE", str(tmp_path / "tune.json")
+    )
+    cache_size = getattr(ragged_call_kernel, "_cache_size", None)
+    if cache_size is None:
+        pytest.skip("jit cache counter unavailable on this jax")
+    sam = make_sam(tmp_path / "novel.sam", ref="novel", L=777, seed=99)
+    want = bam_to_consensus(str(sam)).consensuses
+    with ConsensusService(
+        tuning=TuningConfig(
+            batch_mode="ragged", ragged_classes="only:16x2048"
+        ),
+        max_wait_s=0.01, warmup=True,
+    ) as svc:
+        assert svc.wait_warm(timeout=300)
+        before = cache_size()
+        got = ConsensusClient(svc).consensus(str(sam), timeout=120)
+        assert cache_size() == before, (
+            "post-warmup request of an unseen shape compiled a kernel"
+        )
+        snap = svc.metrics.snapshot()
+    assert [(r.name, r.sequence) for r in got] == [
+        (r.name, r.sequence) for r in want
+    ]
+    shapes = snap.get("kindel_serve_warmup_shape", [])
+    ragged_marks = [
+        s for s in shapes if s.get("shape", "").startswith("ragged:")
+    ]
+    assert ragged_marks, "warmup Info carries no ragged geometries"
+    assert all(s.get("batch_mode") == "ragged" for s in ragged_marks)
+
+
+# ------------------------------------------------ label-cardinality guard
+
+
+def test_label_capper_pins_the_cap():
+    capper = LabelCapper(cap=4)
+    seen = {capper.see(f"shape{i}") for i in range(50)}
+    assert len(seen) == 5  # 4 admitted + "other"
+    assert "other" in seen
+    # admitted values keep reporting under their own name
+    assert capper.see("shape0") == "shape0"
+    assert capper.see("shape49") == "other"
+    assert DEFAULT_LABEL_CAP == 24  # the documented serve-tier bound
+
+
+def test_dispatch_histogram_shape_labels_are_bounded(tmp_path):
+    """Under shape-diverse lanes traffic the per-shape dispatch
+    histogram must stay within the label cap (+1 for `other`)."""
+    sams = _mixed_sams(tmp_path, 8, seed_base=17)
+    _r, snap, _h = _serve_all(sams, "lanes")
+    labels = {
+        k for k in snap
+        if k.startswith("kindel_serve_dispatch_seconds{")
+    }
+    assert labels, "dispatch histogram recorded nothing"
+    assert len(labels) <= DEFAULT_LABEL_CAP + 1
+
+
+# ----------------------------------------------------------- tune knobs
+
+
+def test_batch_mode_resolution_precedence(monkeypatch):
+    from kindel_tpu import tune
+
+    monkeypatch.delenv("KINDEL_TPU_BATCH_MODE", raising=False)
+    assert tune.resolve_batch_mode() == ("lanes", "default")
+    monkeypatch.setenv("KINDEL_TPU_BATCH_MODE", "ragged")
+    assert tune.resolve_batch_mode() == ("ragged", "env")
+    assert tune.resolve_batch_mode("lanes") == ("lanes", "explicit")
+    monkeypatch.setenv("KINDEL_TPU_BATCH_MODE", "garbage")
+    assert tune.resolve_batch_mode() == ("lanes", "default")
+    with pytest.raises(ValueError):
+        tune.resolve_batch_mode("garbage")
+
+
+def test_ragged_classes_resolution_precedence(tmp_path, monkeypatch):
+    from kindel_tpu import tune
+
+    monkeypatch.setenv(
+        "KINDEL_TPU_TUNE_CACHE", str(tmp_path / "tune.json")
+    )
+    monkeypatch.delenv("KINDEL_TPU_RAGGED_CLASSES", raising=False)
+    spec, src = tune.resolve_ragged_classes()
+    assert src == "default" and parse_classes(spec)
+    tune.record(tune.ragged_store_key(), {"classes": "a:8x2048"})
+    assert tune.resolve_ragged_classes() == ("a:8x2048", "cache")
+    monkeypatch.setenv("KINDEL_TPU_RAGGED_CLASSES", "b:4x2048")
+    assert tune.resolve_ragged_classes() == ("b:4x2048", "env")
+    assert tune.resolve_ragged_classes("c:2x2048") == (
+        "c:2x2048", "explicit",
+    )
+
+
+def test_search_ragged_classes_picks_the_fastest():
+    from kindel_tpu import tune
+
+    walls = {"a": 0.3, "b": 0.1, "c": 0.2}
+    chosen, timings = tune.search_ragged_classes(
+        lambda spec: walls[spec], candidates=("a", "b", "c"),
+        budget_s=10.0,
+    )
+    assert chosen == "b" and len(timings) == 3
