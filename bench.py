@@ -143,6 +143,25 @@ def _resilience_counts(snapshot: dict) -> dict:
     }
 
 
+def _fleet_counts(snapshot: dict) -> dict:
+    """Fleet posture (kindel_tpu.fleet): evictions / failovers / hedges
+    / drained / replayed / restarts — all 0 outside fleet serving, and
+    a round that hit its number while evicting replicas must say so,
+    same rationale as the resilience object."""
+    return {
+        "evictions": int(snapshot.get("kindel_fleet_evictions_total", 0)),
+        "failovers": int(snapshot.get("kindel_fleet_failovers_total", 0)),
+        "hedges": int(snapshot.get("kindel_fleet_hedges_total", 0)),
+        "drained": int(snapshot.get(
+            "kindel_fleet_drained_requests_total", 0
+        )),
+        "replays": int(snapshot.get(
+            "kindel_fleet_replayed_requests_total", 0
+        )),
+        "restarts": int(snapshot.get("kindel_fleet_restarts_total", 0)),
+    }
+
+
 def _run_benchmark() -> dict:
     """The measured pipeline. Runs only in a child process (jax imported
     here, never in the parent)."""
@@ -378,6 +397,9 @@ def _run_benchmark() -> dict:
         # hit its number by retrying/degrading is not comparable to a
         # clean one — the trajectory must be able to tell them apart
         "resilience": _resilience_counts(default_registry().snapshot()),
+        # fleet posture (kindel_tpu.fleet): replica evictions/failovers/
+        # drains during the round (nonzero only under fleet serve load)
+        "fleet": _fleet_counts(default_registry().snapshot()),
     }
     if tune:
         result["tune_s"] = {str(k): round(v, 3) for k, v in tune.items()}
@@ -411,11 +433,23 @@ def _run_benchmark() -> dict:
     # the offline headline number. Opt-in because it adds ~seconds of
     # wall and its own kernel-shape compiles; failure never voids the
     # headline metric.
-    if os.environ.get("KINDEL_TPU_BENCH_SERVE"):
+    bench_serve = os.environ.get("KINDEL_TPU_BENCH_SERVE")
+    if bench_serve:
         try:
             from benchmarks.serve_load import run_load
 
-            result["serve"] = run_load(clients=4, requests_per_client=8)
+            # KINDEL_TPU_BENCH_SERVE=N with N>1 runs the loop against a
+            # supervised N-replica fleet (kindel_tpu.fleet) instead of a
+            # single service; any other truthy value keeps the original
+            # single-service loop
+            try:
+                serve_replicas = int(bench_serve)
+            except ValueError:
+                serve_replicas = 1
+            result["serve"] = run_load(
+                clients=4, requests_per_client=8,
+                replicas=serve_replicas if serve_replicas > 1 else 0,
+            )
         except Exception as e:  # noqa: BLE001
             result["serve"] = {"error": repr(e)}
     return result

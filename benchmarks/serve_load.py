@@ -48,8 +48,21 @@ def _synth_sam(dest: Path, ref_len: int = 2048, n_reads: int = 200,
 
 def run_load(bam_path=None, clients: int = 4, requests_per_client: int = 8,
              max_wait_s: float = 0.01, max_batch_rows: int = 64,
-             **service_kwargs) -> dict:
-    """Run the closed loop; returns a JSON-able report dict."""
+             replicas: int = 0, chaos=None, **service_kwargs) -> dict:
+    """Run the closed loop; returns a JSON-able report dict.
+
+    `replicas` > 0 runs the loop against a FleetService of that many
+    supervised replicas (kindel_tpu.fleet) instead of a single
+    ConsensusService, and the report gains a `fleet` object (replica
+    states + the kindel_fleet_* counters). `chaos` is an optional
+    callable invoked on its own thread once the clients start —
+    `chaos(service)` — the fleet chaos suite's hook for killing and
+    draining replicas mid-run. Every completed request's FASTA feeds
+    `fasta_sha256` (digest over the sorted set of distinct outputs), so
+    two runs are byte-comparable without shipping sequences around.
+    """
+    import hashlib
+
     from kindel_tpu.serve import ConsensusClient, ConsensusService
 
     tmp = None
@@ -61,33 +74,64 @@ def run_load(bam_path=None, clients: int = 4, requests_per_client: int = 8,
     latencies: list[float] = []
     lat_lock = threading.Lock()
     errors: list[str] = []
-    start_barrier = threading.Barrier(clients + 1)
+    fastas: set[str] = set()
+    chaos_errors: list[str] = []
+    # the chaos hook (when given) joins the same start barrier as the
+    # clients, so the kill/drain sequence begins exactly at load start
+    start_barrier = threading.Barrier(clients + 1 + (1 if chaos else 0))
 
-    try:
-        with ConsensusService(
+    if replicas:
+        from kindel_tpu.fleet import FleetService
+
+        service = FleetService(
+            replicas=replicas, max_wait_s=max_wait_s,
+            max_batch_rows=max_batch_rows, **service_kwargs,
+        )
+    else:
+        service = ConsensusService(
             max_wait_s=max_wait_s, max_batch_rows=max_batch_rows,
             **service_kwargs,
-        ) as svc:
+        )
+
+    try:
+        with service as svc:
             client = ConsensusClient(svc)
             client.consensus(payload, timeout=300)  # compile warmup
 
             def one_client():
+                from kindel_tpu.io.fasta import format_fasta
+
                 start_barrier.wait()
                 for _ in range(requests_per_client):
                     t0 = time.perf_counter()
                     try:
-                        client.consensus(payload, timeout=300)
+                        records = client.consensus(payload, timeout=300)
                     except Exception as e:  # noqa: BLE001
                         with lat_lock:
                             errors.append(repr(e))
                         continue
                     with lat_lock:
                         latencies.append(time.perf_counter() - t0)
+                        fastas.add(format_fasta(records))
 
             threads = [
                 threading.Thread(target=one_client, name=f"load-client-{i}")
                 for i in range(clients)
             ]
+            chaos_thread = None
+            if chaos is not None:
+                def run_chaos():
+                    start_barrier.wait()
+                    try:
+                        chaos(svc)
+                    except Exception as e:  # noqa: BLE001
+                        chaos_errors.append(repr(e))
+
+                chaos_thread = threading.Thread(
+                    target=run_chaos, name="load-chaos"
+                )
+                threads = threads + [chaos_thread]
+
             for t in threads:
                 t.start()
             start_barrier.wait()
@@ -95,7 +139,12 @@ def run_load(bam_path=None, clients: int = 4, requests_per_client: int = 8,
             for t in threads:
                 t.join()
             wall = time.perf_counter() - t_start
-            snap = svc.metrics.snapshot()
+            if replicas:
+                fleet_snap = svc.fleet_snapshot()
+                snap = fleet_snap["totals"]
+            else:
+                fleet_snap = None
+                snap = svc.metrics.snapshot()
     finally:
         if tmp is not None:
             tmp.cleanup()
@@ -109,12 +158,17 @@ def run_load(bam_path=None, clients: int = 4, requests_per_client: int = 8,
         return latencies[min(done - 1, int(q * done))]
 
     occupancy = snap.get("kindel_serve_batch_occupancy", {})
+    if not isinstance(occupancy, dict):
+        occupancy = {}
     # warmup ran alone before the barrier: exclude it from the coalesce
     # ratio so the ratio reflects the loaded regime only
     dispatches = max(int(snap.get(
         "kindel_serve_device_dispatches_total", 0
     )) - 1, 1)
-    return {
+    digest = hashlib.sha256(
+        "\n".join(sorted(fastas)).encode()
+    ).hexdigest()
+    report = {
         "clients": clients,
         "requests": clients * requests_per_client,
         "completed": done,
@@ -128,7 +182,26 @@ def run_load(bam_path=None, clients: int = 4, requests_per_client: int = 8,
         "device_dispatches": dispatches,
         "coalesce_ratio": round(done / dispatches, 2),
         "max_wait_ms": max_wait_s * 1e3,
+        # byte-identity handle: distinct FASTA outputs (should be 1 for
+        # a single-payload loop) + digest over the sorted set
+        "fasta_distinct": len(fastas),
+        "fasta_sha256": digest,
     }
+    if chaos_errors:
+        report["chaos_errors"] = chaos_errors
+    if fleet_snap is not None:
+        report["fleet"] = {
+            "replicas": {
+                rid: doc["state"]
+                for rid, doc in fleet_snap["replicas"].items()
+            },
+            **{
+                k.replace("kindel_fleet_", "").replace("_total", ""): int(v)
+                for k, v in fleet_snap["fleet"].items()
+                if k.endswith("_total") and isinstance(v, (int, float))
+            },
+        }
+    return report
 
 
 def main(argv=None) -> int:
@@ -141,11 +214,15 @@ def main(argv=None) -> int:
     ap.add_argument("--requests", type=int, default=8,
                     help="requests per client")
     ap.add_argument("--max-wait-ms", type=float, default=10.0)
+    ap.add_argument("--replicas", type=int, default=0,
+                    help="run against a FleetService of N supervised "
+                         "replicas (kindel_tpu.fleet); 0 = single service")
     args = ap.parse_args(argv)
     report = run_load(
         bam_path=args.bam, clients=args.clients,
         requests_per_client=args.requests,
         max_wait_s=args.max_wait_ms / 1e3,
+        replicas=args.replicas,
     )
     print(json.dumps(report))
     return 0 if report["errors"] == 0 else 1

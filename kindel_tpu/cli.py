@@ -463,13 +463,58 @@ def _serve_parser(sub):
              "(name:ROWSxLENGTH; explicit > $KINDEL_TPU_RAGGED_CLASSES "
              "> tune store > default)",
     )
+    p.add_argument(
+        "--replicas", type=int, default=1, metavar="N",
+        help="run N supervised in-process replicas behind a failover "
+             "router (kindel_tpu.fleet): rendezvous-hash placement, "
+             "health-scored eviction with replay onto survivors, "
+             "zero-downtime drain + warm restart. 1 (default) = the "
+             "single-service path",
+    )
+    p.add_argument(
+        "--probe-interval-ms", type=float, default=100.0,
+        help="fleet supervisor health-probe cadence (only with "
+             "--replicas > 1)",
+    )
+    p.add_argument(
+        "--hedge-ms", type=float, default=None, metavar="MS",
+        help="deadline-aware hedging: a request not completed after "
+             "this long gets one speculative duplicate on the next "
+             "healthy replica; first result wins (consensus is pure, "
+             "so duplicates are byte-identical). Off by default; only "
+             "with --replicas > 1",
+    )
+    p.add_argument(
+        "--fleet-watermark", type=int, default=None,
+        help="fleet-wide admission bound: reject with Retry-After once "
+             "total queued depth across replicas reaches this (default: "
+             "sum of per-replica watermarks; only with --replicas > 1)",
+    )
+
+
+def install_drain_handlers(stop_event) -> None:
+    """SIGTERM/SIGINT → graceful drain (satellite of the fleet PR):
+    the first signal only SETS `stop_event`, letting the serve loop
+    drain — stop admitting, finish every in-flight request, flush the
+    final metric state — instead of the old abrupt exit that lost
+    whatever was queued. A second signal raises KeyboardInterrupt so an
+    operator can still force a fast (drain=False-shaped) exit when the
+    drain itself is wedged. Must run on the main thread (signal.signal
+    constraint)."""
+    import signal
+
+    def _on_signal(signum, frame):
+        if stop_event.is_set():
+            raise KeyboardInterrupt  # second signal: stop waiting
+        stop_event.set()
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, _on_signal)
 
 
 def cmd_serve(args) -> int:
-    """Run the online consensus service until interrupted."""
-    import time
-
-    from kindel_tpu.serve import ConsensusService
+    """Run the online consensus service until signaled, then drain."""
+    import threading
 
     tuning = None
     if (
@@ -484,15 +529,13 @@ def cmd_serve(args) -> int:
             batch_mode=args.batch_mode,
             ragged_classes=args.ragged_classes,
         )
-    service = ConsensusService(
+    service_kwargs = dict(
         tuning=tuning,
         max_batch_rows=args.max_batch_rows,
         max_wait_s=args.max_wait_ms / 1e3,
         max_depth=args.max_depth,
         high_watermark=args.watermark,
         decode_workers=args.workers,
-        http_host=args.host,
-        http_port=args.port,
         realign=args.realign,
         min_depth=args.min_depth,
         min_overlap=args.min_overlap,
@@ -505,23 +548,58 @@ def cmd_serve(args) -> int:
         warmup=not args.no_warmup,
         warm_payloads=args.warm,
     )
+    if args.replicas > 1:
+        from kindel_tpu.fleet import FleetService
+
+        service = FleetService(
+            replicas=args.replicas,
+            http_host=args.host,
+            http_port=args.port,
+            probe_interval_s=args.probe_interval_ms / 1e3,
+            hedge_s=(
+                args.hedge_ms / 1e3 if args.hedge_ms is not None else None
+            ),
+            fleet_watermark=args.fleet_watermark,
+            **service_kwargs,
+        )
+        posture = f"{args.replicas} supervised replicas (kindel_tpu.fleet)"
+    else:
+        from kindel_tpu.serve import ConsensusService
+
+        service = ConsensusService(
+            http_host=args.host, http_port=args.port, **service_kwargs
+        )
+        posture = "single replica"
     service.start()
     host, port = service.http_address
     print(
-        f"kindel-tpu serving on http://{host}:{port} — "
+        f"kindel-tpu serving on http://{host}:{port} [{posture}] — "
         "POST /v1/consensus (SAM/BAM body -> FASTA), GET /metrics, "
-        "GET /healthz; Ctrl-C to drain and stop"
+        "GET /healthz, GET /readyz; SIGTERM/Ctrl-C to drain and stop"
         + ("" if args.no_warmup
-           else " (AOT warmup running; /healthz flips warming -> ok)"),
+           else " (AOT warmup running; /readyz flips 503 -> 200)"),
         file=sys.stderr,
     )
+    stop_event = threading.Event()
+    install_drain_handlers(stop_event)
+    forced = False
     try:
-        while True:
-            time.sleep(3600)
+        stop_event.wait()
+        print(
+            "draining: admission closed, finishing in-flight requests…",
+            file=sys.stderr,
+        )
     except KeyboardInterrupt:
-        print("draining…", file=sys.stderr)
+        forced = True
+        print("forced stop: failing pending requests…", file=sys.stderr)
     finally:
-        service.stop(drain=True)
+        if forced:
+            service.stop(drain=False)
+        else:
+            # both shapes drain the same way: admission closed first,
+            # everything already admitted served, then threads join
+            service.drain()
+        print("drained; bye", file=sys.stderr)
     return 0
 
 

@@ -1,0 +1,40 @@
+"""L8 — fleet: replica supervision, failover routing, zero-downtime
+drain over the serve tier.
+
+PR 4's resilience machinery guarantees "no admitted request lost"
+*inside* one ConsensusService; this package promotes the guarantee to
+the replica level — the failure unit production TPU serving stacks
+actually operate on (PAPERS.md: Gemma-on-Cloud-TPU serving). Four
+modules, all jax-free by construction (tier-1 AST guard — the fleet
+tier routes and supervises, only the services it assembles touch the
+device):
+
+  replica.py     Replica handle: state machine (starting/ok/degraded/
+                 draining/dead/restarting), in-flight ticket ledger,
+                 probe → ProbePolicy outcome, warm restart (zero
+                 compiles with a warm AOT store — PR 6), kill() chaos
+                 surface
+  router.py      FleetRouter: rendezvous-hash placement keyed for lane
+                 locality, fleet-watermark + per-replica two-level
+                 admission, failover on FlushTimeout/ServiceDegraded,
+                 deadline-aware hedging, replay — the outer future is
+                 the exactly-once settle point
+  supervisor.py  FleetSupervisor: interval probing, consecutive-probe
+                 scoring (resilience.policy.ProbePolicy), eviction with
+                 replay-first ordering, auto warm-restart
+  service.py     FleetService facade: N replicas + router + supervisor
+                 + one HTTP front (/v1/consensus, /metrics, /healthz,
+                 /readyz), drain(replica) zero-downtime restart
+
+CLI: `kindel serve --replicas N` (kindel_tpu.cli), SIGTERM/SIGINT
+drain. See docs/DESIGN.md §17 (fleet failure model).
+"""
+
+from kindel_tpu.fleet.replica import Replica  # noqa: F401
+from kindel_tpu.fleet.router import (  # noqa: F401
+    FleetRouter,
+    rendezvous_score,
+    routing_key,
+)
+from kindel_tpu.fleet.service import FleetService  # noqa: F401
+from kindel_tpu.fleet.supervisor import FleetSupervisor  # noqa: F401

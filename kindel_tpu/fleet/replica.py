@@ -1,0 +1,213 @@
+"""Replica — one supervised ConsensusService plus its fleet-side state.
+
+The fleet's unit of failure is the replica, not the flush: PR 4's
+breaker/watchdog/restart machinery heals *inside* one service, and this
+handle is what lets the tier above treat the whole service as evictable.
+It owns three things:
+
+  * the **state machine** the supervisor and router coordinate through:
+
+        starting ──► ok ◄──► degraded
+                     │  ▲        │
+                     ▼  │        ▼
+                 draining│      dead ──► restarting ──► ok
+                     │   └──────────────────┘
+                     └► restarting
+
+    `ok`/`degraded` admit traffic (degraded only as a last resort);
+    `draining`/`dead`/`restarting` never do. Transitions are exported on
+    the `kindel_fleet_replica_state` gauge.
+
+  * the **in-flight ledger**: every router ticket currently placed on
+    this replica, keyed by the inner future the replica's service
+    returned. This is what makes "no admitted request lost" survive
+    replica death — when the service dies with futures pending, the
+    ledger is exactly the set the router must replay onto survivors.
+
+  * the **lifecycle verbs**: `probe()` (liveness + /healthz → a
+    ProbePolicy outcome), `drain()` (stop admission, finish in-flight,
+    hand unstarted work back), `restart()` (a fresh service from the
+    factory — with a warm AOT store this is the PR 6 zero-compile path:
+    the new service loads executables instead of compiling), and
+    `kill()` (the chaos surface: abrupt death, futures abandoned).
+
+The module is jax-free by construction (tier-1 AST guard): a replica
+handle routes and supervises; only the service it wraps ever touches
+the device.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from kindel_tpu.obs.metrics import FLEET_STATE_CODES, fleet_metrics
+from kindel_tpu.resilience.policy import (
+    PROBE_DEGRADED,
+    PROBE_FAILED,
+    PROBE_OK,
+    ProbePolicy,
+)
+
+#: states that may receive NEW work from the router
+ADMITTING_STATES = ("ok", "degraded")
+
+
+class Replica:
+    """One supervised service instance inside a FleetService."""
+
+    def __init__(self, replica_id: str, factory,
+                 probe_policy_factory=ProbePolicy):
+        self.replica_id = replica_id
+        self._factory = factory
+        self._probe_policy_factory = probe_policy_factory
+        self._probe_policy = probe_policy_factory()
+        self.service = None
+        self.generation = 0
+        self._state = "starting"
+        self._lock = threading.Lock()
+        #: in-flight ledger: inner future -> router ticket
+        self._inflight: dict = {}
+        self._last_probe_error: str | None = None
+        fleet_metrics().replica_state.labels(
+            replica=replica_id
+        ).set(FLEET_STATE_CODES["starting"])
+
+    # -------------------------------------------------------------- state
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    def set_state(self, state: str) -> None:
+        if state not in FLEET_STATE_CODES:
+            raise ValueError(f"unknown replica state {state!r}")
+        with self._lock:
+            if state == self._state:
+                return
+            self._state = state
+        fleet_metrics().replica_state.labels(
+            replica=self.replica_id
+        ).set(FLEET_STATE_CODES[state])
+
+    @property
+    def admitting(self) -> bool:
+        return self._state in ADMITTING_STATES and self.service is not None
+
+    @property
+    def queue_depth(self) -> int:
+        svc = self.service
+        return svc.queue.depth if svc is not None else 0
+
+    # ---------------------------------------------------------- lifecycle
+
+    def start(self) -> "Replica":
+        self.service = self._factory()
+        self.service.start()
+        self.set_state("ok")
+        return self
+
+    def stop(self, drain: bool = True) -> None:
+        svc = self.service
+        if svc is not None:
+            svc.stop(drain=drain)
+        self.set_state("dead")
+
+    def kill(self) -> None:
+        """Chaos surface: abrupt replica death (ConsensusService.kill)
+        — admitted futures abandoned, threads stopped, nothing settled.
+        The supervisor's next probes see `live` False and evict."""
+        svc = self.service
+        if svc is not None:
+            svc.kill()
+
+    def restart(self) -> "Replica":
+        """Warm restart: a fresh service from the factory (zero-compile
+        when the AOT store is warm — kindel_tpu.aot), a fresh probe
+        ladder, a bumped generation. The old service object is simply
+        dropped: a killed one is already stopped, a drained one already
+        settled everything."""
+        self.set_state("restarting")
+        self.generation += 1
+        fleet_metrics().restarts.inc()
+        self._probe_policy = self._probe_policy_factory()
+        self._last_probe_error = None
+        self.service = None
+        svc = self._factory()
+        svc.start()
+        self.service = svc
+        self.set_state("ok")
+        return self
+
+    # ------------------------------------------------------------ probing
+
+    def probe(self) -> str:
+        """One health probe → a ProbePolicy outcome: failed when the
+        service is gone or not live (worker machinery dead), degraded
+        when /healthz says so (breaker open), ok otherwise (warming
+        counts as alive — a restarting replica must not be re-evicted
+        for paying its warmup)."""
+        svc = self.service
+        if svc is None or not svc.live:
+            return PROBE_FAILED
+        status = svc.healthz().get("status")
+        if status in ("ok", "warming"):
+            return PROBE_OK
+        return PROBE_DEGRADED
+
+    def score(self, outcome: str) -> str:
+        """Fold one probe outcome into the ladder and mirror the verdict
+        onto the replica state (lifecycle states — draining/restarting —
+        are never overridden by probes; their owner transitions them)."""
+        verdict = self._probe_policy.observe(outcome)
+        if self._state in ("draining", "restarting"):
+            return verdict
+        if verdict == "dead":
+            self.set_state("dead")
+        elif verdict == "degraded":
+            self.set_state("degraded")
+        elif self._state in ("starting", "ok", "degraded"):
+            self.set_state("ok")
+        return verdict
+
+    def record_probe_failure(self, error: str) -> str:
+        """A probe that raised: record it (surfaced on the fleet
+        /healthz document) and fold a failed outcome into the ladder."""
+        self._last_probe_error = error
+        return self.score(PROBE_FAILED)
+
+    @property
+    def last_probe_error(self) -> str | None:
+        return self._last_probe_error
+
+    # --------------------------------------------------- in-flight ledger
+
+    def remember(self, inner_future, ticket) -> None:
+        with self._lock:
+            self._inflight[inner_future] = ticket
+
+    def forget(self, inner_future) -> None:
+        with self._lock:
+            self._inflight.pop(inner_future, None)
+
+    def take_inflight(self) -> list:
+        """Drain the ledger: every (inner future, ticket) still placed
+        here — the replay set after death or drain."""
+        with self._lock:
+            items = list(self._inflight.items())
+            self._inflight.clear()
+        return items
+
+    @property
+    def inflight_count(self) -> int:
+        return len(self._inflight)
+
+    def snapshot(self) -> dict:
+        doc = {
+            "state": self._state,
+            "generation": self.generation,
+            "inflight": self.inflight_count,
+            "queue_depth": self.queue_depth,
+        }
+        if self._last_probe_error is not None:
+            doc["last_probe_error"] = self._last_probe_error
+        return doc

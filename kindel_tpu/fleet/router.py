@@ -1,0 +1,342 @@
+"""FleetRouter — rendezvous-hashed placement, two-level admission,
+failover, and hedging over a set of Replicas.
+
+Placement is **rendezvous (highest-random-weight) hashing** on a
+locality key derived from (call options, payload size bucket): requests
+that would coalesce into the same micro-batcher lane — same options,
+similar decoded geometry — hash to the same replica, so PR 7's
+batching locality (page-class superbatches, shape-keyed lanes) keeps
+materializing per replica instead of being sprayed across the fleet.
+Rendezvous rather than a ring: removing a replica only re-homes ITS
+keys, every other key stays put — exactly the property eviction and
+drain need.
+
+Admission is two-level:
+
+  fleet     total queued depth across admitting replicas past the fleet
+            watermark rejects with `AdmissionError` + a JITTERED
+            retry-after (nothing was placed; the whole fleet is loaded)
+  replica   the chosen replica's own admission — watermark 429s and
+            breaker `ServiceDegraded` 503s — is caught per attempt and
+            the router fails over to the next replica in rendezvous
+            order instead of surfacing it
+
+After placement, the router owns the request as a **ticket**: an outer
+future the caller holds, settled exactly once, fed by one or more inner
+submissions. A typed replica-level failure on the current inner —
+`FlushTimeout` (hung flush), `ServiceDegraded` (breaker tripped
+mid-queue) — triggers failover to the next healthy replica, bounded by
+`max_failover`; a request-level failure (undecodable payload,
+`DeadlineExceeded`) surfaces immediately, because it would fail
+identically everywhere. Consensus is pure, so a replayed or hedged
+request is byte-identical wherever it lands and the outer future is
+the exactly-once dedup point: late results from an abandoned inner
+settle first-wins and the loser is dropped silently.
+
+`hedge_s` arms deadline-aware hedging: a primary that has not settled
+within the window gets one speculative duplicate on the next healthy
+replica (bounded to half the request's own deadline budget when it has
+one); first settle wins. Everything is counted on the process-global
+`kindel_fleet_*` family (obs/metrics.py).
+
+jax-free by construction (tier-1 AST guard): the router moves tickets,
+never arrays.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from concurrent.futures import Future, InvalidStateError
+
+from kindel_tpu.obs.metrics import fleet_metrics
+from kindel_tpu.resilience.breaker import FlushTimeout
+from kindel_tpu.serve.queue import (
+    AdmissionError,
+    ServiceDegraded,
+    jittered_retry_after,
+)
+
+#: inner-failure types that indict the REPLICA, not the request —
+#: the router fails these over instead of surfacing them
+REPLICA_FAILURES = (FlushTimeout, ServiceDegraded)
+
+
+def routing_key(payload, opt_overrides: dict | None = None) -> str:
+    """Lane-locality key: call-option identity + power-of-two payload
+    size bucket. Lane shapes derive from decoded unit geometry, which
+    the router cannot know without decoding — payload size is the
+    admission-time proxy that keeps similarly-shaped requests (and
+    byte-identical retries of one request) on one replica."""
+    opts = "" if not opt_overrides else repr(sorted(opt_overrides.items()))
+    if isinstance(payload, (bytes, bytearray)):
+        size = len(payload)
+        tag = "b"
+    else:
+        tag = str(payload)
+        try:
+            import os
+
+            size = os.path.getsize(tag)
+        except OSError:
+            size = len(tag)
+    bucket = 1 << max(int(size) - 1, 0).bit_length() if size else 0
+    return f"{tag if tag != 'b' else 'bytes'}|{bucket}|{opts}"
+
+
+def rendezvous_score(key: str, replica_id: str) -> int:
+    """Highest-random-weight score of (key, replica): stable across
+    processes and runs (blake2b, not Python's salted hash)."""
+    digest = hashlib.blake2b(
+        f"{key}|{replica_id}".encode(), digest_size=8
+    ).digest()
+    return int.from_bytes(digest, "big")
+
+
+class _Ticket:
+    """One outer request: the caller's future plus placement state."""
+
+    __slots__ = ("payload", "overrides", "deadline_s", "future", "key",
+                 "attempts", "replica_id", "inner", "hedge_inner",
+                 "hedge_timer", "lock", "done")
+
+    def __init__(self, payload, overrides: dict, deadline_s):
+        self.payload = payload
+        self.overrides = overrides
+        self.deadline_s = deadline_s
+        self.future: Future = Future()
+        self.key = routing_key(payload, overrides)
+        self.attempts = 0
+        self.replica_id: str | None = None
+        self.inner = None
+        self.hedge_inner = None
+        self.hedge_timer = None
+        self.lock = threading.Lock()
+        self.done = False
+
+
+class FleetRouter:
+    """Placement + failover + hedging over a list of Replicas."""
+
+    def __init__(self, replicas, fleet_watermark: int | None = None,
+                 max_failover: int | None = None,
+                 hedge_s: float | None = None):
+        self.replicas = list(replicas)
+        self._by_id = {r.replica_id: r for r in self.replicas}
+        self.fleet_watermark = fleet_watermark
+        #: distinct replicas one ticket may try (placement + failovers)
+        self.max_failover = (
+            max_failover if max_failover is not None else len(self.replicas)
+        )
+        self.hedge_s = hedge_s
+
+    # ------------------------------------------------------------- ranking
+
+    def rank(self, key: str, exclude=frozenset()) -> list:
+        """Admitting replicas in rendezvous order for `key`, `ok` states
+        strictly before `degraded` ones (a degraded replica sheds most
+        submissions — it is a last resort, not a peer)."""
+        ranked = sorted(
+            (r for r in self.replicas
+             if r.admitting and r.replica_id not in exclude),
+            key=lambda r: rendezvous_score(key, r.replica_id),
+            reverse=True,
+        )
+        return (
+            [r for r in ranked if r.state == "ok"]
+            + [r for r in ranked if r.state != "ok"]
+        )
+
+    def _resolved_watermark(self) -> int | None:
+        if self.fleet_watermark is not None:
+            return self.fleet_watermark
+        marks = [
+            r.service.queue.high_watermark
+            for r in self.replicas if r.service is not None
+        ]
+        return sum(marks) if marks else None
+
+    # ----------------------------------------------------------- admission
+
+    def submit(self, payload, deadline_s: float | None = None,
+               **opt_overrides) -> Future:
+        """Admit one request into the fleet; returns the outer Future.
+        Raises AdmissionError/ServiceDegraded when nothing could be
+        placed (fleet watermark, or every replica shed)."""
+        admitting = [r for r in self.replicas if r.admitting]
+        if not admitting:
+            raise ServiceDegraded(
+                "fleet degraded: no admitting replica",
+                jittered_retry_after(1.0),
+            )
+        watermark = self._resolved_watermark()
+        depth = sum(r.queue_depth for r in admitting)
+        if watermark is not None and depth >= watermark:
+            est = admitting[0].service.queue.estimated_wait_s(
+                depth - watermark + 1
+            )
+            raise AdmissionError(
+                f"fleet depth {depth} at/over watermark {watermark}",
+                jittered_retry_after(est),
+            )
+        ticket = _Ticket(payload, opt_overrides, deadline_s)
+        self._place(ticket)  # raises when every replica sheds
+        return ticket.future
+
+    # ----------------------------------------------------------- placement
+
+    def _place(self, ticket: _Ticket, exclude=frozenset()):
+        """Place `ticket` on the best admitting replica, failing over
+        past sheds. Raises the last shed error when none admitted."""
+        last_err = None
+        skipped = 0
+        for rep in self.rank(ticket.key, exclude=exclude):
+            if ticket.attempts >= self.max_failover:
+                break
+            try:
+                inner = rep.service.submit(
+                    ticket.payload, deadline_s=ticket.deadline_s,
+                    **ticket.overrides,
+                )
+            except (ServiceDegraded, AdmissionError) as e:
+                last_err = e
+                skipped += 1
+                continue
+            if skipped:
+                fleet_metrics().failovers.inc(skipped)
+            with ticket.lock:
+                ticket.attempts += 1
+                ticket.inner = inner
+                ticket.replica_id = rep.replica_id
+            rep.remember(inner, ticket)
+            inner.add_done_callback(
+                lambda f, t=ticket, r=rep: self._on_inner(t, r, f)
+            )
+            self._maybe_arm_hedge(ticket)
+            return rep
+        if last_err is None:
+            last_err = ServiceDegraded(
+                "fleet degraded: no admitting replica",
+                jittered_retry_after(1.0),
+            )
+        raise last_err
+
+    def _on_inner(self, ticket: _Ticket, rep, inner) -> None:
+        """One inner future settled. Success always wins the outer
+        (even a stale/hedge success — it is byte-identical by purity);
+        failures only act when they come from the CURRENT primary
+        inner: replica-level ones fail over, request-level ones
+        surface. Stale failures from abandoned inners are dropped."""
+        rep.forget(inner)
+        try:
+            exc = inner.exception()
+        except BaseException as e:  # noqa: BLE001 — cancelled inner
+            exc = e
+            self._settle(ticket, exc=exc)
+            return
+        if exc is None:
+            self._settle(ticket, result=inner.result())
+            return
+        with ticket.lock:
+            if ticket.done or inner is not ticket.inner:
+                return  # stale or hedge failure: the primary owns it
+        if (
+            isinstance(exc, REPLICA_FAILURES)
+            and ticket.attempts < self.max_failover
+        ):
+            fleet_metrics().failovers.inc()
+            try:
+                self._place(ticket, exclude={rep.replica_id})
+            except (ServiceDegraded, AdmissionError) as e:
+                self._settle(ticket, exc=e)
+            return
+        self._settle(ticket, exc=exc)
+
+    def _settle(self, ticket: _Ticket, *, result=None, exc=None) -> bool:
+        """Resolve the outer future exactly once (first settle wins;
+        the loser of a hedge/replay race records nothing)."""
+        with ticket.lock:
+            if ticket.done:
+                return False
+            ticket.done = True
+            timer = ticket.hedge_timer
+            ticket.hedge_timer = None
+        if timer is not None:
+            timer.cancel()
+        fut = ticket.future
+        try:
+            if not fut.set_running_or_notify_cancel():
+                return False
+        except (InvalidStateError, RuntimeError):
+            return False  # caller cancelled — nothing to record
+        if exc is not None:
+            fut.set_exception(exc)
+        else:
+            fut.set_result(result)
+        return True
+
+    # ------------------------------------------------------------- hedging
+
+    def _maybe_arm_hedge(self, ticket: _Ticket) -> None:
+        if self.hedge_s is None or ticket.hedge_timer is not None:
+            return
+        delay = self.hedge_s
+        if ticket.deadline_s is not None:
+            # deadline-aware: the hedge must leave the duplicate at
+            # least half the budget to actually finish
+            delay = min(delay, max(ticket.deadline_s * 0.5, 0.01))
+        timer = threading.Timer(delay, self._hedge, args=(ticket,))
+        timer.daemon = True
+        ticket.hedge_timer = timer
+        timer.start()
+
+    def _hedge(self, ticket: _Ticket) -> None:
+        """Straggler mitigation: one speculative duplicate on the next
+        healthy replica; first settle wins the outer future."""
+        with ticket.lock:
+            if ticket.done or ticket.hedge_inner is not None:
+                return
+            exclude = {ticket.replica_id} if ticket.replica_id else set()
+        for rep in self.rank(ticket.key, exclude=exclude):
+            try:
+                inner = rep.service.submit(
+                    ticket.payload, deadline_s=ticket.deadline_s,
+                    **ticket.overrides,
+                )
+            except (ServiceDegraded, AdmissionError):
+                continue
+            fleet_metrics().hedges.inc()
+            with ticket.lock:
+                ticket.hedge_inner = inner
+            rep.remember(inner, ticket)
+            inner.add_done_callback(
+                lambda f, t=ticket, r=rep: self._on_inner(t, r, f)
+            )
+            return
+
+    # -------------------------------------------------------------- replay
+
+    def replay(self, rep, counter=None) -> int:
+        """Re-queue every ticket still in-flight on `rep` onto
+        survivors — the no-admitted-request-lost path after death
+        (supervisor eviction) or drain hand-back. Tickets that cannot
+        be placed anywhere settle with the shed error (still exactly
+        once); already-settled tickets are skipped. Returns the number
+        replayed."""
+        if counter is None:
+            counter = fleet_metrics().replays
+        n = 0
+        for _inner, ticket in rep.take_inflight():
+            with ticket.lock:
+                if ticket.done:
+                    continue
+                # the abandoned inner must no longer drive failover
+                ticket.inner = None
+            try:
+                self._place(ticket, exclude={rep.replica_id})
+            except (ServiceDegraded, AdmissionError) as e:
+                self._settle(ticket, exc=e)
+                continue
+            counter.inc()
+            n += 1
+        return n

@@ -1,0 +1,310 @@
+"""FleetService — N supervised in-process replicas behind one front.
+
+The L8 assembly: each replica is a full ConsensusService (its own
+queue, micro-batcher, breaker, watchdog, worker — PR 4's self-healing
+scoped to one service), the FleetRouter places and fails requests over
+between them, and the FleetSupervisor evicts and warm-restarts whole
+replicas. The resulting contract is the fleet-level version of the
+serve tier's founding invariant: **no admitted request is lost when a
+replica dies** — killed replicas are evicted and their admitted work
+replayed onto survivors; drained replicas hand queued work back to the
+router and restart with zero downtime; and because consensus is pure,
+every replay/hedge/failover is byte-identical to the single-replica
+answer, with the outer future as the exactly-once settle point.
+
+HTTP front (one server for the whole fleet): POST `/v1/consensus`
+routes through the router; `/metrics` renders every replica's registry
+plus the process-global one (replica 0's series win name collisions —
+use `fleet_snapshot()` for numeric aggregation); `/healthz` reports
+the fleet + per-replica states; `/readyz` is 503 until at least one
+replica admits (load balancers need the distinction — see
+serve/service.py).
+
+Replica services run with `http_port=None` (the fleet front is the
+only socket) and each replica slot keeps ONE metrics registry across
+restarts, so counters survive eviction and generation bumps are
+visible as continuity, not resets.
+
+jax-free by construction (tier-1 AST guard): the fleet tier routes and
+supervises; only the services it assembles touch the device.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from kindel_tpu.fleet.replica import Replica
+from kindel_tpu.fleet.router import FleetRouter
+from kindel_tpu.fleet.supervisor import FleetSupervisor
+from kindel_tpu.obs.metrics import (
+    MetricsRegistry,
+    MultiRegistry,
+    default_registry,
+    fleet_metrics,
+)
+from kindel_tpu.resilience.policy import ProbePolicy
+
+
+class FleetService:
+    """N supervised replicas + router + drain, one submit() surface."""
+
+    def __init__(self, replicas: int = 2, service_factory=None,
+                 http_host: str = "127.0.0.1", http_port: int | None = None,
+                 probe_interval_s: float = 0.05,
+                 fleet_watermark: int | None = None,
+                 max_failover: int | None = None,
+                 hedge_s: float | None = None,
+                 probe_policy_factory=ProbePolicy,
+                 supervise: bool = True,
+                 **service_kwargs):
+        """`service_kwargs` are ConsensusService knobs applied to every
+        replica (max_batch_rows, max_wait_s, warmup, consensus opts,
+        ...). `service_factory(replica_id, metrics_registry)` overrides
+        replica construction entirely (tests inject stubs). `hedge_s`
+        arms deadline-aware straggler hedging; `fleet_watermark` bounds
+        total queued depth across the fleet (default: the sum of the
+        per-replica watermarks); `probe_interval_s` is the supervisor's
+        probe cadence."""
+        if replicas < 1:
+            raise ValueError("a fleet needs at least one replica")
+        self._service_kwargs = dict(service_kwargs)
+        self._service_kwargs["http_port"] = None
+        self._registries = [MetricsRegistry() for _ in range(replicas)]
+        self.replicas: list[Replica] = []
+        for i in range(replicas):
+            rid = f"r{i}"
+            factory = self._make_factory(rid, self._registries[i],
+                                         service_factory)
+            self.replicas.append(
+                Replica(rid, factory,
+                        probe_policy_factory=probe_policy_factory)
+            )
+        self._by_id = {r.replica_id: r for r in self.replicas}
+        self.router = FleetRouter(
+            self.replicas, fleet_watermark=fleet_watermark,
+            max_failover=max_failover, hedge_s=hedge_s,
+        )
+        self.supervisor = (
+            FleetSupervisor(self.replicas, self.router,
+                            probe_interval_s=probe_interval_s)
+            if supervise else None
+        )
+        self._http = None
+        self._http_host = http_host
+        self._http_port = http_port
+        self._started_at: float | None = None
+        self._stopped = False
+        self._drain_lock = threading.Lock()
+
+    def _make_factory(self, rid: str, registry, service_factory):
+        if service_factory is not None:
+            return lambda: service_factory(rid, registry)
+
+        def factory():
+            from kindel_tpu.serve import ConsensusService
+
+            return ConsensusService(
+                metrics=registry, **self._service_kwargs
+            )
+
+        return factory
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self) -> "FleetService":
+        self._started_at = time.monotonic()
+        fleet_metrics()  # the kindel_fleet_* series exist from boot
+        for rep in self.replicas:
+            rep.start()
+        if self.supervisor is not None:
+            self.supervisor.start()
+        if self._http_port is not None:
+            from kindel_tpu.obs import runtime as obs_runtime
+            from kindel_tpu.serve.metrics import ServeHTTPServer
+            from kindel_tpu.serve.service import (
+                consensus_post_response,
+                readyz_response,
+            )
+
+            self._http = ServeHTTPServer(
+                MultiRegistry(
+                    *self._registries, default_registry(),
+                    refresh=obs_runtime.update_device_gauges,
+                ),
+                host=self._http_host, port=self._http_port,
+                health_fn=self.healthz,
+                post_routes={
+                    "/v1/consensus": lambda body: consensus_post_response(
+                        self.request, body
+                    ),
+                },
+                get_routes={
+                    "/readyz": lambda: readyz_response(self.readyz),
+                },
+            ).start()
+        return self
+
+    def __enter__(self) -> "FleetService":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    @property
+    def http_address(self):
+        if self._http is None:
+            return None
+        return self._http.host, self._http.port
+
+    def replica(self, replica) -> Replica:
+        """Resolve a replica by id ("r1") or index (1)."""
+        if isinstance(replica, Replica):
+            return replica
+        if isinstance(replica, int):
+            return self.replicas[replica]
+        return self._by_id[replica]
+
+    def kill_replica(self, replica) -> None:
+        """Chaos surface: abrupt death of one replica (see
+        ConsensusService.kill) — the supervisor detects, evicts, and
+        replays. Never part of a graceful path; use drain() for that."""
+        self.replica(replica).kill()
+
+    def stop(self, drain: bool = True) -> None:
+        """Full-fleet shutdown. drain=True (the SIGTERM path) serves
+        everything already admitted on live replicas before exit; dead
+        replicas' leftovers are replayed first so survivors can still
+        serve them. drain=False fails pending work fast."""
+        if self._stopped:
+            return
+        self._stopped = True
+        if self.supervisor is not None:
+            self.supervisor.stop()
+        # replay anything stranded on dead replicas while survivors
+        # still admit — after states flip to draining nothing admits
+        for rep in self.replicas:
+            svc = rep.service
+            if svc is None or not svc.live:
+                self.router.replay(rep)
+        for rep in self.replicas:
+            rep.set_state("draining")
+        for rep in self.replicas:
+            svc = rep.service
+            if svc is None:
+                continue
+            if drain and svc.live:
+                svc.drain(handback=False)
+            else:
+                svc.stop(drain=False)
+            rep.set_state("dead")
+        if self._http is not None:
+            self._http.stop()
+            self._http = None
+
+    def drain(self, replica=None) -> int:
+        """Zero-downtime drain. With `replica` (id or index): stop that
+        replica's admission, finish its in-flight flushes, hand its
+        queued-but-unstarted requests back to the router (re-queued on
+        survivors, counted as kindel_fleet_drained_requests_total),
+        then warm-restart it — the rest of the fleet keeps serving
+        throughout. Without `replica`: drain and stop the whole fleet.
+        Returns the number of requests handed back."""
+        if replica is None:
+            self.stop(drain=True)
+            return 0
+        rep = self.replica(replica)
+        with self._drain_lock:
+            rep.set_state("draining")
+            svc = rep.service
+            if svc is not None and svc.live:
+                svc.drain(handback=True)
+            n = self.router.replay(rep, counter=fleet_metrics().drained)
+            rep.restart()
+        return n
+
+    # ------------------------------------------------------------- serving
+
+    def submit(self, payload, deadline_s: float | None = None,
+               **opt_overrides):
+        """Admit one request into the fleet; Future of SampleResult.
+        Raises AdmissionError/ServiceDegraded when shedding (fleet
+        watermark, or no replica admits)."""
+        return self.router.submit(
+            payload, deadline_s=deadline_s, **opt_overrides
+        )
+
+    def request(self, payload, timeout: float | None = None,
+                **opt_overrides):
+        """Synchronous submit: blocks until served (or raises)."""
+        return self.submit(payload, **opt_overrides).result(timeout=timeout)
+
+    # -------------------------------------------------------------- health
+
+    def healthz(self) -> dict:
+        states = [r.state for r in self.replicas]
+        if any(s == "ok" for s in states):
+            status = "ok"
+        elif any(r.admitting for r in self.replicas):
+            status = "degraded"
+        else:
+            status = "dead"
+        return {
+            "status": status,
+            "fleet": True,
+            "replicas": {
+                r.replica_id: {
+                    **r.snapshot(),
+                    "healthz": self._replica_healthz(r),
+                }
+                for r in self.replicas
+            },
+            "uptime_s": (
+                round(time.monotonic() - self._started_at, 3)
+                if self._started_at is not None else 0.0
+            ),
+        }
+
+    @staticmethod
+    def _replica_healthz(rep: Replica) -> dict:
+        svc = rep.service
+        if svc is None:
+            return {"status": "down"}
+        try:
+            return svc.healthz()
+        except Exception as e:  # noqa: BLE001 — a broken probe IS the answer
+            return {"status": "down", "error": repr(e)}
+
+    def readyz(self) -> dict:
+        ready = (not self._stopped) and any(
+            r.admitting for r in self.replicas
+        )
+        return {
+            "ready": ready,
+            "status": "ok" if ready else (
+                "stopped" if self._stopped else "no_admitting_replica"
+            ),
+            "replicas": {r.replica_id: r.state for r in self.replicas},
+        }
+
+    # ------------------------------------------------------------- metrics
+
+    def fleet_snapshot(self) -> dict:
+        """Numeric aggregation across replica registries (counters sum;
+        non-numeric snapshot values are dropped) plus the process-global
+        kindel_fleet_* counters and per-replica states — what the load
+        bench and the chaos suite assert against."""
+        totals: dict = {}
+        for reg in self._registries:
+            for k, v in reg.snapshot().items():
+                if isinstance(v, (int, float)):
+                    totals[k] = totals.get(k, 0) + v
+        fleet = {
+            k: v for k, v in default_registry().snapshot().items()
+            if k.startswith("kindel_fleet_")
+        }
+        return {
+            "replicas": {r.replica_id: r.snapshot() for r in self.replicas},
+            "totals": totals,
+            "fleet": fleet,
+        }

@@ -1,0 +1,121 @@
+"""FleetSupervisor — probe, score, evict, replay, warm-restart.
+
+The fleet-level analogue of the in-service supervisor thread
+(serve/worker.py): that one resurrects a dead intake/dispatch LOOP;
+this one resurrects a dead REPLICA. One daemon thread probes every
+replica on an interval, folds each probe through the replica's
+consecutive-probe ladder (resilience.policy.ProbePolicy — the circuit
+breaker's discipline at probe granularity), and acts on the verdict:
+
+  ok / degraded   mirrored onto the replica state; the router prefers
+                  ok replicas and keeps degraded ones as a last resort
+  dead            **eviction**: the replica's admitted-but-unfinished
+                  tickets are replayed onto survivors FIRST (consensus
+                  is pure and the outer future is the exactly-once
+                  settle point, so replay is idempotent — a zombie
+                  thread's late result just loses the settle race),
+                  its thread pools are reaped, and the replica is
+                  warm-restarted from the factory — with a warm AOT
+                  store (PR 6) the restart loads executables and
+                  compiles nothing
+
+Replicas in lifecycle states the supervisor does not own (`draining`,
+`restarting`) are probed but never evicted: drain owns its own
+restart, and a replica mid-restart has no service to probe.
+
+Everything is counted on `kindel_fleet_*` (obs/metrics.py):
+evictions, replays, restarts, plus the per-replica state gauge.
+jax-free by construction (tier-1 AST guard).
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+
+from kindel_tpu.obs import trace
+from kindel_tpu.obs.metrics import fleet_metrics
+
+
+class FleetSupervisor:
+    """Health-probing eviction loop over a FleetService's replicas."""
+
+    def __init__(self, replicas, router, probe_interval_s: float = 0.05,
+                 auto_restart: bool = True):
+        self.replicas = replicas
+        self.router = router
+        self.probe_interval_s = probe_interval_s
+        self.auto_restart = auto_restart
+        self._stop_event = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "FleetSupervisor":
+        self._thread = threading.Thread(
+            target=self._loop, name="kindel-fleet-supervisor", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop_event.set()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+    # ------------------------------------------------------------ the loop
+
+    def _loop(self) -> None:
+        while not self._stop_event.wait(self.probe_interval_s):
+            for rep in self.replicas:
+                if self._stop_event.is_set():
+                    return
+                self._probe_one(rep)
+
+    def _probe_one(self, rep) -> None:
+        if rep.state in ("draining", "restarting"):
+            return  # lifecycle owner transitions these, not probes
+        try:
+            outcome = rep.probe()
+        except Exception as e:  # noqa: BLE001 — a probe that raises IS data
+            verdict = rep.record_probe_failure(repr(e))
+        else:
+            verdict = rep.score(outcome)
+        if verdict == "dead":
+            self._evict(rep)
+
+    def _evict(self, rep) -> None:
+        """Eviction: replay the dead replica's admitted work onto
+        survivors, reap its pools, warm-restart it. Ordered replay-
+        first so no admitted request waits on the restart."""
+        fleet_metrics().evictions.inc()
+        sp = trace.span("fleet.evict")
+        with sp:
+            if sp is not trace.NOOP_SPAN:
+                sp.set_attribute(
+                    replica=rep.replica_id, generation=rep.generation,
+                    inflight=rep.inflight_count,
+                )
+        rep.set_state("dead")
+        svc = rep.service
+        if svc is not None:
+            # a dead service must never settle anything again mid-replay
+            # races are harmless (first settle wins) but stop the bleeding
+            try:
+                svc.kill()
+                svc.worker.reap()
+            except Exception as e:  # noqa: BLE001 — already dead is fine
+                rep.record_probe_failure(repr(e))
+        replayed = self.router.replay(rep)
+        if replayed:
+            print(
+                f"kindel-fleet: evicted {rep.replica_id}, replayed "
+                f"{replayed} admitted request(s) onto survivors",
+                file=sys.stderr,
+            )
+        if not self.auto_restart:
+            return
+        try:
+            rep.restart()
+        except Exception as e:  # noqa: BLE001 — restart failure is a probe failure
+            rep.record_probe_failure(repr(e))
+            rep.set_state("dead")

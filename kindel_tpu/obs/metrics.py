@@ -492,3 +492,84 @@ _DEFAULT = MetricsRegistry()
 
 def default_registry() -> MetricsRegistry:
     return _DEFAULT
+
+
+#: kindel_fleet_replica_state gauge encoding (kindel_tpu.fleet)
+FLEET_STATE_CODES = {
+    "starting": 0,
+    "ok": 1,
+    "degraded": 2,
+    "draining": 3,
+    "dead": 4,
+    "restarting": 5,
+}
+
+_FLEET_METRICS = None
+_fleet_lock = threading.Lock()
+
+
+def fleet_metrics():
+    """The process-global `kindel_fleet_*` family (kindel_tpu.fleet,
+    DESIGN.md §17), cached so the supervisor's probe loop and the
+    router's placement path never pay a registry lock per decision:
+
+      replica_state  per-replica state gauge (labels: replica), coded
+                     per FLEET_STATE_CODES
+      evictions      replicas evicted after consecutive failed probes
+      failovers      placements moved to the next healthy replica after
+                     a shed/typed failure on the ranked-first choice
+      hedges         duplicate speculative dispatches raced against a
+                     straggling primary (first settle wins)
+      drained        admitted requests handed back by a draining
+                     replica and re-queued on a survivor
+      replays        admitted requests replayed from a DEAD replica
+                     onto survivors (the no-request-lost path)
+      restarts       replica warm restarts (eviction or drain)
+    """
+    global _FLEET_METRICS
+    if _FLEET_METRICS is None:
+        with _fleet_lock:
+            if _FLEET_METRICS is None:
+                from types import SimpleNamespace
+
+                reg = default_registry()
+                _FLEET_METRICS = SimpleNamespace(
+                    replica_state=reg.gauge(
+                        "kindel_fleet_replica_state",
+                        "fleet replica state by replica label (0=starting,"
+                        " 1=ok, 2=degraded, 3=draining, 4=dead,"
+                        " 5=restarting)",
+                    ),
+                    evictions=reg.counter(
+                        "kindel_fleet_evictions_total",
+                        "replicas evicted by the fleet supervisor after "
+                        "consecutive failed health probes",
+                    ),
+                    failovers=reg.counter(
+                        "kindel_fleet_failovers_total",
+                        "request placements failed over to the next "
+                        "healthy replica (shed or typed replica failure "
+                        "on the preferred one)",
+                    ),
+                    hedges=reg.counter(
+                        "kindel_fleet_hedges_total",
+                        "speculative duplicate dispatches raced against "
+                        "a straggling primary replica",
+                    ),
+                    drained=reg.counter(
+                        "kindel_fleet_drained_requests_total",
+                        "admitted requests handed back by a draining "
+                        "replica and re-queued on a survivor",
+                    ),
+                    replays=reg.counter(
+                        "kindel_fleet_replayed_requests_total",
+                        "admitted requests replayed from a dead replica "
+                        "onto surviving replicas",
+                    ),
+                    restarts=reg.counter(
+                        "kindel_fleet_restarts_total",
+                        "replica warm restarts performed by the fleet "
+                        "(post-eviction and post-drain)",
+                    ),
+                )
+    return _FLEET_METRICS

@@ -206,6 +206,11 @@ class RaggedBatcher(MicroBatcher):
         ] + [lane.opened_at for lane in self._rlanes.values()]
         return min(candidates) if candidates else None
 
+    def _seal_open_locked(self) -> None:
+        for key in list(self._rlanes):
+            self._ready.append(self._seal_ragged(key, self._rlanes[key]))
+        super()._seal_open_locked()
+
     # -------------------------------------------------------- flush contract
 
     @property

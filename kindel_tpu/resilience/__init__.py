@@ -38,6 +38,7 @@ from kindel_tpu.resilience.faults import (  # noqa: F401
     hook_bytes,
 )
 from kindel_tpu.resilience.policy import (  # noqa: F401
+    ProbePolicy,
     RetryPolicy,
     classify,
     default_policy,

@@ -148,6 +148,69 @@ def record_degrade(site: str, action: str, depth: int = 1) -> None:
             sp.set_attribute(site=site, action=action, depth=depth)
 
 
+#: probe outcomes the fleet supervisor feeds a ProbePolicy
+PROBE_OK = "ok"
+PROBE_DEGRADED = "degraded"
+PROBE_FAILED = "failed"
+
+#: replica verdicts a ProbePolicy returns
+REPLICA_OK = "ok"
+REPLICA_DEGRADED = "degraded"
+REPLICA_DEAD = "dead"
+
+
+class ProbePolicy:
+    """Consecutive-probe replica scoring for the fleet supervisor
+    (kindel_tpu.fleet) — the circuit breaker's consecutive-failure
+    discipline applied at health-probe granularity, one instance per
+    replica.
+
+    `observe(outcome)` folds one probe result in and returns the
+    replica verdict: `dead_after` CONSECUTIVE failed probes (the
+    service is not live, or the probe itself raised a non-transient
+    error) verdict the replica dead — the supervisor evicts, replays
+    its admitted work onto survivors, and warm-restarts it;
+    `degraded_after` consecutive not-ok probes (breaker open, or a
+    transient probe error) verdict it degraded — the router stops
+    preferring it but keeps it as a last resort. A single ok probe
+    resets both runs, the same asymmetry as the breaker: recovery is
+    instant, demotion needs a run — one flaky probe must not evict a
+    replica holding admitted work."""
+
+    def __init__(self, degraded_after: int = 2, dead_after: int = 3):
+        if degraded_after < 1 or dead_after < 1:
+            raise ValueError("probe thresholds must be >= 1")
+        self.degraded_after = degraded_after
+        self.dead_after = dead_after
+        self._not_ok = 0
+        self._failed = 0
+
+    def observe(self, outcome: str) -> str:
+        """Fold one probe outcome (PROBE_OK/DEGRADED/FAILED) in; return
+        the current replica verdict (REPLICA_OK/DEGRADED/DEAD)."""
+        if outcome == PROBE_OK:
+            self._not_ok = 0
+            self._failed = 0
+            return REPLICA_OK
+        self._not_ok += 1
+        if outcome == PROBE_FAILED:
+            self._failed += 1
+        else:
+            self._failed = 0
+        if self._failed >= self.dead_after:
+            return REPLICA_DEAD
+        if self._not_ok >= self.degraded_after:
+            return REPLICA_DEGRADED
+        return REPLICA_OK
+
+    def classify_error(self, exc: BaseException) -> str:
+        """Probe-exception classification, reusing the transient
+        vocabulary: a transient probe error (an RPC flap against the
+        replica) counts degraded-ward; anything else counts toward
+        death."""
+        return PROBE_DEGRADED if is_transient(exc) else PROBE_FAILED
+
+
 class RetryPolicy:
     """Exponential backoff with full jitter over a transient-error
     classifier (the AWS-style decorrelated cap: sleep ~ U(0, min(max_s,

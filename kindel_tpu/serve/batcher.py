@@ -132,6 +132,15 @@ class MicroBatcher:
         """Any open (unsealed) lane left? Gates the closed-drain exit."""
         return bool(self._lanes)
 
+    def _seal_open_locked(self) -> None:
+        """Seal every open lane into the ready queue — the close-path
+        accounting hook. Safe because close() is ordered after the last
+        add (the worker shuts the decode pool down first), so an open
+        lane can only shrink the drain: aging it toward max_wait would
+        just stall shutdown by up to the knob per lane."""
+        for key in list(self._lanes):
+            self._ready.append(self._seal(key, self._lanes[key]))
+
     def _oldest_open_locked(self) -> float | None:
         """opened_at of the oldest open lane (None when all are sealed)
         — what poll() sleeps against for the max-wait trigger."""
@@ -147,6 +156,11 @@ class MicroBatcher:
         with self._cond:
             while True:
                 now = self._clock()
+                if self._closed:
+                    # drain: seal whatever is still open instead of
+                    # letting it age toward max_wait (nothing new can
+                    # join a lane after close)
+                    self._seal_open_locked()
                 flush = self._due_locked(now)
                 if flush is not None:
                     return flush

@@ -42,6 +42,10 @@ class ServeHTTPServer:
     document; `post_routes` maps a path to `fn(body: bytes) -> (status,
     content_type, body_bytes, extra_headers)` — the consensus ingest
     endpoint plugs in here so the metrics module stays transport-only.
+    `get_routes` maps extra GET paths to `fn() -> (status, content_type,
+    body_bytes, extra_headers)` — the /readyz endpoint plugs in here
+    (readiness must be able to answer 503, which the always-200
+    health_fn cannot).
     """
 
     #: refuse request bodies past this size before allocating (the serve
@@ -50,10 +54,12 @@ class ServeHTTPServer:
     MAX_BODY_BYTES = 1 << 30
 
     def __init__(self, registry, host: str = "127.0.0.1",
-                 port: int = 0, health_fn=None, post_routes: dict | None = None):
+                 port: int = 0, health_fn=None, post_routes: dict | None = None,
+                 get_routes: dict | None = None):
         self.registry = registry
         self._health_fn = health_fn or (lambda: {"status": "ok"})
         self._post_routes = dict(post_routes or {})
+        self._get_routes = dict(get_routes or {})
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -83,6 +89,11 @@ class ServeHTTPServer:
                         200, "application/json",
                         json.dumps(outer._health_fn()).encode(),
                     )
+                elif path in outer._get_routes:
+                    status, ctype, payload, headers = outer._get_routes[
+                        path
+                    ]()
+                    self._reply(status, ctype, payload, headers)
                 else:
                     self._reply(404, "text/plain", b"not found\n")
 

@@ -17,6 +17,7 @@ never spends a decode thread on them.
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 from collections import deque
@@ -24,6 +25,24 @@ from concurrent.futures import Future, InvalidStateError
 from dataclasses import dataclass, field
 
 from kindel_tpu.obs import trace
+
+#: bounded jitter applied to every retry-after hint: ±25% around the
+#: estimate, so a cohort of synchronized clients spreads its retries
+#: over half the window instead of stampeding the service (worst at the
+#: breaker's half-open probe: one probe slot, N clients retrying on the
+#: same fixed hint re-trips the breaker on arrival)
+RETRY_JITTER_FRAC = 0.25
+
+_jitter_rng = random.Random()
+
+
+def jittered_retry_after(base_s: float, *, frac: float = RETRY_JITTER_FRAC,
+                         floor: float = 0.05, rng=None) -> float:
+    """`base_s` spread uniformly over [base*(1-frac), base*(1+frac)],
+    floored — the retry-after de-synchronizer every admission-shed path
+    (watermark, deadline, drain, breaker) runs its hint through."""
+    r = rng if rng is not None else _jitter_rng
+    return max(floor, base_s * (1.0 + frac * (2.0 * r.random() - 1.0)))
 
 
 class AdmissionError(RuntimeError):
@@ -95,6 +114,8 @@ class RequestQueue:
         self._not_empty = threading.Condition(self._lock)
         self._ewma_service_s = self.DEFAULT_SERVICE_S
         self._closed = False
+        #: drain posture: submit rejects, get keeps serving what's queued
+        self._admission_closed = False
         if metrics is not None:
             self._depth_gauge = metrics.gauge(
                 "kindel_serve_queue_depth", "requests waiting for decode"
@@ -148,7 +169,14 @@ class RequestQueue:
         try:
             with self._not_empty:
                 if self._closed:
-                    raise AdmissionError("service is shutting down", 1.0)
+                    raise AdmissionError(
+                        "service is shutting down", jittered_retry_after(1.0)
+                    )
+                if self._admission_closed:
+                    raise AdmissionError(
+                        "service is draining: admission closed",
+                        jittered_retry_after(1.0),
+                    )
                 depth = len(self._q)
                 if traced:
                     adm.set_attribute(depth=depth)
@@ -160,7 +188,7 @@ class RequestQueue:
                     )
                     raise AdmissionError(
                         f"queue depth {depth} at/over watermark "
-                        f"{self.high_watermark}", max(retry, 0.05),
+                        f"{self.high_watermark}", jittered_retry_after(retry),
                     )
                 if req.deadline is not None:
                     budget = req.deadline - now
@@ -170,7 +198,8 @@ class RequestQueue:
                             self._rejects.inc()
                         raise AdmissionError(
                             f"deadline budget {budget:.3f}s < estimated wait "
-                            f"{est:.3f}s", max(est - max(budget, 0), 0.05),
+                            f"{est:.3f}s",
+                            jittered_retry_after(max(est - max(budget, 0), 0.05)),
                         )
                 req.enqueued_at = now
                 self._q.append(req)
@@ -241,6 +270,41 @@ class RequestQueue:
                     remaining = deadline - self._clock()
                     if remaining <= 0 or not self._not_empty.wait(remaining):
                         return None
+
+    def close_admission(self) -> None:
+        """Drain posture: reject every new submit (AdmissionError with a
+        jittered retry-after) while `get` keeps serving what is already
+        queued — the single-replica graceful-shutdown half of the drain
+        path (everything admitted still completes on this service)."""
+        with self._not_empty:
+            self._admission_closed = True
+
+    @property
+    def admitting(self) -> bool:
+        return not (self._closed or self._admission_closed)
+
+    def handback(self) -> list[ServeRequest]:
+        """Drain hand-back: stop admission AND pop every queued-but-
+        unstarted request, returning them with futures untouched — the
+        fleet router re-queues them on a surviving replica (consensus is
+        pure, so replay is idempotent; the Future is the exactly-once
+        settle point). Requests already popped by intake are unaffected
+        and finish here. Every admitted request is therefore in exactly
+        one place afterwards: this service's in-flight set, or the
+        returned list."""
+        with self._not_empty:
+            self._admission_closed = True
+            out = list(self._q)
+            self._q.clear()
+            if self._depth_gauge is not None:
+                self._depth_gauge.set(0)
+            self._not_empty.notify_all()
+        for req in out:
+            if req.wait_span is not None:
+                req.wait_span.set_attribute(outcome="handback")
+                req.wait_span.finish()
+                req.wait_span = None
+        return out
 
     def close(self) -> list[ServeRequest]:
         """Stop admitting; wake blocked getters; return drained leftovers

@@ -37,8 +37,53 @@ from kindel_tpu.serve.queue import (
     RequestQueue,
     ServeRequest,
     ServiceDegraded,
+    jittered_retry_after,
 )
 from kindel_tpu.serve.worker import ServeWorker
+
+
+def consensus_post_response(request_fn, body: bytes):
+    """POST /v1/consensus handler body, shared by the single service and
+    the fleet front (kindel_tpu.fleet): SAM/BAM bytes in, FASTA text
+    out. 429 + Retry-After under load shedding, 503 + Retry-After while
+    degraded/draining, 400 on undecodable input, 504 on deadline
+    expiry. `request_fn(body)` is the synchronous request entry point
+    (ConsensusService.request or FleetService.request)."""
+    from kindel_tpu.io.fasta import format_fasta
+
+    try:
+        res = request_fn(body)
+    except ServiceDegraded as e:
+        doc = {"error": str(e), "retry_after_s": e.retry_after_s}
+        return (
+            503, "application/json", json.dumps(doc).encode(),
+            {"Retry-After": max(1, round(e.retry_after_s))},
+        )
+    except AdmissionError as e:
+        doc = {"error": str(e), "retry_after_s": e.retry_after_s}
+        return (
+            429, "application/json", json.dumps(doc).encode(),
+            {"Retry-After": max(1, round(e.retry_after_s))},
+        )
+    except DeadlineExceeded as e:
+        return 504, "text/plain", f"{e}\n".encode(), {}
+    except ValueError as e:  # decode rejection — the client's fault
+        return 400, "text/plain", f"{e}\n".encode(), {}
+    except Exception as e:  # noqa: BLE001 — server-side failure
+        return 500, "text/plain", f"{e}\n".encode(), {}
+    return (
+        200, "text/x-fasta",
+        format_fasta(res.consensuses).encode(), {},
+    )
+
+
+def readyz_response(readyz_fn):
+    """GET /readyz handler body: 200 while ready, 503 during warmup,
+    drain, and after death — the liveness/readiness split load balancers
+    need (/healthz stays 200 + status text, unchanged)."""
+    doc = readyz_fn()
+    status = 200 if doc.get("ready") else 503
+    return status, "application/json", json.dumps(doc).encode(), {}
 
 
 def _aot_provenance() -> dict:
@@ -190,6 +235,9 @@ class ConsensusService:
         self._http_host = http_host
         self._http_port = http_port
         self._started_at: float | None = None
+        #: drain posture: /readyz answers 503 while True (admission is
+        #: closed on the queue; in-flight work keeps finishing)
+        self._draining = False
 
     # ----------------------------------------------------------- lifecycle
 
@@ -217,6 +265,7 @@ class ConsensusService:
                 host=self._http_host, port=self._http_port,
                 health_fn=self.healthz,
                 post_routes={"/v1/consensus": self._handle_consensus_post},
+                get_routes={"/readyz": self._handle_readyz},
             ).start()
         return self
 
@@ -225,6 +274,38 @@ class ConsensusService:
             self._http.stop()
             self._http = None
         self.worker.stop(drain=drain)
+
+    def drain(self, handback: bool = False) -> list[ServeRequest]:
+        """Graceful shutdown: stop admitting (new submits reject with a
+        jittered retry-after, /readyz flips 503), finish every in-flight
+        request, then stop. With handback=False (the single-replica
+        SIGTERM path) queued-but-unstarted requests are SERVED before
+        shutdown completes and the return value is empty; with
+        handback=True (the fleet drain path) they are popped unresolved
+        and returned, so the fleet router can re-queue them on a
+        surviving replica while this one restarts."""
+        self._draining = True
+        handed = self.queue.handback() if handback else []
+        if not handback:
+            self.queue.close_admission()
+        self.stop(drain=True)
+        return handed
+
+    def kill(self) -> None:
+        """Chaos surface: abrupt replica death (see ServeWorker.kill) —
+        admitted futures are abandoned unresolved, exactly what a
+        SIGKILLed process leaves behind. The fleet supervisor's probe
+        sees `live` go False, evicts, and replays."""
+        if self._http is not None:
+            self._http.stop()
+            self._http = None
+        self.worker.kill()
+
+    @property
+    def live(self) -> bool:
+        """Liveness (vs readiness): can this service still make
+        progress on admitted work? False once killed/stopped."""
+        return self.worker.alive
 
     def __enter__(self) -> "ConsensusService":
         return self.start()
@@ -338,6 +419,27 @@ class ConsensusService:
             doc["warmup_error"] = self._warm_error
         return doc
 
+    def readyz(self) -> dict:
+        """Readiness (vs /healthz liveness): should a load balancer
+        route NEW traffic here right now? Not ready during warmup (the
+        first requests would pay compiles), during drain (admission is
+        closed), and once dead. /healthz keeps its original semantics —
+        always 200 with a status string — because existing probes and
+        tests depend on them; /readyz is the 503-capable split."""
+        if self.warming:
+            ready, status = False, "warming"
+        elif self._draining:
+            ready, status = False, "draining"
+        elif not self.live:
+            ready, status = False, "dead"
+        else:
+            ready, status = True, "ok"
+        return {
+            "ready": ready,
+            "status": status,
+            "queue_depth": self.queue.depth,
+        }
+
     # ------------------------------------------------------------- requests
 
     def submit(self, payload, deadline_s: float | None = None,
@@ -346,9 +448,12 @@ class ConsensusService:
         SampleResult. Raises AdmissionError when load-shedding."""
         if not self.breaker.allow_admission():
             self._m_shed.inc()
+            # jittered so a cohort of synchronized shed clients does not
+            # stampede the single half-open probe slot in lockstep
             raise ServiceDegraded(
                 "service degraded: device circuit breaker is "
-                f"{self.breaker.state}", self.breaker.retry_after_s(),
+                f"{self.breaker.state}",
+                jittered_retry_after(self.breaker.retry_after_s()),
             )
         opts = (
             replace(self.default_opts, **opt_overrides)
@@ -372,36 +477,11 @@ class ConsensusService:
     # ---------------------------------------------------------- HTTP ingest
 
     def _handle_consensus_post(self, body: bytes):
-        """POST /v1/consensus: SAM/BAM bytes in, FASTA text out.
-        429 + Retry-After under load shedding, 503 + Retry-After while
-        the breaker sheds (degraded device), 400 on undecodable input,
-        504 on deadline expiry."""
-        from kindel_tpu.io.fasta import format_fasta
+        """POST /v1/consensus (status mapping in consensus_post_response)."""
+        return consensus_post_response(self.request, body)
 
-        try:
-            res = self.request(body)
-        except ServiceDegraded as e:
-            doc = {"error": str(e), "retry_after_s": e.retry_after_s}
-            return (
-                503, "application/json", json.dumps(doc).encode(),
-                {"Retry-After": max(1, round(e.retry_after_s))},
-            )
-        except AdmissionError as e:
-            doc = {"error": str(e), "retry_after_s": e.retry_after_s}
-            return (
-                429, "application/json", json.dumps(doc).encode(),
-                {"Retry-After": max(1, round(e.retry_after_s))},
-            )
-        except DeadlineExceeded as e:
-            return 504, "text/plain", f"{e}\n".encode(), {}
-        except ValueError as e:  # decode rejection — the client's fault
-            return 400, "text/plain", f"{e}\n".encode(), {}
-        except Exception as e:  # noqa: BLE001 — server-side failure
-            return 500, "text/plain", f"{e}\n".encode(), {}
-        return (
-            200, "text/x-fasta",
-            format_fasta(res.consensuses).encode(), {},
-        )
+    def _handle_readyz(self):
+        return readyz_response(self.readyz)
 
 
 class ConsensusClient:

@@ -296,6 +296,7 @@ class ServeWorker:
         self._stop_event = threading.Event()
         self._draining = False
         self._stopped = False
+        self._killed = False
         self._flush_seq = 0
         #: in-flight flush registry for the watchdog: key → (deadline,
         #: entries); registered around every device dispatch attempt
@@ -410,6 +411,46 @@ class ServeWorker:
             self._supervisor_thread.start()
         return self
 
+    @property
+    def alive(self) -> bool:
+        """Can this worker still make progress? False once stopped or
+        killed, or when a loop thread died with no supervisor to
+        resurrect it — the fleet probe's liveness signal."""
+        if self._stopped or self._killed:
+            return False
+        t_i, t_d = self._intake_thread, self._dispatch_thread
+        if t_i is None or t_d is None:
+            return False  # never started
+        if (
+            self.supervise
+            and self._supervisor_thread is not None
+            and self._supervisor_thread.is_alive()
+        ):
+            return True  # a dead loop will be resurrected
+        return t_i.is_alive() and t_d.is_alive()
+
+    def kill(self) -> None:
+        """Chaos surface: emulate abrupt replica death (a SIGKILLed
+        process). Every loop stops at its next iteration WITHOUT
+        resolving admitted futures — queued and batched requests are
+        simply abandoned, exactly what a killed process leaves behind
+        and exactly what the fleet supervisor (kindel_tpu.fleet) must
+        detect, evict, and replay onto survivors. Never part of any
+        graceful path; stop()/drain() settle every future instead."""
+        self._killed = True
+        self._stopped = True
+        self._stop_event.set()
+        self.queue.close()  # leftovers dropped UNRESOLVED — fleet replays
+        self.batcher.close()
+
+    def reap(self) -> None:
+        """Post-eviction cleanup of a killed worker: shut the host
+        thread pools down without waiting (running decodes finish and
+        lose their settle races harmlessly). Called by the fleet
+        supervisor after replay, never on a live worker."""
+        self._decode_pool.shutdown(wait=False)
+        self._assemble_pool.shutdown(wait=False)
+
     def stop(self, drain: bool = True) -> None:
         """Shut down. drain=True serves everything already admitted;
         drain=False fails pending requests with RuntimeError."""
@@ -514,10 +555,14 @@ class ServeWorker:
 
     def _intake_loop(self) -> None:
         while True:
+            if self._killed:
+                return  # abrupt death: abandon, do not settle
             rfaults.hook("serve.worker")
             req = self.queue.get(timeout=0.05)
             if req is None:
-                if self._draining and self.queue.depth == 0:
+                if self._killed or (
+                    self._draining and self.queue.depth == 0
+                ):
                     return
                 continue
             if self._m_requests is not None:
@@ -561,8 +606,12 @@ class ServeWorker:
 
     def _dispatch_loop(self) -> None:
         while True:
+            if self._killed:
+                return  # abrupt death: abandon, do not settle
             rfaults.hook("serve.worker")
             flush = self.batcher.poll(timeout=0.25)
+            if self._killed:
+                return  # a flush popped mid-kill stays unresolved
             if flush is None:
                 # poll yields None on a timeout OR once the batcher is
                 # closed and drained — only the latter ends the loop

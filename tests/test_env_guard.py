@@ -291,25 +291,65 @@ def test_io_package_never_imports_jax():
     assert checked >= 8, f"only {checked} io/ modules found"
 
 
+def test_fleet_package_never_imports_jax():
+    """The fleet tier (kindel_tpu/fleet/) routes tickets and supervises
+    replicas; only the ConsensusServices it assembles ever touch the
+    device. A direct jax import here would let the supervisor's probe
+    thread or the router's placement path trip backend initialization —
+    and would silently couple eviction/drain decisions to device state.
+    L8 stays jax-free by construction, the same bar as io/."""
+    offenders = []
+    checked = 0
+    for py in sorted((PKG / "fleet").rglob("*.py")):
+        checked += 1
+        tree = ast.parse(py.read_text(), filename=str(py))
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                names = [a.name for a in node.names]
+            elif isinstance(node, ast.ImportFrom):
+                names = [node.module or ""]
+            else:
+                continue
+            for name in names:
+                if name == "jax" or name.startswith("jax."):
+                    offenders.append(
+                        f"{py.relative_to(PKG.parent)}:{node.lineno} "
+                        f"(imports {name})"
+                    )
+    assert not offenders, (
+        "jax import inside kindel_tpu/fleet/ — the fleet tier "
+        "(router/supervisor) must never touch the device:\n"
+        + "\n".join(offenders)
+    )
+    assert checked >= 4, f"only {checked} fleet/ modules found"
+
+
 #: handler calls that count as "the failure was handled, not swallowed":
-#: resolving a request future, recording it on the breaker/metrics, or
-#: handing it to the degrade ladder (which itself settles every future)
+#: resolving a request future, recording it on the breaker/metrics/
+#: probe ladder, or handing it to the degrade ladder (which itself
+#: settles every future). `record_probe_failure` is the fleet
+#: supervisor's handler: a probe/restart exception folds into the
+#: replica's consecutive-probe score (and /healthz surfaces it).
 _FAILURE_HANDLERS = {
     "_fail", "fail", "_settle", "set_exception", "record_failure",
-    "_recover", "record_degrade",
+    "_recover", "record_degrade", "record_probe_failure",
 }
 
 #: deliberately-swallowing sites, each with a local reason:
 #: service._warm — warmup is best-effort, failure is recorded on
-#: _warm_error and /healthz; service._handle_consensus_post — the
-#: handler IS the failure path (it converts to an HTTP 5xx response);
+#: _warm_error and /healthz; service.consensus_post_response — the
+#: handler IS the failure path (it converts to an HTTP 5xx response,
+#: shared by the single service and the fleet front);
 #: service._aot_provenance — a health probe that must answer even when
 #: the AOT store layer is broken (degrades to "disabled", loses no
-#: request)
+#: request); fleet service._replica_healthz — the fleet health document
+#: must render even when one replica's healthz is broken (that IS the
+#: finding: the replica reports "down")
 _SWALLOW_ALLOWLIST = {
     ("serve/service.py", "_warm"),
-    ("serve/service.py", "_handle_consensus_post"),
+    ("serve/service.py", "consensus_post_response"),
     ("serve/service.py", "_aot_provenance"),
+    ("fleet/service.py", "_replica_healthz"),
 }
 
 
@@ -419,12 +459,12 @@ def test_ragged_pack_hot_path_is_vectorized():
 
 
 def test_no_silent_exception_swallow_in_serve_or_resilience():
-    """Every `except Exception` / `except BaseException` in the serving
-    and resilience layers must re-raise, resolve a future, or record the
-    failure — a handler that does none of those is exactly how an
-    admitted request gets silently lost (the invariant the chaos suite
-    enforces dynamically; this guard catches the sites tests never
-    reach)."""
+    """Every `except Exception` / `except BaseException` in the
+    serving, resilience, and fleet layers must re-raise, resolve a
+    future, or record the failure — a handler that does none of those
+    is exactly how an admitted request gets silently lost (the
+    invariant the chaos suites enforce dynamically; this guard catches
+    the sites tests never reach)."""
 
     def names_in(node) -> set:
         out = set()
@@ -471,7 +511,7 @@ def test_no_silent_exception_swallow_in_serve_or_resilience():
 
     offenders = []
     sites = 0
-    for sub in ("serve", "resilience"):
+    for sub in ("serve", "resilience", "fleet"):
         for py in sorted((PKG / sub).rglob("*.py")):
             rel = str(py.relative_to(PKG)).replace("\\", "/")
             tree = ast.parse(py.read_text(), filename=str(py))
