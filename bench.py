@@ -404,6 +404,18 @@ def _run_benchmark() -> dict:
     if tune:
         result["tune_s"] = {str(k): round(v, 3) for k, v in tune.items()}
 
+    # static-analysis posture (kindel_tpu.analysis): rule count, finding
+    # count, baseline state, and wall seconds, so the lint stage's cost
+    # is tracked like every other stage — and a round that ran with new
+    # findings outstanding says so in its provenance. Failure never
+    # voids the headline metric.
+    try:
+        from kindel_tpu.analysis import lint_provenance
+
+        result["lint"] = lint_provenance()
+    except Exception as e:  # noqa: BLE001
+        result["lint"] = {"error": repr(e)}
+
     # Shape-diverse serve scenario (kindel_tpu.ragged): the ROADMAP's
     # multi-sample regime — mixed contig/read lengths, some multi-ref
     # payloads — run through BOTH batch modes; the `ragged` object

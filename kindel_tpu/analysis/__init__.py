@@ -1,0 +1,37 @@
+"""kindel_tpu.analysis — whole-program lint engine (DESIGN.md §18).
+
+The production serve stack's own analyzer: a shared parsed-once
+project model (`model`), a rule engine with baseline discipline and
+text/JSON/SARIF output (`engine`), and a two-tier rule catalogue
+(`rules`) — migrated tier-1 hygiene guards plus whole-program
+analyses (trace-purity closure, lock discipline, future-settlement
+exactly-once, knob/metric doc conformance).
+
+Exposed as `kindel lint` and consumed by the tier-1 guard suite
+(tests/test_env_guard.py, now a thin driver over this engine)."""
+
+from kindel_tpu.analysis.engine import (  # noqa: F401
+    Finding,
+    LintReport,
+    default_baseline_path,
+    lint,
+)
+from kindel_tpu.analysis.model import (  # noqa: F401
+    ProjectModel,
+    build_project,
+    load_project,
+)
+
+
+def lint_provenance() -> dict:
+    """Small provenance object for bench.py's JSON line — the analysis
+    cost tracked like every other stage (rule count, finding count,
+    wall seconds)."""
+    report = lint(load_project(), baseline_path=default_baseline_path())
+    return {
+        "rules": len(report.results),
+        "findings": len(report.findings),
+        "new": len(report.new),
+        "stale_baseline": len(report.stale),
+        "wall_s": round(report.wall_s, 3),
+    }

@@ -1,0 +1,310 @@
+"""Rule engine: registry, findings, baseline discipline, formatters.
+
+A rule is a function ``(ProjectModel) -> (findings, sites)`` registered
+under a stable id. ``sites`` is the rule's own blindness counter — how
+many surfaces it actually inspected (jitted kernels seen, metric
+registrations seen, io/ modules walked). A rule whose site count falls
+below its declared ``min_sites`` emits a *finding against itself*
+(``detector blind``): a refactor that silently starves an analyzer of
+its inputs fails the build exactly like new debt would. This
+generalizes the old guard suite's ``jitted >= 8`` assertion into a
+per-rule contract.
+
+Baseline policy: ``tools/lint_baseline.json`` is the reviewed-and-
+frozen ledger of legacy findings. Finding identity is
+``(rule, path, message)`` — deliberately excluding the line number, so
+unrelated edits that shift a legacy finding do not churn the ledger —
+with per-key occurrence counts. New findings (count above baseline)
+always fail; baseline entries the tree no longer produces are *stale*
+and reported so the ledger burns down deliberately (``--strict`` fails
+on them, which is what keeps the file honest)."""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from kindel_tpu.analysis.model import ProjectModel
+
+SEVERITIES = ("error", "warning")
+
+#: repo-relative default baseline location
+BASELINE_REL = Path("tools") / "lint_baseline.json"
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    severity: str
+    path: str       # package-parent-relative, forward slashes
+    line: int
+    message: str
+
+    def key(self) -> tuple:
+        return (self.rule, self.path, self.message)
+
+    def as_dict(self) -> dict:
+        return {
+            "rule": self.rule, "severity": self.severity,
+            "path": self.path, "line": self.line, "message": self.message,
+        }
+
+
+@dataclass
+class RuleSpec:
+    id: str
+    severity: str
+    fn: object
+    min_sites: int
+    doc: str
+
+
+@dataclass
+class RuleResult:
+    spec: RuleSpec
+    findings: list
+    sites: int
+
+
+#: global rule registry (populated by importing kindel_tpu.analysis.rules)
+RULES: dict[str, RuleSpec] = {}
+
+
+def rule(rule_id: str, *, severity: str = "error", min_sites: int = 0):
+    """Register a rule function under a stable id."""
+    if severity not in SEVERITIES:
+        raise ValueError(f"unknown severity {severity!r}")
+
+    def deco(fn):
+        if rule_id in RULES:
+            raise ValueError(f"duplicate rule id {rule_id!r}")
+        RULES[rule_id] = RuleSpec(
+            rule_id, severity, fn, min_sites, (fn.__doc__ or "").strip()
+        )
+        return fn
+
+    return deco
+
+
+def _ensure_rules_loaded() -> None:
+    from kindel_tpu.analysis import rules  # noqa: F401  (registration)
+
+
+def run(model: ProjectModel, rule_ids=None,
+        check_blindness: bool = True) -> list:
+    """Run rules over a model. ``check_blindness`` applies the
+    ``min_sites`` floor (real tree: on; fixture corpora: off — a
+    three-file fixture legitimately has three sites)."""
+    _ensure_rules_loaded()
+    ids = sorted(RULES) if rule_ids is None else list(rule_ids)
+    results = []
+    for rid in ids:
+        spec = RULES[rid]
+        findings, sites = spec.fn(model)
+        findings = sorted(
+            findings, key=lambda f: (f.path, f.line, f.message)
+        )
+        if check_blindness and sites < spec.min_sites:
+            findings.append(Finding(
+                rule=rid, severity="error",
+                path=model.package, line=0,
+                message=(
+                    f"detector blind: only {sites} site(s) seen, "
+                    f"expected >= {spec.min_sites} — the rule lost its "
+                    "inputs, the codebase did not get clean"
+                ),
+            ))
+        results.append(RuleResult(spec, findings, sites))
+    return results
+
+
+def all_findings(results) -> list:
+    out = []
+    for r in results:
+        out.extend(r.findings)
+    return out
+
+
+# ---------------------------------------------------------------- baseline
+
+def load_baseline(path) -> dict:
+    """Baseline file -> {key tuple: count}. Missing file = empty."""
+    p = Path(path)
+    if not p.exists():
+        return {}
+    doc = json.loads(p.read_text())
+    out: dict[tuple, int] = {}
+    for e in doc.get("findings", ()):
+        key = (e["rule"], e["path"], e["message"])
+        out[key] = out.get(key, 0) + int(e.get("count", 1))
+    return out
+
+
+def write_baseline(path, findings) -> None:
+    counts: dict[tuple, int] = {}
+    for f in findings:
+        counts[f.key()] = counts.get(f.key(), 0) + 1
+    doc = {
+        "version": 1,
+        "policy": (
+            "reviewed-and-frozen legacy findings; new findings fail, "
+            "stale entries must be deleted (kindel lint --strict)"
+        ),
+        "findings": [
+            {"rule": k[0], "path": k[1], "message": k[2], "count": v}
+            for k, v in sorted(counts.items())
+        ],
+    }
+    Path(path).write_text(json.dumps(doc, indent=1) + "\n")
+
+
+def diff_baseline(findings, baseline: dict) -> tuple:
+    """-> (new_findings, stale_entries). A finding is new when its key
+    occurs more times than the baseline admits; a baseline entry is
+    stale when the tree now produces fewer occurrences than frozen."""
+    counts: dict[tuple, int] = {}
+    for f in findings:
+        counts[f.key()] = counts.get(f.key(), 0) + 1
+    new = []
+    seen: dict[tuple, int] = {}
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.message)):
+        k = f.key()
+        seen[k] = seen.get(k, 0) + 1
+        if seen[k] > baseline.get(k, 0):
+            new.append(f)
+    stale = [
+        {"rule": k[0], "path": k[1], "message": k[2],
+         "frozen": n, "present": counts.get(k, 0)}
+        for k, n in sorted(baseline.items())
+        if counts.get(k, 0) < n
+    ]
+    return new, stale
+
+
+# -------------------------------------------------------------- formatters
+
+def render_text(results, new, stale) -> str:
+    lines = []
+    for f in all_findings(results):
+        mark = "NEW " if f in new else ""
+        lines.append(
+            f"{f.path}:{f.line}: {mark}[{f.rule}] {f.message}"
+        )
+    for e in stale:
+        lines.append(
+            f"stale baseline entry [{e['rule']}] {e['path']}: "
+            f"{e['message']} (frozen {e['frozen']}, present "
+            f"{e['present']}) — delete it from the baseline"
+        )
+    total = len(all_findings(results))
+    lines.append(
+        f"{len(RULES)} rules, {total} finding(s), {len(new)} new, "
+        f"{len(stale)} stale baseline entr(ies)"
+    )
+    return "\n".join(lines)
+
+
+def render_json(results, new, stale, wall_s: float | None = None) -> str:
+    doc = {
+        "rules": {
+            r.spec.id: {
+                "severity": r.spec.severity,
+                "sites": r.sites,
+                "findings": len(r.findings),
+            }
+            for r in results
+        },
+        "findings": [f.as_dict() for f in all_findings(results)],
+        "new": [f.as_dict() for f in new],
+        "stale": stale,
+    }
+    if wall_s is not None:
+        doc["wall_s"] = round(wall_s, 3)
+    return json.dumps(doc, indent=1)
+
+
+def render_sarif(results, new, stale) -> str:
+    """Minimal SARIF 2.1.0 document — one run, one driver, every finding
+    a result (baselined findings carry baselineState so viewers can
+    filter to the new ones)."""
+    new_set = set()
+    for f in new:
+        new_set.add(id(f))
+    sarif_results = []
+    for f in all_findings(results):
+        sarif_results.append({
+            "ruleId": f.rule,
+            "level": f.severity,
+            "baselineState": "new" if id(f) in new_set else "unchanged",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": max(f.line, 1)},
+                },
+            }],
+        })
+    doc = {
+        "$schema": (
+            "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+            "master/Schemata/sarif-schema-2.1.0.json"
+        ),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "kindel-lint",
+                    "informationUri": "docs/DESIGN.md#18",
+                    "rules": [
+                        {
+                            "id": r.spec.id,
+                            "shortDescription": {
+                                "text": r.spec.doc.split("\n")[0]
+                                or r.spec.id
+                            },
+                        }
+                        for r in results
+                    ],
+                },
+            },
+            "results": sarif_results,
+        }],
+    }
+    return json.dumps(doc, indent=1)
+
+
+# ------------------------------------------------------------ entry points
+
+@dataclass
+class LintReport:
+    results: list
+    new: list
+    stale: list
+    wall_s: float
+
+    @property
+    def findings(self) -> list:
+        return all_findings(self.results)
+
+    def ok(self, strict: bool = False) -> bool:
+        return not self.new and not (strict and self.stale)
+
+
+def lint(model: ProjectModel, baseline_path=None,
+         check_blindness: bool = True) -> LintReport:
+    """One full engine pass: run every rule, diff against the baseline."""
+    t0 = time.perf_counter()
+    results = run(model, check_blindness=check_blindness)
+    baseline = (
+        load_baseline(baseline_path) if baseline_path is not None else {}
+    )
+    new, stale = diff_baseline(all_findings(results), baseline)
+    return LintReport(results, new, stale, time.perf_counter() - t0)
+
+
+def default_baseline_path() -> Path:
+    from kindel_tpu.analysis.model import DEFAULT_PACKAGE
+
+    return DEFAULT_PACKAGE.parent / BASELINE_REL

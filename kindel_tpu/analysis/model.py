@@ -1,0 +1,368 @@
+"""Shared project model for the static-analysis engine.
+
+One parse per file, ever: the model walks every first-party module
+once, keeps the ASTs, and derives the facts the rules share —
+
+  * a function index (every ``FunctionDef`` anywhere in a module,
+    including methods and nested kernels) with decorator vocabulary
+    and the set of simple names it calls;
+  * an over-approximate intra-package call graph keyed by dotted /
+    attribute simple name, with resolution preference same class >
+    same module > anywhere in the package (a call we cannot resolve
+    is simply absent — rules over-approximate, they never crash);
+  * per-class lock facts: which ``self.X`` attributes hold a
+    ``threading.Lock``/``RLock``/``Condition``, and which Condition
+    wraps which lock (``Condition(self._lock)`` aliases the lock);
+  * module-level locks, for the acquisition-order graph.
+
+The model is the tier-1 perf fix as much as an analysis substrate:
+the old guard suite re-read and re-parsed the whole package once per
+test (13 full passes); ``load_project()`` memoizes per root so the
+entire rule set — and the in-process ``kindel lint`` CLI — runs off
+exactly one parse per file. ``parse_count`` exists so a test can pin
+that invariant instead of trusting it.
+"""
+
+from __future__ import annotations
+
+import ast
+import threading
+from dataclasses import dataclass, field
+from pathlib import Path
+
+#: threading factories whose result makes a ``self.X`` attribute a lock
+LOCK_FACTORIES = {"Lock", "RLock", "Semaphore", "BoundedSemaphore"}
+
+#: attribute-call names too generic to resolve across the package: a
+#: ``d.get(k)`` / ``s.add(x)`` / ``f.flush()`` on a builtin container or
+#: file object would alias onto unrelated first-party methods and
+#: fabricate call-graph edges. Plain-name calls and ``self.m()`` calls
+#: are never filtered — only attribute calls on unknown receivers.
+GENERIC_METHOD_NAMES = {
+    "add", "append", "appendleft", "acquire", "cancel", "clear", "close",
+    "copy", "count", "dec", "discard", "done", "extend", "flush", "get",
+    "inc", "index", "info", "insert", "items", "join", "keys", "labels",
+    "notify", "notify_all", "observe", "pop", "popleft", "put", "read",
+    "recv", "release", "remove", "render", "result", "send", "set",
+    "setdefault", "snapshot", "sort", "split", "start", "stop", "strip",
+    "sum", "update", "values", "wait", "write",
+}
+
+
+def dotted_parts(node) -> set:
+    """Every Name id / Attribute attr reachable in an expression — enough
+    to recognize jit in ``jax.jit``, ``jit``, ``partial(jax.jit, ...)``,
+    ``functools.partial(jit, static_argnames=...)``."""
+    out = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+        elif isinstance(n, ast.Attribute):
+            out.add(n.attr)
+    return out
+
+
+def call_name(call: ast.Call) -> str | None:
+    """The simple name a call dispatches on: ``f(...)`` -> f,
+    ``self.g(...)`` / ``mod.g(...)`` -> g."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+@dataclass
+class FunctionInfo:
+    """One function or method, anywhere in a module (nested included)."""
+
+    rel: str                      # module path relative to package parent
+    name: str
+    qualname: str                 # "rel::Class.name" — unique per model
+    cls: str | None               # enclosing class, when a direct method
+    node: ast.AST
+    decorators: frozenset
+    name_calls: frozenset         # plain `f(...)` call names
+    self_calls: frozenset         # `self.m(...)` call names
+    attr_calls: frozenset         # `obj.m(...)` on other receivers
+
+    @property
+    def calls(self) -> frozenset:
+        return self.name_calls | self.self_calls | self.attr_calls
+
+    @property
+    def jit(self) -> bool:
+        return "jit" in self.decorators
+
+    @property
+    def lineno(self) -> int:
+        return self.node.lineno
+
+
+@dataclass
+class ClassInfo:
+    rel: str
+    name: str
+    node: ast.ClassDef
+    bases: tuple = ()                             # base-class simple names
+    methods: dict = field(default_factory=dict)   # name -> FunctionInfo
+    lock_attrs: set = field(default_factory=set)  # self attrs that ARE locks
+    cond_alias: dict = field(default_factory=dict)  # cond attr -> lock attr
+
+    def lock_names(self) -> set:
+        """Every attribute whose ``with self.X`` means 'the class lock is
+        held' — the locks themselves plus their Condition wrappers."""
+        return self.lock_attrs | set(self.cond_alias)
+
+    def canonical_lock(self, attr: str) -> str | None:
+        """The underlying lock identity for a lock-or-condition attr
+        (``Condition(self._lock)`` and ``self._lock`` are one lock)."""
+        if attr in self.cond_alias:
+            return self.cond_alias[attr]
+        if attr in self.lock_attrs:
+            return attr
+        return None
+
+
+@dataclass
+class ModuleInfo:
+    rel: str
+    path: Path
+    tree: ast.Module
+
+
+class ProjectModel:
+    """Parsed-once view of one Python package tree."""
+
+    def __init__(self, package_dir: Path, docs_dir: Path | None = None):
+        self.package_dir = Path(package_dir).resolve()
+        self.package = self.package_dir.name
+        self.docs_dir = (
+            Path(docs_dir).resolve() if docs_dir is not None
+            else self.package_dir.parent / "docs"
+        )
+        self.modules: dict[str, ModuleInfo] = {}
+        self.functions: list[FunctionInfo] = []
+        self.by_simple_name: dict[str, list[FunctionInfo]] = {}
+        self.by_module: dict[str, list[FunctionInfo]] = {}
+        self.classes: dict[tuple, ClassInfo] = {}
+        self.module_locks: dict[str, set] = {}
+        self.parse_count = 0
+        self._usage_text: str | None = None
+        self._build()
+
+    # ------------------------------------------------------------ build
+
+    def _build(self) -> None:
+        for py in sorted(self.package_dir.rglob("*.py")):
+            rel = str(py.relative_to(self.package_dir.parent)).replace(
+                "\\", "/"
+            )
+            tree = ast.parse(py.read_text(), filename=str(py))
+            self.parse_count += 1
+            self.modules[rel] = ModuleInfo(rel, py, tree)
+            self._index_module(rel, tree)
+
+    def _index_module(self, rel: str, tree: ast.Module) -> None:
+        # module-level locks (acquisition-order graph nodes)
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                if dotted_parts(node.value.func) & LOCK_FACTORIES:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            self.module_locks.setdefault(rel, set()).add(
+                                tgt.id
+                            )
+
+        def visit(node, cls: str | None):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    bases = tuple(
+                        b.id if isinstance(b, ast.Name)
+                        else b.attr if isinstance(b, ast.Attribute)
+                        else ""
+                        for b in child.bases
+                    )
+                    info = ClassInfo(rel, child.name, child, bases)
+                    self.classes[(rel, child.name)] = info
+                    visit(child, child.name)
+                elif isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    owner = cls if isinstance(node, ast.ClassDef) else None
+                    self._index_function(rel, child, owner)
+                    visit(child, None)
+                else:
+                    visit(child, cls)
+
+        visit(tree, None)
+        # class lock facts need the method index, so a second class pass
+        for (mrel, _), cinfo in self.classes.items():
+            if mrel == rel and not cinfo.lock_attrs and not cinfo.cond_alias:
+                self._infer_lock_facts(cinfo)
+
+    def _index_function(self, rel: str, node, cls: str | None) -> None:
+        qual = f"{rel}::{cls + '.' if cls else ''}{node.name}@{node.lineno}"
+        name_calls, self_calls, attr_calls = set(), set(), set()
+        for n in ast.walk(node):
+            if not isinstance(n, ast.Call):
+                continue
+            f = n.func
+            if isinstance(f, ast.Name):
+                name_calls.add(f.id)
+            elif isinstance(f, ast.Attribute):
+                if isinstance(f.value, ast.Name) and f.value.id in (
+                    "self", "cls",
+                ):
+                    self_calls.add(f.attr)
+                else:
+                    attr_calls.add(f.attr)
+        deco = set()
+        for d in node.decorator_list:
+            deco |= dotted_parts(d)
+        info = FunctionInfo(
+            rel=rel, name=node.name, qualname=qual, cls=cls, node=node,
+            decorators=frozenset(deco), name_calls=frozenset(name_calls),
+            self_calls=frozenset(self_calls),
+            attr_calls=frozenset(attr_calls),
+        )
+        self.functions.append(info)
+        self.by_simple_name.setdefault(node.name, []).append(info)
+        self.by_module.setdefault(rel, []).append(info)
+        if cls is not None:
+            cinfo = self.classes.get((rel, cls))
+            if cinfo is not None and node.name not in cinfo.methods:
+                cinfo.methods[node.name] = info
+
+    def _infer_lock_facts(self, cinfo: ClassInfo) -> None:
+        """``self.X = threading.Lock()`` makes X a lock;
+        ``self.X = threading.Condition(self.Y)`` aliases X to lock Y;
+        a bare ``Condition()`` is its own lock."""
+        for m in cinfo.methods.values():
+            for n in ast.walk(m.node):
+                if not (
+                    isinstance(n, ast.Assign)
+                    and isinstance(n.value, ast.Call)
+                ):
+                    continue
+                parts = dotted_parts(n.value.func)
+                for tgt in n.targets:
+                    if not (
+                        isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                    ):
+                        continue
+                    if parts & LOCK_FACTORIES:
+                        cinfo.lock_attrs.add(tgt.attr)
+                    elif "Condition" in parts:
+                        wrapped = None
+                        if n.value.args:
+                            a = n.value.args[0]
+                            if (
+                                isinstance(a, ast.Attribute)
+                                and isinstance(a.value, ast.Name)
+                                and a.value.id == "self"
+                            ):
+                                wrapped = a.attr
+                        if wrapped is not None:
+                            cinfo.cond_alias[tgt.attr] = wrapped
+                        else:
+                            cinfo.lock_attrs.add(tgt.attr)
+
+    # ------------------------------------------------------- call graph
+
+    def _method_lookup(self, cinfo: ClassInfo | None, name: str,
+                       depth: int = 0):
+        """Method resolution walking same-name base classes (bounded)."""
+        if cinfo is None or depth > 4:
+            return None
+        if name in cinfo.methods:
+            return cinfo.methods[name]
+        for base in cinfo.bases:
+            for (rel2, cname), binfo in self.classes.items():
+                if cname == base:
+                    hit = self._method_lookup(binfo, name, depth + 1)
+                    if hit is not None:
+                        return hit
+        return None
+
+    def resolve_calls(self, fn: FunctionInfo) -> list:
+        """Over-approximate callee set for one function.
+
+        Plain-name calls resolve same module > package; ``self.m()``
+        resolves through the class (and same-name base classes);
+        attribute calls on unknown receivers resolve package-wide by
+        simple name *unless* the name is generic (GENERIC_METHOD_NAMES)
+        — ``d.get(k)`` must not alias onto ``RequestQueue.get``."""
+        out = []
+        cinfo = self.classes.get((fn.rel, fn.cls)) if fn.cls else None
+        for name in sorted(fn.name_calls):
+            same_mod = [
+                f for f in self.by_module.get(fn.rel, ())
+                if f.name == name and f.cls is None
+            ]
+            out.extend(same_mod or self.by_simple_name.get(name, ()))
+        for name in sorted(fn.self_calls):
+            hit = self._method_lookup(cinfo, name)
+            if hit is not None:
+                out.append(hit)
+        for name in sorted(fn.attr_calls):
+            if name in GENERIC_METHOD_NAMES or name.startswith("__"):
+                continue
+            out.extend(self.by_simple_name.get(name, ()))
+        return out
+
+    def reachable(self, entry: FunctionInfo) -> list:
+        """Transitive call-graph closure from one function (entry first,
+        each function once, deterministic order)."""
+        seen = {entry.qualname}
+        order = [entry]
+        stack = [entry]
+        while stack:
+            fn = stack.pop()
+            for callee in self.resolve_calls(fn):
+                if callee.qualname not in seen:
+                    seen.add(callee.qualname)
+                    order.append(callee)
+                    stack.append(callee)
+        return order
+
+    # ------------------------------------------------------------- docs
+
+    def usage_text(self) -> str:
+        """docs/usage.md contents ('' when absent) — the conformance
+        rules' doc surface, read once."""
+        if self._usage_text is None:
+            path = self.docs_dir / "usage.md"
+            self._usage_text = (
+                path.read_text() if path.exists() else ""
+            )
+        return self._usage_text
+
+
+_CACHE: dict[Path, ProjectModel] = {}
+_CACHE_LOCK = threading.Lock()
+
+#: the first-party package this repo ships (default lint target)
+DEFAULT_PACKAGE = Path(__file__).resolve().parent.parent
+
+
+def build_project(package_dir, docs_dir=None) -> ProjectModel:
+    """Uncached model build (fixture corpora, mutation tests)."""
+    return ProjectModel(Path(package_dir), docs_dir)
+
+
+def load_project(package_dir=None) -> ProjectModel:
+    """Memoized model for a package tree — every rule, every guard test,
+    and the in-process CLI share one parse per file per process."""
+    root = Path(package_dir or DEFAULT_PACKAGE).resolve()
+    with _CACHE_LOCK:
+        model = _CACHE.get(root)
+        if model is None:
+            model = ProjectModel(root)
+            _CACHE[root] = model
+        return model

@@ -1,0 +1,12 @@
+"""Rule catalogue — importing this package registers every rule with
+the engine. Two tiers: migrated tier-1 hygiene guards (hygiene), and
+whole-program analyses the flat guards could not express (purity,
+locks, futures, conformance). DESIGN.md §18 is the narrative index."""
+
+from kindel_tpu.analysis.rules import (  # noqa: F401  (registration)
+    conformance,
+    futures,
+    hygiene,
+    locks,
+    purity,
+)

@@ -1,0 +1,207 @@
+"""Knob/doc and metric/doc conformance — drift fails the build in
+whichever direction it occurs:
+
+* every ``KINDEL_TPU_*`` string referenced in code must have a tuning
+  resolution path (its literal appears in tune.py, the one-rule
+  resolution module) **or** be a declared mode gate (NON_TUNING_KNOBS,
+  each with a reason), and must have a row in docs/usage.md;
+* every ``KINDEL_TPU_*`` token in docs/usage.md must be read by code
+  (or be a declared bench-harness knob — DOC_ONLY_KNOBS);
+* every metric name registered through an obs registry must appear in
+  docs/usage.md; every ``kindel_*`` metric token in docs/usage.md must
+  correspond to a registered metric (exact, family prefix, or a
+  histogram-series suffix).
+
+Doc tables are part of the contract surface: an operator reading
+usage.md must see every knob that exists and no knob that does not."""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from kindel_tpu.analysis.engine import Finding, rule
+from kindel_tpu.analysis.model import ProjectModel
+
+#: knobs that are deliberate mode gates, not perf knobs — they never get
+#: a TuningConfig field, and each carries its reason for the reviewer
+NON_TUNING_KNOBS = {
+    "KINDEL_TPU_FAULTS": "fault-injection activation (resilience)",
+    "KINDEL_TPU_PROGRESS": "stderr progress reporting toggle",
+    "KINDEL_TPU_TRACE_DIR": "XLA profiler trace destination",
+    "KINDEL_TPU_COMPILE_CACHE": "XLA compile-cache location/gate",
+    "KINDEL_TPU_TUNE_CACHE": "tune-store location/gate (read by tune.py)",
+    "KINDEL_TPU_FORCE_FUSED": "single-chip kernel pin (disables sharding)",
+    "KINDEL_TPU_RAGGED_PALLAS": "Pallas segment-reduction gate",
+    "KINDEL_TPU_AOT_CACHE_MB": "serialized-executable store size cap",
+    "KINDEL_TPU_NO_NATIVE_BUILD": "native-kernel build gate",
+    "KINDEL_TPU_DISABLE_NATIVE": "native-kernel runtime gate",
+    "KINDEL_TPU_DENSE_STATS": "stats engine selection gate",
+    "KINDEL_TPU_COMPACT_STATS": "stats engine selection gate",
+    "KINDEL_TPU_COMPACT_WIRE": "compact wire-format gate",
+}
+
+#: knobs documented in usage.md but read outside the package (bench
+#: harness opt-ins) — legal in docs without an in-package read
+DOC_ONLY_KNOBS = {
+    "KINDEL_TPU_BENCH_SERVE": "bench.py serve-load opt-in",
+    "KINDEL_TPU_BENCH_RAGGED": "bench.py ragged-scenario opt-in",
+}
+
+#: suffixes a doc token may add to a registered histogram name
+_HIST_SUFFIXES = {"", "_bucket", "_sum", "_count", "_max", "_p50", "_p99"}
+
+
+def _docstring_nodes(tree) -> set:
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(
+            node,
+            (ast.Module, ast.ClassDef, ast.FunctionDef,
+             ast.AsyncFunctionDef),
+        ):
+            body = node.body
+            if (
+                body
+                and isinstance(body[0], ast.Expr)
+                and isinstance(body[0].value, ast.Constant)
+                and isinstance(body[0].value.value, str)
+            ):
+                out.add(id(body[0].value))
+    return out
+
+
+def _knob_refs(model: ProjectModel, knob_re) -> dict:
+    """knob -> (rel, line) of first non-docstring reference, per module
+    set of knobs for the tune.py containment check."""
+    refs: dict[str, tuple] = {}
+    per_module: dict[str, set] = {}
+    analysis_prefix = f"{model.package}/analysis/"
+    for rel, mod in sorted(model.modules.items()):
+        if rel.startswith(analysis_prefix):
+            continue  # the analyzer's own vocabulary is not a read
+        doc_ids = _docstring_nodes(mod.tree)
+        for node in ast.walk(mod.tree):
+            if not (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+            ):
+                continue
+            if id(node) in doc_ids:
+                continue
+            for m in knob_re.finditer(node.value):
+                name = m.group(0)
+                refs.setdefault(name, (rel, node.lineno))
+                per_module.setdefault(rel, set()).add(name)
+    return refs, per_module
+
+
+@rule("knob-doc", min_sites=10)
+def knob_doc(model: ProjectModel):
+    """Every env knob read in code is documented and has a resolution
+    story; every knob in the docs exists in code."""
+    prefix = model.package.upper() + "_"
+    knob_re = re.compile(re.escape(prefix) + r"[A-Z0-9_]+")
+    refs, per_module = _knob_refs(model, knob_re)
+    usage = model.usage_text()
+    tune_rel = f"{model.package}/tune.py"
+    tune_knobs = per_module.get(tune_rel, set())
+    findings = []
+    for name, (rel, line) in sorted(refs.items()):
+        if name not in usage:
+            findings.append(Finding(
+                "knob-doc", "error", rel, line,
+                f"env knob {name} is read in code but has no row in "
+                "docs/usage.md — document it or remove the read",
+            ))
+        if name not in tune_knobs and name not in NON_TUNING_KNOBS:
+            findings.append(Finding(
+                "knob-doc", "error", rel, line,
+                f"env knob {name} has no TuningConfig resolution path "
+                "(not referenced by tune.py) and is not a declared "
+                "mode gate (NON_TUNING_KNOBS) — route it through "
+                "kindel_tpu.tune or declare it with a reason",
+            ))
+    for m in knob_re.finditer(usage):
+        name = m.group(0)
+        if name not in refs and name not in DOC_ONLY_KNOBS:
+            findings.append(Finding(
+                "knob-doc", "error", "docs/usage.md",
+                usage.count("\n", 0, m.start()) + 1,
+                f"env knob {name} is documented in usage.md but nothing "
+                "in the package reads it — stale doc row",
+            ))
+    return findings, len(refs)
+
+
+def _registered_metrics(model: ProjectModel) -> dict:
+    """metric name -> (rel, line) of first registration call."""
+    out: dict[str, tuple] = {}
+    analysis_prefix = f"{model.package}/analysis/"
+    for rel, mod in sorted(model.modules.items()):
+        if rel.startswith(analysis_prefix):
+            continue
+        for node in ast.walk(mod.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("counter", "gauge", "histogram",
+                                       "info")
+            ):
+                continue
+            if not (
+                node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                continue
+            name = node.args[0].value
+            if re.fullmatch(r"kindel_[a-z0-9_:]+", name):
+                out.setdefault(name, (rel, node.lineno))
+    return out
+
+
+@rule("metric-doc", min_sites=40)
+def metric_doc(model: ProjectModel):
+    """Every registered metric appears in docs/usage.md; every metric
+    token in usage.md corresponds to a registered metric."""
+    registered = _registered_metrics(model)
+    usage = model.usage_text()
+    findings = []
+    for name, (rel, line) in sorted(registered.items()):
+        if name not in usage:
+            findings.append(Finding(
+                "metric-doc", "error", rel, line,
+                f"metric {name} is registered but absent from "
+                "docs/usage.md — add it to the metrics reference table",
+            ))
+
+    def token_ok(token: str) -> bool:
+        t = token.rstrip("_")
+        if t == model.package:
+            return True  # the package name itself (module paths in prose)
+        if t in registered:
+            return True
+        if token.endswith("_") and any(
+            r.startswith(token) or r == t for r in registered
+        ):
+            return True  # family-prefix mention (kindel_fleet_…)
+        for r in registered:
+            if t.startswith(r) and t[len(r):] in _HIST_SUFFIXES:
+                return True  # histogram series (…_bucket/_p99)
+        return False
+
+    seen_doc = set()
+    for m in re.finditer(r"kindel_[a-z0-9_]+", usage):
+        token = m.group(0)
+        if token in seen_doc:
+            continue
+        seen_doc.add(token)
+        if not token_ok(token):
+            findings.append(Finding(
+                "metric-doc", "error", "docs/usage.md",
+                usage.count("\n", 0, m.start()) + 1,
+                f"metric token {token} in usage.md matches no "
+                "registered metric — stale doc row or typo",
+            ))
+    return findings, len(registered)

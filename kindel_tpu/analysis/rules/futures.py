@@ -1,0 +1,222 @@
+"""Future-settlement exactly-once: every function in serve/ + fleet/
+that *creates* a Future (directly, or wrapped in a ServeRequest) must,
+on **every** exit path — normal returns, early returns, and every
+except arm — do one of:
+
+  * settle it (``set_result`` / ``set_exception`` / ``cancel``),
+  * hand it back (return/yield it, store it into shared state, pass it
+    to a call — ownership transferred, the receiver settles), or
+  * re-raise (the caller owns the failure).
+
+This upgrades the silent-swallow lint from "some handler exists" to
+"all paths covered": a ``try: dispatch() except Exception: pass`` that
+leaks a created future passes the swallow rule's handler-recognizer
+shape but still strands a waiter forever — the exact bug class the
+"no admitted request lost / settled exactly once" contract (PRs 4+8)
+exists to prevent.
+
+The analysis is a structural path interpreter over the statement tree
+(if/try/loop/with), conservative about exceptions: a handler is
+assumed enterable with the future created but *not yet* settled (the
+throw may have happened first)."""
+
+from __future__ import annotations
+
+import ast
+
+from kindel_tpu.analysis.engine import Finding, rule
+from kindel_tpu.analysis.model import ProjectModel
+
+#: packages holding the settled-exactly-once contract
+FUTURE_SCOPE = ("serve", "fleet")
+
+#: constructors whose result is (or owns) a fresh unsettled Future
+_CREATORS = {"Future", "ServeRequest"}
+
+#: methods that settle a future
+_SETTLERS = {"set_result", "set_exception", "cancel"}
+
+
+def _creates_future(stmt) -> list:
+    """Variable names bound to a fresh Future by this statement."""
+    out = []
+    if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+        f = stmt.value.func
+        name = (
+            f.id if isinstance(f, ast.Name)
+            else f.attr if isinstance(f, ast.Attribute) else None
+        )
+        if name in _CREATORS:
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name):
+                    out.append(tgt.id)
+    return out
+
+
+def _mentions(node, var: str) -> bool:
+    return any(
+        isinstance(n, ast.Name) and n.id == var for n in ast.walk(node)
+    )
+
+
+def _settles(stmt, var: str) -> bool:
+    """True when this statement (anywhere inside it, nested defs
+    included — a closure that settles later still owns the future)
+    settles var or transfers its ownership."""
+    for n in ast.walk(stmt):
+        if isinstance(n, ast.Call):
+            # var.settle(...) / var.future.settle(...)
+            f = n.func
+            if isinstance(f, ast.Attribute) and f.attr in _SETTLERS:
+                if _mentions(f.value, var):
+                    return True
+            # handed to a call: f(var) / obj.m(var, ...) / f(x=var)
+            for arg in list(n.args) + [kw.value for kw in n.keywords]:
+                if _mentions(arg, var):
+                    return True
+        elif isinstance(n, (ast.Return, ast.Yield, ast.YieldFrom)):
+            if n.value is not None and _mentions(n.value, var):
+                return True
+        elif isinstance(n, ast.Assign):
+            # stored into shared state: self.x = var / d[k] = var
+            if _mentions(n.value, var):
+                for tgt in n.targets:
+                    if isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                        return True
+    return False
+
+
+class _PathState:
+    __slots__ = ("created", "settled")
+
+    def __init__(self, created=False, settled=False):
+        self.created = created
+        self.settled = settled
+
+    def copy(self):
+        return _PathState(self.created, self.settled)
+
+
+def _analyze(fn_node, var: str) -> list:
+    """Lines where a path exits with `var` created but unsettled."""
+    violations = []
+
+    def exit_check(state, line):
+        if state.created and not state.settled:
+            violations.append(line)
+
+    def run(stmts, state) -> list:
+        """Process a statement list; return the list of fall-through
+        states (empty when every path returns/raises)."""
+        states = [state]
+        for stmt in stmts:
+            nxt = []
+            for s in states:
+                nxt.extend(step(stmt, s))
+            states = nxt
+            if not states:
+                break
+        return states
+
+    def step(stmt, state) -> list:
+        s = state.copy()
+        created_here = _creates_future(stmt)
+        if var in created_here:
+            s.created, s.settled = True, False
+            # the creating statement may itself hand off (x = Future();
+            # later stmts handle the rest)
+            if _settles(stmt, var):
+                s.settled = True
+            return [s]
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None and _mentions(stmt.value, var):
+                s.settled = True
+            exit_check(s, stmt.lineno)
+            return []
+        if isinstance(stmt, ast.Raise):
+            return []  # propagates: the caller owns the failure
+        if isinstance(stmt, ast.If):
+            return run(stmt.body, s) + run(stmt.orelse, s.copy())
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            zero = s.copy()
+            once = run(stmt.body, s.copy())
+            after = [zero] + once
+            out = []
+            for a in after:
+                out.extend(run(stmt.orelse, a) if stmt.orelse else [a])
+            return out
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            if any(_settles(item.context_expr, var)
+                   for item in stmt.items):
+                s.settled = True
+            return run(stmt.body, s)
+        if isinstance(stmt, ast.Try):
+            body_creates = any(
+                var in _creates_future(inner)
+                for inner in ast.walk(stmt)
+                if isinstance(inner, ast.stmt)
+            )
+            body_out = run(stmt.body, s.copy())
+            ok_out = []
+            for b in body_out:
+                ok_out.extend(run(stmt.orelse, b) if stmt.orelse else [b])
+            # conservative handler-entry state: the exception may have
+            # fired after creation but before any settle in the body
+            handler_entry = s.copy()
+            if body_creates:
+                handler_entry.created, handler_entry.settled = True, False
+            for handler in stmt.handlers:
+                ok_out.extend(run(handler.body, handler_entry.copy()))
+            if stmt.finalbody:
+                final_out = []
+                for o in ok_out:
+                    final_out.extend(run(stmt.finalbody, o))
+                # uncaught-exception path through finally: propagates,
+                # but the finally body may still settle — and if it
+                # does not, propagation counts as re-raise (ok)
+                run(stmt.finalbody, handler_entry.copy())
+                return final_out
+            return ok_out
+        if isinstance(
+            stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            # a nested def that captures and settles the future counts
+            # as ownership transfer at definition time
+            if _settles(stmt, var):
+                s.settled = True
+            return [s]
+        if isinstance(stmt, (ast.Break, ast.Continue)):
+            return [s]  # loop approximation: falls through
+        if _settles(stmt, var):
+            s.settled = True
+        return [s]
+
+    for s in run(list(fn_node.body), _PathState()):
+        exit_check(s, getattr(fn_node, "end_lineno", fn_node.lineno))
+    return violations
+
+
+@rule("future-settlement", min_sites=1)
+def future_settlement(model: ProjectModel):
+    """Path-sensitive exactly-once settlement for serve/ + fleet/."""
+    findings, sites = [], 0
+    for fn in model.functions:
+        parts = fn.rel.split("/")
+        if len(parts) < 2 or parts[1] not in FUTURE_SCOPE:
+            continue
+        created = set()
+        for n in ast.walk(fn.node):
+            if isinstance(n, ast.stmt):
+                created.update(_creates_future(n))
+        for var in sorted(created):
+            sites += 1
+            lines = _analyze(fn.node, var)
+            if lines:
+                owner = f"{fn.cls}.{fn.name}" if fn.cls else fn.name
+                findings.append(Finding(
+                    "future-settlement", "error", fn.rel, min(lines),
+                    f"future `{var}` created in `{owner}` can exit "
+                    "unsettled: some path neither settles it, hands it "
+                    "back, nor re-raises — a waiter would block forever",
+                ))
+    return findings, sites

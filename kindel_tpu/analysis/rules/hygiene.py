@@ -1,0 +1,419 @@
+"""Migrated tier-1 hygiene guards (formerly flat AST checks in
+tests/test_env_guard.py), re-expressed as rules over the shared project
+model. Semantics are preserved check-for-check — same recognizers, same
+allowlists — so the same offenders are detected; what changed is that
+every rule now reads the one cached parse instead of re-reading the
+package, and blindness floors are engine-enforced ``min_sites``
+contracts instead of ad-hoc asserts."""
+
+from __future__ import annotations
+
+import ast
+
+from kindel_tpu.analysis.engine import Finding, rule
+from kindel_tpu.analysis.model import ProjectModel, dotted_parts
+
+
+def _env_read_lines(fn) -> list:
+    hits = []
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Attribute) and n.attr == "environ":
+            hits.append(n.lineno)
+        elif isinstance(n, ast.Call):
+            f = n.func
+            if (isinstance(f, ast.Attribute) and f.attr == "getenv") or (
+                isinstance(f, ast.Name) and f.id == "getenv"
+            ):
+                hits.append(n.lineno)
+    return hits
+
+
+def _enclosing_functions(tree) -> dict:
+    out = {}
+
+    def visit(node, fname):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fname = node.name
+        out[node] = fname
+        for child in ast.iter_child_nodes(node):
+            visit(child, fname)
+
+    visit(tree, "<module>")
+    return out
+
+
+@rule("jit-env-read", min_sites=8)
+def jit_env_read(model: ProjectModel):
+    """No ``os.environ`` / ``os.getenv`` read inside a jit-decorated
+    body: tuning knobs resolve at config-build time (kindel_tpu.tune),
+    never at trace time — a traced env read only runs once and then the
+    knob silently stops responding, while compiled behavior depends on
+    ambient state the compile cache key does not capture."""
+    findings, jitted = [], 0
+    for fn in model.functions:
+        if not fn.jit:
+            continue
+        jitted += 1
+        for line in _env_read_lines(fn.node):
+            findings.append(Finding(
+                "jit-env-read", "error", fn.rel, line,
+                f"os.environ read inside jitted `{fn.name}` — resolve "
+                "the knob at config-build time (kindel_tpu.tune)",
+            ))
+    return findings, jitted
+
+
+@rule("init-env-read", min_sites=10)
+def init_env_read(model: ProjectModel):
+    """No env read inside ``__init__`` either: instrumented classes
+    (PhaseTimer, tracers, workers) must resolve env state where it is
+    used, never cache it at construction — an env var exported between
+    construction and use must win (the PhaseTimer trace-dir bug)."""
+    findings, inits = [], 0
+    for fn in model.functions:
+        if fn.name != "__init__" or fn.cls is None:
+            continue
+        inits += 1
+        for line in _env_read_lines(fn.node):
+            findings.append(Finding(
+                "init-env-read", "error", fn.rel, line,
+                f"os.environ read cached in {fn.cls}.__init__ — resolve "
+                "it where it is used instead",
+            ))
+    return findings, inits
+
+
+#: wall-clock *timestamps* (not durations) where time.time() is the
+#: point: the tune store's recorded_at field is read by humans
+TIME_TIME_ALLOWLIST = {("tune.py", "record")}
+
+
+@rule("time-time-duration", min_sites=1)
+def time_time_duration(model: ProjectModel):
+    """Durations come from ``time.perf_counter()`` — ``time.time()`` is
+    a wall clock subject to NTP steps, and a negative "duration" in a
+    span or latency histogram is a debugging rabbit hole. Timestamp
+    uses must be allowlisted explicitly (TIME_TIME_ALLOWLIST)."""
+    findings, sites = [], 0
+    for rel, mod in model.modules.items():
+        owners = _enclosing_functions(mod.tree)
+        basename = rel.rsplit("/", 1)[-1]
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (
+                isinstance(f, ast.Attribute)
+                and f.attr == "time"
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "time"
+            ):
+                continue
+            sites += 1
+            owner = owners.get(node, "<module>")
+            if (basename, owner) in TIME_TIME_ALLOWLIST:
+                continue
+            findings.append(Finding(
+                "time-time-duration", "error", rel, node.lineno,
+                f"time.time() in {owner} — use time.perf_counter() for "
+                "durations, or allowlist a genuine timestamp",
+            ))
+    return findings, sites
+
+
+@rule("metric-help-text", min_sites=15)
+def metric_help_text(model: ProjectModel):
+    """Every ``.counter/.gauge/.histogram/.info`` registration passes
+    non-empty help text (second positional arg or ``help_text=``) — the
+    exposition renders ``# HELP`` verbatim and a blank one is useless
+    to whoever is staring at the dashboard. Also enforced at runtime by
+    MetricsRegistry; the static rule catches sites tests never run."""
+    findings, registrations = [], 0
+    for rel, mod in model.modules.items():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (
+                isinstance(f, ast.Attribute)
+                and f.attr in ("counter", "gauge", "histogram", "info")
+            ):
+                continue
+            registrations += 1
+            help_arg = None
+            if len(node.args) >= 2:
+                help_arg = node.args[1]
+            else:
+                for kw in node.keywords:
+                    if kw.arg == "help_text":
+                        help_arg = kw.value
+            if help_arg is None:
+                findings.append(Finding(
+                    "metric-help-text", "error", rel, node.lineno,
+                    f".{f.attr}() registration without help text",
+                ))
+            elif isinstance(help_arg, ast.Constant) and not help_arg.value:
+                findings.append(Finding(
+                    "metric-help-text", "error", rel, node.lineno,
+                    f".{f.attr}() registration with empty help text",
+                ))
+    return findings, registrations
+
+
+@rule("zlib-confinement", min_sites=3)
+def zlib_confinement(model: ProjectModel):
+    """``import zlib`` (or direct ``zlib.decompress`` /
+    ``zlib.decompressobj``) may only appear inside the io/ package —
+    every inflate goes through the parallel-ingest chokepoint
+    (io/inflate.py) and its ordering / bounded-window / metric
+    invariants."""
+    findings, io_sites = [], 0
+    for rel, mod in model.modules.items():
+        inside_io = rel.split("/")[1:2] == ["io"]
+        for node in ast.walk(mod.tree):
+            hit = None
+            if isinstance(node, ast.Import):
+                if any(a.name.split(".")[0] == "zlib" for a in node.names):
+                    hit = "import zlib"
+            elif isinstance(node, ast.ImportFrom):
+                if (node.module or "").split(".")[0] == "zlib":
+                    hit = "from zlib import"
+            elif isinstance(node, ast.Call):
+                f = node.func
+                if (
+                    isinstance(f, ast.Attribute)
+                    and f.attr in ("decompress", "decompressobj")
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "zlib"
+                ):
+                    hit = f"zlib.{f.attr}"
+            if hit is None:
+                continue
+            if inside_io:
+                io_sites += 1
+            else:
+                findings.append(Finding(
+                    "zlib-confinement", "error", rel, node.lineno,
+                    f"{hit} outside {model.package}/io/ — route "
+                    "inflation through the single chokepoint "
+                    "(io/inflate.py)",
+                ))
+    return findings, io_sites
+
+
+def _jax_free(model: ProjectModel, rule_id: str, subdir: str, why: str):
+    findings, checked = [], 0
+    prefix = f"{model.package}/{subdir}/"
+    for rel, mod in model.modules.items():
+        if not rel.startswith(prefix):
+            continue
+        checked += 1
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                names = [a.name for a in node.names]
+            elif isinstance(node, ast.ImportFrom):
+                names = [node.module or ""]
+            else:
+                continue
+            for name in names:
+                if name == "jax" or name.startswith("jax."):
+                    findings.append(Finding(
+                        rule_id, "error", rel, node.lineno,
+                        f"imports {name} inside {subdir}/ — {why}",
+                    ))
+    return findings, checked
+
+
+@rule("io-jax-free", min_sites=8)
+def io_jax_free(model: ProjectModel):
+    """Nothing under io/ imports jax: inflate pool workers execute only
+    io/ code on non-main threads, and a worker thread tripping lazy
+    backend initialization mid-stream would deadlock or double-init the
+    runtime. io/ stays L0 by construction."""
+    return _jax_free(
+        model, "io-jax-free", "io",
+        "the ingest layer (and its worker threads) must stay jax-free",
+    )
+
+
+@rule("fleet-jax-free", min_sites=4)
+def fleet_jax_free(model: ProjectModel):
+    """The fleet tier (router/supervisor) never touches the device —
+    only the ConsensusServices it assembles do. A jax import here would
+    let the probe thread or the placement path trip backend init and
+    couple eviction/drain decisions to device state."""
+    return _jax_free(
+        model, "fleet-jax-free", "fleet",
+        "the fleet tier (router/supervisor) must never touch the device",
+    )
+
+
+_AOT_ATTRS = {
+    "deserialize_and_load",
+    "deserialize_executable",
+    "serialize_executable",
+    "runtime_executable",
+}
+
+
+@rule("aot-confinement", min_sites=3)
+def aot_confinement(model: ProjectModel):
+    """One AOT surface: ``.lower(...).compile(...)`` chains and PjRt
+    executable (de)serialization may only appear in aot.py — a second
+    lowering site would fork the store keying, the parity discipline,
+    and the warn-once fallback. Dispatch sites consult the aot
+    registry; they never compile or deserialize themselves."""
+    findings, aot_sites = [], 0
+    for rel, mod in model.modules.items():
+        is_aot = rel == f"{model.package}/aot.py"
+        for node in ast.walk(mod.tree):
+            hit = None
+            if isinstance(node, ast.Call):
+                f = node.func
+                if (
+                    isinstance(f, ast.Attribute)
+                    and f.attr == "compile"
+                    and isinstance(f.value, ast.Call)
+                    and isinstance(f.value.func, ast.Attribute)
+                    and f.value.func.attr == "lower"
+                ):
+                    hit = ".lower().compile()"
+                elif isinstance(f, ast.Attribute) and f.attr in _AOT_ATTRS:
+                    hit = f".{f.attr}()"
+            elif isinstance(node, ast.Import):
+                if any("serialize_executable" in a.name for a in node.names):
+                    hit = "import serialize_executable"
+            elif isinstance(node, ast.ImportFrom):
+                mod_name = node.module or ""
+                if "serialize_executable" in mod_name or any(
+                    a.name == "serialize_executable" for a in node.names
+                ):
+                    hit = "import serialize_executable"
+            if hit is None:
+                continue
+            if is_aot:
+                aot_sites += 1
+            else:
+                findings.append(Finding(
+                    "aot-confinement", "error", rel, node.lineno,
+                    f"{hit} outside aot.py — route it through the one "
+                    "AOT surface",
+                ))
+    return findings, aot_sites
+
+
+#: ragged/pack.py functions on the superbatch hot path — they run once
+#: per dispatched flush, so per-request Python cost must stay O(1) array
+#: bookkeeping, never an explicit loop hiding per-element work
+RAGGED_HOT_FUNCTIONS = {"build_segment_table", "pack_superbatch"}
+
+
+@rule("ragged-pack-vectorized", min_sites=2)
+def ragged_pack_vectorized(model: ProjectModel):
+    """Vectorized-only lint over the ragged packer: no ``for``/``while``
+    anywhere inside the hot functions of ragged/pack.py — numpy does
+    the per-element work; Python touches each request exactly once via
+    comprehensions. A hot function going missing (renamed) is itself a
+    finding, not a silent skip."""
+    rel = f"{model.package}/ragged/pack.py"
+    mod = model.modules.get(rel)
+    if mod is None:
+        return [], 0
+    findings, found = [], set()
+    for fn in model.by_module.get(rel, ()):
+        if fn.name not in RAGGED_HOT_FUNCTIONS:
+            continue
+        found.add(fn.name)
+        for n in ast.walk(fn.node):
+            if isinstance(n, (ast.For, ast.AsyncFor, ast.While)):
+                findings.append(Finding(
+                    "ragged-pack-vectorized", "error", rel, n.lineno,
+                    f"{type(n).__name__} loop inside hot `{fn.name}` — "
+                    "keep the pack path vectorized (numpy concatenate/"
+                    "cumsum over per-request comprehensions)",
+                ))
+    for missing in sorted(RAGGED_HOT_FUNCTIONS - found):
+        findings.append(Finding(
+            "ragged-pack-vectorized", "error", rel, 1,
+            f"hot function `{missing}` missing from ragged/pack.py — "
+            "renamed without updating the lint contract",
+        ))
+    return findings, len(found)
+
+
+#: handler calls that count as "the failure was handled, not swallowed"
+FAILURE_HANDLERS = {
+    "_fail", "fail", "_settle", "set_exception", "record_failure",
+    "_recover", "record_degrade", "record_probe_failure",
+}
+
+#: deliberately-swallowing sites, each with a local reason (see the
+#: original guard's rationale comments, preserved in DESIGN.md §18)
+SWALLOW_ALLOWLIST = {
+    ("serve/service.py", "_warm"),
+    ("serve/service.py", "consensus_post_response"),
+    ("serve/service.py", "_aot_provenance"),
+    ("fleet/service.py", "_replica_healthz"),
+}
+
+#: packages whose broad except handlers must handle the failure —
+#: serve/resilience/fleet (original scope) plus ragged/parallel (the
+#: two other layers that sit on the admitted-request path)
+SWALLOW_SCOPE = ("serve", "resilience", "fleet", "ragged", "parallel")
+
+
+@rule("silent-swallow", min_sites=5)
+def silent_swallow(model: ProjectModel):
+    """Every ``except Exception`` / ``except BaseException`` in the
+    serving, resilience, fleet, ragged, and parallel layers must
+    re-raise, resolve a future, or record the failure — a handler that
+    does none of those is exactly how an admitted request gets silently
+    lost (the invariant the chaos suites enforce dynamically; this rule
+    catches the sites tests never reach)."""
+
+    def catches_broad(handler: ast.ExceptHandler) -> bool:
+        if handler.type is None:
+            return True
+        return bool(
+            dotted_parts(handler.type) & {"Exception", "BaseException"}
+        )
+
+    def handles_failure(handler: ast.ExceptHandler) -> bool:
+        for n in ast.walk(handler):
+            if isinstance(n, ast.Raise):
+                return True
+            if isinstance(n, ast.Call):
+                f = n.func
+                name = (
+                    f.attr if isinstance(f, ast.Attribute)
+                    else f.id if isinstance(f, ast.Name) else None
+                )
+                if name in FAILURE_HANDLERS:
+                    return True
+        return False
+
+    findings, sites = [], 0
+    for rel, mod in model.modules.items():
+        parts = rel.split("/")
+        if len(parts) < 2 or parts[1] not in SWALLOW_SCOPE:
+            continue
+        sub_rel = "/".join(parts[1:])
+        owners = _enclosing_functions(mod.tree)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not catches_broad(node):
+                continue
+            sites += 1
+            owner = owners.get(node, "<module>")
+            if (sub_rel, owner) in SWALLOW_ALLOWLIST:
+                continue
+            if not handles_failure(node):
+                findings.append(Finding(
+                    "silent-swallow", "error", rel, node.lineno,
+                    f"broad except in {owner} neither re-raises, "
+                    "resolves a future, nor records the failure — add "
+                    "handling or extend SWALLOW_ALLOWLIST with a "
+                    "justification",
+                ))
+    return findings, sites

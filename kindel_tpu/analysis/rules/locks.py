@@ -1,0 +1,286 @@
+"""Lock discipline for the concurrent tier (serve/, fleet/, ragged/):
+
+* **guarded-by inference** — a ``self`` field ever *mutated* under a
+  class lock (assignment, item store, or a mutating container call)
+  is inferred guarded-by that lock; any later access outside the lock
+  (and outside ``__init__``, which runs before the object is shared,
+  and outside ``*_locked`` methods, the repo's called-under-lock
+  convention) is a finding. ``Condition(self._lock)`` aliases to the
+  lock it wraps, so ``with self._not_empty:`` counts as holding
+  ``_lock``.
+
+* **acquisition-order graph** — an edge A -> B whenever lock B is
+  acquired (lexically, or by a resolvable callee) while A is held.
+  A cycle in that graph is a static deadlock candidate for the code
+  the fleet tier made deeply concurrent; every cycle is a finding.
+
+Both analyses are over-approximate by design: a finding means "show
+why this is safe (then baseline it with the reason reviewed)", not
+"this deadlocks"."""
+
+from __future__ import annotations
+
+import ast
+
+from kindel_tpu.analysis.engine import Finding, rule
+from kindel_tpu.analysis.model import ProjectModel
+
+#: packages whose classes get lock analysis (the admitted-request path)
+LOCK_SCOPE = ("serve", "fleet", "ragged")
+
+#: container-mutation methods that count as writes for guard inference
+_MUTATORS = {
+    "append", "appendleft", "extend", "insert", "add", "update",
+    "pop", "popleft", "remove", "discard", "clear", "setdefault",
+}
+
+def _in_scope(model: ProjectModel, rel: str) -> bool:
+    parts = rel.split("/")
+    return len(parts) >= 2 and parts[1] in LOCK_SCOPE
+
+
+def _self_attr(node) -> str | None:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _accesses(cinfo, method) -> list:
+    """(attr, is_write, held_locks frozenset, lineno) for every
+    ``self.X`` touch in one method, with the lexically-held canonical
+    lock set. Nested defs inherit the lexical set (under-approximate:
+    a deferred closure may run unlocked, but flagging every closure
+    drowns the signal)."""
+    lock_names = cinfo.lock_names()
+    out = []
+
+    def expr_accesses(node, held):
+        for n in ast.walk(node):
+            attr = _self_attr(n)
+            if attr is None or attr in lock_names:
+                continue
+            is_write = isinstance(n.ctx, (ast.Store, ast.Del))
+            out.append((attr, is_write, held, n.lineno))
+        # item store / container mutation on a self field = write
+        for n in ast.walk(node):
+            if (
+                isinstance(n, ast.Subscript)
+                and isinstance(n.ctx, (ast.Store, ast.Del))
+            ):
+                attr = _self_attr(n.value)
+                if attr is not None and attr not in lock_names:
+                    out.append((attr, True, held, n.lineno))
+            elif isinstance(n, ast.Call) and isinstance(
+                n.func, ast.Attribute
+            ):
+                if n.func.attr in _MUTATORS:
+                    attr = _self_attr(n.func.value)
+                    if attr is not None and attr not in lock_names:
+                        out.append((attr, True, held, n.lineno))
+
+    def visit(node, held):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired = set()
+            for item in node.items:
+                expr_accesses(item.context_expr, held)
+                attr = _self_attr(item.context_expr)
+                if attr is not None and attr in lock_names:
+                    canon = cinfo.canonical_lock(attr)
+                    if canon:
+                        acquired.add(canon)
+            inner = held | frozenset(acquired)
+            for child in node.body:
+                visit(child, inner)
+            return
+        if isinstance(node, ast.stmt):
+            # expression parts of this statement at the current level
+            for field_name, value in ast.iter_fields(node):
+                if field_name in ("body", "orelse", "finalbody",
+                                  "handlers", "items"):
+                    continue
+                for v in (value if isinstance(value, list) else [value]):
+                    if isinstance(v, ast.AST) and not isinstance(
+                        v, ast.stmt
+                    ):
+                        expr_accesses(v, held)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.stmt, ast.ExceptHandler)):
+                visit(child, held)
+
+    for stmt in method.node.body:
+        visit(stmt, frozenset())
+    return out
+
+
+@rule("lock-guarded-by", min_sites=3)
+def lock_guarded_by(model: ProjectModel):
+    """A field ever mutated under ``self._lock`` must always be
+    accessed under it (outside ``__init__`` / ``*_locked`` methods)."""
+    findings, guarded_total = [], 0
+    for (rel, _), cinfo in sorted(model.classes.items()):
+        if not _in_scope(model, rel) or not cinfo.lock_names():
+            continue
+        per_method = {}
+        guarded = set()
+        for name, m in cinfo.methods.items():
+            if name == "__init__":
+                continue
+            acc = _accesses(cinfo, m)
+            per_method[name] = acc
+            for attr, is_write, held, _line in acc:
+                if is_write and held:
+                    guarded.add(attr)
+        guarded_total += len(guarded)
+        for name, acc in sorted(per_method.items()):
+            if name.endswith("_locked"):
+                continue  # convention: caller holds the lock
+            for attr, is_write, held, line in acc:
+                if attr in guarded and not held:
+                    kind = "written" if is_write else "read"
+                    findings.append(Finding(
+                        "lock-guarded-by", "error", rel, line,
+                        f"{cinfo.name}.{attr} is lock-guarded (mutated "
+                        f"under the class lock) but {kind} without it "
+                        f"in `{name}`",
+                    ))
+    return findings, guarded_total
+
+
+def _lock_id(cinfo, attr: str) -> str:
+    return f"{cinfo.name}.{attr}"
+
+
+def _acquired_in_with(cinfo, mod_locks, node) -> list:
+    """Canonical lock ids acquired by one With statement."""
+    out = []
+    for item in node.items:
+        ce = item.context_expr
+        attr = _self_attr(ce)
+        if cinfo is not None and attr is not None:
+            canon = cinfo.canonical_lock(attr)
+            if canon:
+                out.append(_lock_id(cinfo, canon))
+        elif isinstance(ce, ast.Name) and ce.id in mod_locks:
+            out.append(f"module:{ce.id}")
+    return out
+
+
+@rule("lock-order", min_sites=0)
+def lock_order(model: ProjectModel):
+    """Build the lock acquisition-order graph across the concurrent
+    tier and fail on cycles — a static deadlock detector."""
+    # per-function resolvable callees (the model already refuses to
+    # resolve generic container/thread method names across the package)
+    fns = [
+        fn for fn in model.functions if _in_scope(model, fn.rel)
+    ]
+    by_qual = {fn.qualname: fn for fn in fns}
+
+    def callees(fn):
+        out = []
+        for target in model.resolve_calls(fn):
+            if target.qualname == fn.qualname:
+                continue
+            if target.qualname in by_qual:
+                out.append(target)
+        return out
+
+    # transitive "locks this function may acquire" (memoized)
+    memo: dict[str, frozenset] = {}
+
+    def acquires(fn, stack=()) -> frozenset:
+        if fn.qualname in memo:
+            return memo[fn.qualname]
+        if fn.qualname in stack:
+            return frozenset()
+        cinfo = model.classes.get((fn.rel, fn.cls)) if fn.cls else None
+        mod_locks = model.module_locks.get(fn.rel, set())
+        own = set()
+        for n in ast.walk(fn.node):
+            if isinstance(n, (ast.With, ast.AsyncWith)):
+                own.update(_acquired_in_with(cinfo, mod_locks, n))
+        for callee in callees(fn):
+            own |= acquires(callee, stack + (fn.qualname,))
+        result = frozenset(own)
+        if not stack:
+            memo[fn.qualname] = result
+        return result
+
+    # edges: held A -> acquired B (lexical nesting + one call layer)
+    edges: dict[tuple, tuple] = {}  # (A, B) -> (rel, line)
+
+    def walk(fn, cinfo, mod_locks, node, held):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            got = _acquired_in_with(cinfo, mod_locks, node)
+            for b in got:
+                for a in held:
+                    if a != b:
+                        edges.setdefault((a, b), (fn.rel, node.lineno))
+            inner = held | set(got)
+            for child in node.body:
+                walk(fn, cinfo, mod_locks, child, inner)
+            return
+        if held and isinstance(node, ast.Call):
+            name = (
+                node.func.attr if isinstance(node.func, ast.Attribute)
+                else node.func.id if isinstance(node.func, ast.Name)
+                else None
+            )
+            if name is not None:
+                for target in model.resolve_calls(fn):
+                    if target.name != name:
+                        continue
+                    for b in acquires(target):
+                        for a in held:
+                            if a != b:
+                                edges.setdefault(
+                                    (a, b), (fn.rel, node.lineno)
+                                )
+        for child in ast.iter_child_nodes(node):
+            walk(fn, cinfo, mod_locks, child, held)
+
+    for fn in fns:
+        cinfo = model.classes.get((fn.rel, fn.cls)) if fn.cls else None
+        mod_locks = model.module_locks.get(fn.rel, set())
+        for stmt in getattr(fn.node, "body", ()):
+            walk(fn, cinfo, mod_locks, stmt, set())
+
+    # cycle detection: DFS over the edge graph
+    graph: dict[str, set] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+    findings = []
+    reported = set()
+
+    def find_cycle(start):
+        stack = [(start, [start])]
+        while stack:
+            node, path = stack.pop()
+            for nxt in sorted(graph.get(node, ())):
+                if nxt == start:
+                    return path + [start]
+                if nxt not in path:
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    for start in sorted(graph):
+        cycle = find_cycle(start)
+        if cycle is None:
+            continue
+        canon = frozenset(cycle)
+        if canon in reported:
+            continue
+        reported.add(canon)
+        first_edge = edges[(cycle[0], cycle[1])]
+        findings.append(Finding(
+            "lock-order", "error", first_edge[0], first_edge[1],
+            "lock acquisition-order cycle: "
+            + " -> ".join(cycle)
+            + " — a static deadlock candidate; break the cycle or "
+            "document the exclusion that makes it unreachable",
+        ))
+    return findings, len(edges)

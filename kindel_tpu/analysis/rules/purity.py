@@ -1,0 +1,109 @@
+"""Trace-purity closure: from every jit-decorated entry point, walk the
+over-approximate call graph and flag transitively reachable trace-time
+impurities — the analysis the decorated-body-only guard approximates.
+
+The hazard (the central one for tracing compilers): code inside a
+``jax.jit`` body runs at *trace time*, once, and the result is baked
+into the compiled program. An env read, a wall-clock read, host RNG,
+file I/O, a lock acquisition, or a metrics mutation reached from traced
+code therefore (a) silently stops responding after the first call and
+(b) makes compiled behavior depend on ambient state the compile cache
+key does not capture. The direct-body rule (jit-env-read) catches the
+env case one level deep; this rule catches a jitted kernel calling a
+helper calling a helper that does any of it."""
+
+from __future__ import annotations
+
+import ast
+
+from kindel_tpu.analysis.engine import Finding, rule
+from kindel_tpu.analysis.model import ProjectModel, dotted_parts
+
+#: time.* attrs that are trace-time hazards inside traced code
+_TIME_ATTRS = {"time", "perf_counter", "monotonic", "sleep",
+               "perf_counter_ns", "monotonic_ns", "time_ns"}
+
+#: metric mutation methods (registry families are host state)
+_METRIC_MUTATORS = {"inc", "dec", "observe"}
+
+#: Path / file-object methods that are file I/O
+_IO_ATTRS = {"read_text", "read_bytes", "write_text", "write_bytes"}
+
+
+def _impurities(model: ProjectModel, fn) -> list:
+    """(kind, line) trace-time hazards lexically inside one function."""
+    out = []
+    cinfo = model.classes.get((fn.rel, fn.cls)) if fn.cls else None
+    lock_names = cinfo.lock_names() if cinfo is not None else set()
+    mod_locks = model.module_locks.get(fn.rel, set())
+    for n in ast.walk(fn.node):
+        if isinstance(n, ast.Attribute) and n.attr == "environ":
+            out.append(("env read", n.lineno))
+        elif isinstance(n, (ast.With, ast.AsyncWith)):
+            for item in n.items:
+                ce = item.context_expr
+                if (
+                    isinstance(ce, ast.Attribute)
+                    and isinstance(ce.value, ast.Name)
+                    and ce.value.id == "self"
+                    and ce.attr in lock_names
+                ) or (isinstance(ce, ast.Name) and ce.id in mod_locks):
+                    out.append(("lock acquisition", n.lineno))
+        elif isinstance(n, ast.Call):
+            f = n.func
+            if isinstance(f, ast.Name):
+                if f.id == "getenv":
+                    out.append(("env read", n.lineno))
+                elif f.id == "open":
+                    out.append(("file I/O", n.lineno))
+                continue
+            if not isinstance(f, ast.Attribute):
+                continue
+            if f.attr == "getenv":
+                out.append(("env read", n.lineno))
+            elif (
+                f.attr in _TIME_ATTRS
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "time"
+            ):
+                out.append(("wall-clock read", n.lineno))
+            elif f.attr == "acquire":
+                out.append(("lock acquisition", n.lineno))
+            elif f.attr in _METRIC_MUTATORS:
+                out.append(("metrics mutation", n.lineno))
+            elif f.attr in _IO_ATTRS:
+                out.append(("file I/O", n.lineno))
+            else:
+                # host RNG: random.* / np.random.* — jax.random is pure
+                # (explicit keys) and stays legal inside traced code
+                chain = dotted_parts(f.value)
+                if "random" in chain and "jax" not in chain:
+                    out.append(("host RNG", n.lineno))
+    return out
+
+
+@rule("trace-purity", min_sites=8)
+def trace_purity(model: ProjectModel):
+    """From each jit entry, flag impurities anywhere in its call-graph
+    closure. One finding per (impure function, kind, line), attributed
+    to the alphabetically first jit entry that reaches it."""
+    findings = {}
+    entries = [fn for fn in model.functions if fn.jit]
+    for entry in sorted(entries, key=lambda f: (f.rel, f.name)):
+        for reached in model.reachable(entry):
+            for kind, line in _impurities(model, reached):
+                key = (reached.qualname, kind, line)
+                if key in findings:
+                    continue
+                via = (
+                    "directly in the traced body"
+                    if reached.qualname == entry.qualname
+                    else f"via reachable `{reached.name}` ({reached.rel})"
+                )
+                findings[key] = Finding(
+                    "trace-purity", "error", reached.rel, line,
+                    f"{kind} reachable from jit entry `{entry.name}` "
+                    f"{via} — trace-time state leaks into the compiled "
+                    "program",
+                )
+    return list(findings.values()), len(entries)

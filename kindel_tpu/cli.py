@@ -865,6 +865,97 @@ def _export_aot(bam_path: str, ev, dry_run: bool = False) -> dict:
     }
 
 
+def _lint_parser(sub):
+    p = sub.add_parser(
+        "lint",
+        help="run the whole-program static analyzer "
+             "(kindel_tpu.analysis): migrated tier-1 hygiene guards "
+             "plus trace-purity closure, lock discipline, "
+             "future-settlement, and knob/metric doc conformance",
+    )
+    p.add_argument(
+        "--format", choices=["text", "json", "sarif"], default="text",
+        help="output format (SARIF 2.1.0 for code-review UIs)",
+    )
+    p.add_argument(
+        "--baseline", default=None, metavar="PATH",
+        help="baseline file of reviewed legacy findings "
+             "(default tools/lint_baseline.json; 'none' disables)",
+    )
+    p.add_argument(
+        "--strict", action="store_true",
+        help="also fail on stale baseline entries (fixed findings whose "
+             "ledger row was not deleted) — what tier-1 runs",
+    )
+    p.add_argument(
+        "--rules", default=None, metavar="IDS",
+        help="comma-separated rule ids to run (default: all)",
+    )
+    p.add_argument(
+        "--write-baseline", action="store_true",
+        help="refreeze the baseline from the current findings (review "
+             "the diff before committing) instead of checking",
+    )
+
+
+def cmd_lint(args) -> int:
+    """Run the rule engine; exit 0 clean, 1 on new findings (or stale
+    baseline entries under --strict), 2 on usage errors."""
+    from kindel_tpu.analysis import engine as lint_engine
+    from kindel_tpu.analysis import load_project
+
+    rule_ids = None
+    if args.rules:
+        rule_ids = sorted(
+            {r.strip() for r in args.rules.split(",") if r.strip()}
+        )
+        lint_engine._ensure_rules_loaded()
+        unknown = [r for r in rule_ids if r not in lint_engine.RULES]
+        if unknown:
+            print(
+                f"unknown rule id(s): {', '.join(unknown)} — known: "
+                + ", ".join(sorted(lint_engine.RULES)),
+                file=sys.stderr,
+            )
+            return 2
+
+    import time as _time
+
+    t0 = _time.perf_counter()
+    model = load_project()
+    results = lint_engine.run(model, rule_ids=rule_ids)
+    findings = lint_engine.all_findings(results)
+
+    if args.write_baseline:
+        path = lint_engine.default_baseline_path()
+        lint_engine.write_baseline(path, findings)
+        print(f"froze {len(findings)} finding(s) into {path}",
+              file=sys.stderr)
+        return 0
+
+    if args.baseline == "none":
+        baseline = {}
+    else:
+        baseline = lint_engine.load_baseline(
+            args.baseline or lint_engine.default_baseline_path()
+        )
+    if rule_ids is not None:
+        # a partial run must not report unrun rules' entries as stale
+        baseline = {
+            k: v for k, v in baseline.items() if k[0] in rule_ids
+        }
+    new, stale = lint_engine.diff_baseline(findings, baseline)
+    wall = _time.perf_counter() - t0
+    if args.format == "json":
+        print(lint_engine.render_json(results, new, stale, wall_s=wall))
+    elif args.format == "sarif":
+        print(lint_engine.render_sarif(results, new, stale))
+    else:
+        print(lint_engine.render_text(results, new, stale))
+    failed = bool(new) or (args.strict and bool(stale))
+    return 1 if failed else 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="kindel-tpu",
@@ -1004,6 +1095,7 @@ def build_parser() -> argparse.ArgumentParser:
 
     _serve_parser(sub)
     _tune_parser(sub)
+    _lint_parser(sub)
 
     sub.add_parser("version", help="show version")
     return parser
@@ -1038,6 +1130,7 @@ def main(argv=None) -> int:
         "batch": cmd_batch,
         "serve": cmd_serve,
         "tune": cmd_tune,
+        "lint": cmd_lint,
     }[args.command]
     trace_path = getattr(args, "trace", None)
     if trace_path is None:

@@ -95,7 +95,7 @@ class MicroBatcher:
             lane.rows += len(units)
             sealed = lane.rows >= self.max_batch_rows
             if sealed:
-                self._ready.append(self._seal(key, lane))
+                self._ready.append(self._seal_locked(key, lane))
             self._cond.notify_all()
         # trace-id propagation stage 2 of 4 (queue → BATCHER → worker →
         # device dispatch): mark the coalescing decision on the request's
@@ -108,7 +108,7 @@ class MicroBatcher:
                 lane_shape="x".join(str(s) for s in shapes),
             )
 
-    def _seal(self, key, lane: _Lane) -> Flush:
+    def _seal_locked(self, key, lane: _Lane) -> Flush:
         del self._lanes[key]
         return Flush(lane.opts, lane.shapes, lane.entries, lane.opened_at)
 
@@ -121,7 +121,7 @@ class MicroBatcher:
             if oldest is None or lane.opened_at < oldest.opened_at:
                 oldest_key, oldest = key, lane
         if oldest is not None and now - oldest.opened_at >= self.max_wait_s:
-            return self._seal(oldest_key, oldest)
+            return self._seal_locked(oldest_key, oldest)
         return None
 
     # poll() drives these two hooks so a subclass with extra lane kinds
@@ -139,7 +139,7 @@ class MicroBatcher:
         lane can only shrink the drain: aging it toward max_wait would
         just stall shutdown by up to the knob per lane."""
         for key in list(self._lanes):
-            self._ready.append(self._seal(key, self._lanes[key]))
+            self._ready.append(self._seal_locked(key, self._lanes[key]))
 
     def _oldest_open_locked(self) -> float | None:
         """opened_at of the oldest open lane (None when all are sealed)
@@ -208,7 +208,7 @@ class MicroBatcher:
             out = list(self._ready)
             self._ready.clear()
             for key in list(self._lanes):
-                out.append(self._seal(key, self._lanes[key]))
+                out.append(self._seal_locked(key, self._lanes[key]))
             return out
 
     @property

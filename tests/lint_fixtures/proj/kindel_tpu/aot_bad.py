@@ -1,0 +1,5 @@
+"""Known-bad: a second AOT lowering site (aot-confinement)."""
+
+
+def rogue_compile(fn, args):
+    return fn.lower(*args).compile()

@@ -1,0 +1,7 @@
+"""Known-bad: the fleet tier touching the device (fleet-jax-free)."""
+
+import jax
+
+
+def peek_devices():
+    return jax.devices()

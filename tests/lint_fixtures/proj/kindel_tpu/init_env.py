@@ -1,0 +1,8 @@
+"""Known-bad: env state cached at construction (init-env-read)."""
+
+import os
+
+
+class CachesEnv:
+    def __init__(self):
+        self.trace_dir = os.getenv("KINDEL_TPU_TRACE_DIR")

@@ -1,0 +1,7 @@
+"""Known-bad: jax import inside the io/ layer (io-jax-free)."""
+
+import jax.numpy as jnp
+
+
+def not_allowed(x):
+    return jnp.asarray(x)

@@ -1,0 +1,11 @@
+"""Known-bad: env read inside a jit-traced body (jit-env-read)."""
+
+import os
+
+import jax
+
+
+@jax.jit
+def bad_kernel(x):
+    slabs = int(os.environ.get("KINDEL_TPU_SLABS", "4"))
+    return x * slabs

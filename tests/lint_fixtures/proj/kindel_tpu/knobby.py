@@ -1,0 +1,8 @@
+"""Known-bad: an env knob with no doc row and no tuning resolution
+path (knob-doc fires twice for it)."""
+
+import os
+
+
+def rogue_knob() -> int:
+    return int(os.environ.get("KINDEL_TPU_UNDOCUMENTED_KNOB", "0"))

@@ -1,0 +1,10 @@
+"""Known-bad: registration without help text (metric-help-text), and a
+helped metric that no docs table mentions (metric-doc)."""
+
+
+def register(registry):
+    helpless = registry.counter("kindel_fixture_helpless_total")
+    documented_nowhere = registry.counter(
+        "kindel_fixture_total", "fires the metric-doc conformance rule"
+    )
+    return helpless, documented_nowhere

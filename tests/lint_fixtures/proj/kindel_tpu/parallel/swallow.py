@@ -1,0 +1,9 @@
+"""Known-bad: silent swallow in parallel/ — proves the rule's scope
+extension beyond the original serve/resilience/fleet set."""
+
+
+def shard_and_forget(mesh, fn):
+    try:
+        return fn(mesh)
+    except Exception:
+        return None

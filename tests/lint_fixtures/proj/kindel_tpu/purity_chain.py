@@ -1,0 +1,20 @@
+"""Known-bad: impurity two calls deep from a jit entry (trace-purity).
+The entry body itself is clean — only the closure sees the hazard."""
+
+import os
+
+import jax
+
+
+def _resolve_knob_chain():
+    return _read_ambient_state()
+
+
+def _read_ambient_state():
+    return os.environ.get("KINDEL_TPU_SLABS")
+
+
+@jax.jit
+def chained_kernel(x):
+    scale = _resolve_knob_chain()
+    return x * (1 if scale is None else int(scale))

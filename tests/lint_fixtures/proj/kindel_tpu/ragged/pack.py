@@ -1,0 +1,16 @@
+"""Known-bad: explicit loops on the superbatch hot path
+(ragged-pack-vectorized)."""
+
+
+def build_segment_table(units, cls):
+    table = []
+    for u in units:
+        table.append(len(u))
+    return table
+
+
+def pack_superbatch(units, table):
+    out = []
+    while units:
+        out.append(units.pop())
+    return out

@@ -1,0 +1,20 @@
+"""Known-bad: a created Future leaks on the except arm
+(future-settlement). The handler exists — so the silent-swallow
+handler-recognizer shape is satisfiable — but the failure path neither
+settles, hands back, nor re-raises."""
+
+from concurrent.futures import Future
+
+
+def submit_leaky(work):
+    fut = Future()
+    try:
+        work()
+        fut.set_result(True)
+    except Exception:
+        record_metric_only()
+    return None
+
+
+def record_metric_only():
+    pass

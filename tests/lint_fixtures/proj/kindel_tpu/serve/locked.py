@@ -1,0 +1,17 @@
+"""Known-bad: a lock-guarded field read outside the lock
+(lock-guarded-by)."""
+
+import threading
+
+
+class Racy:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def bump(self):
+        with self._lock:
+            self._count += 1
+
+    def peek(self):
+        return self._count

@@ -1,0 +1,9 @@
+"""Known-bad: broad except that neither re-raises, settles a future,
+nor records the failure (silent-swallow)."""
+
+
+def dispatch_and_forget(flush):
+    try:
+        flush.launch()
+    except Exception:
+        pass

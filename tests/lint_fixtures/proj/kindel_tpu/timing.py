@@ -1,0 +1,9 @@
+"""Known-bad: wall clock used for a duration (time-time-duration)."""
+
+import time
+
+
+def measure(fn):
+    t0 = time.time()
+    fn()
+    return time.time() - t0

@@ -1,0 +1,7 @@
+"""Known-bad: inflate outside the io/ chokepoint (zlib-confinement)."""
+
+import zlib
+
+
+def sneak_inflate(blob: bytes) -> bytes:
+    return zlib.decompress(blob)

@@ -1,0 +1,365 @@
+"""kindel_tpu.analysis test suite: engine unit tests (model cache,
+call-graph closure, baseline match/expiry, SARIF shape, blindness
+floors), per-rule liveness against the known-bad fixture corpus under
+tests/lint_fixtures/ (every registered rule MUST fire there — a
+silently-blind analyzer is itself a test failure), mutation spot checks
+over real package sources, and the `kindel lint` CLI contract that
+tier-1 runs."""
+
+import ast
+import json
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from kindel_tpu.analysis import build_project, load_project
+from kindel_tpu.analysis import engine as lint_engine
+from kindel_tpu.analysis.engine import (
+    Finding,
+    all_findings,
+    diff_baseline,
+    load_baseline,
+    render_sarif,
+    write_baseline,
+)
+
+lint_engine._ensure_rules_loaded()
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURE_PKG = Path(__file__).resolve().parent / "lint_fixtures" / "proj" / "kindel_tpu"
+
+
+# ------------------------------------------------------------------ model
+
+def _mk_pkg(tmp_path, files: dict) -> Path:
+    pkg = tmp_path / "kindel_tpu"
+    for rel, src in files.items():
+        p = pkg / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return pkg
+
+
+def test_call_graph_closure_crosses_modules(tmp_path):
+    pkg = _mk_pkg(tmp_path, {
+        "a.py": """
+            from kindel_tpu.b import middle
+
+            def entry():
+                return middle()
+            """,
+        "b.py": """
+            def middle():
+                return deep()
+
+            def deep():
+                return 1
+            """,
+    })
+    model = build_project(pkg)
+    entry = next(f for f in model.functions if f.name == "entry")
+    names = {f.name for f in model.reachable(entry)}
+    assert {"entry", "middle", "deep"} <= names
+
+
+def test_self_call_resolves_through_base_class(tmp_path):
+    pkg = _mk_pkg(tmp_path, {
+        "base.py": """
+            class Base:
+                def helper(self):
+                    return 1
+            """,
+        "child.py": """
+            from kindel_tpu.base import Base
+
+            class Child(Base):
+                def run(self):
+                    return self.helper()
+            """,
+    })
+    model = build_project(pkg)
+    run = next(f for f in model.functions if f.name == "run")
+    assert "helper" in {f.name for f in model.resolve_calls(run)}
+
+
+def test_generic_attr_calls_do_not_resolve(tmp_path):
+    """d.get(k) must not alias onto an unrelated first-party `get`."""
+    pkg = _mk_pkg(tmp_path, {
+        "q.py": """
+            class Q:
+                def get(self):
+                    return 1
+            """,
+        "user.py": """
+            def reads_dict(d):
+                return d.get("k")
+            """,
+    })
+    model = build_project(pkg)
+    fn = next(f for f in model.functions if f.name == "reads_dict")
+    assert model.resolve_calls(fn) == []
+
+
+def test_model_cache_one_parse_per_file(tmp_path):
+    pkg = _mk_pkg(tmp_path, {"a.py": "x = 1\n", "b.py": "y = 2\n"})
+    m1 = load_project(pkg)
+    m2 = load_project(pkg)
+    assert m1 is m2
+    assert m1.parse_count == len(m1.modules) == 2
+
+
+def test_lock_facts_condition_aliases_wrapped_lock(tmp_path):
+    pkg = _mk_pkg(tmp_path, {
+        "c.py": """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._cond = threading.Condition(self._lock)
+                    self._own = threading.Condition()
+            """,
+    })
+    model = build_project(pkg)
+    cinfo = model.classes[("kindel_tpu/c.py", "C")]
+    assert cinfo.canonical_lock("_cond") == "_lock"
+    assert cinfo.canonical_lock("_own") == "_own"
+    assert cinfo.lock_names() == {"_lock", "_cond", "_own"}
+
+
+# ----------------------------------------------------------------- engine
+
+def test_baseline_match_and_expiry(tmp_path):
+    f1 = Finding("r", "error", "p.py", 3, "legacy debt")
+    f2 = Finding("r", "error", "p.py", 9, "fresh debt")
+    path = tmp_path / "baseline.json"
+    write_baseline(path, [f1])
+    baseline = load_baseline(path)
+
+    # exact match: nothing new, nothing stale
+    new, stale = diff_baseline([f1], baseline)
+    assert new == [] and stale == []
+
+    # a line move does not churn the ledger (identity excludes line)
+    moved = Finding("r", "error", "p.py", 42, "legacy debt")
+    new, stale = diff_baseline([moved], baseline)
+    assert new == [] and stale == []
+
+    # new debt fails even while legacy debt persists
+    new, stale = diff_baseline([f1, f2], baseline)
+    assert [f.message for f in new] == ["fresh debt"] and stale == []
+
+    # fixed debt leaves a stale entry (strict mode burns it down)
+    new, stale = diff_baseline([f2], baseline)
+    assert len(stale) == 1 and stale[0]["message"] == "legacy debt"
+
+    # duplicate occurrences count: two of a once-baselined finding = new
+    new, _ = diff_baseline([f1, f1], baseline)
+    assert len(new) == 1
+
+
+def test_blindness_floor_is_a_finding(tmp_path):
+    """An (almost) empty package starves every min_sites rule — the
+    engine must turn that into findings, not silence."""
+    pkg = _mk_pkg(tmp_path, {"empty.py": "x = 1\n"})
+    results = lint_engine.run(build_project(pkg))
+    blind = [
+        f for f in all_findings(results) if "detector blind" in f.message
+    ]
+    blind_rules = {f.rule for f in blind}
+    assert "jit-env-read" in blind_rules
+    assert "metric-help-text" in blind_rules
+    # and with the floor waived (fixture mode), the same model is clean
+    results = lint_engine.run(build_project(pkg), check_blindness=False)
+    assert not any(
+        "detector blind" in f.message for f in all_findings(results)
+    )
+
+
+def test_sarif_document_shape():
+    model = build_project(FIXTURE_PKG)
+    results = lint_engine.run(model, check_blindness=False)
+    new, stale = diff_baseline(all_findings(results), {})
+    doc = json.loads(render_sarif(results, new, stale))
+    assert doc["version"] == "2.1.0"
+    assert "sarif-schema-2.1.0" in doc["$schema"]
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "kindel-lint"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert rule_ids == set(lint_engine.RULES)
+    assert run["results"], "fixture corpus must produce results"
+    for res in run["results"]:
+        assert res["ruleId"] in rule_ids
+        assert res["level"] in ("error", "warning")
+        assert res["message"]["text"]
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"]
+        assert loc["region"]["startLine"] >= 1
+    assert all(r["baselineState"] == "new" for r in run["results"])
+
+
+# ------------------------------------------------- fixture corpus liveness
+
+@pytest.fixture(scope="module")
+def fixture_results():
+    model = build_project(FIXTURE_PKG)
+    return lint_engine.run(model, check_blindness=False)
+
+
+@pytest.mark.parametrize("rule_id", sorted(lint_engine.RULES))
+def test_rule_fires_on_known_bad_fixture(fixture_results, rule_id):
+    """Per-rule liveness: every registered rule must detect its
+    deliberately-bad fixture (tests/lint_fixtures/proj). Registering a
+    new rule without a firing fixture fails here — a silently-blind
+    analyzer is itself a test failure."""
+    result = next(r for r in fixture_results if r.spec.id == rule_id)
+    assert result.findings, (
+        f"rule {rule_id} found nothing in the known-bad fixture corpus "
+        "— add a fixture it fires on under tests/lint_fixtures/proj/"
+    )
+
+
+def test_fixture_scope_extension_hits_parallel(fixture_results):
+    """The silent-swallow scope extension (satellite): the rule must
+    fire in parallel/ (and ragged/ shares the same scope list)."""
+    swallow = next(
+        r for r in fixture_results if r.spec.id == "silent-swallow"
+    )
+    assert any("parallel/" in f.path for f in swallow.findings)
+
+
+def test_purity_fixture_needs_the_closure(fixture_results):
+    """The chained fixture's jit body is clean — only the call-graph
+    walk sees the env read two calls deep, which is exactly what the
+    old decorated-body-only guard could not do."""
+    purity = next(
+        r for r in fixture_results if r.spec.id == "trace-purity"
+    )
+    chained = [
+        f for f in purity.findings if f.path.endswith("purity_chain.py")
+    ]
+    assert chained and all(
+        "_read_ambient_state" in f.message for f in chained
+    )
+    direct = next(
+        r for r in fixture_results if r.spec.id == "jit-env-read"
+    )
+    assert not any(
+        f.path.endswith("purity_chain.py") for f in direct.findings
+    )
+
+
+# -------------------------------------------------- mutation spot checks
+
+def _mutate_first_jitted(src: str) -> str:
+    """Insert an env read at the top of the first jit-decorated function
+    of real package source (AST-level, so formatting never breaks it)."""
+    tree = ast.parse(src)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any("jit" in ast.dump(d) for d in node.decorator_list):
+                inject = ast.parse(
+                    "import os\n_leak = os.environ.get('X')"
+                ).body
+                node.body = inject + node.body
+                return ast.unparse(ast.fix_missing_locations(tree))
+    raise AssertionError("no jit-decorated function found to mutate")
+
+
+def test_mutated_real_kernel_is_detected(tmp_path):
+    """Mutation spot check over real code: injecting an env read into a
+    real jitted kernel must be flagged by the migrated rule (same
+    offenders detected as the pre-migration guard)."""
+    real = (REPO / "kindel_tpu" / "pileup_jax.py").read_text()
+    pkg = _mk_pkg(tmp_path, {"pileup_jax.py": _mutate_first_jitted(real)})
+    results = lint_engine.run(
+        build_project(pkg), rule_ids=["jit-env-read"],
+        check_blindness=False,
+    )
+    assert results[0].findings, "mutated jitted kernel not detected"
+
+
+def test_mutated_real_pack_loop_is_detected(tmp_path):
+    """Turning real ragged/pack.py's vectorized hot path into a loop
+    must be flagged."""
+    real = (REPO / "kindel_tpu" / "ragged" / "pack.py").read_text()
+    tree = ast.parse(real)
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.FunctionDef)
+            and node.name == "build_segment_table"
+        ):
+            loop = ast.parse("for _i in range(3):\n    pass").body
+            node.body = loop + node.body
+            break
+    mutated = ast.unparse(ast.fix_missing_locations(tree))
+    pkg = _mk_pkg(tmp_path, {"ragged/pack.py": mutated})
+    results = lint_engine.run(
+        build_project(pkg), rule_ids=["ragged-pack-vectorized"],
+        check_blindness=False,
+    )
+    assert any("For loop" in f.message for f in results[0].findings)
+
+
+# ---------------------------------------------------------- CLI contract
+
+def test_cli_lint_strict_is_clean(capsys):
+    """The tier-1 wrapper: `kindel lint --strict` exits 0 on the tree —
+    all legacy findings baselined, none stale, no blind rules."""
+    from kindel_tpu import cli
+
+    rc = cli.main(["lint", "--strict"])
+    out = capsys.readouterr().out
+    assert rc == 0, f"kindel lint --strict failed:\n{out}"
+    assert "0 new" in out and "0 stale" in out
+
+
+def test_cli_lint_json_format(capsys):
+    from kindel_tpu import cli
+
+    rc = cli.main(["lint", "--format", "json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert set(doc["rules"]) == set(lint_engine.RULES)
+    assert doc["new"] == []
+    assert doc["wall_s"] >= 0
+
+
+def test_cli_lint_sarif_format(capsys):
+    from kindel_tpu import cli
+
+    rc = cli.main(["lint", "--format", "sarif"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["version"] == "2.1.0"
+
+
+def test_cli_lint_unknown_rule_errors(capsys):
+    from kindel_tpu import cli
+
+    assert cli.main(["lint", "--rules", "no-such-rule"]) == 2
+
+
+def test_cli_lint_without_baseline_reports_legacy(capsys):
+    """--baseline none shows the raw debt: the baselined legacy findings
+    become 'new' and the exit code says so."""
+    from kindel_tpu import cli
+
+    rc = cli.main(["lint", "--baseline", "none",
+                   "--rules", "lock-guarded-by"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "lock-guarded-by" in out
+
+
+def test_lint_provenance_object():
+    """bench.py's `lint` provenance: rule count, finding count, wall
+    seconds — the analysis cost tracked like every other stage."""
+    from kindel_tpu.analysis import lint_provenance
+
+    prov = lint_provenance()
+    assert prov["rules"] == len(lint_engine.RULES)
+    assert prov["new"] == 0 and prov["stale_baseline"] == 0
+    assert prov["findings"] >= prov["new"]
+    assert prov["wall_s"] >= 0

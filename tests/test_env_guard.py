@@ -1,540 +1,78 @@
-"""Tier-1 AST guards over kindel_tpu/ — invariants that are cheap to
-state and expensive to debug when broken:
+"""Tier-1 static guard suite — a thin driver over the whole-program
+lint engine (kindel_tpu.analysis, DESIGN.md §18).
 
-  1. tuning knobs resolve at config-build time, never at trace time —
-     no `os.environ` / `os.getenv` read inside a jit-decorated body
-     (the refactor invariant of the tune subsystem, kindel_tpu/tune.py);
-  2. no env read inside `__init__` either — instrumented classes must
-     not cache ambient env state at construction (the PhaseTimer
-     trace-dir bug: an env var exported between construction and
-     trace-start silently lost);
-  3. durations come from `time.perf_counter()` — `time.time()` is a
-     wall clock subject to NTP steps and is banned except for an
-     explicit timestamp allowlist;
-  4. every metric registered through an obs registry carries help text
-     (also enforced at runtime by MetricsRegistry, but the static guard
-     catches sites the tests never execute);
-  5. zlib is a single-chokepoint dependency — `zlib.decompress` /
-     `zlib.decompressobj` (and `import zlib` itself) may only appear
-     inside `kindel_tpu/io/`, so every inflate goes through the
-     parallel-ingest path (kindel_tpu/io/inflate.py) and its metrics /
-     ordering / RSS-bound invariants;
-  6. nothing under `kindel_tpu/io/` imports jax — inflate pool workers
-     execute only io/ code, and a worker thread tripping a lazy backend
-     initialization mid-stream would deadlock or double-init the
-     runtime.
+History: these invariants started life as 13 flat, single-function AST
+checks in this file, each re-reading and re-parsing all of kindel_tpu/
+(13 full passes per suite run). They are now rules over one shared,
+parsed-once project model — the migrated hygiene guards keep their
+exact recognizers and allowlists (kindel_tpu/analysis/rules/hygiene.py),
+and the whole-program analyses the flat checks could not express
+(trace-purity closure, lock discipline, future-settlement, knob/metric
+doc conformance) run beside them. This driver asserts three things:
 
-An env read inside a traced body is doubly wrong: it only runs at trace
-time (so the knob silently stops responding once the kernel is cached),
-and it makes compiled behavior depend on ambient process state that the
-compile cache key does not capture."""
+  1. zero non-baselined findings, per rule (the baseline —
+     tools/lint_baseline.json — is the reviewed legacy-debt ledger;
+     anything new fails here with the offending file:line);
+  2. no stale baseline entries (a fixed finding must take its ledger
+     row with it — the baseline only ever burns down);
+  3. the shared model parsed each file exactly once (the perf fix this
+     migration bought; the counter would catch a regression to
+     per-rule re-parsing).
 
-import ast
-from pathlib import Path
+Rule blindness (`min_sites`) is engine-enforced: a rule that lost its
+inputs emits a finding against itself, so it fails assertion 1. Per-rule
+liveness against known-bad fixtures is pinned in tests/test_analysis.py.
+"""
 
-PKG = Path(__file__).resolve().parent.parent / "kindel_tpu"
+import pytest
+
+from kindel_tpu.analysis import engine as lint_engine
+from kindel_tpu.analysis import load_project
+
+lint_engine._ensure_rules_loaded()
 
 
-def _dotted_parts(node) -> set:
-    """Every Name id / Attribute attr reachable in an expression — enough
-    to recognize jit in `jax.jit`, `jit`, `partial(jax.jit, ...)`,
-    `functools.partial(jit, static_argnames=...)`."""
-    out = set()
-    for n in ast.walk(node):
-        if isinstance(n, ast.Name):
-            out.add(n.id)
-        elif isinstance(n, ast.Attribute):
-            out.add(n.attr)
-    return out
-
-
-def _is_jit_decorated(fn) -> bool:
-    return any("jit" in _dotted_parts(d) for d in fn.decorator_list)
-
-
-def _env_read_lines(fn) -> list:
-    hits = []
-    for n in ast.walk(fn):
-        if isinstance(n, ast.Attribute) and n.attr == "environ":
-            hits.append(n.lineno)
-        elif isinstance(n, ast.Call):
-            f = n.func
-            if (isinstance(f, ast.Attribute) and f.attr == "getenv") or (
-                isinstance(f, ast.Name) and f.id == "getenv"
-            ):
-                hits.append(n.lineno)
-    return hits
-
-
-def test_no_env_reads_inside_jit_traced_function_bodies():
-    offenders = []
-    jitted = 0
-    for py in sorted(PKG.rglob("*.py")):
-        tree = ast.parse(py.read_text(), filename=str(py))
-        for node in ast.walk(tree):
-            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                continue
-            if not _is_jit_decorated(node):
-                continue
-            jitted += 1
-            for line in _env_read_lines(node):
-                offenders.append(
-                    f"{py.relative_to(PKG.parent)}:{line} "
-                    f"(inside jitted `{node.name}`)"
-                )
-    assert not offenders, (
-        "os.environ read inside a jit-traced body — tuning knobs must "
-        "resolve at config-build time (kindel_tpu.tune):\n"
-        + "\n".join(offenders)
+@pytest.fixture(scope="module")
+def lint_state():
+    model = load_project()
+    results = lint_engine.run(model)
+    baseline = lint_engine.load_baseline(
+        lint_engine.default_baseline_path()
     )
-    # the guard must actually be seeing the kernels: if this count ever
-    # drops to ~0 the detector went blind, not the codebase clean
-    assert jitted >= 8, f"only {jitted} jit-decorated functions found"
-
-
-def test_no_env_reads_inside_init_methods():
-    """Instrumented classes (PhaseTimer, tracers, workers) must resolve
-    env state where it is used, never cache it at construction — an env
-    var exported between __init__ and use must win."""
-    offenders = []
-    inits = 0
-    for py in sorted(PKG.rglob("*.py")):
-        tree = ast.parse(py.read_text(), filename=str(py))
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.ClassDef):
-                continue
-            for fn in node.body:
-                if (
-                    isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
-                    and fn.name == "__init__"
-                ):
-                    inits += 1
-                    for line in _env_read_lines(fn):
-                        offenders.append(
-                            f"{py.relative_to(PKG.parent)}:{line} "
-                            f"({node.name}.__init__)"
-                        )
-    assert not offenders, (
-        "os.environ read cached at __init__ time — resolve it where it "
-        "is used instead:\n" + "\n".join(offenders)
+    new, stale = lint_engine.diff_baseline(
+        lint_engine.all_findings(results), baseline
     )
-    assert inits >= 10, f"only {inits} __init__ methods found"
+    return model, results, new, stale
 
 
-#: wall-clock *timestamps* (not durations) where time.time() is the
-#: point: the tune store's recorded_at field is read by humans
-_TIME_TIME_ALLOWLIST = {("tune.py", "record")}
-
-
-def test_no_time_time_for_durations():
-    """Durations must come from time.perf_counter() — time.time() is
-    subject to NTP steps/smearing, and a negative "duration" in a span
-    or a latency histogram is a debugging rabbit hole. Timestamp uses
-    must be allowlisted explicitly."""
-
-    def enclosing_functions(tree):
-        out = {}  # node -> function name
-
-        def visit(node, fname):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                fname = node.name
-            out[node] = fname
-            for child in ast.iter_child_nodes(node):
-                visit(child, fname)
-
-        visit(tree, "<module>")
-        return out
-
-    offenders = []
-    for py in sorted(PKG.rglob("*.py")):
-        tree = ast.parse(py.read_text(), filename=str(py))
-        owners = enclosing_functions(tree)
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
-            f = node.func
-            if not (
-                isinstance(f, ast.Attribute)
-                and f.attr == "time"
-                and isinstance(f.value, ast.Name)
-                and f.value.id == "time"
-            ):
-                continue
-            key = (py.name, owners.get(node, "<module>"))
-            if key in _TIME_TIME_ALLOWLIST:
-                continue
-            offenders.append(
-                f"{py.relative_to(PKG.parent)}:{node.lineno} "
-                f"(in {owners.get(node, '<module>')})"
-            )
-    assert not offenders, (
-        "time.time() used outside the timestamp allowlist — use "
-        "time.perf_counter() for durations:\n" + "\n".join(offenders)
+@pytest.mark.parametrize("rule_id", sorted(lint_engine.RULES))
+def test_rule_has_no_new_findings(lint_state, rule_id):
+    _model, results, new, _stale = lint_state
+    mine = [f for f in new if f.rule == rule_id]
+    spec = lint_engine.RULES[rule_id]
+    assert not mine, (
+        f"[{rule_id}] {spec.doc.splitlines()[0]}\n"
+        "new non-baselined finding(s):\n"
+        + "\n".join(f"  {f.path}:{f.line}: {f.message}" for f in mine)
     )
 
 
-def test_metric_registrations_carry_help_text():
-    """Every `.counter(...)` / `.gauge(...)` / `.histogram(...)` /
-    `.info(...)` registration call passes help text (second positional
-    arg or help_text=), and a literal help string is non-empty — the
-    exposition renders `# HELP` verbatim, and a blank one is useless to
-    whoever is staring at the dashboard."""
-    offenders = []
-    registrations = 0
-    for py in sorted(PKG.rglob("*.py")):
-        tree = ast.parse(py.read_text(), filename=str(py))
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
-            f = node.func
-            if not (
-                isinstance(f, ast.Attribute)
-                and f.attr in ("counter", "gauge", "histogram", "info")
-            ):
-                continue
-            registrations += 1
-            help_arg = None
-            if len(node.args) >= 2:
-                help_arg = node.args[1]
-            else:
-                for kw in node.keywords:
-                    if kw.arg == "help_text":
-                        help_arg = kw.value
-            loc = f"{py.relative_to(PKG.parent)}:{node.lineno}"
-            if help_arg is None:
-                offenders.append(f"{loc} (.{f.attr} without help text)")
-            elif isinstance(help_arg, ast.Constant) and not help_arg.value:
-                offenders.append(f"{loc} (.{f.attr} with empty help)")
-    assert not offenders, (
-        "metric registered without help text:\n" + "\n".join(offenders)
-    )
-    # blindness check, as for the jit guard above
-    assert registrations >= 15, (
-        f"only {registrations} registration calls found"
-    )
-
-
-def test_zlib_only_inside_io_package():
-    """The inflate chokepoint invariant: any `import zlib` (or direct
-    `zlib.decompress` / `zlib.decompressobj` call) outside kindel_tpu/io/
-    bypasses the parallel inflater — its ordering guarantee, its bounded
-    in-flight window, and its ingest metrics. New decompression sites
-    must route through kindel_tpu.io.inflate / kindel_tpu.io.bgzf."""
-    offenders = []
-    io_sites = 0
-    for py in sorted(PKG.rglob("*.py")):
-        inside_io = "io" in py.relative_to(PKG).parts[:1]
-        tree = ast.parse(py.read_text(), filename=str(py))
-        for node in ast.walk(tree):
-            hit = None
-            if isinstance(node, ast.Import):
-                if any(a.name.split(".")[0] == "zlib" for a in node.names):
-                    hit = "import zlib"
-            elif isinstance(node, ast.ImportFrom):
-                if (node.module or "").split(".")[0] == "zlib":
-                    hit = "from zlib import"
-            elif isinstance(node, ast.Call):
-                f = node.func
-                if (
-                    isinstance(f, ast.Attribute)
-                    and f.attr in ("decompress", "decompressobj")
-                    and isinstance(f.value, ast.Name)
-                    and f.value.id == "zlib"
-                ):
-                    hit = f"zlib.{f.attr}"
-            if hit is None:
-                continue
-            if inside_io:
-                io_sites += 1
-            else:
-                offenders.append(
-                    f"{py.relative_to(PKG.parent)}:{node.lineno} ({hit})"
-                )
-    assert not offenders, (
-        "zlib used outside kindel_tpu/io/ — all inflation must go "
-        "through the single chokepoint (kindel_tpu.io.inflate):\n"
-        + "\n".join(offenders)
-    )
-    # blindness check: the chokepoint itself must be visible
-    assert io_sites >= 3, f"only {io_sites} zlib sites found in io/"
-
-
-def test_io_package_never_imports_jax():
-    """Inflate pool workers (kindel_tpu/io/inflate.py) run arbitrary
-    io/-resident code on non-main threads; an `import jax` reachable
-    from io/ could make a worker thread initialize the backend (slow,
-    non-reentrant, and on a tunneled relay potentially hanging the whole
-    ingest). io/ stays a jax-free layer — L0 by construction."""
-    offenders = []
-    checked = 0
-    for py in sorted((PKG / "io").rglob("*.py")):
-        checked += 1
-        tree = ast.parse(py.read_text(), filename=str(py))
-        for node in ast.walk(tree):
-            if isinstance(node, ast.Import):
-                names = [a.name for a in node.names]
-            elif isinstance(node, ast.ImportFrom):
-                names = [node.module or ""]
-            else:
-                continue
-            for name in names:
-                if name == "jax" or name.startswith("jax."):
-                    offenders.append(
-                        f"{py.relative_to(PKG.parent)}:{node.lineno} "
-                        f"(imports {name})"
-                    )
-    assert not offenders, (
-        "jax import inside kindel_tpu/io/ — the ingest layer (and the "
-        "inflate worker threads that execute it) must stay jax-free:\n"
-        + "\n".join(offenders)
-    )
-    assert checked >= 8, f"only {checked} io/ modules found"
-
-
-def test_fleet_package_never_imports_jax():
-    """The fleet tier (kindel_tpu/fleet/) routes tickets and supervises
-    replicas; only the ConsensusServices it assembles ever touch the
-    device. A direct jax import here would let the supervisor's probe
-    thread or the router's placement path trip backend initialization —
-    and would silently couple eviction/drain decisions to device state.
-    L8 stays jax-free by construction, the same bar as io/."""
-    offenders = []
-    checked = 0
-    for py in sorted((PKG / "fleet").rglob("*.py")):
-        checked += 1
-        tree = ast.parse(py.read_text(), filename=str(py))
-        for node in ast.walk(tree):
-            if isinstance(node, ast.Import):
-                names = [a.name for a in node.names]
-            elif isinstance(node, ast.ImportFrom):
-                names = [node.module or ""]
-            else:
-                continue
-            for name in names:
-                if name == "jax" or name.startswith("jax."):
-                    offenders.append(
-                        f"{py.relative_to(PKG.parent)}:{node.lineno} "
-                        f"(imports {name})"
-                    )
-    assert not offenders, (
-        "jax import inside kindel_tpu/fleet/ — the fleet tier "
-        "(router/supervisor) must never touch the device:\n"
-        + "\n".join(offenders)
-    )
-    assert checked >= 4, f"only {checked} fleet/ modules found"
-
-
-#: handler calls that count as "the failure was handled, not swallowed":
-#: resolving a request future, recording it on the breaker/metrics/
-#: probe ladder, or handing it to the degrade ladder (which itself
-#: settles every future). `record_probe_failure` is the fleet
-#: supervisor's handler: a probe/restart exception folds into the
-#: replica's consecutive-probe score (and /healthz surfaces it).
-_FAILURE_HANDLERS = {
-    "_fail", "fail", "_settle", "set_exception", "record_failure",
-    "_recover", "record_degrade", "record_probe_failure",
-}
-
-#: deliberately-swallowing sites, each with a local reason:
-#: service._warm — warmup is best-effort, failure is recorded on
-#: _warm_error and /healthz; service.consensus_post_response — the
-#: handler IS the failure path (it converts to an HTTP 5xx response,
-#: shared by the single service and the fleet front);
-#: service._aot_provenance — a health probe that must answer even when
-#: the AOT store layer is broken (degrades to "disabled", loses no
-#: request); fleet service._replica_healthz — the fleet health document
-#: must render even when one replica's healthz is broken (that IS the
-#: finding: the replica reports "down")
-_SWALLOW_ALLOWLIST = {
-    ("serve/service.py", "_warm"),
-    ("serve/service.py", "consensus_post_response"),
-    ("serve/service.py", "_aot_provenance"),
-    ("fleet/service.py", "_replica_healthz"),
-}
-
-
-def test_aot_compile_surface_confined_to_aot_module():
-    """One AOT surface: `.lower(...).compile(...)` chains and PjRt
-    executable (de)serialization may only appear in kindel_tpu/aot.py.
-    A second lowering/deserialization site would fork the store keying,
-    the parity discipline, and the warn-once fallback — exactly the
-    kind of drift that ends with a replica silently serving a kernel
-    the store never verified. Dispatch sites consult the aot registry;
-    they never compile or deserialize themselves."""
-    _AOT_ATTRS = {
-        "deserialize_and_load",
-        "deserialize_executable",
-        "serialize_executable",
-        "runtime_executable",
-    }
-    offenders = []
-    aot_sites = 0
-    for py in sorted(PKG.rglob("*.py")):
-        is_aot = py.relative_to(PKG).as_posix() == "aot.py"
-        tree = ast.parse(py.read_text(), filename=str(py))
-        for node in ast.walk(tree):
-            hit = None
-            if isinstance(node, ast.Call):
-                f = node.func
-                if (
-                    isinstance(f, ast.Attribute)
-                    and f.attr == "compile"
-                    and isinstance(f.value, ast.Call)
-                    and isinstance(f.value.func, ast.Attribute)
-                    and f.value.func.attr == "lower"
-                ):
-                    hit = ".lower().compile()"
-                elif isinstance(f, ast.Attribute) and f.attr in _AOT_ATTRS:
-                    hit = f".{f.attr}()"
-            elif isinstance(node, ast.Import):
-                if any(
-                    "serialize_executable" in a.name for a in node.names
-                ):
-                    hit = "import serialize_executable"
-            elif isinstance(node, ast.ImportFrom):
-                mod = node.module or ""
-                if "serialize_executable" in mod or any(
-                    a.name == "serialize_executable" for a in node.names
-                ):
-                    hit = "import serialize_executable"
-            if hit is None:
-                continue
-            if is_aot:
-                aot_sites += 1
-            else:
-                offenders.append(
-                    f"{py.relative_to(PKG.parent)}:{node.lineno} ({hit})"
-                )
-    assert not offenders, (
-        "AOT lowering/executable-(de)serialization outside "
-        "kindel_tpu/aot.py — route it through the one AOT surface:\n"
-        + "\n".join(offenders)
-    )
-    # blindness check: the surface itself must be visible
-    assert aot_sites >= 3, f"only {aot_sites} AOT sites found in aot.py"
-
-
-#: ragged/pack.py functions on the superbatch hot path — they run once
-#: per dispatched flush, so per-request Python cost must stay O(1) array
-#: bookkeeping (comprehensions feeding concatenate/cumsum/fromiter),
-#: never an explicit loop that could hide per-element work
-_RAGGED_HOT_FUNCTIONS = {"build_segment_table", "pack_superbatch"}
-
-
-def test_ragged_pack_hot_path_is_vectorized():
-    """Vectorized-only lint over the ragged packer (same style as the
-    zlib/jax confinement guards): no `for`/`while` statement anywhere
-    inside the hot functions of kindel_tpu/ragged/pack.py — numpy does
-    the per-element work; Python touches each request exactly once via
-    comprehensions. (The `.lower().compile()` confinement guard above
-    already covers ragged/: its kernel consults the aot registry and
-    never lowers anything itself.)"""
-    path = PKG / "ragged" / "pack.py"
-    tree = ast.parse(path.read_text(), filename=str(path))
-    offenders = []
-    found = set()
-    for node in ast.walk(tree):
-        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
-        if node.name not in _RAGGED_HOT_FUNCTIONS:
-            continue
-        found.add(node.name)
-        for n in ast.walk(node):
-            if isinstance(n, (ast.For, ast.AsyncFor, ast.While)):
-                offenders.append(
-                    f"kindel_tpu/ragged/pack.py:{n.lineno} "
-                    f"({type(n).__name__} inside `{node.name}`)"
-                )
-    assert not offenders, (
-        "explicit loop on the ragged pack hot path — keep it vectorized "
-        "(numpy concatenate/cumsum over per-request comprehensions):\n"
-        + "\n".join(offenders)
-    )
-    # blindness check: renaming a hot function must fail the guard, not
-    # silently skip it
-    assert found == _RAGGED_HOT_FUNCTIONS, (
-        f"hot functions missing from ragged/pack.py: "
-        f"{_RAGGED_HOT_FUNCTIONS - found}"
-    )
-
-
-def test_no_silent_exception_swallow_in_serve_or_resilience():
-    """Every `except Exception` / `except BaseException` in the
-    serving, resilience, and fleet layers must re-raise, resolve a
-    future, or record the failure — a handler that does none of those
-    is exactly how an admitted request gets silently lost (the
-    invariant the chaos suites enforce dynamically; this guard catches
-    the sites tests never reach)."""
-
-    def names_in(node) -> set:
-        out = set()
-        for n in ast.walk(node):
-            if isinstance(n, ast.Name):
-                out.add(n.id)
-            elif isinstance(n, ast.Attribute):
-                out.add(n.attr)
-        return out
-
-    def catches_broad(handler: ast.ExceptHandler) -> bool:
-        if handler.type is None:  # bare `except:`
-            return True
-        return bool(
-            names_in(handler.type) & {"Exception", "BaseException"}
+def test_baseline_has_no_stale_entries(lint_state):
+    _model, _results, _new, stale = lint_state
+    assert not stale, (
+        "baseline entries no longer produced by the tree — delete them "
+        "from tools/lint_baseline.json so the ledger burns down:\n"
+        + "\n".join(
+            f"  [{e['rule']}] {e['path']}: {e['message']} "
+            f"(frozen {e['frozen']}, present {e['present']})"
+            for e in stale
         )
-
-    def handles_failure(handler: ast.ExceptHandler) -> bool:
-        for n in ast.walk(handler):
-            if isinstance(n, ast.Raise):
-                return True
-            if isinstance(n, ast.Call):
-                f = n.func
-                name = (
-                    f.attr if isinstance(f, ast.Attribute)
-                    else f.id if isinstance(f, ast.Name) else None
-                )
-                if name in _FAILURE_HANDLERS:
-                    return True
-        return False
-
-    def enclosing_functions(tree):
-        out = {}
-
-        def visit(node, fname):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                fname = node.name
-            out[node] = fname
-            for child in ast.iter_child_nodes(node):
-                visit(child, fname)
-
-        visit(tree, "<module>")
-        return out
-
-    offenders = []
-    sites = 0
-    for sub in ("serve", "resilience", "fleet"):
-        for py in sorted((PKG / sub).rglob("*.py")):
-            rel = str(py.relative_to(PKG)).replace("\\", "/")
-            tree = ast.parse(py.read_text(), filename=str(py))
-            owners = enclosing_functions(tree)
-            for node in ast.walk(tree):
-                if not isinstance(node, ast.ExceptHandler):
-                    continue
-                if not catches_broad(node):
-                    continue
-                sites += 1
-                key = (rel, owners.get(node, "<module>"))
-                if key in _SWALLOW_ALLOWLIST:
-                    continue
-                if not handles_failure(node):
-                    offenders.append(
-                        f"kindel_tpu/{rel}:{node.lineno} "
-                        f"(in {owners.get(node, '<module>')})"
-                    )
-    assert not offenders, (
-        "broad except that neither re-raises, resolves a future, nor "
-        "records the failure — add handling or extend "
-        "_SWALLOW_ALLOWLIST with a justification:\n" + "\n".join(offenders)
     )
-    # blindness check: the serve/resilience layers deliberately hold
-    # several isolation boundaries; ~0 means the detector went blind
-    assert sites >= 5, f"only {sites} broad except sites found"
+
+
+def test_model_parses_each_file_exactly_once(lint_state):
+    """The migration's perf contract: the whole rule set runs off one
+    parse per file, and repeated loads reuse the cached model."""
+    model, _results, _new, _stale = lint_state
+    assert model.parse_count == len(model.modules)
+    assert load_project() is model  # memoized — no second parse pass
