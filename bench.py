@@ -310,6 +310,7 @@ def _run_benchmark() -> dict:
     from kindel_tpu.io import inflate as ingest_inflate
 
     ingest_workers, ingest_source = tunelib.resolve_ingest_workers()
+    ingest_mode, ingest_mode_source = tunelib.resolve_ingest_mode()
     ingest_after = {
         k: v for k, v in default_registry().snapshot().items()
         if k.startswith("kindel_ingest_")
@@ -322,12 +323,22 @@ def _run_benchmark() -> dict:
     ingest = {
         "workers": ingest_workers,
         "workers_source": ingest_source,
+        # mode provenance mirrors tune_source: the "ingest no longer
+        # host-bound" claim is attributable to a mode + its origin, and
+        # the device wall split below accounts the moved work
+        "mode": ingest_mode,
+        "mode_source": ingest_mode_source,
         "pool_workers_used": ingest_inflate.pool_workers(),
         "inflate_s": round(ingest_delta("inflate_seconds_total"), 3),
         "scan_s": round(ingest_delta("scan_seconds_total"), 3),
         "expand_s": round(ingest_delta("expand_seconds_total"), 3),
         "read_s": round(ingest_delta("read_seconds_total"), 3),
         "stall_s": round(ingest_delta("stall_seconds_total"), 3),
+        "scan_device_s": round(ingest_delta("scan_device_seconds_total"), 3),
+        "expand_device_s": round(
+            ingest_delta("expand_device_seconds_total"), 3
+        ),
+        "upload_bytes": int(ingest_delta("upload_bytes_total")),
         "members": int(ingest_delta("members_total")),
         "bytes_in": int(ingest_delta("bytes_in_total")),
         "bytes_out": int(ingest_delta("bytes_out_total")),

@@ -33,6 +33,7 @@ NON_TUNING_KNOBS = {
     "KINDEL_TPU_TUNE_CACHE": "tune-store location/gate (read by tune.py)",
     "KINDEL_TPU_FORCE_FUSED": "single-chip kernel pin (disables sharding)",
     "KINDEL_TPU_RAGGED_PALLAS": "Pallas segment-reduction gate",
+    "KINDEL_TPU_DEVINGEST_PALLAS": "Pallas ingest-expansion gate",
     "KINDEL_TPU_AOT_CACHE_MB": "serialized-executable store size cap",
     "KINDEL_TPU_NO_NATIVE_BUILD": "native-kernel build gate",
     "KINDEL_TPU_DISABLE_NATIVE": "native-kernel runtime gate",

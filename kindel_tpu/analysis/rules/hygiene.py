@@ -358,8 +358,12 @@ SWALLOW_ALLOWLIST = {
 
 #: packages whose broad except handlers must handle the failure —
 #: serve/resilience/fleet (original scope) plus ragged/parallel (the
-#: two other layers that sit on the admitted-request path)
-SWALLOW_SCOPE = ("serve", "resilience", "fleet", "ragged", "parallel")
+#: two other layers that sit on the admitted-request path) and
+#: devingest (its oracle-fallback discipline uses TYPED excepts only;
+#: a broad swallow there would hide a device/host divergence)
+SWALLOW_SCOPE = (
+    "serve", "resilience", "fleet", "ragged", "parallel", "devingest",
+)
 
 
 @rule("silent-swallow", min_sites=5)

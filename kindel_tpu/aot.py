@@ -597,6 +597,42 @@ def load_ragged(page_class, opts):
     return load_executable(ragged_sig(page_class.key(), opts.want_masks))
 
 
+def ingest_sig(data_pad: int, cap: int) -> tuple:
+    """Static signature of one device-ingest record-scan executable
+    (kindel_tpu.devingest.scan) — the ingest-mode dimension of the AOT
+    store keying: a replica serving ``--ingest-mode device`` warm-loads
+    its scan executables exactly like cohort/fused/ragged kernels, so a
+    device-ingest replica still starts zero-compile from a warm store.
+    Chunk buffers are power-of-two bucketed, so a handful of signatures
+    covers every stream."""
+    return ("ingest_scan", int(data_pad), int(cap))
+
+
+def export_ingest_scan(data_pad: int, verify: bool = True) -> bool:
+    """AOT-export the devingest record-scan kernel for one buffer
+    bucket (`kindel tune --export-aot` under device ingest mode; serve
+    warmup miss path). The parity probe runs both executables over a
+    zero buffer — deterministic, and the scan is pure."""
+    import jax.numpy as jnp
+
+    from kindel_tpu.devingest import scan as dscan
+
+    cap = dscan.record_capacity(data_pad)
+    sig = ingest_sig(data_pad, cap)
+    args = (jnp.zeros(data_pad, jnp.uint8), jnp.int32(0))
+    return export_executable(
+        dscan.scan_kernel, args, {"cap": cap}, sig, verify=verify,
+    )
+
+
+def load_ingest_scan(data_pad: int):
+    """Load (or fetch from the registry) the scan executable for one
+    buffer bucket; None → the dispatch site runs the jit kernel."""
+    from kindel_tpu.devingest import scan as dscan
+
+    return load_executable(ingest_sig(data_pad, dscan.record_capacity(data_pad)))
+
+
 def export_fused(buf, pads: tuple, length: int, want_masks: bool,
                  c_pad: int | None, verify: bool = True) -> bool:
     """AOT-export the fused single-sample kernel for one upload-buffer

@@ -142,6 +142,15 @@ def _consensus_parser(sub):
              "explicit > $KINDEL_TPU_INGEST_WORKERS > tune store > "
              "per-core default order; 1 = the serial inflate path)",
     )
+    p.add_argument(
+        "--ingest-mode", choices=["host", "device"], default=None,
+        help="where the streamed decode's record scan + CIGAR event "
+             "expansion run: 'host' = numpy (the oracle), 'device' = "
+             "the kindel_tpu.devingest kernels on the accelerator — "
+             "byte-identical output (top of the explicit > "
+             "$KINDEL_TPU_INGEST_MODE > tune store > host order; "
+             "`kindel tune --ingest-mode-budget-s` measures a winner)",
+    )
     _add_backend(p)
 
 
@@ -153,11 +162,16 @@ def cmd_consensus(args) -> int:
         timer = enable_profiling()
         timer.start_trace()
     tuning = None
-    if args.slabs is not None or args.ingest_workers is not None:
+    if (
+        args.slabs is not None
+        or args.ingest_workers is not None
+        or args.ingest_mode is not None
+    ):
         from kindel_tpu.tune import TuningConfig
 
         tuning = TuningConfig(
-            n_slabs=args.slabs, ingest_workers=args.ingest_workers
+            n_slabs=args.slabs, ingest_workers=args.ingest_workers,
+            ingest_mode=args.ingest_mode,
         )
     try:
         res = workloads.bam_to_consensus(
@@ -464,6 +478,13 @@ def _serve_parser(sub):
              "> tune store > default)",
     )
     p.add_argument(
+        "--ingest-mode", choices=["host", "device"], default=None,
+        help="where request decode's record scan + CIGAR expansion "
+             "run: 'host' numpy or the kindel_tpu.devingest device "
+             "kernels — byte-identical output (explicit > "
+             "$KINDEL_TPU_INGEST_MODE > tune store > host)",
+    )
+    p.add_argument(
         "--replicas", type=int, default=1, metavar="N",
         help="run N supervised in-process replicas behind a failover "
              "router (kindel_tpu.fleet): rendezvous-hash placement, "
@@ -521,6 +542,7 @@ def cmd_serve(args) -> int:
         args.lane_coalesce is not None
         or args.batch_mode is not None
         or args.ragged_classes is not None
+        or args.ingest_mode is not None
     ):
         from kindel_tpu.tune import TuningConfig
 
@@ -528,6 +550,7 @@ def cmd_serve(args) -> int:
             lane_coalesce=args.lane_coalesce,
             batch_mode=args.batch_mode,
             ragged_classes=args.ragged_classes,
+            ingest_mode=args.ingest_mode,
         )
     service_kwargs = dict(
         tuning=tuning,
@@ -630,6 +653,14 @@ def _tune_parser(sub):
         "--ingest-budget-s", type=float, default=20.0,
         help="wall budget for the parallel-ingest worker sweep (streamed "
              "decode passes over the same BAM); 0 skips it",
+    )
+    p.add_argument(
+        "--ingest-mode-budget-s", type=float, default=0.0,
+        help="wall budget for the ingest-mode sweep (one streamed "
+             "decode+expand pass per mode: host numpy vs the devingest "
+             "device kernels); the winner persists host-keyed so every "
+             "streamed entry point and serve decode start in the "
+             "measured mode. 0 (default) skips it",
     )
     p.add_argument(
         "--ragged-budget-s", type=float, default=0.0,
@@ -735,6 +766,45 @@ def cmd_tune(args) -> int:
                     "bam_path": str(args.bam_path),
                 },
             )
+    # ingest-mode sweep (kindel_tpu.devingest): one streamed
+    # decode+expand pass per mode, mode explicit (no env mutation); the
+    # winner persists host-keyed next to the worker count so serve
+    # decode and every streamed entry point start in the measured mode
+    mode_chosen, mode_timings, mode_persisted = None, {}, False
+    if args.ingest_mode_budget_s > 0:
+        from kindel_tpu.events import extract_events as _exev
+        from kindel_tpu.io.stream import stream_alignment as _stream
+
+        def mode_pass(mode: str) -> float:
+            t = _time.perf_counter()
+            if mode == "device":
+                from kindel_tpu import devingest
+
+                for _ev in devingest.stream_device_events(
+                    args.bam_path, 16 << 20
+                ):
+                    if hasattr(_ev, "to_host"):
+                        _ev.to_host()  # force the async work (fair wall)
+            else:
+                for _batch in _stream(args.bam_path, 16 << 20):
+                    _exev(_batch)
+            return _time.perf_counter() - t
+
+        mode_chosen, mode_timings = tune.search_ingest_mode(
+            mode_pass, budget_s=args.ingest_mode_budget_s
+        )
+        if not args.dry_run and mode_timings:
+            mode_persisted = tune.record(
+                tune.ingest_store_key(),
+                {
+                    "ingest_mode": mode_chosen,
+                    "mode_timings_s": {
+                        k: round(v, 4) for k, v in mode_timings.items()
+                        if v != float("inf")
+                    },
+                    "bam_path": str(args.bam_path),
+                },
+            )
     # page-class geometry sweep (kindel_tpu.ragged): pack this BAM's
     # units into each candidate class set, time one superbatch launch,
     # persist the winning spec host-keyed
@@ -806,6 +876,13 @@ def cmd_tune(args) -> int:
         "persisted": persisted,
         "store": str(tune.store_path()),
     }
+    if mode_chosen is not None:
+        doc["ingest_mode"] = mode_chosen
+        doc["ingest_mode_timings_s"] = {
+            k: round(v, 4) for k, v in mode_timings.items()
+            if v != float("inf")
+        }
+        doc["ingest_mode_persisted"] = mode_persisted
     if ragged_chosen is not None:
         doc["ragged_classes"] = ragged_chosen
         doc["ragged_timings_s"] = {
@@ -855,12 +932,34 @@ def _export_aot(bam_path: str, ev, dry_run: bool = False) -> dict:
             )
         if aot.export_fused(buf, pads, u.L, False, c_pad):
             fused += 1
+    # the ingest-mode dimension: under device ingest, pre-bake the
+    # devingest record-scan executables for the chunk-buffer buckets a
+    # streamed decode of this BAM would hit, so a device-ingest replica
+    # starts zero-compile too (DESIGN.md §19)
+    ingest_exported = 0
+    from kindel_tpu import tune as _tune
+
+    if _tune.resolve_ingest_mode()[0] == "device":
+        import os as _os
+
+        from kindel_tpu.devingest import _DATA_BUCKET_MIN, _bucket
+        from kindel_tpu.io.stream import DEFAULT_CHUNK_BYTES
+
+        size = _os.path.getsize(bam_path)
+        pads = {
+            _bucket(min(size * 4, DEFAULT_CHUNK_BYTES), _DATA_BUCKET_MIN),
+            _bucket(DEFAULT_CHUNK_BYTES, _DATA_BUCKET_MIN),
+        }
+        for pad in sorted(pads):
+            if aot.export_ingest_scan(pad):
+                ingest_exported += 1
     return {
         "enabled": True,
         "cohort_shapes": {
             label: t.get("source") for label, t in shapes.items()
         },
         "fused_exported": fused,
+        "ingest_scan_exported": ingest_exported,
         **aot.provenance(),
     }
 

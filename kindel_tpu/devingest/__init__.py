@@ -1,0 +1,401 @@
+"""kindel_tpu.devingest — device-side ingest: bytes → events on the
+accelerator.
+
+PR 5 made BGZF inflate parallel; record-boundary scan and CIGAR event
+expansion stayed host Python and became the next chokepoint at high
+worker counts (the ``scan``/``expand`` entries of the ingest wall
+split). Following DNATokenizer's GPU-first byte-to-identifier design
+(PAPERS.md), this package uploads each inflated chunk ONCE as a uint8
+device array and derives all structure with vectorized kernels:
+
+  upload (bytes, one h2d)
+    → scan.py    record-boundary walk on device (tail carried across
+                 chunks exactly like io/stream._scan_complete_records)
+    → fields.py  fixed-layout field gathers + flat CIGAR/SEQ planes
+    → expand.py  masked-scatter event expansion (Pallas-gated wrap
+                 arithmetic), host-exact wrap/bounds per family
+
+feeding events.py's stream format directly: on the jax backend the
+event planes scatter into the accumulator state without a host round
+trip (streaming.StreamAccumulator), while ``to_host()`` materializes
+the host EventSet element-for-element for the numpy oracle and the
+parity harness.
+
+The host path stays the oracle everywhere: reads the vectorized
+expansion cannot reproduce (the trailing-S clamp interaction) route to
+the host exact walk per read, corrupt/truncated inputs re-raise the
+HOST scanner's canonical errors, any device/host disagreement or
+capacity overflow silently falls back to host decode for that chunk,
+and SAM-text input falls back to the host path wholesale. Selected by
+``--ingest-mode device`` resolved like every knob
+(TuningConfig.ingest_mode > KINDEL_TPU_INGEST_MODE > host-keyed store
+> host default). This module imports jax; io/ never imports it.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import Counter
+from pathlib import Path
+from typing import Iterator
+
+import numpy as np
+
+from kindel_tpu.events import EventSet, extract_events
+from kindel_tpu.io import bgzf
+from kindel_tpu.io.bam import _fields_from_offsets, parse_bam_bytes, parse_bam_header
+from kindel_tpu.io.errors import TruncatedInputError
+from kindel_tpu.io.stream import (
+    DEFAULT_CHUNK_BYTES,
+    _inflate_stream,
+    _Prefetcher,
+    _read_bam_header,
+    _scan_complete_records,
+    iter_payload_chunks,
+    sniff_alignment,
+    stream_alignment,
+)
+from kindel_tpu.obs import runtime as obs_runtime
+from kindel_tpu.obs import trace as obs_trace
+
+__all__ = [
+    "DeviceEvents",
+    "extract_events_device",
+    "ingest_chunk",
+    "stream_device_events",
+]
+
+#: chunk-buffer bucket floor (pow2): small test chunks share executables
+_DATA_BUCKET_MIN = 1 << 16
+#: device offsets/fields are int32 — a larger single buffer routes host
+_MAX_DEVICE_BYTES = 2**31 - 64
+
+
+def _bucket(n: int, minimum: int) -> int:
+    from kindel_tpu.pileup_jax import _bucket as _pb
+
+    return _pb(max(int(n), 1), minimum)
+
+
+def _upload(data: bytes):
+    """One h2d of the (bucket-padded) chunk bytes."""
+    import jax.numpy as jnp
+
+    counters = obs_runtime.ingest_counters()
+    pad = _bucket(len(data), _DATA_BUCKET_MIN)
+    buf = np.zeros(pad, dtype=np.uint8)
+    buf[: len(data)] = np.frombuffer(data, dtype=np.uint8)
+    with obs_trace.span("ingest.upload") as sp:
+        dev = jnp.asarray(buf)
+        counters.upload_bytes.inc(len(data))
+        obs_runtime.transfer_counters()[0].inc(pad)
+        if sp is not obs_trace.NOOP_SPAN:
+            sp.set_attribute(bytes=len(data), pad=pad)
+    return dev
+
+
+def _host_chunk_events(data: bytes, offs: np.ndarray, ref_names,
+                       ref_lens) -> EventSet | None:
+    """Host-oracle decode of one chunk's complete records (fallback and
+    disagreement path — byte-identical by definition)."""
+    if not len(offs):
+        return None
+    return extract_events(
+        _fields_from_offsets(data, offs, ref_names, ref_lens)
+    )
+
+
+def _ins_string(data: bytes, seq_start: int, q0: int, ln: int) -> bytes:
+    """Inserted bases as ASCII, decoded from the packed nibbles exactly
+    like the host decoder (SEQ_NT16 per nibble, high first)."""
+    from kindel_tpu.io.bam import SEQ_NT16
+
+    out = bytearray()
+    for k in range(q0, q0 + ln):
+        b = data[seq_start + (k >> 1)]
+        out.append(int(SEQ_NT16[(b >> 4) if (k & 1) == 0 else (b & 0xF)]))
+    return bytes(out)
+
+
+def _present_ref_ids(ref_id: np.ndarray) -> list[int]:
+    """First-appearance reference order (host extractor verbatim)."""
+    present_mask = ref_id >= 0
+    if not present_mask.any():
+        return []
+    rids = ref_id[present_mask]
+    uniq, first_idx = np.unique(rids, return_index=True)
+    return [int(r) for r in uniq[np.argsort(first_idx)]]
+
+
+def ingest_chunk(data: bytes, ref_names, ref_lens):
+    """bytes of BAM record payload → (events, consumed).
+
+    ``events`` is a DeviceEvents (bulk planes on device), a host
+    EventSet (oracle fallback for this chunk), or None (no complete
+    record framed). Corrupt block_size raises the HOST scanner's
+    canonical ValueError. The tail past the last complete record is the
+    caller's carry, exactly like io/stream."""
+    from kindel_tpu.devingest import expand as dexpand
+    from kindel_tpu.devingest import fields as dfields
+    from kindel_tpu.devingest import scan as dscan
+
+    if len(data) > _MAX_DEVICE_BYTES:
+        offs, consumed = _scan_complete_records(data)
+        return _host_chunk_events(data, offs, ref_names, ref_lens), consumed
+
+    import jax.numpy as jnp
+
+    counters = obs_runtime.ingest_counters()
+    data_dev = _upload(data)
+
+    t0 = time.perf_counter()
+    with obs_trace.span("ingest.scan_device") as sp:
+        try:
+            offs, consumed = dscan.scan_records_device(data_dev, data)
+        except dscan._DeviceScanDisagreement:
+            offs, consumed = _scan_complete_records(data)
+            ev = _host_chunk_events(data, offs, ref_names, ref_lens)
+            counters.scan_device_s.inc(time.perf_counter() - t0)
+            return ev, consumed
+        counters.scan_device_s.inc(time.perf_counter() - t0)
+        if sp is not obs_trace.NOOP_SPAN:
+            sp.set_attribute(records=len(offs), consumed=consumed)
+    n_rec = len(offs)
+    if n_rec == 0:
+        return None, consumed
+
+    t1 = time.perf_counter()
+    with obs_trace.span("ingest.expand_device") as sp:
+        ev = _expand_chunk(
+            data, data_dev, offs, ref_names, ref_lens,
+            dfields, dexpand, jnp,
+        )
+        counters.expand_device_s.inc(time.perf_counter() - t1)
+        if sp is not obs_trace.NOOP_SPAN:
+            sp.set_attribute(
+                records=n_rec,
+                device=not isinstance(ev, EventSet),
+            )
+    return ev, consumed
+
+
+def _expand_chunk(data, data_dev, offs, ref_names, ref_lens,
+                  dfields, dexpand, jnp):
+    """fields → count → expand for one scanned chunk (device planes out;
+    host-oracle EventSet out on capacity overflow)."""
+    n_rec = len(offs)
+    rec_cap = _bucket(n_rec, 256)
+    offs_pad = np.full(rec_cap, 4, dtype=np.int32)
+    offs_pad[:n_rec] = offs.astype(np.int32)
+    rec = np.asarray(dfields.rec_kernel(data_dev, jnp.asarray(offs_pad)))
+    rec = rec[:, :n_rec]
+    dfields.validate_fields(rec, offs, len(ref_lens))
+
+    ref_id = rec[dfields.REC_REF_ID]
+    pos = rec[dfields.REC_POS]
+    l_read_name = rec[dfields.REC_LNAME].astype(np.int64)
+    n_cigar = rec[dfields.REC_NCIG].astype(np.int64)
+    flag = rec[dfields.REC_FLAG]
+    l_seq = rec[dfields.REC_LSEQ].astype(np.int64)
+
+    cig_start = offs + 32 + l_read_name
+    seq_start = cig_start + 4 * n_cigar
+    cig_off = np.zeros(n_rec + 1, dtype=np.int64)
+    np.cumsum(n_cigar, out=cig_off[1:])
+    seq_off = np.zeros(n_rec + 1, dtype=np.int64)
+    np.cumsum(l_seq, out=seq_off[1:])
+    op_total = int(cig_off[-1])
+    s_total = int(seq_off[-1])
+    if op_total > 2**30 or s_total > 2**30:
+        # int32 flat-plane territory: the host oracle owns this chunk
+        return _host_chunk_events(data, offs, ref_names, ref_lens)
+    keep = (
+        (ref_id >= 0)
+        & ((flag & np.int32(0x4)) == 0)
+        & (l_seq > 1)
+    )
+    present = _present_ref_ids(ref_id)
+    ref_lens64 = np.asarray(ref_lens, dtype=np.int64)
+
+    def pad_rec(arr, fill, dtype=np.int32):
+        out = np.full(rec_cap, fill, dtype=dtype)
+        out[:n_rec] = arr
+        return jnp.asarray(out)
+
+    def pad_off(arr):
+        out = np.full(rec_cap + 1, arr[-1], dtype=np.int32)
+        out[: n_rec + 1] = arr
+        return jnp.asarray(out)
+
+    op_cap = _bucket(op_total, 256)
+    s_cap = _bucket(s_total, 1024)
+    cig_start_dev = pad_rec(cig_start, 4)
+    cig_off_dev = pad_off(cig_off)
+    seq_off_dev = pad_off(seq_off)
+    pos_dev = pad_rec(pos, 0)
+    rid_dev = pad_rec(ref_id, -1)
+    keep_dev = pad_rec(keep, False, dtype=bool)
+    lens_dev = jnp.asarray(
+        np.maximum(ref_lens64, 0).astype(np.int32)
+        if len(ref_lens64) else np.zeros(1, np.int32)
+    )
+
+    op_code, op_len, op_i, op_read = dfields.ops_kernel(
+        data_dev, cig_start_dev, cig_off_dev, cap=op_cap
+    )
+    seq_codes = dfields.seq_kernel(
+        data_dev, pad_rec(seq_start, 4), seq_off_dev, cap=s_cap
+    )
+
+    n_ops = jnp.int32(op_total)
+    totals, slow = dexpand.count_kernel(
+        op_code, op_len, op_i, op_read, cig_off_dev, pos_dev, rid_dev,
+        keep_dev, seq_off_dev, lens_dev, n_ops,
+    )
+    totals = np.asarray(totals)
+    slow = np.asarray(slow)[:n_rec]
+    if (totals < 0).any() or int(totals.max()) > dexpand.EVENT_CAP_LIMIT:
+        # a lying CIGAR sum would size an absurd device plane: the host
+        # oracle owns this chunk (it allocates O(total) the same way)
+        return _host_chunk_events(data, offs, ref_names, ref_lens)
+
+    caps = {
+        f"cap_{name}": _bucket(int(t), 1024)
+        for name, t in zip(dexpand.FAMILIES, totals)
+    }
+    planes = dexpand.expand_kernel(
+        op_code, op_len, op_i, op_read, cig_off_dev, pos_dev, rid_dev,
+        keep_dev, seq_off_dev, lens_dev, seq_codes, n_ops,
+        pallas=dexpand.use_pallas_expand(), **caps,
+    )
+
+    # --- insertions: host dictionary encoding from the descriptors ---
+    insertions: Counter = Counter()
+    ins = [np.asarray(a) for a in planes.pop("ins")]
+    i_rec, i_r, i_q, i_len, i_rid, i_l, i_ok = ins
+    for j in np.flatnonzero(i_ok):
+        L1 = int(i_l[j]) + 1
+        p = int(i_r[j])
+        if p < 0:
+            p += L1
+        if 0 <= p < L1:
+            nts = _ins_string(
+                data, int(seq_start[i_rec[j]]), int(i_q[j]),
+                int(i_len[j]),
+            )
+            insertions[(int(i_rid[j]), p, nts)] += 1
+
+    # --- slow reads: the host oracle's exact per-read walk ---
+    slow_events: dict = {}
+    slow_idx = np.flatnonzero(slow)
+    if len(slow_idx):
+        from kindel_tpu.events import _exact_read_events
+
+        mini = _fields_from_offsets(
+            data, offs[slow_idx], ref_names, ref_lens64
+        )
+        out = {
+            "match": ([], [], []), "del": ([], []), "cs": ([], []),
+            "ce": ([], []), "csw": ([], [], []), "cew": ([], [], []),
+        }
+        for k in range(len(slow_idx)):
+            _exact_read_events(out, insertions, mini, k)
+        for key, cols in out.items():
+            slow_events[key] = list(zip(*cols)) if cols[0] else []
+
+    return dexpand.DeviceEvents(
+        ref_names=ref_names, ref_lens=ref_lens64,
+        present_ref_ids=present, insertions=insertions, planes=planes,
+        slow_events=slow_events, n_records=n_rec,
+    )
+
+
+# re-export for consumers (streaming's device-resident reduce)
+from kindel_tpu.devingest.expand import DeviceEvents, rid_flat_index  # noqa: E402
+
+
+def extract_events_device(data: bytes) -> EventSet:
+    """One-shot payload decode (serve's per-request path): whole BAM
+    byte string → host EventSet via the device kernels. Any anomaly —
+    corrupt record, truncated tail, scan disagreement — re-runs the
+    HOST decoder so the raised error (or accepted result) is canonical.
+    Compressed payloads inflate through io first (zlib stays in io/)."""
+    raw = bytes(data)
+    if bgzf.is_gzipped(raw[:4]):
+        raw = bgzf.decompress(raw)
+    ref_names, ref_lens, first = parse_bam_header(raw)
+    payload = raw[first:]
+    try:
+        ev, consumed = ingest_chunk(payload, ref_names, ref_lens)
+    except ValueError:
+        # host-oracle error surface: the slurp decoder raises (or
+        # accepts) canonically for this payload
+        return extract_events(parse_bam_bytes(raw))
+    if consumed != len(payload) or ev is None:
+        return extract_events(parse_bam_bytes(raw))
+    return ev.to_host() if isinstance(ev, DeviceEvents) else ev
+
+
+def _host_fallback_events(path, chunk_bytes, ingest_workers):
+    """SAM text (or anything the device tier does not frame): the host
+    path wholesale — stream, extract, yield host EventSets."""
+    for batch in stream_alignment(path, chunk_bytes, ingest_workers):
+        yield extract_events(batch)
+
+
+def stream_device_events(
+    path, chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+    ingest_workers: int | None = None,
+) -> Iterator:
+    """Device-ingest counterpart of io.stream.stream_alignment: yields
+    one DeviceEvents (or host-oracle EventSet) per ~chunk_bytes of
+    decompressed payload. The inflate pool runs ahead on host threads
+    (io.inflate), the upload of chunk k+1 overlaps the expansion of
+    chunk k through jax's async dispatch, and truncation/fault
+    attribution (path, chunk index, message) is identical to the host
+    path — both consume io.stream.iter_payload_chunks, the one
+    io.read_chunk hook site."""
+    path = Path(path)
+    if sniff_alignment(path) != "bam":
+        yield from _host_fallback_events(path, chunk_bytes, ingest_workers)
+        return
+    with open(path, "rb") as fh:
+        pf = _Prefetcher(_inflate_stream(fh, ingest_workers))
+        try:
+            ref_names, ref_lens = _read_bam_header(pf)
+        except TruncatedInputError as e:
+            e.path = path
+            e.chunk_index = 0
+            raise
+        carry = b""
+        chunk_index = 0
+        payload = iter_payload_chunks(pf, chunk_bytes)
+        while True:
+            try:
+                new, exhausted = next(payload)
+                data = carry + new
+                if not data:
+                    break
+                ev, consumed = ingest_chunk(data, ref_names, ref_lens)
+            except TruncatedInputError as e:
+                e.path = path
+                e.chunk_index = chunk_index
+                raise
+            if consumed == 0 and exhausted:
+                raise TruncatedInputError(
+                    f"truncated BAM record at end of stream "
+                    f"({len(data)} trailing bytes)",
+                    path=path, chunk_index=chunk_index,
+                )
+            carry = data[consumed:]
+            if ev is not None:
+                yield ev
+            chunk_index += 1
+            if exhausted and not carry:
+                break
+        if carry:
+            raise TruncatedInputError(
+                f"truncated BAM record at end of stream "
+                f"({len(carry)} trailing bytes)",
+                path=path, chunk_index=max(chunk_index - 1, 0),
+            )

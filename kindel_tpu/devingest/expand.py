@@ -1,0 +1,416 @@
+"""Device-side CIGAR-op expansion: flat op arrays → pileup event streams.
+
+The host expander (events._extract_events_impl) turns each CIGAR op
+into 0..op_len events per channel family with numpy repeat/arange
+ragged expansion. Here the same expansion runs on the accelerator as a
+masked scatter over fixed-capacity event planes:
+
+  1. ``count_kernel`` — per-op reference/query advances (the host
+     ``_advances`` rules verbatim), per-read exclusive cumsums
+     (segmented prefix-sum restarting at each record), the host's
+     trailing-S clamp detection routed per read (``slow`` reads go to
+     the host oracle's exact walk, exactly like the host fast path
+     routes them), and exact per-family event totals.
+  2. ``expand_kernel`` — for each family, the inverse ragged expansion:
+     event e's op is a searchsorted bucket over the per-op count
+     cumsum, its local index the distance from the op's first event;
+     position wrap + bounds masks mirror events._wrap/_fast_events
+     branch for branch, so the emitted (rid, pos, base, ok) planes are
+     the host streams element-for-element (pad slots masked by ``ok``).
+
+The per-event wrap+bounds arithmetic has a Pallas block-tiled fast
+path behind the same backend-gate pattern as ragged/kernel.py
+(``KINDEL_TPU_DEVINGEST_PALLAS`` overrides; default on only off-CPU;
+interpret mode serves the CPU parity tests). Event capacities are
+power-of-two buckets of the exact totals, so a chunk stream
+re-dispatches a bounded set of compiled executables.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from functools import partial
+
+from kindel_tpu.utils.jax_cache import ensure_compilation_cache
+
+ensure_compilation_cache()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kindel_tpu.events import N_CHANNELS, EventSet
+from kindel_tpu.io.records import (
+    OP_D,
+    OP_EQ,
+    OP_I,
+    OP_M,
+    OP_N,
+    OP_S,
+    OP_X,
+)
+
+_INT32_MAX = np.int32(2**31 - 1)
+
+#: event-plane block width of the Pallas wrap/bounds kernel (capacities
+#: are power-of-two buckets >= 1024, so planes always divide)
+_PALLAS_BLOCK = 1024
+
+#: per-family event ceiling — past this the chunk routes to the host
+#: oracle instead of sizing a device plane from a (possibly lying)
+#: CIGAR sum; the host path allocates O(total) for the same input, so
+#: this only trades device OOM for the host's own behavior
+EVENT_CAP_LIMIT = 1 << 27
+
+#: family order of count_kernel's totals vector
+FAMILIES = ("match", "del", "ins", "ce", "cew", "cs", "csw")
+
+
+def use_pallas_expand() -> bool:
+    """Gate of the Pallas wrap/bounds fast path, resolved on the host at
+    launch time (never inside the traced body — tier-1 guard):
+    KINDEL_TPU_DEVINGEST_PALLAS=1/0 overrides; default on only off-CPU.
+    On CPU the override runs the kernel in interpret mode (tests)."""
+    import os
+
+    override = os.environ.get("KINDEL_TPU_DEVINGEST_PALLAS")
+    if override is not None:
+        return override not in ("0", "")
+    return jax.default_backend() != "cpu"
+
+
+def _geometry(op_code, op_len, op_i, op_read, cig_off, pos_rec, rid_rec,
+              keep_rec, seq_off, ref_lens, n_ops):
+    """Shared per-op geometry (host events._advances + the exclusive
+    segmented cumsums + clamp routing), used identically by the count
+    and expand kernels so their masks can never drift."""
+    op_cap = op_code.shape[0]
+    e = jnp.arange(op_cap, dtype=jnp.int32)
+    valid = (e < n_ops) & keep_rec[op_read]
+
+    is_m = (op_code == OP_M) | (op_code == OP_EQ) | (op_code == OP_X)
+    is_i = op_code == OP_I
+    is_d = op_code == OP_D
+    is_s = op_code == OP_S
+    is_ts = is_s & (op_i > 0)
+
+    ref_adv = jnp.where(
+        is_m | is_d | (op_code == OP_N) | is_ts, op_len, 0
+    ).astype(jnp.int32)
+    qry_adv = jnp.where(is_m | is_i | is_s, op_len, 0).astype(jnp.int32)
+    # pad/invalid ops contribute nothing past their read (cumsum is
+    # rebased per read below), but zero them for cleanliness
+    in_stream = e < n_ops
+    ref_adv = jnp.where(in_stream, ref_adv, 0)
+    qry_adv = jnp.where(in_stream, qry_adv, 0)
+
+    first_op = cig_off[op_read]
+    excl_r = jnp.cumsum(ref_adv) - ref_adv
+    excl_q = jnp.cumsum(qry_adv) - qry_adv
+    r_excl = excl_r - excl_r[first_op]
+    q_excl = excl_q - excl_q[first_op]
+
+    rid = jnp.maximum(rid_rec[op_read], 0)
+    L = ref_lens[rid]
+    r_start = pos_rec[op_read] + r_excl
+    q_abs = seq_off[op_read] + q_excl
+
+    # trailing-S clamp routing (host slow_read predicate verbatim)
+    clamped = is_ts & (r_start + op_len > L) & valid
+    matters = (is_m | is_i | is_d | is_s) & valid
+    first_clamped = jax.ops.segment_min(
+        jnp.where(clamped, op_i, _INT32_MAX), op_read,
+        num_segments=pos_rec.shape[0],
+    )
+    last_matters = jax.ops.segment_max(
+        jnp.where(matters, op_i, -1), op_read,
+        num_segments=pos_rec.shape[0],
+    )
+    slow_read = first_clamped < last_matters
+    fast = valid & ~slow_read[op_read]
+
+    counts = {
+        "match": jnp.where(fast & is_m, op_len, 0),
+        "del": jnp.where(fast & is_d, op_len, 0),
+        "ins": jnp.where(fast & is_i, 1, 0),
+        "ce": jnp.where(fast & is_s & (op_i == 0), 1, 0),
+        "cew": jnp.where(fast & is_s & (op_i == 0), op_len, 0),
+        "cs": jnp.where(fast & is_s & (op_i > 0), 1, 0),
+        "csw": jnp.where(fast & is_s & (op_i > 0), op_len, 0),
+    }
+    geo = {
+        "rid": rid_rec[op_read], "L": L, "r_start": r_start,
+        "q_abs": q_abs, "op_len": op_len, "op_read": op_read,
+        "q_excl": q_excl,
+    }
+    return counts, geo, slow_read
+
+
+@jax.jit
+def count_kernel(op_code, op_len, op_i, op_read, cig_off, pos_rec,
+                 rid_rec, keep_rec, seq_off, ref_lens, n_ops):
+    """Exact per-family event totals + the slow-read routing mask —
+    the capacity-planning half of the expansion (one small download
+    sizes the expand planes)."""
+    counts, _geo, slow_read = _geometry(
+        op_code, op_len, op_i, op_read, cig_off, pos_rec, rid_rec,
+        keep_rec, seq_off, ref_lens, n_ops,
+    )
+    totals = jnp.stack([counts[f].sum() for f in FAMILIES])
+    return totals, slow_read
+
+
+def _wrap_xla(p, mod):
+    p2 = jnp.where(p < 0, p + mod, p)
+    return p2, (p2 >= 0) & (p2 < mod)
+
+
+def _wrap_pallas_kernel(p_ref, m_ref, out_p_ref, out_ok_ref):
+    p = p_ref[0, :]
+    m = m_ref[0, :]
+    p2 = jnp.where(p < 0, p + m, p)
+    out_p_ref[0, :] = p2
+    out_ok_ref[0, :] = ((p2 >= 0) & (p2 < m)).astype(jnp.int32)
+
+
+def _wrap_pallas(p, mod):
+    """Pallas block-tiled wrap+bounds over one event plane (the
+    per-event hot arithmetic); interpret mode on CPU — the gate only
+    reaches here off-CPU or under the env override."""
+    from jax.experimental import pallas as pl
+
+    cap = int(p.shape[0])
+    grid = cap // _PALLAS_BLOCK
+    interpret = jax.default_backend() == "cpu"
+    p2, ok = pl.pallas_call(
+        _wrap_pallas_kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((1, _PALLAS_BLOCK), lambda i: (0, i)),
+            pl.BlockSpec((1, _PALLAS_BLOCK), lambda i: (0, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, _PALLAS_BLOCK), lambda i: (0, i)),
+            pl.BlockSpec((1, _PALLAS_BLOCK), lambda i: (0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, cap), jnp.int32),
+            jax.ShapeDtypeStruct((1, cap), jnp.int32),
+        ],
+        interpret=interpret,
+    )(p[None, :], mod[None, :])
+    return p2[0], ok[0].astype(jnp.bool_)
+
+
+def _emit(counts, cap: int):
+    """Inverse ragged expansion for one family: event index → (owning
+    op, local offset, in-stream mask)."""
+    incl = jnp.cumsum(counts)
+    total = incl[-1]
+    e = jnp.arange(cap, dtype=jnp.int32)
+    op_of = jnp.searchsorted(incl, e, side="right").astype(jnp.int32)
+    op_of = jnp.minimum(op_of, counts.shape[0] - 1)
+    local = e - (incl[op_of] - counts[op_of])
+    return op_of, local, e < total
+
+
+@partial(jax.jit, static_argnames=(
+    "cap_match", "cap_del", "cap_ins", "cap_ce", "cap_cew", "cap_cs",
+    "cap_csw", "pallas",
+))
+def expand_kernel(op_code, op_len, op_i, op_read, cig_off, pos_rec,
+                  rid_rec, keep_rec, seq_off, ref_lens, seq_codes, n_ops,
+                  *, cap_match: int, cap_del: int, cap_ins: int,
+                  cap_ce: int, cap_cew: int, cap_cs: int, cap_csw: int,
+                  pallas: bool = False):
+    """Expand every fast op into its event streams (module docstring);
+    returns a dict of per-family (rid, pos[, base], ok) planes plus the
+    insertion descriptors the host dictionary-encodes."""
+    counts, geo, _slow = _geometry(
+        op_code, op_len, op_i, op_read, cig_off, pos_rec, rid_rec,
+        keep_rec, seq_off, ref_lens, n_ops,
+    )
+    wrap = _wrap_pallas if pallas else _wrap_xla
+    out = {}
+
+    # --- M/=/X: one weighted event per aligned base (mod L) ---
+    op, loc, ok = _emit(counts["match"], cap_match)
+    p, bok = wrap(geo["r_start"][op] + loc, geo["L"][op])
+    out["match"] = (
+        geo["rid"][op], p, seq_codes[geo["q_abs"][op] + loc], ok & bok,
+    )
+
+    # --- D: one event per deleted reference position (mod L+1) ---
+    op, loc, ok = _emit(counts["del"], cap_del)
+    p, bok = wrap(geo["r_start"][op] + loc, geo["L"][op] + 1)
+    out["del"] = (geo["rid"][op], p, ok & bok)
+
+    # --- S at i==0: clip_ends event (mod L+1) + leftward projection ---
+    op, _loc, ok = _emit(counts["ce"], cap_ce)
+    p, bok = wrap(geo["r_start"][op], geo["L"][op] + 1)
+    out["ce"] = (geo["rid"][op], p, ok & bok)
+
+    op, loc, ok = _emit(counts["cew"], cap_cew)
+    rel = geo["r_start"][op] - geo["op_len"][op] + loc
+    L = geo["L"][op]
+    out["cew"] = (
+        geo["rid"][op], rel, seq_codes[geo["q_abs"][op] + loc],
+        ok & (rel >= 0) & (rel < L),  # reference guards rel >= 0, no wrap
+    )
+
+    # --- S at i>0: clip_starts event + rightward projection ---
+    op, _loc, ok = _emit(counts["cs"], cap_cs)
+    p, bok = wrap(geo["r_start"][op] - 1, geo["L"][op] + 1)
+    out["cs"] = (geo["rid"][op], p, ok & bok)
+
+    op, loc, ok = _emit(counts["csw"], cap_csw)
+    praw = geo["r_start"][op] + loc
+    L = geo["L"][op]
+    pre = praw < L  # writes stop when r_pos reaches ref_len
+    p = jnp.where(praw < 0, praw + L, praw)
+    out["csw"] = (
+        geo["rid"][op], p, seq_codes[geo["q_abs"][op] + loc],
+        ok & pre & (p >= 0),
+    )
+
+    # --- I: descriptors only — the host dictionary-encodes strings ---
+    op, _loc, ok = _emit(counts["ins"], cap_ins)
+    out["ins"] = (
+        geo["op_read"][op], geo["r_start"][op], geo["q_excl"][op],
+        geo["op_len"][op], geo["rid"][op], geo["L"][op], ok,
+    )
+    return out
+
+
+# ------------------------------------------------------------ container
+
+def _np64(a):
+    return np.asarray(a).astype(np.int64, copy=False)
+
+
+class DeviceEvents:
+    """One chunk's event streams, resident on device.
+
+    Exposes the EventSet header surface (ref_names/ref_lens/
+    present_ref_ids/insertions) so accumulators latch state identically;
+    the bulk streams stay as fixed-capacity device planes consumed
+    either by the device-resident scatter (streaming.StreamAccumulator
+    on the jax backend — no host round-trip) or materialized once via
+    ``to_host()`` into a host EventSet that is element-for-element the
+    host expander's output (fast events in flat-op order, then the
+    slow reads' exact-walk events in record order)."""
+
+    def __init__(self, ref_names, ref_lens, present_ref_ids, insertions,
+                 planes, slow_events, n_records: int):
+        self.ref_names = ref_names
+        self.ref_lens = ref_lens
+        self.present_ref_ids = present_ref_ids
+        self.insertions: Counter = insertions
+        self.planes = planes          # family -> tuple of device arrays
+        self.slow_events = slow_events  # events-dict of host arrays
+        self.n_records = n_records
+        self._host: EventSet | None = None
+
+    def to_host(self) -> EventSet:
+        """Download + compact into the host EventSet (cached)."""
+        if self._host is not None:
+            return self._host
+
+        def fam(name, with_base):
+            arrs = self.planes[name]
+            parts_r, parts_p, parts_b = [], [], []
+            if arrs is not None:
+                ok = np.asarray(arrs[-1])
+                parts_r.append(_np64(arrs[0])[ok])
+                parts_p.append(_np64(arrs[1])[ok])
+                if with_base:
+                    parts_b.append(
+                        np.asarray(arrs[2]).astype(np.uint8)[ok]
+                    )
+            key = {"match": "match", "del": "del", "ce": "ce",
+                   "cs": "cs", "cew": "cew", "csw": "csw"}[name]
+            for part in self.slow_events.get(key, ()):
+                parts_r.append(part[0])
+                parts_p.append(part[1])
+                if with_base:
+                    parts_b.append(part[2])
+
+            def cat(parts, dtype):
+                if not parts:
+                    return np.empty(0, dtype=dtype)
+                return np.concatenate(
+                    [np.asarray(p, dtype=dtype) for p in parts]
+                )
+
+            if with_base:
+                return (cat(parts_r, np.int64), cat(parts_p, np.int64),
+                        cat(parts_b, np.uint8))
+            return cat(parts_r, np.int64), cat(parts_p, np.int64)
+
+        m = fam("match", True)
+        d = fam("del", False)
+        cs = fam("cs", False)
+        ce = fam("ce", False)
+        csw = fam("csw", True)
+        cew = fam("cew", True)
+        self._host = EventSet(
+            ref_names=self.ref_names, ref_lens=self.ref_lens,
+            present_ref_ids=self.present_ref_ids,
+            match_rid=m[0], match_pos=m[1], match_base=m[2],
+            del_rid=d[0], del_pos=d[1],
+            cs_rid=cs[0], cs_pos=cs[1], ce_rid=ce[0], ce_pos=ce[1],
+            csw_rid=csw[0], csw_pos=csw[1], csw_base=csw[2],
+            cew_rid=cew[0], cew_pos=cew[1], cew_base=cew[2],
+            insertions=self.insertions,
+        )
+        return self._host
+
+    def host_residue(self) -> EventSet | None:
+        """The slow reads' host-walked events alone, as an EventSet
+        (None when every read took the fast path) — the device-resident
+        reduce adds these through the ordinary host scatter while the
+        bulk planes scatter straight from device."""
+        if not any(self.slow_events.values()):
+            return None
+
+        def cat(key, col, dtype):
+            parts = [p[col] for p in self.slow_events.get(key, ())]
+            if not parts:
+                return np.empty(0, dtype=dtype)
+            return np.concatenate(
+                [np.asarray(p, dtype=dtype) for p in parts]
+            )
+
+        return EventSet(
+            ref_names=self.ref_names, ref_lens=self.ref_lens,
+            present_ref_ids=self.present_ref_ids,
+            match_rid=cat("match", 0, np.int64),
+            match_pos=cat("match", 1, np.int64),
+            match_base=cat("match", 2, np.uint8),
+            del_rid=cat("del", 0, np.int64),
+            del_pos=cat("del", 1, np.int64),
+            cs_rid=cat("cs", 0, np.int64), cs_pos=cat("cs", 1, np.int64),
+            ce_rid=cat("ce", 0, np.int64), ce_pos=cat("ce", 1, np.int64),
+            csw_rid=cat("csw", 0, np.int64),
+            csw_pos=cat("csw", 1, np.int64),
+            csw_base=cat("csw", 2, np.uint8),
+            cew_rid=cat("cew", 0, np.int64),
+            cew_pos=cat("cew", 1, np.int64),
+            cew_base=cat("cew", 2, np.uint8),
+            insertions=Counter(),  # already merged into self.insertions
+        )
+
+
+@partial(jax.jit, static_argnames=("weighted",))
+def rid_flat_index(rid_arr, pos, base, ok, rid, sentinel,
+                   *, weighted: bool):
+    """Device-resident scatter indices for one (family, reference):
+    events of other references / pad slots take the sentinel (one past
+    the state's end, dropped by the scatter's mode="drop") — fixed
+    shapes, no download, the jax-backend accumulator's fast path."""
+    sel = ok & (rid_arr == rid)
+    if weighted:
+        idx = pos * np.int32(N_CHANNELS) + base.astype(jnp.int32)
+    else:
+        idx = pos
+    return jnp.where(sel, idx, sentinel)

@@ -1,0 +1,131 @@
+"""Device-side record-boundary scan: BAM payload bytes → record offsets.
+
+The host streamer walks record boundaries one ``struct.unpack`` at a
+time (io/stream._scan_complete_records). Here the same walk runs ON the
+accelerator over an uploaded uint8 chunk: a ``lax.while_loop`` chases
+the block_size chain (the chain is genuinely data-dependent — each
+boundary is only known once the previous block_size is read — so the
+walk is sequential by construction; everything downstream of it in
+fields.py/expand.py is fully vectorized), emitting record-body offsets
+into a fixed-capacity plane. The tail beyond the last complete record
+is carried into the next chunk by the driver, exactly like the host
+path, and a corrupt block_size stops the walk with the offending
+offset so the host can raise the identical error.
+
+Shapes are static per (padded-buffer, capacity) pair: the driver pads
+chunks to power-of-two buckets, so a handful of executables serve every
+chunk of a stream — and each is AOT-exportable (kindel_tpu.aot
+``ingest_sig``), so a device-ingest replica warm-loads them like every
+other kernel.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+from kindel_tpu.utils.jax_cache import ensure_compilation_cache
+
+ensure_compilation_cache()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kindel_tpu.io.stream import _MAX_RECORD_BYTES, _scan_complete_records
+
+#: block_size floor (record body is >= 32 fixed bytes) — mirror of the
+#: host scanner's lower bound
+_MIN_BLOCK = 32
+
+
+def record_capacity(data_pad: int) -> int:
+    """Offset-plane capacity for a padded buffer: every complete record
+    consumes >= 4 + _MIN_BLOCK bytes, so this bound is never hit before
+    the buffer runs out."""
+    return data_pad // (_MIN_BLOCK + 4) + 1
+
+
+@partial(jax.jit, static_argnames=("cap",))
+def scan_kernel(data, n_bytes, *, cap: int):
+    """Chase the block_size chain over ``data[:n_bytes]``.
+
+    Returns (offsets[cap] int32 record-BODY offsets, count, consumed,
+    bad_off, bad_bs): ``bad_off`` >= 0 flags a corrupt block_size at
+    that offset (value in ``bad_bs``) — the host raises; otherwise
+    ``consumed`` bytes of complete records were framed and the rest is
+    the carry tail."""
+
+    def le32(off):
+        b = jax.lax.dynamic_slice(data, (off,), (4,)).astype(jnp.uint32)
+        word = b[0] | (b[1] << 8) | (b[2] << 16) | (b[3] << 24)
+        return jax.lax.bitcast_convert_type(word, jnp.int32)
+
+    def cond(state):
+        off, count, _offs, bad_off, _bad_bs, done = state
+        return (~done) & (off + 4 <= n_bytes) & (count < cap) & (bad_off < 0)
+
+    def body(state):
+        off, count, offs, bad_off, bad_bs, _done = state
+        bs = le32(off)
+        corrupt = (bs < _MIN_BLOCK) | (bs > _MAX_RECORD_BYTES)
+        fits = (~corrupt) & (off + 4 + bs <= n_bytes)
+        offs = offs.at[jnp.where(fits, count, cap)].set(
+            off + 4, mode="drop"
+        )
+        return (
+            jnp.where(fits, off + 4 + bs, off),
+            count + fits.astype(jnp.int32),
+            offs,
+            jnp.where(corrupt, off, bad_off),
+            jnp.where(corrupt, bs, bad_bs),
+            ~fits,
+        )
+
+    init = (
+        jnp.int32(0),
+        jnp.int32(0),
+        jnp.zeros(cap, jnp.int32),
+        jnp.int32(-1),
+        jnp.int32(0),
+        jnp.bool_(False),
+    )
+    off, count, offs, bad_off, bad_bs, _done = jax.lax.while_loop(
+        cond, body, init
+    )
+    return offs, count, off, bad_off, bad_bs
+
+
+def scan_records_device(data_dev, data: bytes) -> tuple[np.ndarray, int]:
+    """Run the device scan over one uploaded chunk and return
+    (record-body offsets int64, bytes consumed) — the host scanner's
+    exact contract. A corrupt block_size delegates to the host scanner
+    so the raised ValueError (message, offset) is identical by
+    construction; if the two scanners ever disagree the host oracle
+    wins (the caller falls back to host decode for the chunk)."""
+    from kindel_tpu import aot
+
+    cap = record_capacity(int(data_dev.shape[0]))
+    args = (data_dev, jnp.int32(len(data)))
+    out = aot.call(aot.ingest_sig(int(data_dev.shape[0]), cap), args)
+    if out is None:
+        out = scan_kernel(*args, cap=cap)
+    offs, count, consumed, bad_off, _bad_bs = (np.asarray(o) for o in out)
+    if int(bad_off) >= 0:
+        # host oracle raises the canonical corrupt-record error (or, if
+        # it disagrees, its result stands — signalled to the caller)
+        _scan_complete_records(data)
+        raise _DeviceScanDisagreement(int(bad_off))
+    n = int(count)
+    return offs[:n].astype(np.int64), int(consumed)
+
+
+class _DeviceScanDisagreement(RuntimeError):
+    """Device scan flagged a record the host scanner accepts — the
+    driver catches this and routes the chunk through the host oracle
+    (correctness over speed on a path that should never fire)."""
+
+    def __init__(self, offset: int):
+        super().__init__(
+            f"device record scan disagreed with host at offset {offset}"
+        )
+        self.offset = offset

@@ -68,6 +68,15 @@ BASE_CODE = np.full(256, 4, dtype=np.uint8)
 for _i, _b in enumerate(BASES):
     BASE_CODE[_b] = _i
 
+#: BAM 4-bit nibble → channel code directly (BASE_CODE ∘ SEQ_NT16): the
+#: device-side ingest (kindel_tpu.devingest) decodes packed SEQ nibbles
+#: straight to channel codes with one 16-entry gather, skipping the
+#: ASCII intermediate — composition of the two host tables, so the two
+#: paths agree by construction
+from kindel_tpu.io.bam import SEQ_NT16 as _SEQ_NT16
+
+NIBBLE_CODE = BASE_CODE[_SEQ_NT16]
+
 
 @dataclass
 class EventSet:

@@ -151,6 +151,35 @@ def _read_bam_header(pf: _Prefetcher):
 _MAX_RECORD_BYTES = 256 << 20
 
 
+def iter_payload_chunks(pf: _Prefetcher, chunk_bytes: int) -> Iterator[tuple]:
+    """Post-header payload chunks of a BAM stream: yields (new_bytes,
+    exhausted) forever (empty chunks after EOF), with the io.read_chunk
+    fault hook applied once per chunk — the ONE hook site both the host
+    record scanner below and the device-side ingest driver
+    (kindel_tpu.devingest) consume, so chunk indices, truncation
+    attribution, and fault replay are identical across ingest modes
+    (io/ stays jax-free; the device tier imports from here, never the
+    reverse)."""
+    while True:
+        new = _faults.hook_bytes("io.read_chunk", pf.fill_to(chunk_bytes))
+        yield new, pf.exhausted
+
+
+def sniff_alignment(path) -> str:
+    """"bam" when the file is BAM (plain or BGZF/gzip-compressed),
+    "sam" otherwise (SAM text, possibly gzip-compressed) — the routing
+    decision _stream_alignment_impl makes, exported so the device-side
+    ingest driver routes identically and falls back to the host path
+    for textual input."""
+    with open(path, "rb") as fh:
+        head = fh.read(4)
+        fh.seek(0)
+        if not bgzf.is_gzipped(head):
+            return "bam" if head[:4] == b"BAM\x01" else "sam"
+        pf = _Prefetcher(_inflate_stream(fh, 1))
+        return "bam" if pf.peek(4) == b"BAM\x01" else "sam"
+
+
 def _scan_complete_records(data: bytes) -> tuple[np.ndarray, int]:
     """Record-body offsets of every complete record in `data`; returns
     (offsets, bytes_consumed) — the tail beyond the last complete record
@@ -234,14 +263,15 @@ def _stream_alignment_impl(
             raise
         carry = b""
         chunk_index = 0
+        payload = iter_payload_chunks(pf, chunk_bytes)
         while True:
-            # the fault hook lets chaos tests truncate/stall one decode
-            # chunk (KINDEL_TPU_FAULTS="io.read_chunk:truncate"); the
-            # except arms back-fill which chunk of which file died
+            # the fault hook (inside iter_payload_chunks) lets chaos
+            # tests truncate/stall one decode chunk
+            # (KINDEL_TPU_FAULTS="io.read_chunk:truncate"); the except
+            # arms back-fill which chunk of which file died
             try:
-                data = carry + _faults.hook_bytes(
-                    "io.read_chunk", pf.fill_to(chunk_bytes)
-                )
+                new, exhausted = next(payload)
+                data = carry + new
                 if not data:
                     break
                 offs, consumed = _scan_complete_records(data)
@@ -249,7 +279,7 @@ def _stream_alignment_impl(
                 e.path = path
                 e.chunk_index = chunk_index
                 raise
-            if consumed == 0 and pf.exhausted:
+            if consumed == 0 and exhausted:
                 raise TruncatedInputError(
                     f"truncated BAM record at end of stream "
                     f"({len(data)} trailing bytes)",
@@ -259,7 +289,7 @@ def _stream_alignment_impl(
             if len(offs):
                 yield _fields_from_offsets(data, offs, ref_names, ref_lens)
             chunk_index += 1
-            if pf.exhausted and not carry:
+            if exhausted and not carry:
                 break
         if carry:
             raise TruncatedInputError(

@@ -156,7 +156,8 @@ class _IngestCounters:
 
     __slots__ = (
         "members", "bytes_in", "bytes_out", "inflate_s", "scan_s",
-        "stall_s", "read_s", "expand_s", "workers",
+        "stall_s", "read_s", "expand_s", "workers", "upload_bytes",
+        "scan_device_s", "expand_device_s", "mode",
     )
 
     def __init__(self, registry):
@@ -199,6 +200,25 @@ class _IngestCounters:
         self.workers = registry.gauge(
             "kindel_ingest_pool_workers",
             "resolved inflate worker count of the most recent ingest run",
+        )
+        self.upload_bytes = registry.counter(
+            "kindel_ingest_upload_bytes_total",
+            "decompressed chunk bytes uploaded to the accelerator by "
+            "the device ingest path (kindel_tpu.devingest)",
+        )
+        self.scan_device_s = registry.counter(
+            "kindel_ingest_scan_device_seconds_total",
+            "wall spent in the device record-boundary scan (upload-side "
+            "sync included; 0 under host ingest mode)",
+        )
+        self.expand_device_s = registry.counter(
+            "kindel_ingest_expand_device_seconds_total",
+            "wall spent in the device field-extraction + CIGAR event "
+            "expansion kernels (0 under host ingest mode)",
+        )
+        self.mode = registry.info(
+            "kindel_ingest_mode",
+            "resolved ingest mode (host|device) and where it came from",
         )
 
 

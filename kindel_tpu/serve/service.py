@@ -196,6 +196,16 @@ class ConsensusService:
             getattr(tuning, "batch_mode", None)
         )
         self._m_tune_source.set(knob="batch_mode", source=bm_src)
+        # ingest mode (DESIGN.md §19): where each request's record scan
+        # + CIGAR expansion run — host numpy (oracle) or the devingest
+        # device kernels; byte-identical either way
+        self.ingest_mode, im_src = tune.resolve_ingest_mode(
+            getattr(tuning, "ingest_mode", None)
+        )
+        self._m_tune_source.set(knob="ingest_mode", source=im_src)
+        obs_runtime.ingest_counters().mode.set(
+            mode=self.ingest_mode, source=im_src
+        )
         self._ragged_classes: tuple = ()
         self.queue = RequestQueue(
             max_depth=max_depth, high_watermark=high_watermark,
@@ -230,6 +240,7 @@ class ConsensusService:
             decode_workers=decode_workers, row_bucket=row_bucket,
             breaker=self.breaker, retry=retry, watchdog_s=watchdog_s,
             numpy_fallback=numpy_fallback, lane_coalesce=lane_coalesce,
+            ingest_mode=self.ingest_mode,
         )
         self._http: ServeHTTPServer | None = None
         self._http_host = http_host
@@ -330,6 +341,7 @@ class ConsensusService:
             timings = warm_shapes(
                 self.default_opts, row_bucket=self.worker.row_bucket,
                 payloads=self._warm_payloads,
+                ingest_mode=self.ingest_mode,
             )
             if self.batch_mode == "ragged" and self._ragged_classes:
                 # superbatch geometries are startup-known in FULL — with

@@ -43,14 +43,18 @@ _SYNTH_SAM = (
 )
 
 
-def decode_payload(payload, opts) -> list:
+def decode_payload(payload, opts, ingest_mode: str = "host") -> list:
     """Payload (path or SAM/BAM bytes) → CallUnits, through the same
     decode the worker's decode stage runs — warmed shapes must be
-    derived exactly the way served shapes are."""
+    derived exactly the way served shapes are. A device-ingest service
+    passes its mode here, so warmup also loads-or-compiles the
+    devingest kernels (zero-compile first request, ingest included)."""
     from kindel_tpu.serve.queue import ServeRequest
     from kindel_tpu.serve.worker import decode_request
 
-    return decode_request(ServeRequest(payload=payload, opts=opts))
+    return decode_request(
+        ServeRequest(payload=payload, opts=opts), ingest_mode=ingest_mode
+    )
 
 
 def shape_label(shapes: tuple, n_rows: int) -> str:
@@ -58,7 +62,8 @@ def shape_label(shapes: tuple, n_rows: int) -> str:
 
 
 def warm_shapes(opts, row_bucket: int = 8, payloads=(),
-                include_synthetic: bool = True) -> dict[str, dict]:
+                include_synthetic: bool = True,
+                ingest_mode: str = "host") -> dict[str, dict]:
     """Ready the batched cohort kernel for every lane shape the given
     payloads (plus the minimal synthetic cohort) land in — by loading a
     stored AOT executable when the store is warm, by compiling (and
@@ -93,7 +98,7 @@ def warm_shapes(opts, row_bucket: int = 8, payloads=(),
     if include_synthetic:
         cohorts.append(decode_payload(_SYNTH_SAM, opts))
     for p in payloads:
-        cohorts.append(decode_payload(p, opts))
+        cohorts.append(decode_payload(p, opts, ingest_mode=ingest_mode))
 
     timings: dict[str, dict] = {}
     for units in cohorts:

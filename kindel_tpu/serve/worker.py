@@ -150,24 +150,53 @@ def _shape_label(shapes: tuple) -> str:
     return "x".join(str(s) for s in shapes)
 
 
-def decode_request(req: ServeRequest) -> list:
-    """Host stage: payload → CallUnits (empty list = no aligned reads)."""
+def decode_request(req: ServeRequest, ingest_mode: str = "host") -> list:
+    """Host stage: payload → CallUnits (empty list = no aligned reads).
+    Under ingest_mode="device" the record scan + CIGAR expansion run as
+    kindel_tpu.devingest kernels on the accelerator (byte-identical;
+    SAM-text payloads and any anomaly fall back to the host oracle)."""
     from kindel_tpu.call_jax import CallUnit
     from kindel_tpu.events import extract_events
     from kindel_tpu.io import load_alignment, load_alignment_bytes
 
     payload = req.payload
     with maybe_phase("serve decode"):
-        if isinstance(payload, (bytes, bytearray)):
-            batch = load_alignment_bytes(bytes(payload))
-        else:
-            batch = load_alignment(str(payload))
-        ev = extract_events(batch)
+        ev = None
+        if ingest_mode == "device":
+            ev = _decode_device(payload)
+        if ev is None:
+            if isinstance(payload, (bytes, bytearray)):
+                batch = load_alignment_bytes(bytes(payload))
+            else:
+                batch = load_alignment(str(payload))
+            ev = extract_events(batch)
     units = []
     for rid in ev.present_ref_ids:
         u = CallUnit(ev, rid, with_ins_table=True, realign=req.opts.realign)
         units.append(u)
     return units
+
+
+def _decode_device(payload):
+    """Device-ingest decode of one payload, or None to fall back to the
+    host path: SAM text (which the device tier does not frame) and any
+    decode error both return None, and the host decoder then accepts or
+    raises canonically — so device mode never changes the service's
+    error surface."""
+    from kindel_tpu import devingest
+
+    try:
+        if isinstance(payload, (bytes, bytearray)):
+            return devingest.extract_events_device(bytes(payload))
+        from kindel_tpu.io.stream import sniff_alignment
+
+        path = str(payload)
+        if sniff_alignment(path) != "bam":
+            return None
+        with open(path, "rb") as fh:
+            return devingest.extract_events_device(fh.read())
+    except ValueError:
+        return None  # not BAM / corrupt: the host decoder owns the verdict
 
 
 def numpy_request_result(req: ServeRequest) -> SampleResult:
@@ -260,10 +289,14 @@ class ServeWorker:
                  watchdog_s: float | None = None,
                  numpy_fallback: bool = True, supervise: bool = True,
                  supervise_interval_s: float = 0.1,
-                 lane_coalesce: int = 1):
+                 lane_coalesce: int = 1, ingest_mode: str = "host"):
         self.queue = queue
         self.batcher = batcher
         self._clock = clock
+        #: where request decode's scan/expand run (resolved once by the
+        #: service through kindel_tpu.tune): "device" routes payloads
+        #: through kindel_tpu.devingest, byte-identically
+        self.ingest_mode = ingest_mode
         #: rows pad to this power-of-two bucket so repeat flushes of a
         #: lane reuse one compiled kernel shape even as occupancy varies
         self.row_bucket = row_bucket
@@ -574,7 +607,7 @@ class ServeWorker:
         traced = sp is not trace.NOOP_SPAN
         with sp:
             try:
-                units = decode_request(req)
+                units = decode_request(req, ingest_mode=self.ingest_mode)
             except BaseException as e:  # noqa: BLE001 — isolation boundary
                 if isinstance(e, (KeyboardInterrupt, SystemExit)):
                     # shutdown is not a per-request failure: resolve the

@@ -36,18 +36,28 @@ from kindel_tpu.pileup import (
 )
 
 
-def _stream_reduce(acc, path, chunk_bytes, ingest_workers=None) -> None:
+def _stream_reduce(acc, path, chunk_bytes, ingest_workers=None,
+                   ingest_mode=None) -> None:
     """Drive the chunked decode→reduce loop under one span, counting
     chunks into the process-global registry (the serve/bench exposition
     sees streamed work too). With ingest_workers > 1 the BGZF inflate of
     chunk k+1 runs on the shared pool (kindel_tpu.io.inflate) while this
     thread scans records and expands CIGAR events of chunk k and jax's
     async dispatch reduces chunk k−1 on device — the three-stage overlap
-    SURVEY §7 prescribes. A truncated/corrupt input dies with the typed
-    TruncatedInputError naming which chunk of which file — the span and
-    a counter record the casualty."""
+    SURVEY §7 prescribes. Under ``ingest_mode="device"`` (resolved like
+    every knob: explicit > KINDEL_TPU_INGEST_MODE > store > host) the
+    scan/expand stages themselves run as kindel_tpu.devingest kernels:
+    the host thread only inflates and uploads, and chunk k+1's upload
+    overlaps chunk k's expansion through jax's async dispatch. A
+    truncated/corrupt input dies with the typed TruncatedInputError
+    naming which chunk of which file — the span and a counter record
+    the casualty, identically in both modes."""
+    from kindel_tpu import tune
     from kindel_tpu.io.errors import TruncatedInputError
+    from kindel_tpu.obs import runtime as obs_runtime
 
+    mode, mode_src = tune.resolve_ingest_mode(ingest_mode)
+    obs_runtime.ingest_counters().mode.set(mode=mode, source=mode_src)
     chunks = default_registry().counter(
         "kindel_stream_chunks_total",
         "streamed decode chunks reduced into accumulator state",
@@ -55,9 +65,20 @@ def _stream_reduce(acc, path, chunk_bytes, ingest_workers=None) -> None:
     with obs_trace.span("stream.reduce") as sp:
         n = 0
         try:
-            for batch in stream_alignment(path, chunk_bytes, ingest_workers):
-                acc.add_batch(batch)
-                n += 1
+            if mode == "device":
+                from kindel_tpu import devingest
+
+                for ev in devingest.stream_device_events(
+                    path, chunk_bytes, ingest_workers
+                ):
+                    acc.add_events(ev)
+                    n += 1
+            else:
+                for batch in stream_alignment(
+                    path, chunk_bytes, ingest_workers
+                ):
+                    acc.add_batch(batch)
+                    n += 1
         except TruncatedInputError as e:
             default_registry().counter(
                 "kindel_stream_truncated_total",
@@ -71,7 +92,8 @@ def _stream_reduce(acc, path, chunk_bytes, ingest_workers=None) -> None:
         chunks.inc(n)
         if sp is not obs_trace.NOOP_SPAN:
             sp.set_attribute(
-                chunks=n, chunk_bytes=chunk_bytes, refs=len(acc.present)
+                chunks=n, chunk_bytes=chunk_bytes, refs=len(acc.present),
+                ingest_mode=mode,
             )
 
 #: hard framework-wide limit of the int32 flat-index scatter scheme
@@ -165,6 +187,10 @@ class StreamAccumulatorBase:
     `_reduce(state, ev, rid)` (single-device host/device state here;
     position-sharded mesh state in parallel.stream_product)."""
 
+    #: subclasses that reduce devingest.DeviceEvents planes natively set
+    #: this True; everyone else receives the materialized host EventSet
+    accepts_device_events = False
+
     def __init__(self):
         self.ref_names: list[str] = []
         self.ref_lens = None
@@ -173,10 +199,16 @@ class StreamAccumulatorBase:
         self.insertions: Counter = Counter()
 
     def add_batch(self, batch) -> None:
+        self.add_events(extract_events(batch))
+
+    def add_events(self, ev) -> None:
+        """Reduce one chunk's event streams (host EventSet, or a
+        devingest.DeviceEvents whose bulk planes are still on device)."""
+        if not self.accepts_device_events and hasattr(ev, "to_host"):
+            ev = ev.to_host()
         if self.ref_lens is None:
-            self.ref_names = batch.ref_names
-            self.ref_lens = np.asarray(batch.ref_lens, dtype=np.int64)
-        ev = extract_events(batch)
+            self.ref_names = ev.ref_names
+            self.ref_lens = np.asarray(ev.ref_lens, dtype=np.int64)
         self.insertions.update(ev.insertions)
         for rid in ev.present_ref_ids:
             if rid not in self.states:
@@ -194,6 +226,9 @@ class StreamAccumulator(StreamAccumulatorBase):
         self.device = backend == "jax"
         self.full = full
         self.clip_weights = clip_weights
+        # the jax backend scatters devingest planes straight from
+        # device (no host round-trip); the numpy oracle materializes
+        self.accepts_device_events = self.device
 
     # -- helpers -----------------------------------------------------------
 
@@ -228,6 +263,54 @@ class StreamAccumulator(StreamAccumulatorBase):
         )
 
     def _reduce(self, st: _RefState, ev, rid: int) -> None:
+        if hasattr(ev, "planes"):  # devingest.DeviceEvents (jax backend)
+            return self._reduce_device_events(st, ev, rid)
+        return self._reduce_host(st, ev, rid)
+
+    def _reduce_device_events(self, st: _RefState, dev, rid: int) -> None:
+        """Scatter a devingest chunk's event planes into the donated
+        device state WITHOUT materializing them on host: per (family,
+        reference) the fixed-shape plane becomes flat indices with a
+        drop sentinel (devingest.rid_flat_index), fed straight to the
+        same donated scatter-adds the host-upload path uses — so the
+        accumulated tensors are bit-identical by construction. The rare
+        slow-read residue (host-walked exact events) reduces through
+        the ordinary host path."""
+        import jax.numpy as jnp
+
+        from kindel_tpu.devingest import rid_flat_index
+
+        add1, _addc = _dev_ops()
+        L = st.L
+        rid32 = jnp.int32(rid)
+
+        def scatter(state, plane, weighted):
+            if state is None or plane is None:
+                return state
+            sentinel = jnp.int32(state.shape[0])
+            if weighted:
+                rid_a, pos, base, ok = plane
+            else:
+                rid_a, pos, ok = plane
+                base = pos  # unused under weighted=False (static branch)
+            idx = rid_flat_index(
+                rid_a, pos, base, ok, rid32, sentinel, weighted=weighted
+            )
+            return add1(state, idx)
+
+        st.w = scatter(st.w, dev.planes["match"], True)
+        st.d = scatter(st.d, dev.planes["del"], False)
+        if self.full:
+            if self.clip_weights:
+                st.csw = scatter(st.csw, dev.planes["csw"], True)
+                st.cew = scatter(st.cew, dev.planes["cew"], True)
+            st.cs = scatter(st.cs, dev.planes["cs"], False)
+            st.ce = scatter(st.ce, dev.planes["ce"], False)
+        residue = dev.host_residue()
+        if residue is not None:
+            self._reduce_host(st, residue, rid)
+
+    def _reduce_host(self, st: _RefState, ev, rid: int) -> None:
         L = st.L
 
         def stream(rids, pos, base=None):
@@ -318,6 +401,15 @@ def _resolve_ingest_workers(ingest_workers, tuning):
     return getattr(tuning, "ingest_workers", None)
 
 
+def _resolve_ingest_mode(ingest_mode, tuning):
+    """Same shape as _resolve_ingest_workers: explicit arg wins, then
+    the tuning config's pin; full env/store/default resolution happens
+    once in _stream_reduce (kindel_tpu.tune.resolve_ingest_mode)."""
+    if ingest_mode is not None:
+        return ingest_mode
+    return getattr(tuning, "ingest_mode", None)
+
+
 def stream_pileups(
     path,
     chunk_bytes: int | None = DEFAULT_CHUNK_BYTES,
@@ -325,17 +417,20 @@ def stream_pileups(
     clip_weights: bool = True,
     tuning=None,
     ingest_workers: int | None = None,
+    ingest_mode: str | None = None,
 ) -> dict[str, Pileup]:
     """Bounded-RSS replacement for build_pileups(extract_events(load…)):
     same output, O(chunk + L) host memory. chunk_bytes=None resolves the
     chunk size through kindel_tpu.tune (`tuning` > env > store > default);
-    ingest_workers resolves the same way."""
+    ingest_workers and ingest_mode resolve the same way."""
     chunk_bytes = _resolve_chunk_bytes(chunk_bytes, tuning, path)
     acc = StreamAccumulator(
         backend=backend, full=True, clip_weights=clip_weights
     )
     _stream_reduce(
-        acc, path, chunk_bytes, _resolve_ingest_workers(ingest_workers, tuning)
+        acc, path, chunk_bytes,
+        _resolve_ingest_workers(ingest_workers, tuning),
+        _resolve_ingest_mode(ingest_mode, tuning),
     )
     return {acc.ref_names[rid]: acc.pileup(rid) for rid in acc.present}
 
@@ -355,6 +450,7 @@ def streamed_consensus(
     fix_clip_artifacts: bool = False,
     tuning=None,
     ingest_workers: int | None = None,
+    ingest_mode: str | None = None,
 ):
     """bam_to_consensus over a streamed decode — identical output, host
     RSS bounded by O(chunk + reference length).
@@ -362,10 +458,13 @@ def streamed_consensus(
     Returns the same result namedtuple as workloads.bam_to_consensus.
     chunk_bytes=None resolves the chunk size through kindel_tpu.tune
     (`tuning` arg > env pin > persisted store > default); ingest_workers
-    (the parallel-inflate pool size) resolves identically.
+    (the parallel-inflate pool size) and ingest_mode (host numpy vs the
+    devingest device kernels — byte-identical output) resolve
+    identically.
     """
     chunk_bytes = _resolve_chunk_bytes(chunk_bytes, tuning, bam_path)
     ingest_workers = _resolve_ingest_workers(ingest_workers, tuning)
+    ingest_mode = _resolve_ingest_mode(ingest_mode, tuning)
     from kindel_tpu.call import _insertion_calls, assemble, call_consensus
     from kindel_tpu.io.fasta import Sequence
     from kindel_tpu.realign import cdrp_consensuses, merge_cdrps
@@ -388,14 +487,14 @@ def streamed_consensus(
             clip_decay_threshold, mask_ends, trim_ends, uppercase,
             chunk_bytes, mesh, cdr_gap=cdr_gap,
             fix_clip_artifacts=fix_clip_artifacts,
-            ingest_workers=ingest_workers,
+            ingest_workers=ingest_workers, ingest_mode=ingest_mode,
         )
 
     # realign (or the numpy oracle) consumes host pileups; the plain jax
     # path keeps everything on device until the packed wire download
     full = realign or backend != "jax"
     acc = StreamAccumulator(backend=backend, full=full)
-    _stream_reduce(acc, bam_path, chunk_bytes, ingest_workers)
+    _stream_reduce(acc, bam_path, chunk_bytes, ingest_workers, ingest_mode)
 
     consensuses, refs_changes, refs_reports = [], {}, {}
     for rid in acc.present:
@@ -465,7 +564,7 @@ def _streamed_sharded_consensus(
     bam_path, realign, min_depth, min_overlap, clip_decay_threshold,
     mask_ends, trim_ends, uppercase, chunk_bytes, mesh=None,
     cdr_gap: int = 0, fix_clip_artifacts: bool = False,
-    ingest_workers: int | None = None,
+    ingest_workers: int | None = None, ingest_mode: str | None = None,
 ):
     """Streamed decode reduced into position-sharded device state; the
     closing call + (optional) lazy CDR walk run through the product
@@ -476,7 +575,7 @@ def _streamed_sharded_consensus(
     from kindel_tpu.workloads import build_report, result
 
     acc = ShardedStreamAccumulator(mesh=mesh, full=realign)
-    _stream_reduce(acc, bam_path, chunk_bytes, ingest_workers)
+    _stream_reduce(acc, bam_path, chunk_bytes, ingest_workers, ingest_mode)
 
     consensuses, refs_changes, refs_reports = [], {}, {}
     for rid in acc.present:

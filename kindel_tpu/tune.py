@@ -78,6 +78,14 @@ INGEST_PREFETCH_MB_DEFAULT = 8
 #: pays pack + upload + dispatch once instead of N times.
 LANE_COALESCE_DEFAULT = 4
 
+#: ingest mode: "host" = record scan + CIGAR expansion as host numpy
+#: (the oracle), "device" = bytes upload + scan/fields/expand kernels
+#: on the accelerator (kindel_tpu.devingest — byte-identical output);
+#: the env pin is KINDEL_TPU_INGEST_MODE, `kindel tune
+#: --ingest-mode-budget-s` persists a measured winner host-keyed
+INGEST_MODE_DEFAULT = "host"
+INGEST_MODES = ("host", "device")
+
 #: serve batching mode: "lanes" = the shape-keyed micro-batcher (one
 #: compiled kernel per lane shape), "ragged" = page-class superbatching
 #: (kindel_tpu.ragged — one compiled kernel per page class serves all
@@ -127,6 +135,7 @@ class TuningConfig:
     stream_chunk_mb: float | None = None
     cohort_budget_mb: int | None = None
     ingest_workers: int | None = None
+    ingest_mode: str | None = None
     lane_coalesce: int | None = None
     batch_mode: str | None = None
     ragged_classes: str | None = None
@@ -528,6 +537,63 @@ def search_ingest_workers(measure, max_workers: int | None = None,
     return min(timings, key=timings.get), timings
 
 
+def resolve_ingest_mode(explicit: str | None = None) -> tuple[str, str]:
+    """The ingest-mode knob (host numpy scan/expand vs the
+    kindel_tpu.devingest device kernels — byte-identical output):
+    explicit arg > KINDEL_TPU_INGEST_MODE > host-keyed store > host
+    default. A malformed env/store value falls through to the default —
+    an unknown mode must never take a pipeline down; an unknown
+    EXPLICIT mode is caller error and raises (same contract as
+    resolve_batch_mode)."""
+    if explicit is not None:
+        mode = str(explicit).strip().lower()
+        if mode in INGEST_MODES:
+            return mode, "explicit"
+        raise ValueError(
+            f"unknown ingest mode {explicit!r} (expected one of "
+            f"{'/'.join(INGEST_MODES)})"
+        )
+    env = os.environ.get("KINDEL_TPU_INGEST_MODE", "").strip().lower()
+    if env in INGEST_MODES:
+        return env, "env"
+    entry = lookup(ingest_store_key())
+    if entry and entry.get("ingest_mode") in INGEST_MODES:
+        return entry["ingest_mode"], "cache"
+    return INGEST_MODE_DEFAULT, "default"
+
+
+def search_ingest_mode(measure, budget_s: float = 30.0,
+                       clock=time.perf_counter):
+    """Measure host vs device ingest on this host and pick the faster:
+    `measure(mode) -> wall seconds` receives the mode EXPLICITLY (no env
+    mutation — same contract as every search here); a mode whose probe
+    raises is scored unusable (inf) rather than failing the sweep, so a
+    host without a working accelerator path still tunes. `kindel tune
+    --ingest-mode-budget-s` persists the winner under
+    ingest_store_key()."""
+    from kindel_tpu.obs import trace as obs_trace
+
+    timings: dict[str, float] = {}
+    t0 = clock()
+    for mode in INGEST_MODES:
+        with obs_trace.span("tune.ingest_mode_probe") as sp:
+            try:
+                wall = measure(mode)
+            except Exception as exc:
+                wall = float("inf")
+                if sp is not obs_trace.NOOP_SPAN:
+                    sp.set_attribute(error=repr(exc))
+            if sp is not obs_trace.NOOP_SPAN:
+                sp.set_attribute(mode=mode, wall_s=round(wall, 4))
+        timings[mode] = wall
+        if clock() - t0 > budget_s:
+            break
+    usable = {k: v for k, v in timings.items() if v != float("inf")}
+    if not usable:
+        return INGEST_MODE_DEFAULT, timings
+    return min(usable, key=usable.get), timings
+
+
 def resolve_cohort_budget_mb(explicit: int | None = None) -> tuple[int, str]:
     """The cohort device-footprint budget: explicit arg >
     KINDEL_TPU_COHORT_BUDGET_MB > default (512 MB). Not measured — it is
@@ -633,6 +699,7 @@ def resolve(explicit: TuningConfig | None = None, backend: str = "cpu",
     coalesce, s5 = resolve_lane_coalesce(e.lane_coalesce)
     batch_mode, s6 = resolve_batch_mode(e.batch_mode)
     ragged_classes, s7 = resolve_ragged_classes(e.ragged_classes)
+    ingest_mode, s8 = resolve_ingest_mode(e.ingest_mode)
     # knob provenance into the shared exposition: one Info sample per
     # (knob, source, value) — the serve /metrics and bench snapshots show
     # WHERE each performance knob came from, not just its value
@@ -649,14 +716,16 @@ def resolve(explicit: TuningConfig | None = None, backend: str = "cpu",
     info.set(knob="lane_coalesce", source=s5, value=str(coalesce))
     info.set(knob="batch_mode", source=s6, value=batch_mode)
     info.set(knob="ragged_classes", source=s7, value=ragged_classes)
+    info.set(knob="ingest_mode", source=s8, value=ingest_mode)
     return TuningConfig(
         n_slabs=n_slabs, stream_chunk_mb=chunk, cohort_budget_mb=budget,
-        ingest_workers=ingest, lane_coalesce=coalesce,
+        ingest_workers=ingest, ingest_mode=ingest_mode,
+        lane_coalesce=coalesce,
         batch_mode=batch_mode, ragged_classes=ragged_classes,
         sources=(("n_slabs", s1), ("stream_chunk_mb", s2),
                  ("cohort_budget_mb", s3), ("ingest_workers", s4),
                  ("lane_coalesce", s5), ("batch_mode", s6),
-                 ("ragged_classes", s7)),
+                 ("ragged_classes", s7), ("ingest_mode", s8)),
     )
 
 

@@ -1,0 +1,17 @@
+"""Known-bad: a devingest kernel whose helper reads env one call deep
+(trace-purity) — proves the new package's jitted kernels sit inside
+the whole-program closure, not just the decorated-body guard."""
+
+import os
+from functools import partial
+
+import jax
+
+
+def _block_width():
+    return int(os.environ.get("KINDEL_TPU_DEVINGEST_BLOCK", "128"))
+
+
+@partial(jax.jit, static_argnames=())
+def bad_scan_kernel(data):
+    return data[:: _block_width()]

@@ -1,0 +1,10 @@
+"""Known-bad: silent swallow in devingest/ — the scope extension for
+the device-ingest tier (its real oracle-fallback paths use TYPED
+excepts; a broad swallow would hide a device/host divergence)."""
+
+
+def expand_or_forget(launch):
+    try:
+        return launch()
+    except Exception:
+        return None

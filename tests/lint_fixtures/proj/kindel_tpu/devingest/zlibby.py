@@ -1,0 +1,9 @@
+"""Known-bad: zlib inside devingest/ (zlib-confinement) — the device
+tier consumes the io/ inflate chokepoint's output; it never inflates
+itself."""
+
+import zlib
+
+
+def inline_inflate(member: bytes) -> bytes:
+    return zlib.decompress(member)

@@ -229,6 +229,25 @@ def test_fixture_scope_extension_hits_parallel(fixture_results):
     assert any("parallel/" in f.path for f in swallow.findings)
 
 
+def test_fixture_scope_extension_hits_devingest(fixture_results):
+    """The devingest scope extension (PR 10 satellite): the new package
+    is covered by the silent-swallow lint, zlib stays confined to io/
+    (so devingest/ is zlib-free), and its jitted kernels sit inside the
+    trace-purity closure — one known-bad fixture per rule scope."""
+    by_id = {r.spec.id: r for r in fixture_results}
+    assert any(
+        "devingest/" in f.path for f in by_id["silent-swallow"].findings
+    )
+    assert any(
+        "devingest/" in f.path for f in by_id["zlib-confinement"].findings
+    )
+    purity = [
+        f for f in by_id["trace-purity"].findings
+        if "devingest/" in f.path
+    ]
+    assert purity and all("_block_width" in f.message for f in purity)
+
+
 def test_purity_fixture_needs_the_closure(fixture_results):
     """The chained fixture's jit body is clean — only the call-graph
     walk sees the env read two calls deep, which is exactly what the

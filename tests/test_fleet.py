@@ -348,7 +348,7 @@ def test_readyz_splits_from_healthz(monkeypatch):
     keeps its original always-200 semantics."""
     gate = threading.Event()
 
-    def gated_warm_shapes(opts, row_bucket=8, payloads=()):
+    def gated_warm_shapes(opts, row_bucket=8, payloads=(), **kw):
         assert gate.wait(10), "test gate never opened"
         return {"stub": 0.01}
 

@@ -789,7 +789,7 @@ def test_serve_decode_interrupt_resolves_future_and_reraises(
     *shutdown*, not masquerade as that request's decode failure."""
     import kindel_tpu.serve.worker as worker_mod
 
-    def interrupted(req):
+    def interrupted(req, **kw):
         raise KeyboardInterrupt
 
     monkeypatch.setattr(worker_mod, "decode_request", interrupted)

@@ -385,7 +385,7 @@ def test_healthz_flips_warming_to_ok(monkeypatch):
     stand-in for the real shape warmer."""
     gate = threading.Event()
 
-    def gated_warm_shapes(opts, row_bucket=8, payloads=()):
+    def gated_warm_shapes(opts, row_bucket=8, payloads=(), **kw):
         assert gate.wait(10), "test gate never opened"
         return {"r8xL1024o64b256d64i64cNone": 0.01}
 
@@ -420,7 +420,7 @@ def test_warmup_failure_degrades_to_serving(monkeypatch, tmp_path):
     """A warmup crash must not take the service down — requests still
     serve (paying their own compile), and /healthz surfaces the error."""
 
-    def broken_warm_shapes(opts, row_bucket=8, payloads=()):
+    def broken_warm_shapes(opts, row_bucket=8, payloads=(), **kw):
         raise RuntimeError("synthetic warmup failure")
 
     monkeypatch.setattr(
