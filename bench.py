@@ -450,6 +450,26 @@ def _run_benchmark() -> dict:
         except Exception as e:  # noqa: BLE001
             result["ragged"] = {"error": repr(e)}
 
+    # Open-loop continuous-superbatching scenario (kindel_tpu.paged):
+    # the straggler-heavy + repeated-reference arrival mix run through
+    # lanes/ragged/paged with byte-identity asserted; the `paged`
+    # object records per-mode occupancy/latency plus paged residency,
+    # retire p50/p99, and the panel-cache hit rate. Same gating rule as
+    # the ragged scenario (KINDEL_TPU_BENCH_PAGED overrides; default-on
+    # only for CPU children). Failure never voids the headline metric.
+    paged_pin = os.environ.get("KINDEL_TPU_BENCH_PAGED")
+    want_paged = (
+        jax.default_backend() == "cpu" if paged_pin is None
+        else paged_pin not in ("", "0")
+    )
+    if want_paged:
+        try:
+            from benchmarks.paged_load import run_open_loop
+
+            result["paged"] = run_open_loop(requests=15)
+        except Exception as e:  # noqa: BLE001
+            result["paged"] = {"error": repr(e)}
+
     # Optional serving metrics (KINDEL_TPU_BENCH_SERVE=1): a small
     # closed-loop load run against the in-process service, so rounds can
     # track online throughput / p99 latency / batch occupancy alongside

@@ -1,0 +1,259 @@
+"""Open-loop paged-serving scenario: continuous superbatching vs the
+flush-barrier modes.
+
+`ragged_load.py` replays a closed loop — every client waits for its
+previous answer, so the service never sees the bursty, mixed-size
+arrival process that motivates per-segment admit/retire. This scenario
+submits an OPEN-LOOP arrival stream (fixed inter-arrival, nobody
+waits) of two mixes:
+
+  * straggler-heavy: mostly small segments with periodic large ones —
+    the regime where a sealed superbatch holds everyone behind its
+    biggest member, and where paged retirement should beat the ragged
+    flush barrier on tail latency;
+  * amplicon: one payload replayed many times (same reference, same
+    reads — surveillance traffic) — the regime the reference-panel
+    cache dedupes, so the paged run should show a non-zero panel hit
+    rate.
+
+The identical request set runs through lanes, ragged, and paged modes;
+byte-identity across modes is asserted on every run, and the report
+records per mode: occupancy (payload/padded bases), dispatch counts,
+client-observed p50/p99 latency, jit-cache entries — plus, for paged,
+retire p50/p99, residency, and the panel hit rate. `bench.py` attaches
+the report as its `paged` object (KINDEL_TPU_BENCH_PAGED opt-in).
+
+Standalone:
+
+    python -m benchmarks.paged_load --requests 18
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import tempfile
+import threading
+import time
+from pathlib import Path
+
+
+def make_payloads(out_dir: Path, n: int = 18, seed: int = 0) -> list:
+    """(kind, bytes) arrival list: a straggler-heavy mixed-size stream
+    with an amplicon tail — every third small payload is a REPLAY of
+    one fixed amplicon sample (identical bytes → panel-cache hits)."""
+    import numpy as np
+
+    from benchmarks.ragged_load import make_mixed_sams
+
+    rng = np.random.default_rng(seed)
+    mixed = [
+        p.read_bytes()
+        for p in make_mixed_sams(out_dir, max(4, n // 3), seed)
+    ]
+    # one big straggler payload: a reference ~10× the small ones
+    lines = ["@HD\tVN:1.6", "@SQ\tSN:strag\tLN:24000"]
+    for j in range(120):
+        pos = int(rng.integers(0, 24000 - 120))
+        seq = "".join("ACGT"[b] for b in rng.integers(0, 4, size=100))
+        lines.append(
+            f"s{j}\t0\tstrag\t{pos + 1}\t60\t100M\t*\t0\t0\t{seq}\t*"
+        )
+    straggler = ("\n".join(lines) + "\n").encode()
+    amplicon = mixed[0]
+    payloads = []
+    for i in range(n):
+        if i % 6 == 5:
+            payloads.append(("straggler", straggler))
+        elif i % 3 == 0:
+            payloads.append(("amplicon", amplicon))
+        else:
+            payloads.append(("mixed", mixed[i % len(mixed)]))
+    return payloads
+
+
+def _counter_totals(snapshot: dict, prefix: str) -> float:
+    return sum(
+        float(v) for k, v in snapshot.items()
+        if (k == prefix or k.startswith(prefix + "{"))
+        and not isinstance(v, dict)
+    )
+
+
+def _global_snapshot() -> dict:
+    from kindel_tpu.obs.metrics import default_registry
+
+    return default_registry().snapshot()
+
+
+def run_open_loop(requests: int = 18, seed: int = 0,
+                  arrival_ms: float = 4.0,
+                  max_wait_s: float = 0.03) -> dict:
+    """Run the open-loop arrival stream through all three batch modes;
+    returns the comparison report (see module docstring)."""
+    from kindel_tpu.obs import runtime as obs_runtime
+    from kindel_tpu.serve import ConsensusService
+    from kindel_tpu.tune import TuningConfig
+
+    tmp = tempfile.TemporaryDirectory(prefix="kindel_paged_load_")
+    try:
+        payloads = make_payloads(Path(tmp.name), requests, seed)
+
+        def run_mode(mode: str):
+            from kindel_tpu.io.fasta import format_fasta
+
+            snap0 = _global_snapshot()
+            cache0 = obs_runtime.jit_cache_sizes()
+            results: list = [None] * len(payloads)
+            latencies: list = [None] * len(payloads)
+            errors: list = []
+            with ConsensusService(
+                tuning=TuningConfig(batch_mode=mode),
+                max_wait_s=max_wait_s, decode_workers=4,
+            ) as svc:
+                # warm outside the measured window (compile walls would
+                # swamp an open-loop latency comparison on CPU)
+                svc.request(payloads[0][1], timeout=600)
+                t_submit: list = [0.0] * len(payloads)
+                futs = []
+                t_start = time.perf_counter()
+                for i, (_kind, body) in enumerate(payloads):
+                    t_submit[i] = time.perf_counter()
+                    futs.append(svc.submit(body))
+                    time.sleep(arrival_ms / 1e3)  # open loop: no waiting
+
+                def settle(i, fut):
+                    try:
+                        res = fut.result(timeout=600)
+                        latencies[i] = time.perf_counter() - t_submit[i]
+                        results[i] = format_fasta(res.consensuses)
+                    except Exception as e:  # noqa: BLE001
+                        errors.append((i, repr(e)))
+
+                threads = [
+                    threading.Thread(target=settle, args=(i, f))
+                    for i, f in enumerate(futs)
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                wall = time.perf_counter() - t_start
+                svc_snap = svc.metrics.snapshot()
+            snap1 = _global_snapshot()
+            cache1 = obs_runtime.jit_cache_sizes()
+
+            def delta(prefix):
+                return _counter_totals(snap1, prefix) - _counter_totals(
+                    snap0, prefix
+                )
+
+            lat = sorted(v for v in latencies if v is not None)
+
+            def pct(q):
+                return (
+                    lat[min(len(lat) - 1, int(q * len(lat)))]
+                    if lat else 0.0
+                )
+
+            payload = delta("kindel_dispatch_payload_bases_total")
+            padded = delta("kindel_dispatch_padded_bases_total")
+            report = {
+                "errors": len(errors),
+                "wall_s": round(wall, 3),
+                "dispatches": int(
+                    svc_snap.get("kindel_serve_device_dispatches_total", 0)
+                ),
+                "payload_bases": int(payload),
+                "padded_bases": int(padded),
+                "occupancy": round(payload / padded, 4) if padded else 0.0,
+                "latency_p50_ms": round(pct(0.5) * 1e3, 2),
+                "latency_p99_ms": round(pct(0.99) * 1e3, 2),
+                "jit_cache_entries": sum(cache1.values())
+                - sum(cache0.values()),
+            }
+            if mode == "paged":
+                retire = snap1.get("kindel_paged_retire_seconds", {})
+                residency = snap1.get("kindel_paged_residency", {})
+                hits = delta("kindel_paged_panel_hits_total")
+                misses = delta("kindel_paged_panel_misses_total")
+                report.update({
+                    "launches": int(
+                        delta("kindel_paged_launches_total")
+                    ),
+                    "retires": int(
+                        retire.get("count", 0) if isinstance(retire, dict)
+                        else 0
+                    ),
+                    "retire_p50_ms": round(
+                        float(retire.get("p50", 0.0)) * 1e3, 2
+                    ) if isinstance(retire, dict) else 0.0,
+                    "retire_p99_ms": round(
+                        float(retire.get("p99", 0.0)) * 1e3, 2
+                    ) if isinstance(retire, dict) else 0.0,
+                    "residency_mean": round(
+                        float(residency.get("mean", 0.0)), 4
+                    ) if isinstance(residency, dict) else 0.0,
+                    "panel_hits": int(hits),
+                    "panel_hit_rate": round(
+                        hits / (hits + misses), 4
+                    ) if hits + misses else 0.0,
+                })
+            if mode == "ragged":
+                # the flush barrier paged retirement is measured against:
+                # client-observed dispatch latency of the sealed
+                # superbatches (per-shape histograms, worst p99)
+                flush_p99 = 0.0
+                for k, v in svc_snap.items():
+                    if k.startswith("kindel_serve_dispatch_seconds") and (
+                        isinstance(v, dict)
+                    ):
+                        flush_p99 = max(flush_p99, float(v.get("p99", 0.0)))
+                report["flush_p99_ms"] = round(flush_p99 * 1e3, 2)
+            return results, report
+
+        out: dict = {"requests": requests, "arrival_ms": arrival_ms}
+        fastas = {}
+        for mode in ("lanes", "ragged", "paged"):
+            fastas[mode], out[mode] = run_mode(mode)
+        out["identical"] = (
+            fastas["lanes"] == fastas["ragged"] == fastas["paged"]
+        )
+        # the acceptance claims, recorded (not asserted — perf claims
+        # belong to the bench record; identity is the hard gate)
+        out["claims"] = {
+            "paged_occupancy_ge_ragged": (
+                out["paged"]["occupancy"] >= out["ragged"]["occupancy"]
+            ),
+            "paged_retire_p99_lt_ragged_flush_p99": (
+                out["paged"].get("retire_p99_ms", 0.0)
+                < out["ragged"].get("flush_p99_ms", float("inf"))
+            ),
+            "panel_hit_rate_nonzero": (
+                out["paged"].get("panel_hit_rate", 0.0) > 0.0
+            ),
+        }
+        return out
+    finally:
+        tmp.cleanup()
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--requests", type=int, default=18)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--arrival-ms", type=float, default=4.0)
+    args = ap.parse_args(argv)
+    report = run_open_loop(
+        requests=args.requests, seed=args.seed,
+        arrival_ms=args.arrival_ms,
+    )
+    print(json.dumps(report))
+    return 0 if report["identical"] else 1
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    sys.exit(main())

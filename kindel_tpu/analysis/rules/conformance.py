@@ -47,6 +47,7 @@ NON_TUNING_KNOBS = {
 DOC_ONLY_KNOBS = {
     "KINDEL_TPU_BENCH_SERVE": "bench.py serve-load opt-in",
     "KINDEL_TPU_BENCH_RAGGED": "bench.py ragged-scenario opt-in",
+    "KINDEL_TPU_BENCH_PAGED": "bench.py paged-scenario opt-in",
 }
 
 #: suffixes a doc token may add to a registered histogram name

@@ -27,8 +27,9 @@ import ast
 from kindel_tpu.analysis.engine import Finding, rule
 from kindel_tpu.analysis.model import ProjectModel
 
-#: packages holding the settled-exactly-once contract
-FUTURE_SCOPE = ("serve", "fleet")
+#: packages holding the settled-exactly-once contract (paged joined in
+#: PR 11: a launch tick owns its entries' futures until settle/recover)
+FUTURE_SCOPE = ("serve", "fleet", "paged")
 
 #: constructors whose result is (or owns) a fresh unsettled Future
 _CREATORS = {"Future", "ServeRequest"}

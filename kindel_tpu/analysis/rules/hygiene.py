@@ -358,11 +358,14 @@ SWALLOW_ALLOWLIST = {
 
 #: packages whose broad except handlers must handle the failure —
 #: serve/resilience/fleet (original scope) plus ragged/parallel (the
-#: two other layers that sit on the admitted-request path) and
-#: devingest (its oracle-fallback discipline uses TYPED excepts only;
-#: a broad swallow there would hide a device/host divergence)
+#: two other layers that sit on the admitted-request path), devingest
+#: (its oracle-fallback discipline uses TYPED excepts only; a broad
+#: swallow there would hide a device/host divergence), and paged (the
+#: continuous-superbatching tier holds admitted futures AND page
+#: references — a swallowed failure leaks both)
 SWALLOW_SCOPE = (
     "serve", "resilience", "fleet", "ragged", "parallel", "devingest",
+    "paged",
 )
 
 

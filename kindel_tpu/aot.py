@@ -550,24 +550,29 @@ def load_cohort(arrays, meta, opts):
     return load_executable(cohort_sig_for(arrays, meta[0], opts))
 
 
-def ragged_sig(class_key: tuple, want_masks: bool) -> tuple:
+def ragged_sig(class_key: tuple, want_masks: bool,
+               realign: bool = False) -> tuple:
     """Static signature of one ragged superbatch executable: the page
     class's geometry key (kindel_tpu.ragged.pack.PageClass.key()) + the
-    wire variant. ONE executable per (class, variant) serves every
-    request shape the class admits — that is the point of the ragged
-    tier (DESIGN.md §16)."""
-    return ("ragged", tuple(class_key), bool(want_masks))
+    wire variant + the realign (clip-channel) dimension. ONE executable
+    per (class, variant) serves every request shape the class admits —
+    that is the point of the ragged tier (DESIGN.md §16)."""
+    return ("ragged", tuple(class_key), bool(want_masks), bool(realign))
 
 
 def ragged_args(arrays, opts) -> tuple:
     """Device args exactly as ragged.kernel.launch_ragged builds them —
-    same aval-agreement contract as cohort_args."""
+    same aval-agreement contract as cohort_args. The two call scalars
+    splice in after the 9 core arrays; realign's clip channels (when
+    `arrays` carries them) trail, matching the kernel's signature."""
     import jax.numpy as jnp
 
-    return tuple(jnp.asarray(a) for a in arrays) + (
+    dev = tuple(jnp.asarray(a) for a in arrays)
+    scalars = (
         jnp.int32(opts.min_depth),
         jnp.int32(1 if opts.fix_clip_artifacts else 0),
     )
+    return dev[:9] + scalars + dev[9:]
 
 
 def export_ragged(arrays, page_class, opts, verify: bool = True) -> bool:
@@ -578,13 +583,14 @@ def export_ragged(arrays, page_class, opts, verify: bool = True) -> bool:
         use_pallas_segments,
     )
 
-    sig = ragged_sig(page_class.key(), opts.want_masks)
+    sig = ragged_sig(page_class.key(), opts.want_masks, opts.realign)
     return export_executable(
         ragged_call_kernel, ragged_args(arrays, opts),
         {
             "n_slots": page_class.n_slots,
             "s_pad": page_class.s_pad,
             "want_masks": opts.want_masks,
+            "realign": opts.realign,
             "pallas_segments": use_pallas_segments(),
         },
         sig, verify=verify,
@@ -594,7 +600,9 @@ def export_ragged(arrays, page_class, opts, verify: bool = True) -> bool:
 def load_ragged(page_class, opts):
     """Load (or fetch from the registry) the executable for one page
     class; None → caller runs the jit kernel."""
-    return load_executable(ragged_sig(page_class.key(), opts.want_masks))
+    return load_executable(
+        ragged_sig(page_class.key(), opts.want_masks, opts.realign)
+    )
 
 
 def ingest_sig(data_pad: int, cap: int) -> tuple:

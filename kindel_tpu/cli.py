@@ -461,14 +461,17 @@ def _serve_parser(sub):
              "per-dispatch upload/launch overhead",
     )
     p.add_argument(
-        "--batch-mode", choices=["lanes", "ragged"], default=None,
+        "--batch-mode", choices=["lanes", "ragged", "paged"], default=None,
         help="admission→dispatch batching: 'lanes' keys coalescing on "
              "padded lane shapes (one compiled kernel per shape), "
              "'ragged' packs variable-length requests into fixed "
              "page-class superbatches with a segment table (one "
              "compiled/AOT executable per page class serves ALL "
-             "shapes — DESIGN.md §16). Top of the explicit > "
-             "$KINDEL_TPU_BATCH_MODE > default-lanes order",
+             "shapes — DESIGN.md §16), 'paged' keeps the pileup "
+             "resident as a paged device state with per-segment "
+             "admit/retire — no flush barrier, same kernel, same "
+             "geometry-only signature (DESIGN.md §20). Top of the "
+             "explicit > $KINDEL_TPU_BATCH_MODE > default-lanes order",
     )
     p.add_argument(
         "--ragged-classes", default=None, metavar="SPEC",
@@ -666,9 +669,12 @@ def _tune_parser(sub):
         "--ragged-budget-s", type=float, default=0.0,
         help="wall budget for the ragged page-class geometry sweep "
              "(packs this BAM's units into each candidate class set and "
-             "times the segment kernel); the winner persists host-keyed "
-             "so `kindel serve --batch-mode ragged` starts with measured "
-             "geometry. 0 (default) skips it",
+             "times the segment kernel). Candidates derive from the "
+             "traffic histogram the serve batcher records (host-keyed); "
+             "the static ladder is the cold-start fallback. The winner "
+             "persists host-keyed so `kindel serve --batch-mode "
+             "ragged|paged` starts with measured geometry. 0 (default) "
+             "skips it",
     )
     p.add_argument(
         "--dry-run", action="store_true",
@@ -841,8 +847,12 @@ def cmd_tune(args) -> int:
             np.asarray(launch_ragged(arrays, cls, opts))
             return _time.perf_counter() - t
 
+        # candidates come from the recorded traffic histogram when the
+        # serve batcher has observed real arrivals on this host (the
+        # static three-probe ladder is only the cold-start fallback)
         ragged_chosen, ragged_timings = tune.search_ragged_classes(
-            ragged_pass, budget_s=args.ragged_budget_s
+            ragged_pass, candidates=tune.ragged_class_candidates(),
+            budget_s=args.ragged_budget_s,
         )
         measurable = {k: v for k, v in ragged_timings.items() if v < 1e9}
         if not args.dry_run and measurable:

@@ -1,0 +1,36 @@
+"""kindel_tpu.paged — continuous superbatching: a persistent paged
+pileup with per-segment admit/retire (DESIGN.md §20).
+
+The ragged tier's superbatch is a barrier: sealed, launched, unpacked
+as a unit. This tier keeps the same fixed-geometry segment kernel and
+the same byte-identity contract, but makes the pileup an always-
+resident paged device state — segments admitted into free pages as
+requests arrive, retired individually the moment their reads complete,
+the kernel re-invoked over whatever is resident. The jit/AOT signature
+stays page geometry only, so PR 6 zero-compile warmup and `ragged_sig`
+keying carry over unchanged.
+
+Layers: `state` (page pool + free list + segment ledger + reference-
+panel cache), `admit` (atomic request binding + jittered wait hints),
+`retire` (per-tick extraction + release), `batcher` (the MicroBatcher-
+contract front the serve worker drives).
+"""
+
+from kindel_tpu.paged.batcher import PagedBatcher, PagedFlush
+from kindel_tpu.paged.state import (
+    PAGE_SLOTS,
+    PagePool,
+    ResidentSegment,
+    paged_metrics,
+    panel_key,
+)
+
+__all__ = [
+    "PAGE_SLOTS",
+    "PagePool",
+    "PagedBatcher",
+    "PagedFlush",
+    "ResidentSegment",
+    "paged_metrics",
+    "panel_key",
+]

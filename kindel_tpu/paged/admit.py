@@ -1,0 +1,50 @@
+"""Per-segment admission: bind one request's units to resident pages.
+
+A request is atomic — every unit binds (as a panel-cache hit on an
+already-resident segment, or a fresh page-run admission) or none do,
+and a failed admission rolls back cleanly so the pool is untouched.
+The caller (PagedBatcher) holds the batcher lock; a request the pool
+cannot take right now parks pending and retries on retirement with a
+wait hint from `wait_hint_s` — which routes through
+`kindel_tpu.serve.queue.jittered_retry_after`, the PR 8 ±25% jitter
+rule, never a raw page-full constant (no new thundering-herd site).
+"""
+
+from __future__ import annotations
+
+from kindel_tpu.serve.queue import jittered_retry_after
+
+from kindel_tpu.paged.state import paged_metrics
+
+#: base of the jittered pool-full retry hint (seconds) — scaled by the
+#: batcher's max_wait so a tighter latency target retries faster
+WAIT_HINT_BASE_S = 0.01
+
+
+def wait_hint_s(max_wait_s: float) -> float:
+    """Pool-full admission retry hint (see module docstring)."""
+    return jittered_retry_after(
+        max(WAIT_HINT_BASE_S, max_wait_s), floor=0.002
+    )
+
+
+def admit_request(pool, units, needs) -> list | None:
+    """Bind every unit to a resident segment; returns [(segment, unit),
+    ...] or None when the pool cannot take the request right now (the
+    pool is left exactly as found — all-or-nothing)."""
+    m = paged_metrics()
+    segs: list = []
+    for u, need in zip(units, needs):
+        seg = pool.panel_hit(u)
+        if seg is not None:
+            m["panel_hits"].inc()
+            segs.append((seg, u))
+            continue
+        seg = pool.admit_unit(u, need)
+        if seg is None:
+            for s, _u in segs:  # rollback: all units or none
+                pool.release(s)
+            return None
+        m["panel_misses"].inc()
+        segs.append((seg, u))
+    return segs

@@ -1,0 +1,419 @@
+"""PagedBatcher — continuous superbatching over persistent page pools.
+
+`--batch-mode paged`: instead of sealing a superbatch and paying a full
+pack→upload→launch→unpack barrier per flush (the ragged tier), requests
+are **admitted** into an always-resident PagePool the moment they
+decode, and a `PagedFlush` is only a *tick*: "these newly-bound
+requests want a launch over whatever is resident". The serve worker
+runs each tick's launch + extraction on its own executor slot, so one
+stalled or slow launch never blocks the next tick — the straggler
+isolation the flush-barrier design could not give. Segments retire
+individually as their requests settle (`retire_flush`), freeing pages
+for the pending queue immediately.
+
+Admission control: a request the current pool cannot take (pages or
+stream capacity) parks on a per-pool pending queue and is retried on
+every retirement. The retry wait hint runs through
+`kindel_tpu.serve.queue.jittered_retry_after` — the same ±25% rule
+every other shed/retry surface uses (PR 8), so a fleet of full pools
+does not wake in lockstep.
+
+The batcher also records the live traffic histogram (unit strides,
+pow2-bucketed), persists it host-keyed through `kindel_tpu.tune`, and
+periodically re-derives its page-class geometry from the observed
+distribution (`tune.derive_page_classes`) — geometry follows traffic
+instead of three static probes, and re-tunes online as traffic drifts
+(new pools open with the new geometry; old pools drain and are pruned).
+
+Oversize requests no class admits still fall through to the inherited
+shape-keyed lanes, counted on the same fallback counter as ragged.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+
+from kindel_tpu.obs import trace as obs_trace
+from kindel_tpu.ragged import pack as rpack
+from kindel_tpu.ragged.batcher import _fallback_counter
+from kindel_tpu.serve.batcher import Flush, MicroBatcher, opts_key
+
+from kindel_tpu.paged.admit import admit_request, wait_hint_s
+from kindel_tpu.paged.state import PAGE_SLOTS, PagePool, paged_metrics
+
+#: admissions between histogram persists / geometry re-derivations
+HIST_PERSIST_EVERY = 64
+RETUNE_EVERY = 128
+
+
+@dataclass
+class PagedFlush(Flush):
+    """One launch tick: the requests newly bound to resident segments
+    since the previous tick, plus the lane whose pool the launch reads.
+    `shapes` carries the page-class geometry key (flush identity /
+    metric labels); `bindings` maps each entry to its (segment, unit)
+    pairs so extraction and retirement are per-segment."""
+
+    lane: object = None
+    bindings: list = field(default_factory=list)
+
+    @property
+    def page_class(self):
+        return self.lane.pool.page_class
+
+
+class _PooledLane:
+    """One (opts, page class) pool plus its admission bookkeeping."""
+
+    __slots__ = ("opts", "pool", "fresh", "pending", "fresh_since",
+                 "fresh_segments")
+
+    def __init__(self, opts, pool: PagePool):
+        self.opts = opts
+        self.pool = pool
+        #: bindings admitted since the last tick: [(req, [(seg, unit)…])]
+        self.fresh: list = []
+        self.fresh_since: float | None = None
+        self.fresh_segments = 0
+        #: requests waiting for pages: deque of (req, units, needs)
+        self.pending: deque = deque()
+
+    @property
+    def idle(self) -> bool:
+        return (
+            not self.fresh and not self.pending
+            and not self.pool.segments
+        )
+
+
+class PagedBatcher(MicroBatcher):
+    """Per-segment admit/retire over persistent pools, with the
+    MicroBatcher flush contract (poll/close/flush_all untouched)."""
+
+    def __init__(self, classes, max_batch_rows: int = 64,
+                 max_wait_s: float = 0.02, clock=None,
+                 page_slots: int = PAGE_SLOTS,
+                 retune_every: int = RETUNE_EVERY):
+        import time
+
+        super().__init__(
+            max_batch_rows=max_batch_rows, max_wait_s=max_wait_s,
+            clock=clock if clock is not None else time.monotonic,
+        )
+        self.classes = tuple(classes)
+        if not self.classes:
+            raise ValueError("PagedBatcher needs at least one page class")
+        self.page_slots = page_slots
+        self.retune_every = retune_every
+        self._lanes_paged: dict[tuple, _PooledLane] = {}
+        self._hist: dict[int, int] = {}
+        self._hist_unsaved: dict[int, int] = {}
+        self._admissions = 0
+        self._last_derived: str | None = None
+        self._next_admit_at: float | None = None
+
+    # ------------------------------------------------------------- admission
+
+    def _wait_hint_s(self) -> float:
+        """Pool-full retry hint: the PR 8 jitter rule (admit.py →
+        queue.jittered_retry_after), never a raw constant — a fleet of
+        saturated pools must not retry admission in lockstep (the same
+        thundering-herd argument as the breaker's half-open probe
+        slot)."""
+        return wait_hint_s(self.max_wait_s)
+
+    def _record_traffic_locked(self, units) -> None:
+        from kindel_tpu.pileup_jax import _bucket
+
+        for u in units:
+            b = _bucket(rpack.stride_for(u.L))
+            self._hist[b] = self._hist.get(b, 0) + 1
+            self._hist_unsaved[b] = self._hist_unsaved.get(b, 0) + 1
+        self._admissions += len(units)
+
+    def _maybe_retune_locked(self, now: float) -> None:
+        """Online geometry retune: derive page classes from the
+        observed histogram every `retune_every` admissions; a changed
+        spec swaps the class list for NEW pools (existing pools drain
+        under their own geometry and are pruned once idle) and persists
+        host-keyed so the next replica boots with traffic-shaped
+        geometry."""
+        from kindel_tpu import tune
+
+        if self._hist_unsaved and self._admissions % HIST_PERSIST_EVERY == 0:
+            tune.record_traffic_histogram(dict(self._hist_unsaved))
+            self._hist_unsaved.clear()
+        if self.retune_every <= 0 or self._admissions % self.retune_every:
+            return
+        spec = tune.derive_page_classes(self._hist)
+        if spec is None or spec == self._last_derived:
+            return
+        self._last_derived = spec
+        try:
+            classes = rpack.parse_classes(spec)
+        except ValueError:
+            return
+        if tuple(c.key() for c in classes) == tuple(
+            c.key() for c in self.classes
+        ):
+            return
+        self.classes = classes
+        tune.record(tune.ragged_store_key(), {"classes": spec,
+                                              "source": "traffic"})
+
+    def _lane_for(self, okey, cls, opts) -> _PooledLane:
+        key = (okey, cls.key())
+        lane = self._lanes_paged.get(key)
+        if lane is None:
+            lane = self._lanes_paged[key] = _PooledLane(
+                opts, PagePool(
+                    cls, clock=self._clock, page_slots=min(
+                        self.page_slots, cls.n_slots
+                    ),
+                )
+            )
+        return lane
+
+    def _admit_locked(self, lane: _PooledLane, req, units,
+                      needs) -> bool:
+        """Bind every unit of one request to a resident segment (panel
+        hit or fresh admission) atomically (admit.admit_request); False
+        leaves the pool untouched."""
+        segs = admit_request(lane.pool, units, needs)
+        if segs is None:
+            return False
+        now = self._clock()
+        lane.fresh.append((req, segs))
+        if lane.fresh_since is None:
+            lane.fresh_since = now
+        lane.fresh_segments += len(segs)
+        return True
+
+    def add(self, req, units) -> None:
+        if not units:
+            raise ValueError("a request with no units has nothing to batch")
+        cls_idx = rpack.classify_units(units, self.classes)
+        if cls_idx is None:
+            _fallback_counter().labels(reason="oversize").inc()
+            super().add(req, units)
+            return
+        needs = [rpack.consumption([u]) for u in units]
+        okey = opts_key(req.opts)
+        with self._cond:
+            self._record_traffic_locked(units)
+            self._maybe_retune_locked(self._clock())
+            admitted = False
+            # occupancy-first: join any existing pool (this class or a
+            # larger one, same opts) that admits the request right now
+            for c in range(cls_idx, len(self.classes)):
+                lane = self._lanes_paged.get(
+                    (okey, self.classes[c].key())
+                )
+                if lane is not None and self._admit_locked(
+                    lane, req, units, needs
+                ):
+                    admitted = True
+                    break
+            if not admitted:
+                home = self._lane_for(okey, self.classes[cls_idx],
+                                      req.opts)
+                admitted = self._admit_locked(home, req, units, needs)
+                if not admitted:
+                    paged_metrics()["waits"].inc()
+                    home.pending.append((req, units, needs))
+                    self._next_admit_at = (
+                        self._clock() + self._wait_hint_s()
+                    )
+            self._cond.notify_all()
+        span = getattr(req, "span", None)
+        if span is not None and span is not obs_trace.NOOP_SPAN:
+            span.add_event(
+                "batcher.paged_add", segments=len(units),
+                admitted=admitted,
+            )
+
+    def _drain_pending_locked(self) -> None:
+        """Retry parked admissions (called on retirement and from the
+        poll loop at the jittered hint)."""
+        progressed = False
+        for lane in self._lanes_paged.values():
+            while lane.pending:
+                req, units, needs = lane.pending[0]
+                if not self._admit_locked(lane, req, units, needs):
+                    break
+                lane.pending.popleft()
+                progressed = True
+        still_waiting = any(
+            lane.pending for lane in self._lanes_paged.values()
+        )
+        if not still_waiting:
+            self._next_admit_at = None
+        elif progressed or self._next_admit_at is None or (
+            self._clock() >= self._next_admit_at
+        ):
+            self._next_admit_at = self._clock() + self._wait_hint_s()
+
+    # ------------------------------------------------------------ poll hooks
+
+    def _seal_paged(self, key, lane: _PooledLane) -> PagedFlush:
+        flush = PagedFlush(
+            lane.opts, lane.pool.page_class.key(),
+            [(req, [u for _s, u in segs]) for req, segs in lane.fresh],
+            lane.fresh_since if lane.fresh_since is not None
+            else self._clock(),
+            lane=lane, bindings=lane.fresh,
+        )
+        lane.fresh = []
+        lane.fresh_since = None
+        lane.fresh_segments = 0
+        return flush
+
+    def _due_locked(self, now: float):
+        flush = super()._due_locked(now)
+        if flush is not None:
+            return flush
+        if self._next_admit_at is not None and now >= self._next_admit_at:
+            self._drain_pending_locked()
+        # prune drained pools (geometry retune leaves old ones behind)
+        for key in [
+            k for k, ln in self._lanes_paged.items() if ln.idle
+        ]:
+            del self._lanes_paged[key]
+        seg_cap = self.max_batch_rows
+        for key, lane in self._lanes_paged.items():
+            if not lane.fresh:
+                continue
+            if (
+                lane.fresh_segments >= min(
+                    seg_cap, lane.pool.page_class.rows
+                )
+                or now - lane.fresh_since >= self.max_wait_s
+            ):
+                return self._seal_paged(key, lane)
+        return None
+
+    def _has_open_locked(self) -> bool:
+        return super()._has_open_locked() or any(
+            lane.fresh or lane.pending
+            for lane in self._lanes_paged.values()
+        )
+
+    def _oldest_open_locked(self) -> float | None:
+        candidates = [
+            t for t in (super()._oldest_open_locked(),) if t is not None
+        ] + [
+            lane.fresh_since for lane in self._lanes_paged.values()
+            if lane.fresh_since is not None
+        ]
+        if self._next_admit_at is not None:
+            # wake at the jittered admission-retry hint: poll sleeps to
+            # oldest + max_wait_s, so shift the hint back by max_wait_s
+            candidates.append(self._next_admit_at - self.max_wait_s)
+        return min(candidates) if candidates else None
+
+    def _seal_open_locked(self) -> None:
+        """Drain: fresh bindings seal into launch ticks; pending
+        requests (never admitted — no pages to read back) seal into
+        classic shape-keyed flushes so every admitted future is still
+        served by this process. Resident zero-ref panel state drops."""
+        from kindel_tpu.batch import cohort_pad_shapes
+
+        for key in list(self._lanes_paged):
+            lane = self._lanes_paged[key]
+            if lane.fresh:
+                self._ready.append(self._seal_paged(key, lane))
+            while lane.pending:
+                req, units, _needs = lane.pending.popleft()
+                self._ready.append(Flush(
+                    req.opts, cohort_pad_shapes(units, req.opts),
+                    [(req, units)], self._clock(),
+                ))
+        super()._seal_open_locked()
+
+    # --------------------------------------------------------- flush contract
+
+    @property
+    def pending_rows(self) -> int:
+        with self._cond:
+            classic = sum(lane.rows for lane in self._lanes.values())
+            paged = sum(
+                lane.fresh_segments + sum(
+                    len(units) for _r, units, _n in lane.pending
+                )
+                for lane in self._lanes_paged.values()
+            )
+            ready = sum(f.n_rows for f in self._ready)
+            return classic + paged + ready
+
+    def take_ready(self, like, limit: int) -> list:
+        # a launch tick already covers everything resident — there is
+        # nothing fatter to coalesce into
+        if isinstance(like, PagedFlush):
+            return []
+        return super().take_ready(like, limit)
+
+    def flush_all(self) -> list:
+        with self._cond:
+            out = [
+                self._seal_paged(key, lane)
+                for key, lane in list(self._lanes_paged.items())
+                if lane.fresh
+            ]
+        return out + super().flush_all()
+
+    # -------------------------------------------------------------- launches
+
+    def snapshot_for_launch(self, flush: PagedFlush):
+        """Consistent kernel-input snapshot of the flush's pool: the
+        resident set assembled into a segment table and packed arrays
+        (host copies — later admissions/retirements never mutate an
+        in-flight launch's inputs). Returns (arrays, table, row_of)."""
+        with self._cond:
+            units, table, row_of = flush.lane.pool.assemble()
+            arrays = rpack.pack_superbatch(
+                units, table, realign=flush.opts.realign
+            )
+            residency = (
+                flush.lane.pool.pages_in_use / flush.lane.pool.n_pages
+            )
+        m = paged_metrics()
+        m["residency"].observe(residency)
+        m["launches"].labels(
+            page_class=flush.lane.pool.page_class.name
+        ).inc()
+        return arrays, table, row_of
+
+    # ------------------------------------------------------------ retirement
+
+    def retire_flush(self, flush: PagedFlush) -> None:
+        """Release every segment reference one launch tick held; pages
+        free as refcounts hit zero, and parked admissions retry
+        immediately (the batcher-side half of per-segment retire)."""
+        with self._cond:
+            for _req, segs in flush.bindings:
+                for seg, _u in segs:
+                    flush.lane.pool.release(seg)
+            self._drain_pending_locked()
+            self._cond.notify_all()
+
+    def release_flush(self, flush: PagedFlush) -> None:
+        """Failure path: drop the tick's references WITHOUT extraction
+        (the worker re-dispatches the requests down the classic §13
+        ladder) so a failed launch cannot leak pages."""
+        self.retire_flush(flush)
+
+    def residency_snapshot(self) -> dict:
+        """Pool residency for /healthz and the bench report."""
+        with self._cond:
+            pools = {}
+            for (_okey, ckey), lane in self._lanes_paged.items():
+                label = lane.pool.page_class.label()
+                doc = pools.setdefault(label, {
+                    "pages": lane.pool.n_pages, "pages_in_use": 0,
+                    "resident_segments": 0, "pending": 0,
+                })
+                doc["pages_in_use"] += lane.pool.pages_in_use
+                doc["resident_segments"] += lane.pool.n_resident
+                doc["pending"] += len(lane.pending)
+            return pools

@@ -1,0 +1,49 @@
+"""Per-segment extraction and retirement.
+
+The launch computed every RESIDENT segment; extraction reads back only
+the rows bound to this tick's requests (`ragged.unpack.unpack_rows` —
+cached panel segments ride along unread), folds them into one
+SampleResult per request, and the batcher then releases the tick's
+segment references so pages free the moment their reads complete —
+independent of any co-resident straggler still in flight on another
+tick. Decode runs inline in the tick's own executor slot: the ticks
+themselves are the parallelism, and nesting pool.map inside a pool
+task would deadlock a saturated executor.
+"""
+
+from __future__ import annotations
+
+
+class _InlineMap:
+    """Minimal pool stand-in for unpack_rows (see module docstring)."""
+
+    @staticmethod
+    def map(fn, items):
+        return map(fn, items)
+
+
+def extract_flush(out, table, row_of, flush, opts) -> list:
+    """Per-request results for one launch tick: returns [(req,
+    SampleResult), ...] in binding order. `out` is launch_ragged's
+    result over the snapshot `table`; `row_of` maps seg_id → table row."""
+    from kindel_tpu.batch import _fold_results
+    from kindel_tpu.ragged.unpack import unpack_rows
+    from kindel_tpu.serve.worker import _payload_label
+
+    row_units = []
+    units_flat = []
+    paths = []
+    for idx, (req, segs) in enumerate(flush.bindings):
+        paths.append(_payload_label(req.payload))
+        for seg, unit in segs:
+            unit.sample_idx = idx
+            row_units.append((row_of[seg.seg_id], unit))
+            units_flat.append(unit)
+    outputs = unpack_rows(
+        out, table, row_units, opts, _InlineMap(), paths=paths
+    )
+    grouped = _fold_results(units_flat, outputs, len(flush.bindings))
+    return [
+        (req, grouped[idx])
+        for idx, (req, _segs) in enumerate(flush.bindings)
+    ]

@@ -1,0 +1,371 @@
+"""Paged pileup state: a page pool, a free list, and a per-page segment
+ledger.
+
+The ragged tier (kindel_tpu.ragged, DESIGN.md §16) seals, launches, and
+unpacks each superbatch as a unit — one straggler segment holds the
+whole page grid, and every flush pays a full pack→upload→launch→unpack
+barrier. This module is the state half of the continuous alternative
+(PAPERS.md "Ragged Paged Attention"): the flat slot axis of ONE page
+class becomes an always-resident pool of fixed-size pages; segments are
+**admitted** into free contiguous page runs as requests arrive and
+**retired** individually the moment their reads complete, and the
+segment kernel is simply re-invoked over whatever is resident. Slot
+placement is persistent — a segment keeps its page run (and therefore
+its pre-offset scatter coordinates) across every launch it rides — so
+the jit/AOT signature stays page geometry only and PR 6's zero-compile
+warmup and `ragged_sig` keying carry over unchanged.
+
+The ledger also hosts the **reference-panel cache**: amplicon and
+surveillance traffic hits the same few references with identical
+payloads, so identical `(reference, opts)` panel state dedupes across
+requests — a panel hit bumps the resident segment's refcount instead of
+admitting new pages (the prefix-sharing trick of paged attention). A
+segment whose refcount drops to zero but which carries a panel key is
+not freed eagerly: it parks on an LRU reclaim list, still resident, and
+either revives on the next identical request or is reclaimed when
+admission actually needs its pages.
+
+Concurrency: the pool is NOT internally locked — the owning
+PagedBatcher serializes every mutation and snapshot under its own
+condition lock (the same lock the poll/flush contract already holds).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from kindel_tpu.ragged import pack as rpack
+from kindel_tpu.ragged.pack import PAD_POS, SegmentTable
+
+#: slots per page: small enough that short amplicon segments waste
+#: little tail, large enough that the free list stays tiny; a multiple
+#: of the 8-slot granule so page boundaries are wire-byte-aligned
+PAGE_SLOTS = 256
+
+
+def _paged_metrics():
+    """Process-global paged-tier metrics (DESIGN.md §20): residency,
+    retire latency, panel-cache traffic, admission waits."""
+    from kindel_tpu.obs.metrics import default_registry
+
+    reg = default_registry()
+    return {
+        "pages_in_use": reg.gauge(
+            "kindel_paged_pages_in_use",
+            "pages currently holding resident segments, summed over "
+            "every paged pool",
+        ),
+        "resident": reg.gauge(
+            "kindel_paged_resident_segments",
+            "segments currently resident in paged pools (including "
+            "zero-ref panel-cache entries awaiting reuse)",
+        ),
+        "residency": reg.histogram(
+            "kindel_paged_residency",
+            "pages-in-use fraction of the page grid per paged launch",
+            buckets=(0.05, 0.1, 0.2, 0.3, 0.5, 0.7, 0.85, 0.95, 1.0),
+        ),
+        "retire_s": reg.histogram(
+            "kindel_paged_retire_seconds",
+            "admit-to-retire wall time of one paged segment",
+            buckets=(0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 10.0),
+        ),
+        "panel_hits": reg.counter(
+            "kindel_paged_panel_hits_total",
+            "request units served by an already-resident reference-panel "
+            "segment (no new pages admitted)",
+        ),
+        "panel_misses": reg.counter(
+            "kindel_paged_panel_misses_total",
+            "request units that admitted a fresh segment (panel-cache "
+            "miss or non-cacheable)",
+        ),
+        "launches": reg.counter(
+            "kindel_paged_launches_total",
+            "segment-kernel launches over resident paged state, labeled "
+            "by page class",
+        ),
+        "waits": reg.counter(
+            "kindel_paged_admission_waits_total",
+            "request admissions deferred because the page pool was full "
+            "(retried on retirement with a jittered wait hint)",
+        ),
+    }
+
+
+_METRICS: dict | None = None
+
+
+def paged_metrics() -> dict:
+    global _METRICS
+    if _METRICS is None:
+        _METRICS = _paged_metrics()
+    return _METRICS
+
+
+def panel_key(unit) -> tuple:
+    """Content identity of one unit's panel state: two units with equal
+    keys produce byte-identical kernel rows (same reference, same event
+    streams, same insertion strings), so their device state is
+    shareable. Options identity is the pool key, not part of this."""
+    h = hashlib.sha1()
+    for arr in (
+        unit.op_r_start, unit.op_off, unit.base_packed, unit.del_pos,
+        unit.ins_pos, unit.ins_cnt, unit.csw_pos, unit.csw_base,
+        unit.cew_pos, unit.cew_base,
+    ):
+        if arr is not None:
+            h.update(np.ascontiguousarray(arr).tobytes())
+        h.update(b"|")
+    tab = unit.ins_table
+    if tab is not None:
+        # insertion strings resolve host-side at assembly — identical
+        # keys must imply identical emitted insertions too
+        h.update(np.ascontiguousarray(tab.pos).tobytes())
+        h.update(np.ascontiguousarray(tab.str_id).tobytes())
+        h.update(np.ascontiguousarray(tab.count).tobytes())
+        h.update(b"\x00".join(tab.strings))
+    return (unit.ref_id, int(unit.L), int(unit.n_events), h.hexdigest())
+
+
+@dataclass
+class ResidentSegment:
+    """Ledger row for one resident segment (one CallUnit's pages)."""
+
+    seg_id: int
+    unit: object
+    page0: int
+    n_pages: int
+    need: rpack.Consumption
+    panel: tuple | None
+    admitted_at: float
+    refs: int = 1
+
+    @property
+    def slot_start(self) -> int:
+        return self.page0 * PAGE_SLOTS
+
+
+@dataclass
+class PoolCounters:
+    spans: int = 0
+    events: int = 0
+    dels: int = 0
+    inss: int = 0
+    clips: int = 0
+
+    def add(self, need: rpack.Consumption, sign: int = 1) -> None:
+        self.spans += sign * need.spans
+        self.events += sign * need.events
+        self.dels += sign * need.dels
+        self.inss += sign * need.inss
+        self.clips += sign * need.clips
+
+
+@dataclass
+class PagePool:
+    """One page class's always-resident paged state (see module doc)."""
+
+    page_class: rpack.PageClass
+    clock: object
+    page_slots: int = PAGE_SLOTS
+    segments: dict = field(default_factory=dict)
+    panel_index: dict = field(default_factory=dict)
+    reclaimable: OrderedDict = field(default_factory=OrderedDict)
+    totals: PoolCounters = field(default_factory=PoolCounters)
+    _next_id: int = 0
+    _used: np.ndarray = None
+
+    def __post_init__(self):
+        if self.page_class.n_slots % self.page_slots:
+            raise ValueError(
+                f"page size {self.page_slots} does not divide the "
+                f"{self.page_class.label()} slot grid"
+            )
+        self.n_pages = self.page_class.n_slots // self.page_slots
+        self._used = np.zeros(self.n_pages, dtype=bool)
+
+    # ------------------------------------------------------------ accounting
+
+    @property
+    def pages_in_use(self) -> int:
+        return int(self._used.sum())
+
+    @property
+    def n_resident(self) -> int:
+        return len(self.segments)
+
+    def _pages_for(self, stride: int) -> int:
+        return -(-int(stride) // self.page_slots)
+
+    def _find_run(self, n: int) -> int | None:
+        """First-fit contiguous free page run (None when fragmented or
+        full). n_pages is small (≤ a few hundred), so a linear scan is
+        cheaper than maintaining a buddy structure."""
+        free = ~self._used
+        run = 0
+        for i in range(self.n_pages):
+            run = run + 1 if free[i] else 0
+            if run >= n:
+                return i - n + 1
+        return None
+
+    def _caps_admit(self, need: rpack.Consumption) -> bool:
+        c, t = self.page_class, self.totals
+        return (
+            self.n_resident < c.rows
+            and t.spans + need.spans <= c.o_cap
+            and t.events + need.events <= c.e_cap
+            and t.dels + need.dels <= c.d_cap
+            and t.inss + need.inss <= c.i_cap
+            and t.clips + need.clips <= c.c_cap
+        )
+
+    # -------------------------------------------------------------- admission
+
+    def admit_unit(self, unit, need: rpack.Consumption):
+        """Admit one unit into free pages; returns the ResidentSegment
+        or None when the pool cannot take it right now (the batcher
+        parks the request pending and retries on retirement). Reclaims
+        LRU zero-ref panel segments when that is what stands between
+        the request and a free run."""
+        n = self._pages_for(rpack.stride_for(unit.L))
+        while True:
+            if self._caps_admit(need):
+                at = self._find_run(n)
+                if at is not None:
+                    return self._place(unit, need, at, n)
+            if not self.reclaimable:
+                return None
+            self._reclaim_one()
+
+    def _place(self, unit, need, page0: int, n: int) -> ResidentSegment:
+        self._next_id += 1
+        seg = ResidentSegment(
+            seg_id=self._next_id, unit=unit, page0=page0, n_pages=n,
+            need=need, panel=panel_key(unit), admitted_at=self.clock(),
+        )
+        self._used[page0: page0 + n] = True
+        self.totals.add(need)
+        self.segments[seg.seg_id] = seg
+        self.panel_index[seg.panel] = seg.seg_id
+        m = paged_metrics()
+        m["pages_in_use"].set(self.pages_in_use)
+        m["resident"].set(self.n_resident)
+        return seg
+
+    def panel_hit(self, unit) -> ResidentSegment | None:
+        """Resident segment with this unit's panel identity, revived
+        from the reclaim list when parked there; None on a miss."""
+        seg_id = self.panel_index.get(panel_key(unit))
+        if seg_id is None:
+            return None
+        seg = self.segments.get(seg_id)
+        if seg is None:
+            return None
+        was_parked = seg_id in self.reclaimable
+        self.reclaimable.pop(seg_id, None)
+        if was_parked or seg.refs == 0:
+            # revival of a parked segment starts a fresh residency
+            # interval — admit→retire latency measures THIS use
+            seg.admitted_at = self.clock()
+        seg.refs += 1
+        return seg
+
+    # ------------------------------------------------------------- retirement
+
+    def release(self, seg: ResidentSegment) -> None:
+        """Drop one reference; at zero the segment RETIRES — its reads
+        are complete (the admit→retire latency observes here), and it
+        is freed outright for one-shot state or parked reclaimable for
+        panel state (the cache half of the paged design)."""
+        seg.refs -= 1
+        if seg.refs > 0:
+            return
+        paged_metrics()["retire_s"].observe(
+            max(0.0, self.clock() - seg.admitted_at)
+        )
+        if seg.panel is not None and seg.seg_id in self.segments:
+            self.reclaimable[seg.seg_id] = None
+            self.reclaimable.move_to_end(seg.seg_id)
+            return
+        self._free(seg)
+
+    def _reclaim_one(self) -> None:
+        seg_id, _ = self.reclaimable.popitem(last=False)  # LRU
+        seg = self.segments.get(seg_id)
+        if seg is not None:
+            self._free(seg)
+
+    def _free(self, seg: ResidentSegment) -> None:
+        if seg.seg_id not in self.segments:
+            return
+        del self.segments[seg.seg_id]
+        self.reclaimable.pop(seg.seg_id, None)
+        if self.panel_index.get(seg.panel) == seg.seg_id:
+            del self.panel_index[seg.panel]
+        self._used[seg.page0: seg.page0 + seg.n_pages] = False
+        self.totals.add(seg.need, sign=-1)
+        m = paged_metrics()
+        m["pages_in_use"].set(self.pages_in_use)
+        m["resident"].set(self.n_resident)
+
+    def drop_all(self) -> None:
+        """Retire everything (pool teardown on drain)."""
+        for seg in list(self.segments.values()):
+            self._free(seg)
+
+    # --------------------------------------------------------------- assembly
+
+    def assemble(self):
+        """Snapshot the resident set as kernel inputs: (units in slot
+        order, SegmentTable over the PERSISTENT page-run offsets,
+        {seg_id: table row}). The caller packs with
+        ragged.pack_superbatch — identical math, arbitrary (paged)
+        starts instead of cumulative ones."""
+        segs = sorted(self.segments.values(), key=lambda s: s.page0)
+        if not segs:
+            raise ValueError("an empty pool has nothing to assemble")
+        units = [s.unit for s in segs]
+        n = len(units)
+        lens = np.fromiter((u.L for u in units), np.int64, count=n)
+        ev_len = np.fromiter((u.n_events for u in units), np.int64, count=n)
+        del_len = np.fromiter(
+            (len(u.del_pos) for u in units), np.int64, count=n
+        )
+        ins_len = np.fromiter(
+            (len(u.ins_pos) for u in units), np.int64, count=n
+        )
+        table = SegmentTable(
+            page_class=self.page_class,
+            entry_idx=np.zeros(n, np.int32),
+            seg_start=np.fromiter(
+                (s.slot_start for s in segs), np.int64, count=n
+            ).astype(np.int32),
+            seg_len=lens.astype(np.int32),
+            ev_off=np.concatenate(
+                ([0], np.cumsum(ev_len)[:-1])
+            ).astype(np.int32),
+            ev_len=ev_len.astype(np.int32),
+            del_off=np.concatenate(
+                ([0], np.cumsum(del_len)[:-1])
+            ).astype(np.int32),
+            del_len=del_len.astype(np.int32),
+            ins_off=np.concatenate(
+                ([0], np.cumsum(ins_len)[:-1])
+            ).astype(np.int32),
+            ins_len=ins_len.astype(np.int32),
+        )
+        row_of = {s.seg_id: i for i, s in enumerate(segs)}
+        return units, table, row_of
+
+
+# re-exported sentinel so state consumers need not reach into pileup_jax
+__all__ = [
+    "PAGE_SLOTS", "PAD_POS", "PagePool", "ResidentSegment", "panel_key",
+    "paged_metrics",
+]

@@ -10,12 +10,13 @@ batch-full / max-wait / drain semantics the worker's dispatch loop
 already drives through `poll`, so the worker, watchdog, supervisor, and
 admission watermarks are untouched.
 
-Two request kinds cannot ride a superbatch and fall through to the
+One request kind cannot ride a superbatch and falls through to the
 inherited shape-keyed lanes (still one batcher, one poll loop, one
-dispatch thread): requests whose options carry `realign` (the CDR walk
-needs the row-structured dense channels of the cohort kernel), and
-oversize requests no page class admits. Both are counted on the
-process-global registry so the fallback volume is visible.
+dispatch thread): oversize requests no page class admits. Realign
+traffic used to fall back too, until the segment kernel learned the
+flat clip-channel scatter and segment-windowed CDR fetches — the
+`reason="realign"` label of the fallback counter is now a regression
+tripwire pinned at zero, and only `reason="oversize"` is a live route.
 
 Fat-dispatch coalescing (`take_ready`) degrades to "already one batch"
 for superbatch flushes: merging two sealed superbatches would overflow
@@ -45,7 +46,8 @@ def _fallback_counter():
         _FALLBACK_COUNTER = default_registry().counter(
             "kindel_ragged_fallback_total",
             "requests routed to the shape-keyed lanes path instead of a "
-            "superbatch (reason label: realign/oversize)",
+            "superbatch (reason label: oversize is the only live route; "
+            "realign is a regression tripwire pinned at zero)",
         )
     return _FALLBACK_COUNTER
 
@@ -61,7 +63,7 @@ class RaggedFlush(Flush):
 
 class _RaggedLane:
     __slots__ = ("opts", "cls_idx", "entries", "opened_at", "segments",
-                 "slots", "spans", "events", "dels", "inss")
+                 "slots", "spans", "events", "dels", "inss", "clips")
 
     def __init__(self, opts, cls_idx, now):
         self.opts = opts
@@ -74,6 +76,7 @@ class _RaggedLane:
         self.events = 0
         self.dels = 0
         self.inss = 0
+        self.clips = 0
 
     def admits(self, need: rpack.Consumption, cls: rpack.PageClass,
                seg_cap: int) -> bool:
@@ -84,6 +87,7 @@ class _RaggedLane:
             and self.events + need.events <= cls.e_cap
             and self.dels + need.dels <= cls.d_cap
             and self.inss + need.inss <= cls.i_cap
+            and self.clips + need.clips <= cls.c_cap
         )
 
     def take(self, req, units, need: rpack.Consumption) -> None:
@@ -94,6 +98,7 @@ class _RaggedLane:
         self.events += need.events
         self.dels += need.dels
         self.inss += need.inss
+        self.clips += need.clips
 
 
 class RaggedBatcher(MicroBatcher):
@@ -123,14 +128,14 @@ class RaggedBatcher(MicroBatcher):
     def add(self, req, units) -> None:
         if not units:
             raise ValueError("a request with no units has nothing to batch")
-        cls_idx = None
-        if not req.opts.realign:
-            cls_idx = rpack.classify_units(units, self.classes)
+        # realign rides a superbatch like everything else since the
+        # segment kernel learned the clip-channel scatter + windowed CDR
+        # fetches — reason="realign" is a regression tripwire pinned at
+        # zero by tests/test_ragged.py, never a live route
+        cls_idx = rpack.classify_units(units, self.classes)
         if cls_idx is None:
-            # realign/oversize: the inherited shape-keyed lane path
-            _fallback_counter().labels(
-                reason="realign" if req.opts.realign else "oversize"
-            ).inc()
+            # oversize: the inherited shape-keyed lane path
+            _fallback_counter().labels(reason="oversize").inc()
             super().add(req, units)
             return
         need = rpack.consumption(units)

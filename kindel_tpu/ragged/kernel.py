@@ -67,11 +67,10 @@ def use_pallas_segments() -> bool:
     return jax.default_backend() != "cpu"
 
 
-def _segment_depth_xla(acgt, slot_seg, slot_end, s_pad: int):
-    """Per-segment min/max ACGT depth via jax.ops segment reductions."""
-    n_slots = acgt.shape[0]
-    slot = jnp.arange(n_slots, dtype=jnp.int32)
-    in_ref = slot < slot_end
+def _segment_depth_xla(acgt, slot_seg, in_ref, s_pad: int):
+    """Per-segment min/max ACGT depth via jax.ops segment reductions.
+    `in_ref` is the per-slot membership mask (slot inside its segment's
+    true reference span) computed once by the caller."""
     dmin = jax.ops.segment_min(
         jnp.where(in_ref, acgt, _INT32_MAX), slot_seg, num_segments=s_pad
     )
@@ -84,7 +83,7 @@ def _segment_depth_xla(acgt, slot_seg, slot_end, s_pad: int):
     return dmin, jnp.maximum(dmax, -1)
 
 
-def _pallas_seg_kernel(depth_ref, seg_ref, end_ref, dmin_ref, dmax_ref,
+def _pallas_seg_kernel(depth_ref, seg_ref, in_ref_ref, dmin_ref, dmax_ref,
                        *, s_tile: int):
     """One grid step: fold a slot block's depths into the running
     per-segment min/max (output block revisited across the sequential
@@ -100,11 +99,7 @@ def _pallas_seg_kernel(depth_ref, seg_ref, end_ref, dmin_ref, dmax_ref,
 
     depth = depth_ref[0, :]
     seg = seg_ref[0, :]
-    base = i * _PALLAS_BLOCK
-    slot = base + jax.lax.broadcasted_iota(
-        jnp.int32, (1, _PALLAS_BLOCK), 1
-    )[0]
-    in_ref = slot < end_ref[0, :]
+    in_ref = in_ref_ref[0, :] != 0
     # [BLOCK, S] one-hot segment membership → masked column reductions
     sid = jax.lax.broadcasted_iota(jnp.int32, (_PALLAS_BLOCK, s_tile), 1)
     mask = (seg[:, None] == sid) & in_ref[:, None]
@@ -118,7 +113,7 @@ def _pallas_seg_kernel(depth_ref, seg_ref, end_ref, dmin_ref, dmax_ref,
     )
 
 
-def _segment_depth_pallas(acgt, slot_seg, slot_end, s_pad: int):
+def _segment_depth_pallas(acgt, slot_seg, in_ref, s_pad: int):
     """Pallas fast path of the per-segment depth reduction: grid over
     slot blocks, [BLOCK, S]-masked min/max per step, running fold into a
     revisited [1, S] output. Segment axis padded to a lane-friendly
@@ -130,7 +125,7 @@ def _segment_depth_pallas(acgt, slot_seg, slot_end, s_pad: int):
     s_tile = max(128, -(-s_pad // 128) * 128)
     grid = n_slots // _PALLAS_BLOCK
     interpret = jax.default_backend() == "cpu"
-    # slot_end, per slot, is what the block mask needs; the seg axis is
+    # in_ref, per slot, is what the block mask needs; the seg axis is
     # padded with an id (s_tile - 1 >= s_pad) no real slot carries
     dmin, dmax = pl.pallas_call(
         partial(_pallas_seg_kernel, s_tile=s_tile),
@@ -146,38 +141,78 @@ def _segment_depth_pallas(acgt, slot_seg, slot_end, s_pad: int):
         ],
         out_shape=[jax.ShapeDtypeStruct((1, s_tile), jnp.int32)] * 2,
         interpret=interpret,
-    )(acgt[None, :], slot_seg[None, :], slot_end[None, :])
+    )(acgt[None, :], slot_seg[None, :],
+      in_ref.astype(jnp.int32)[None, :])
     return dmin[0, :s_pad], dmax[0, :s_pad]
 
 
 @partial(
     jax.jit,
-    static_argnames=("n_slots", "s_pad", "want_masks", "pallas_segments"),
+    static_argnames=("n_slots", "s_pad", "want_masks", "realign",
+                     "pallas_segments"),
 )
 def ragged_call_kernel(op_r_start, op_off, base_packed, del_pos, ins_pos,
                        ins_cnt, seg_starts, seg_lens, n_events, min_depth,
-                       flags=0, *, n_slots: int, s_pad: int,
-                       want_masks: bool = False,
+                       flags=0, csw_pos=None, csw_base=None, cew_pos=None,
+                       cew_base=None, *, n_slots: int, s_pad: int,
+                       want_masks: bool = False, realign: bool = False,
                        pallas_segments: bool = False):
     """Scatter + call every packed segment of one superbatch; see the
     module docstring for the wire layout. Static only in the page-class
-    geometry (array shapes + n_slots/s_pad) and the wire variant."""
+    geometry (array shapes + n_slots/s_pad) and the wire variant.
+
+    Under `realign` the flat clip-projection channels scatter exactly
+    like the cohort realign kernel's per-row ones (positions pre-offset
+    by pack.py, so the same integer-exact dominance triggers
+    2·csd > w+d+1 apply per slot), two trigger bitplanes join the wire,
+    and the dense (weights, deletions, csw, cew) tensors are returned
+    device-resident for the segment-windowed CDR fetches — the output
+    tuple mirrors `batched_realign_call_kernel`."""
     out = _call_core(
         op_r_start, op_off, base_packed, del_pos, ins_pos, ins_cnt,
         n_events, min_depth, n_slots, want_masks, keep_dense=True,
         flags=flags,
     )
-    (main, parts, _dmin, _dmax), (weights, _deletions) = out[:4], out[4:]
+    (main, parts, _dmin, _dmax), (weights, deletions) = out[:4], out[4:]
 
     # segment ids + in-reference bounds from the uploaded segment table:
     # boundary scatter + prefix sum, the same trick the span-id
-    # reconstruction uses (pad seg_starts carry PAD_POS → dropped)
+    # reconstruction uses (pad seg_starts carry PAD_POS → dropped). The
+    # membership mask checks BOTH bounds: a paged pool may leave leading
+    # or interior pages free, so a slot below its attributed segment's
+    # start is free space, not segment 0 (ragged superbatches always
+    # start at slot 0 — the lower bound is vacuous there).
     acgt = weights[:, :4].sum(axis=1)
     marks = jnp.zeros(n_slots, jnp.int32).at[seg_starts].add(1, mode="drop")
     slot_seg = jnp.clip(jnp.cumsum(marks) - 1, 0, s_pad - 1)
-    slot_end = (seg_starts + seg_lens)[slot_seg]
+    slot = jnp.arange(n_slots, dtype=jnp.int32)
+    in_ref = (slot >= seg_starts[slot_seg]) & (
+        slot < (seg_starts + seg_lens)[slot_seg]
+    )
     seg_fn = _segment_depth_pallas if pallas_segments else _segment_depth_xla
-    seg_dmin, seg_dmax = seg_fn(acgt, slot_seg, slot_end, s_pad)
+    seg_dmin, seg_dmax = seg_fn(acgt, slot_seg, in_ref, s_pad)
+
+    extra = ()
+    if realign:
+        # flat clip-channel scatter + per-slot dominance triggers —
+        # the decision math is shared with the cohort realign kernel
+        # verbatim (reference kindel.py:182-185,229-238); in_ref plays
+        # the per-row valid mask's role
+        def clip_scatter(p, b):
+            return (
+                jnp.zeros(n_slots * weights.shape[1], jnp.int32)
+                .at[p * weights.shape[1] + b]
+                .add(1, mode="drop")
+                .reshape(n_slots, weights.shape[1])
+            )
+
+        csw = clip_scatter(csw_pos, csw_base)
+        cew = clip_scatter(cew_pos, cew_base)
+        denom = weights.sum(axis=1) + deletions + 1
+        trig_f = jnp.packbits((2 * csw[:, :4].sum(axis=1) > denom) & in_ref)
+        trig_r = jnp.packbits((2 * cew[:, :4].sum(axis=1) > denom) & in_ref)
+        parts = tuple(parts) + (trig_f, trig_r)
+        extra = (weights, deletions, csw, cew)
 
     segs = [main]
     segs.extend(
@@ -189,18 +224,26 @@ def ragged_call_kernel(op_r_start, op_off, base_packed, del_pos, ins_pos,
     segs.append(
         jax.lax.bitcast_convert_type(seg_dmax, jnp.uint8).reshape(-1)
     )
-    return jnp.concatenate(segs)
+    wire = jnp.concatenate(segs)
+    if realign:
+        return (wire,) + extra
+    return wire
 
 
-def wire_sizes(page_class, want_masks: bool) -> list[int]:
+def wire_sizes(page_class, want_masks: bool,
+               realign: bool = False) -> list[int]:
     """Byte sizes of the ragged wire's segments, in producer order —
-    the single source of truth `unpack.py` slices by."""
+    the single source of truth `unpack.py` slices by. Under `realign`
+    two n_slots/8 trigger bitplanes ride between the call segments and
+    the per-segment depth scalars."""
     n = page_class.n_slots
     if want_masks:
         sizes = [n // 2, n // 8, n // 8, n // 8]
     else:
         sizes = [n // 4, n // 8, -(-page_class.d_cap // 8),
                  -(-page_class.i_cap // 8)]
+    if realign:
+        sizes += [n // 8, n // 8]
     return sizes + [4 * page_class.s_pad, 4 * page_class.s_pad]
 
 
@@ -209,7 +252,9 @@ def launch_ragged(arrays, page_class, opts):
     (async, like every dispatch site). Consults the AOT registry first
     (kindel_tpu.aot — serve warmup loads/exports page-class executables
     exactly as it does lane shapes); a miss or rejected call runs the
-    jit kernel, byte-identically."""
+    jit kernel, byte-identically. Under realign `arrays` carries the
+    four clip channels (pack_superbatch realign=True) and the result is
+    the (wire, weights, deletions, csw, cew) tuple."""
     from kindel_tpu import aot
 
     rfaults.hook("device.dispatch")
@@ -219,17 +264,21 @@ def launch_ragged(arrays, page_class, opts):
     with obs_trace.span("ragged.launch") as sp:
         dev = aot.ragged_args(arrays, opts)
         out = aot.call(
-            aot.ragged_sig(page_class.key(), opts.want_masks), dev
+            aot.ragged_sig(page_class.key(), opts.want_masks,
+                           opts.realign),
+            dev,
         )
         aot_hit = out is not None
         if out is None:
             out = ragged_call_kernel(
                 *dev, n_slots=page_class.n_slots, s_pad=page_class.s_pad,
-                want_masks=opts.want_masks, pallas_segments=pallas,
+                want_masks=opts.want_masks, realign=opts.realign,
+                pallas_segments=pallas,
             )
         if sp is not obs_trace.NOOP_SPAN:
             sp.set_attribute(
                 page_class=page_class.label(), n_slots=page_class.n_slots,
                 h2d_bytes=h2d_bytes, aot=aot_hit, pallas=pallas,
+                realign=opts.realign,
             )
     return out

@@ -110,12 +110,19 @@ class PageClass:
     def i_cap(self) -> int:
         return max(64, self.n_slots // INDEL_SLOT_FRACTION)
 
+    @property
+    def c_cap(self) -> int:
+        """Clip-projection event capacity (realign traffic): soft-clip
+        projections are bounded by read bases, so one event per slot is
+        a generous budget that still keeps the upload O(n_slots)."""
+        return max(128, self.n_slots)
+
     def key(self) -> tuple:
         """Static geometry identity — the jit/AOT signature component
         (the leading marker keeps it disjoint from every shape-keyed
         lane tuple, so flush identities never collide)."""
         return ("ragged", self.name, self.rows, self.length, self.o_cap,
-                self.b_cap, self.d_cap, self.i_cap)
+                self.b_cap, self.d_cap, self.i_cap, self.c_cap)
 
     def label(self) -> str:
         return f"{self.name}:r{self.rows}xL{self.length}"
@@ -158,6 +165,13 @@ class Consumption:
     events: int
     dels: int
     inss: int
+    clips: int = 0  # soft-clip projection events (realign traffic)
+
+
+def _n_clips(u) -> int:
+    csw = getattr(u, "csw_pos", None)
+    cew = getattr(u, "cew_pos", None)
+    return (0 if csw is None else len(csw)) + (0 if cew is None else len(cew))
 
 
 def consumption(units) -> Consumption:
@@ -170,6 +184,7 @@ def consumption(units) -> Consumption:
         events=sum(u.n_events for u in units),
         dels=sum(len(u.del_pos) for u in units),
         inss=sum(len(u.ins_pos) for u in units),
+        clips=sum(_n_clips(u) for u in units),
     )
 
 
@@ -186,6 +201,7 @@ def fits(need: Consumption, cls: PageClass,
         and need.events <= cls.e_cap
         and need.dels <= cls.d_cap
         and need.inss <= cls.i_cap
+        and need.clips <= cls.c_cap
     )
 
 
@@ -280,7 +296,7 @@ def build_segment_table(units, page_class: PageClass) -> SegmentTable:
     return table
 
 
-def pack_superbatch(units, table: SegmentTable):
+def pack_superbatch(units, table: SegmentTable, realign: bool = False):
     """Concatenate every unit's event tensors into the page class's
     fixed-capacity flat arrays (vectorized; loop-free by tier-1 AST
     guard). Positions are pre-offset by each unit's slot start, so the
@@ -291,7 +307,14 @@ def pack_superbatch(units, table: SegmentTable):
       (op_r_start[o_cap], op_off[o_cap], base_packed[b_cap],
        del_pos[d_cap], ins_pos[i_cap], ins_cnt[i_cap],
        seg_starts[s_pad], seg_lens[s_pad], n_events)
-    """
+    plus, under `realign`, the flat clip-projection channels
+      (csw_pos[c_cap], csw_base[c_cap], cew_pos[c_cap], cew_base[c_cap]).
+    Clip events at positions >= a unit's own reference length are
+    dropped at pack time: unlike the row-structured cohort kernel
+    (where they scatter into that row's private pad tail) a flat
+    over-length position would land in another segment's slots, and no
+    decode surface ever reads a clip channel past L (the CDR walk's
+    windows are bounded to [0, L))."""
     from kindel_tpu.call_jax import unpack_base_codes
 
     c = table.page_class
@@ -333,7 +356,27 @@ def pack_superbatch(units, table: SegmentTable):
     seg_starts[: table.n_segments] = table.seg_start
     seg_lens = np.zeros(c.s_pad, np.int32)
     seg_lens[: table.n_segments] = table.seg_len
-    return (
+    out = (
         op_r_start, op_off, base_packed, del_pos, ins_pos, ins_cnt,
         seg_starts, seg_lens, np.int32(total_events),
     )
+    if not realign:
+        return out
+
+    def clip_pair(pos_attr, base_attr):
+        # see docstring: the in-segment filter keeps the flat scatter
+        # from crossing into a neighboring segment's slots
+        pairs = [
+            (p[keep] + s, getattr(u, base_attr)[keep])
+            for u, s in zip(units, table.seg_start)
+            if (p := getattr(u, pos_attr, None)) is not None and len(p)
+            for keep in ((p < u.L),)
+        ]
+        return (
+            flat([a for a, _ in pairs], c.c_cap, PAD_POS),
+            flat([b for _, b in pairs], c.c_cap, 0),
+        )
+
+    csw_pos, csw_base = clip_pair("csw_pos", "csw_base")
+    cew_pos, cew_base = clip_pair("cew_pos", "cew_base")
+    return out + (csw_pos, csw_base, cew_pos, cew_base)

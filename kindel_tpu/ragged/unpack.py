@@ -9,10 +9,31 @@ offsets. From there the decode is the SAME host code the lanes path
 runs (`decode_fast` / `masks_from_wire` / `assemble`), so any divergence
 would have to come from the device math — which `ragged/kernel.py`
 shares with the cohort kernel position-for-position.
+
+Realign traffic adds two trigger bitplanes to the wire and keeps the
+dense (weights, deletions, csw, cew) tensors device-resident; the CDR
+walk reads them through `SegmentCdrFetcher` — segment-windowed
+dynamic-slice fetches into the FLAT tensors, the ragged counterpart of
+the cohort path's `_RowCdrFetcher` (a few KB per clip-dominant region,
+never a dense download).
+
+`unpack_rows` extracts an arbitrary subset of segments, which is what
+the paged pileup (kindel_tpu.paged) uses: a launch computes every
+RESIDENT segment, but only the segments newly bound to requests are
+extracted and settled — cached reference-panel segments ride along
+unread.
 """
 
 from __future__ import annotations
 
+from functools import partial
+
+from kindel_tpu.utils.jax_cache import ensure_compilation_cache
+
+ensure_compilation_cache()
+
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from kindel_tpu.call import _insertion_calls, assemble
@@ -20,21 +41,69 @@ from kindel_tpu.call_jax import decode_fast, masks_from_wire
 from kindel_tpu.io.fasta import Sequence
 from kindel_tpu.obs import runtime as obs_runtime
 from kindel_tpu.ragged.kernel import wire_sizes
+from kindel_tpu.realign import LazyCdrWindows
 
 
-def unpack_superbatch(buf, table, units, opts, pool, paths=None) -> list:
-    """Download one superbatch wire and splice per-unit results (host,
-    thread-parallel) — the ragged counterpart of
-    `batch._assemble_outputs`, returning the same (Sequence,
-    changes|None, report|None) per unit, in unit order."""
-    buf = np.asarray(buf)  # blocks on the device→host copy
+@partial(jax.jit, static_argnames=("chunk",))
+def _fetch_flat2d(arr, start, *, chunk: int):
+    return jax.lax.dynamic_slice(arr, (start, 0), (chunk, arr.shape[1]))
+
+
+@partial(jax.jit, static_argnames=("chunk",))
+def _fetch_flat1d(arr, start, *, chunk: int):
+    return jax.lax.dynamic_slice(arr, (start,), (chunk,))
+
+
+class SegmentCdrFetcher(LazyCdrWindows):
+    """Lazy CDR-window access into one segment's span of the FLAT
+    device-resident channel tensors: fetches are dynamic slices at
+    `seg_start + start`, bounded to the segment's stride (which always
+    covers [0, L] plus zero-depth gap slots, so a clamped window never
+    reads a neighboring segment)."""
+
+    def __init__(self, dense, seg_start: int, stride: int, L: int):
+        weights, deletions, csw, cew = dense
+        self._arrs = {
+            "weights": weights, "deletions": deletions,
+            "csw": csw, "cew": cew,
+        }
+        self._base = int(seg_start)
+        self.L = int(L)
+        self.Lp = int(stride)
+        self._chunk = min(4096, self.Lp)
+
+    def _fetch(self, key: str, start: int) -> np.ndarray:
+        arr = self._arrs[key]
+        fetch = _fetch_flat2d if arr.ndim == 2 else _fetch_flat1d
+        return np.asarray(
+            fetch(arr, jnp.int32(self._base + start), chunk=self._chunk)
+        )
+
+    def _empty(self, key: str) -> np.ndarray:
+        return np.empty((0,) + self._arrs[key].shape[1:], np.int32)
+
+
+def unpack_rows(out, table, row_units, opts, pool, paths=None) -> list:
+    """Download one superbatch wire and splice results for the given
+    `(row, unit)` pairs (host, thread-parallel) — the subset form of
+    `unpack_superbatch`, returning the same (Sequence, changes|None,
+    report|None) per pair, in pair order. `out` is launch_ragged's
+    result: the wire buffer, or the (wire, weights, deletions, csw,
+    cew) tuple under realign."""
+    if opts.realign:
+        wire, *dense = out
+    else:
+        wire, dense = out, None
+    buf = np.asarray(wire)  # blocks on the device→host copy
     obs_runtime.transfer_counters()[1].inc(int(buf.nbytes))
     cls = table.page_class
-    sizes = wire_sizes(cls, opts.want_masks)
+    sizes = wire_sizes(cls, opts.want_masks, opts.realign)
     offs = np.cumsum([0] + sizes)
     segs = [buf[offs[k]: offs[k + 1]] for k in range(len(sizes))]
     seg_dmin = np.frombuffer(segs[-2].tobytes(), np.int32)
     seg_dmax = np.frombuffer(segs[-1].tobytes(), np.int32)
+    if opts.realign:
+        trig_f_w, trig_r_w = segs[-4], segs[-3]
     if opts.want_masks:
         emit_w, del_b, n_b, ins_b = segs[:4]
     else:
@@ -44,10 +113,29 @@ def unpack_superbatch(buf, table, units, opts, pool, paths=None) -> list:
         del_bits = np.unpackbits(del_f)
         ins_bits = np.unpackbits(ins_f)
 
-    def one(i_u):
-        i, u = i_u
+    def one(pair):
+        i, u = pair
         o = int(table.seg_start[i])
         L = u.L
+        if opts.realign:
+            # byte-aligned by the 8-slot granule: this segment's trigger
+            # bits are a plain byte slice of the flat planes
+            trig_f = np.flatnonzero(
+                np.unpackbits(trig_f_w[o // 8: o // 8 + -(-L // 8)])[:L]
+            )
+            trig_r = np.flatnonzero(
+                np.unpackbits(trig_r_w[o // 8: o // 8 + -(-L // 8)])[:L]
+            )
+            from kindel_tpu.ragged.pack import stride_for
+
+            u.cdr_patches = SegmentCdrFetcher(
+                dense, o, stride_for(L), L
+            ).cdr_patches_from_triggers(
+                trig_f, trig_r, opts.clip_decay_threshold,
+                opts.mask_ends, opts.min_overlap, max_gap=opts.cdr_gap,
+                flank_dedup=opts.fix_clip_artifacts,
+                min_depth=opts.min_depth,
+            )
         if opts.want_masks:
             emit_s = emit_w[o // 2: o // 2 + -(-L // 2)]
             masks_s = tuple(
@@ -86,4 +174,11 @@ def unpack_superbatch(buf, table, units, opts, pool, paths=None) -> list:
             )
         return seq, changes, report
 
-    return list(pool.map(one, enumerate(units)))
+    return list(pool.map(one, row_units))
+
+
+def unpack_superbatch(out, table, units, opts, pool, paths=None) -> list:
+    """Extraction of EVERY table row, in unit order — the ragged
+    counterpart of `batch._assemble_outputs` (see unpack_rows)."""
+    return unpack_rows(out, table, list(enumerate(units)), opts, pool,
+                       paths=paths)

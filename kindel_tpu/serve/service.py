@@ -211,7 +211,7 @@ class ConsensusService:
             max_depth=max_depth, high_watermark=high_watermark,
             metrics=self.metrics,
         )
-        if self.batch_mode == "ragged":
+        if self.batch_mode in ("ragged", "paged"):
             from kindel_tpu.ragged import RaggedBatcher, parse_classes
 
             spec, rc_src = tune.resolve_ragged_classes(
@@ -219,10 +219,18 @@ class ConsensusService:
             )
             self._m_tune_source.set(knob="ragged_classes", source=rc_src)
             self._ragged_classes = parse_classes(spec)
-            self.batcher = RaggedBatcher(
-                self._ragged_classes, max_batch_rows=max_batch_rows,
-                max_wait_s=max_wait_s,
-            )
+            if self.batch_mode == "paged":
+                from kindel_tpu.paged import PagedBatcher
+
+                self.batcher = PagedBatcher(
+                    self._ragged_classes, max_batch_rows=max_batch_rows,
+                    max_wait_s=max_wait_s,
+                )
+            else:
+                self.batcher = RaggedBatcher(
+                    self._ragged_classes, max_batch_rows=max_batch_rows,
+                    max_wait_s=max_wait_s,
+                )
         else:
             self.batcher = MicroBatcher(
                 max_batch_rows=max_batch_rows, max_wait_s=max_wait_s
@@ -343,10 +351,16 @@ class ConsensusService:
                 payloads=self._warm_payloads,
                 ingest_mode=self.ingest_mode,
             )
-            if self.batch_mode == "ragged" and self._ragged_classes:
+            if (
+                self.batch_mode in ("ragged", "paged")
+                and self._ragged_classes
+            ):
                 # superbatch geometries are startup-known in FULL — with
                 # a warm AOT store this is the zero-compile startup that
-                # covers arbitrary traffic, not just derivable shapes
+                # covers arbitrary traffic, not just derivable shapes.
+                # Paged mode runs the SAME kernel over the same
+                # geometries (its signature is geometry-only by design),
+                # so one warmup covers both modes.
                 from kindel_tpu.serve.warmup import warm_ragged
 
                 timings.update(
@@ -427,6 +441,10 @@ class ConsensusService:
             doc["ragged"] = {
                 "classes": [c.label() for c in self._ragged_classes],
             }
+        if self.batch_mode == "paged":
+            # live residency per pool (pages in use, resident segments,
+            # parked admissions) — the paged tier's capacity signal
+            doc["paged"] = self.batcher.residency_snapshot()
         if self._warm_error is not None:
             doc["warmup_error"] = self._warm_error
         return doc

@@ -164,23 +164,33 @@ def warm_ragged(opts, classes) -> dict[str, dict]:
     from kindel_tpu.resilience import faults as rfaults
 
     obs_runtime.install()
-    # ragged flushes are always non-realign (the batcher routes realign
-    # to the shape-keyed lanes), so warm the geometry the kernel runs
+    # every wire variant warms: the fast path, the masks path
+    # (build_changes/build_reports requests), and the realign variant —
+    # since the segment kernel learned the clip channels, realign
+    # traffic rides superbatches too and must not compile post-startup
     base = replace(opts, realign=False)
     variants = (
         ("", replace(base, build_changes=False, build_reports=False)),
         (":masks", replace(base, build_changes=True)),
+        (":realign", replace(base, realign=True, build_changes=False,
+                             build_reports=False)),
     )
     units = decode_payload(_SYNTH_SAM, base)
+    realign_units = decode_payload(
+        _SYNTH_SAM, replace(base, realign=True)
+    )
     timings: dict[str, dict] = {}
     for cls in classes:
         table = build_segment_table(units, cls)
+        realign_table = build_segment_table(realign_units, cls)
         for suffix, vopts in variants:
             label = f"ragged:{cls.label()}{suffix}"
             rfaults.hook("device.compile")
             t0 = time.monotonic()
             _c0, compile_wall0 = obs_runtime.compile_totals()
-            arrays = pack_superbatch(units, table)
+            vunits = realign_units if vopts.realign else units
+            vtable = realign_table if vopts.realign else table
+            arrays = pack_superbatch(vunits, vtable, realign=vopts.realign)
             if aot.enabled():
                 if aot.load_ragged(cls, vopts) is not None:
                     source = "store"
@@ -189,7 +199,8 @@ def warm_ragged(opts, classes) -> dict[str, dict]:
                     aot.export_ragged(arrays, cls, vopts)
             else:
                 source = "disabled"
-            wire = launch_ragged(arrays, cls, vopts)
+            out = launch_ragged(arrays, cls, vopts)
+            wire = out[0] if vopts.realign else out
             np.asarray(wire)  # block: load/compile + execute must be done
             total = time.monotonic() - t0
             _c1, compile_wall1 = obs_runtime.compile_totals()

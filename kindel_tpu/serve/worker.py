@@ -323,6 +323,12 @@ class ServeWorker:
             max_workers=decode_workers,
             thread_name_prefix="kindel-serve-assemble",
         )
+        #: paged-mode launch executor (lazy — only --batch-mode paged
+        #: creates it): each launch tick runs on its own slot so a
+        #: stalled or slow launch never blocks the next tick, which is
+        #: the paged tier's straggler-isolation property
+        self._paged_pool: ThreadPoolExecutor | None = None
+        self._paged_pool_lock = threading.Lock()
         self._intake_thread: threading.Thread | None = None
         self._dispatch_thread: threading.Thread | None = None
         self._supervisor_thread: threading.Thread | None = None
@@ -483,6 +489,10 @@ class ServeWorker:
         supervisor after replay, never on a live worker."""
         self._decode_pool.shutdown(wait=False)
         self._assemble_pool.shutdown(wait=False)
+        with self._paged_pool_lock:
+            paged_pool = self._paged_pool
+        if paged_pool is not None:
+            paged_pool.shutdown(wait=False)
 
     def stop(self, drain: bool = True) -> None:
         """Shut down. drain=True serves everything already admitted;
@@ -510,6 +520,12 @@ class ServeWorker:
         self.batcher.close()
         if self._dispatch_thread is not None:
             self._dispatch_thread.join()
+        with self._paged_pool_lock:
+            paged_pool = self._paged_pool
+        if paged_pool is not None:
+            # in-flight launch ticks finish (and settle their futures)
+            # before the assemble pool they extract on goes away
+            paged_pool.shutdown(wait=True)
         self._assemble_pool.shutdown(wait=True)
 
     # ----------------------------------------------------------- supervisor
@@ -652,6 +668,14 @@ class ServeWorker:
                 if self.batcher.closed and self.batcher.pending_rows == 0:
                     return
                 continue
+            from kindel_tpu.paged.batcher import PagedFlush
+
+            if isinstance(flush, PagedFlush):
+                # continuous path: the tick's launch + extraction run
+                # on the paged executor, never on this loop — the loop
+                # immediately polls for the next tick
+                self._paged_dispatch(flush)
+                continue
             flush = self._coalesce(flush)
             try:
                 self._execute(flush)
@@ -695,6 +719,116 @@ class ServeWorker:
         self._dispatch_entries(
             flush.entries, flush, self._flush_seq, flush.shapes, depth=0
         )
+
+    # ------------------------------------------------- paged (continuous)
+
+    def _paged_executor(self) -> ThreadPoolExecutor:
+        with self._paged_pool_lock:
+            if self._paged_pool is None:
+                self._paged_pool = ThreadPoolExecutor(
+                    max_workers=4, thread_name_prefix="kindel-serve-paged",
+                )
+            return self._paged_pool
+
+    def _paged_dispatch(self, flush) -> None:
+        """Hand one launch tick to the paged executor (DESIGN.md §20):
+        the dispatch loop never blocks on a launch, so a straggler tick
+        stalls only its own requests while later ticks launch and
+        retire around it."""
+        self._flush_seq += 1
+        self._paged_executor().submit(
+            self._paged_execute, flush, self._flush_seq
+        )
+
+    def _paged_execute(self, flush, flush_id: int) -> None:
+        """One tick end to end: snapshot → launch → extract → settle →
+        retire. Failures release the tick's page references and walk
+        the requests down the classic §13 ladder (retry already
+        exhausted here), so no admitted future is lost and no pages
+        leak."""
+        entries = flush.entries
+        t0 = time.perf_counter()
+        wkey = self._watch(entries)
+        try:
+            results = self.retry.run(
+                "serve.flush", lambda: self._paged_run(flush)
+            )
+        except BaseException as e:  # noqa: BLE001 — isolation boundary
+            self._unwatch(wkey)
+            try:
+                self.batcher.release_flush(flush)
+            except Exception:  # noqa: BLE001 — pages may leak; the
+                # futures below still settle through the ladder
+                rpolicy.record_degrade("serve.flush", "release_failed", 1)
+            if isinstance(e, (KeyboardInterrupt, SystemExit)):
+                for req, _units in entries:
+                    self._fail(
+                        req,
+                        RuntimeError(
+                            f"service interrupted ({type(e).__name__})"
+                        ),
+                    )
+                raise
+            try:
+                self._recover(entries, flush, flush_id, 0, e)
+            except BaseException as e2:  # noqa: BLE001
+                # the executor swallows raises — settle what remains so
+                # no admitted future dies with the tick
+                for req, _units in entries:
+                    self._fail(
+                        req, RuntimeError(f"paged recovery aborted: {e2!r}")
+                    )
+                raise
+            return
+        self._unwatch(wkey)
+        if self.breaker is not None:
+            self.breaker.record_success()
+        t1 = time.perf_counter()
+        if self._m_dispatches is not None:
+            self._m_dispatches.inc()
+            self._m_occupancy.observe(len(entries))
+            self._m_dispatch_s.labels(
+                shape=f"paged:{flush.page_class.name}"
+            ).observe(t1 - t0)
+        for req, result in results:
+            self._complete(req, result)
+        # retire AFTER settle: a segment's read completes when its
+        # request has its bytes — the admit→retire histogram then bounds
+        # end-to-end residency, not just device wall
+        self.batcher.retire_flush(flush)
+        if self._m_pending_rows is not None:
+            self._m_pending_rows.set(self.batcher.pending_rows)
+
+    def _paged_run(self, flush):
+        """Snapshot + launch + extract one tick (retried as a unit by
+        the §13 retry policy; references release only on the final
+        outcome, so a retry re-reads a consistent resident set)."""
+        from kindel_tpu.paged.retire import extract_flush
+        from kindel_tpu.ragged.kernel import launch_ragged
+
+        rfaults.hook("serve.flush")
+        arrays, table, row_of = self.batcher.snapshot_for_launch(flush)
+        cls = flush.page_class
+        with trace.span("paged.launch") as sp:
+            out = launch_ragged(arrays, cls, flush.opts)
+            if sp is not trace.NOOP_SPAN:
+                sp.set_attribute(
+                    page_class=cls.label(), resident=table.n_segments,
+                    tick_entries=len(flush.entries),
+                )
+        payload, padded = _padding_counters()
+        payload.inc(sum(u.L for _r, units in flush.entries for u in units))
+        # paged occupancy denominator = the pages the tick's segments
+        # actually hold (free pages serve other traffic — that is the
+        # point of paging), unlike ragged's whole-grid denominator
+        from kindel_tpu.paged.state import PAGE_SLOTS
+
+        padded.inc(sum(
+            seg.n_pages * PAGE_SLOTS
+            for _req, segs in flush.bindings
+            for seg, _u in segs
+        ))
+        return extract_flush(out, table, row_of, flush, flush.opts)
 
     def _dispatch_entries(self, entries, flush: Flush, flush_id: int,
                           shapes, depth: int) -> None:
@@ -838,13 +972,13 @@ class ServeWorker:
         if probing:
             cache_before = obs_runtime.jit_cache_entries()
             launch_window["t0"] = time.perf_counter()
-        if page_class is not None and not opts.realign:
+        if page_class is not None:
             from kindel_tpu.ragged import build_segment_table, pack_superbatch
             from kindel_tpu.ragged.kernel import launch_ragged
             from kindel_tpu.ragged.unpack import unpack_superbatch
 
             table = build_segment_table(units, page_class)
-            arrays = pack_superbatch(units, table)
+            arrays = pack_superbatch(units, table, realign=opts.realign)
             wire = launch_ragged(arrays, page_class, opts)
             if probing:
                 launch_window["t1"] = time.perf_counter()

@@ -89,9 +89,11 @@ INGEST_MODES = ("host", "device")
 #: serve batching mode: "lanes" = the shape-keyed micro-batcher (one
 #: compiled kernel per lane shape), "ragged" = page-class superbatching
 #: (kindel_tpu.ragged — one compiled kernel per page class serves all
-#: request shapes); the env pin is KINDEL_TPU_BATCH_MODE
+#: request shapes), "paged" = continuous superbatching (kindel_tpu.paged
+#: — a persistent paged pileup with per-segment admit/retire over the
+#: same fixed-geometry kernel); the env pin is KINDEL_TPU_BATCH_MODE
 BATCH_MODE_DEFAULT = "lanes"
-BATCH_MODES = ("lanes", "ragged")
+BATCH_MODES = ("lanes", "ragged", "paged")
 
 #: default page-class geometry spec (name:ROWSxLENGTH, ascending —
 #: kindel_tpu.ragged.pack.parse_classes is the grammar); the env pin is
@@ -659,6 +661,103 @@ def resolve_ragged_classes(explicit: str | None = None) -> tuple[str, str]:
     if entry and isinstance(entry.get("classes"), str):
         return entry["classes"], "cache"
     return RAGGED_CLASSES_DEFAULT, "default"
+
+
+def traffic_store_key() -> str:
+    """Observed unit-size traffic histogram — a property of what this
+    host actually serves, host-keyed like the other serving knobs."""
+    return "traffic|" + host_fingerprint()
+
+
+def record_traffic_histogram(hist: dict) -> bool:
+    """Merge an observed unit-stride histogram ({pow2-bucket: count})
+    into the store, host-keyed. The serve batcher calls this
+    periodically; `derive_page_classes` turns the accumulated
+    distribution into geometry candidates, replacing the static
+    three-probe candidate list. Returns False when the store is off."""
+    entry = lookup(traffic_store_key()) or {}
+    merged = dict(entry.get("histogram") or {})
+    for bucket, count in hist.items():
+        key = str(int(bucket))
+        if int(count) > 0:
+            merged[key] = int(merged.get(key, 0)) + int(count)
+    if not merged:
+        return False
+    return record(traffic_store_key(), {"histogram": merged})
+
+
+def load_traffic_histogram() -> dict[int, int]:
+    """The accumulated unit-stride histogram ({} when none recorded)."""
+    entry = lookup(traffic_store_key())
+    hist = entry.get("histogram") if entry else None
+    if not isinstance(hist, dict):
+        return {}
+    out: dict[int, int] = {}
+    for k, v in hist.items():
+        try:
+            out[int(k)] = int(v)
+        except (TypeError, ValueError):
+            continue
+    return out
+
+
+#: geometry-derivation shape: one class per quantile of the observed
+#: stride distribution, rows sized to a per-class slot budget that
+#: doubles with length (mirrors the static default's 64Ki/128Ki/512Ki
+#: ladder) and clamps to a sane segment count
+_GEOMETRY_QUANTILES = ((0.5, "p50"), (0.9, "p90"), (1.0, "max"))
+_GEOMETRY_BASE_SLOTS = 65536
+
+
+def derive_page_classes(hist: dict) -> str | None:
+    """Page-class spec derived from an observed unit-stride histogram —
+    the traffic-shaped replacement for the static candidate list: class
+    lengths sit at the weighted p50/p90/max of what this host actually
+    serves (rounded up to the 1024-multiple the page-class grammar
+    requires), rows fill a slot budget that doubles with length. None
+    when the histogram is empty (callers fall back to the default)."""
+    buckets = sorted((int(b), int(c)) for b, c in hist.items() if int(c) > 0)
+    if not buckets:
+        return None
+    total = sum(c for _, c in buckets)
+    cum = 0.0
+    lengths: list[int] = []
+    by_quantile: dict[str, int] = {}
+    for b, c in buckets:
+        cum += c
+        for q, _name in _GEOMETRY_QUANTILES:
+            key = f"q{q}"
+            if key not in by_quantile and cum >= q * total:
+                by_quantile[key] = b
+    for i, (q, _name) in enumerate(_GEOMETRY_QUANTILES):
+        raw = by_quantile.get(f"q{q}", buckets[-1][0])
+        length = max(1024, -(-raw // 1024) * 1024)
+        if not lengths or length > lengths[-1]:
+            lengths.append(length)
+    parts = []
+    budget = _GEOMETRY_BASE_SLOTS
+    names = [name for _q, name in _GEOMETRY_QUANTILES]
+    for i, length in enumerate(lengths):
+        rows = max(4, min(64, budget // length))
+        parts.append(f"{names[i]}:{rows}x{length}")
+        budget *= 2
+    return ",".join(parts)
+
+
+def ragged_class_candidates(hist: dict | None = None) -> tuple:
+    """Geometry candidates for the page-class sweep: when a traffic
+    histogram has been recorded (the serve batcher persists one,
+    host-keyed), the traffic-derived spec LEADS the candidate list and
+    the static ladder trails as a safety net; with no observations the
+    static candidates stand alone — the pre-traffic behavior."""
+    if hist is None:
+        hist = load_traffic_histogram()
+    derived = derive_page_classes(hist) if hist else None
+    if derived is None:
+        return RAGGED_CLASS_CANDIDATES
+    return (derived,) + tuple(
+        c for c in RAGGED_CLASS_CANDIDATES if c != derived
+    )
 
 
 def search_ragged_classes(measure, candidates=RAGGED_CLASS_CANDIDATES,

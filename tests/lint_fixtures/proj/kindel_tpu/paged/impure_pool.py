@@ -1,0 +1,17 @@
+"""Known-bad: a paged kernel whose helper reads env one call deep
+(trace-purity) — the page-pool tier's jit entries sit inside the
+whole-program closure like every other kernel's."""
+
+import os
+from functools import partial
+
+import jax
+
+
+def _page_slots():
+    return int(os.environ.get("KINDEL_TPU_PAGED_SLOTS", "256"))
+
+
+@partial(jax.jit, static_argnames=())
+def bad_pool_kernel(state):
+    return state[:: _page_slots()]

@@ -1,0 +1,14 @@
+"""Known-bad: a created Future leaks on a launch tick's failure path
+(future-settlement, paged scope) — the handler releases the page
+references but forgets the waiter."""
+
+from concurrent.futures import Future
+
+
+def tick_leaky(launch, release):
+    fut = Future()
+    try:
+        fut.set_result(launch())
+    except Exception:
+        release()  # pages freed, waiter stranded forever
+    return None

@@ -1,0 +1,10 @@
+"""Known-bad: silent swallow in paged/ — the scope extension for the
+continuous-superbatching tier (a swallowed launch failure strands the
+tick's admitted futures AND leaks its page references)."""
+
+
+def launch_or_forget(launch):
+    try:
+        return launch()
+    except Exception:
+        return None

@@ -248,6 +248,24 @@ def test_fixture_scope_extension_hits_devingest(fixture_results):
     assert purity and all("_block_width" in f.message for f in purity)
 
 
+def test_fixture_scope_extension_hits_paged(fixture_results):
+    """The paged scope extension (PR 11 satellite): the continuous-
+    superbatching tier is covered by the silent-swallow lint, the
+    future-settlement exactly-once contract, and the trace-purity
+    closure — one known-bad fixture per rule scope."""
+    by_id = {r.spec.id: r for r in fixture_results}
+    assert any(
+        "paged/" in f.path for f in by_id["silent-swallow"].findings
+    )
+    assert any(
+        "paged/" in f.path for f in by_id["future-settlement"].findings
+    )
+    purity = [
+        f for f in by_id["trace-purity"].findings if "paged/" in f.path
+    ]
+    assert purity and all("_page_slots" in f.message for f in purity)
+
+
 def test_purity_fixture_needs_the_closure(fixture_results):
     """The chained fixture's jit body is clean — only the call-graph
     walk sees the env read two calls deep, which is exactly what the

@@ -276,11 +276,19 @@ def _stub_serialization(monkeypatch):
 
 
 def _clear_tracked_jit_caches():
+    import sys
+
     import kindel_tpu.call_jax as cj
 
     for fn in (cj.batched_call_kernel, cj.batched_realign_call_kernel,
                cj.counts_call_kernel, cj.fused_call_kernel_slab):
         fn.clear_cache()
+    # the segment kernel is tracked too (obs.runtime _TRACKED_KERNELS)
+    # but only compiled when a ragged/paged test ran earlier in the
+    # session — clear it without forcing the import
+    rk = sys.modules.get("kindel_tpu.ragged.kernel")
+    if rk is not None:
+        rk.ragged_call_kernel.clear_cache()
 
 
 def test_zero_compile_replica_start(tmp_path, monkeypatch):

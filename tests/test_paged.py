@@ -1,0 +1,505 @@
+"""kindel_tpu.paged — continuous superbatching: persistent paged pileup
+with per-segment admit/retire.
+
+Covers the pool/ledger layer (admission, free-list reuse, panel-cache
+refcounts + LRU reclaim), the admission wait-hint jitter contract, the
+assembled serve path (byte-identity vs lanes incl. realign and the
+pool-full pending queue), straggler isolation under injected
+serve.flush stalls, drain semantics, traffic-histogram geometry
+derivation, and the flagship: randomized mixed-shape + realign traffic
+through `--batch-mode paged` across a supervised fleet with a replica
+kill + drain and active faults — FASTA sha256 identical to
+single-replica lanes, every admitted future settled exactly once, and
+at most one kernel compile per page geometry.
+"""
+
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from kindel_tpu.batch import BatchOptions
+from kindel_tpu.obs import runtime as obs_runtime
+from kindel_tpu.obs.metrics import default_registry
+from kindel_tpu.paged import PAGE_SLOTS, PagePool, PagedBatcher, PagedFlush
+from kindel_tpu.paged import batcher as paged_batcher_mod
+from kindel_tpu.paged.state import panel_key
+from kindel_tpu.ragged import classify_units, parse_classes
+from kindel_tpu.ragged import pack as rpack
+from kindel_tpu.resilience import FaultPlan
+from kindel_tpu.resilience import faults as rfaults
+from kindel_tpu.serve import ConsensusClient, ConsensusService
+from kindel_tpu.serve.queue import ServeRequest
+from kindel_tpu.serve.worker import decode_request
+from kindel_tpu.tune import TuningConfig
+from kindel_tpu.workloads import bam_to_consensus
+
+from tests.test_serve import make_sam
+
+CLASSES = parse_classes("small:32x2048,medium:16x8192")
+
+
+def _decode(payload, **opt_kwargs):
+    return decode_request(
+        ServeRequest(payload=payload, opts=BatchOptions(**opt_kwargs))
+    )
+
+
+def _mixed_sams(tmp_path, n, seed_base=0, l_lo=260, l_hi=5200):
+    rng = np.random.default_rng(seed_base)
+    return [
+        make_sam(
+            tmp_path / f"mix{i}.sam", ref=f"pref{i}",
+            L=int(rng.integers(l_lo, l_hi)),
+            n_reads=int(rng.integers(10, 45)), seed=seed_base * 100 + i,
+        )
+        for i in range(n)
+    ]
+
+
+def _counter(name: str) -> float:
+    snap = default_registry().snapshot()
+    return sum(
+        float(v) for k, v in snap.items()
+        if (k == name or k.startswith(name + "{"))
+        and not isinstance(v, dict)
+    )
+
+
+# ------------------------------------------------------------ pool / ledger
+
+
+def _unit(tmp_path, name, L=400, seed=0, **opt_kwargs):
+    sam = make_sam(tmp_path / f"{name}.sam", ref=name, L=L, seed=seed)
+    (u,) = _decode(str(sam), **opt_kwargs)
+    return u
+
+
+def test_pool_admit_retire_reuses_pages(tmp_path):
+    pool = PagePool(CLASSES[0], clock=time.monotonic)
+    u1 = _unit(tmp_path, "a", L=700, seed=1)
+    u2 = _unit(tmp_path, "b", L=500, seed=2)
+    s1 = pool.admit_unit(u1, rpack.consumption([u1]))
+    s2 = pool.admit_unit(u2, rpack.consumption([u2]))
+    assert s1 is not None and s2 is not None
+    assert s1.slot_start % PAGE_SLOTS == 0
+    assert s2.page0 >= s1.page0 + s1.n_pages  # disjoint page runs
+    used = pool.pages_in_use
+    # s1 retires (non-panel free path exercised via panel=None override)
+    s1.panel = None
+    pool.release(s1)
+    assert pool.pages_in_use == used - s1.n_pages
+    # freed run is reusable: a same-size unit lands back at page 0
+    u3 = _unit(tmp_path, "c", L=700, seed=3)
+    s3 = pool.admit_unit(u3, rpack.consumption([u3]))
+    assert s3.page0 == s1.page0
+
+
+def test_pool_panel_cache_refcount_and_lru_reclaim(tmp_path):
+    pool = PagePool(CLASSES[0], clock=time.monotonic)
+    sam = make_sam(tmp_path / "amp.sam", ref="amp", L=600, seed=5)
+    (u1,) = _decode(str(sam))
+    (u2,) = _decode(str(sam))  # identical payload, fresh unit objects
+    assert panel_key(u1) == panel_key(u2)
+    s1 = pool.admit_unit(u1, rpack.consumption([u1]))
+    hit = pool.panel_hit(u2)
+    assert hit is s1 and s1.refs == 2
+    pool.release(s1)
+    pool.release(s1)
+    # zero refs + panel key: parked reclaimable, STILL resident
+    assert s1.seg_id in pool.segments
+    assert s1.seg_id in pool.reclaimable
+    # a re-hit revives it with no new pages
+    used = pool.pages_in_use
+    again = pool.panel_hit(u2)
+    assert again is s1 and pool.pages_in_use == used
+    pool.release(s1)
+    # admission pressure reclaims the parked segment LRU
+    big = _unit(tmp_path, "big", L=1900, seed=6)
+    while pool.admit_unit(big, rpack.consumption([big])) is not None:
+        big = _unit(tmp_path, f"big{pool.n_resident}", L=1900, seed=6)
+    assert s1.seg_id not in pool.segments, "LRU reclaim never fired"
+
+
+def test_admission_wait_hint_uses_jittered_retry_after(monkeypatch):
+    """The pool-full wait hint must route through the PR 8 ±25% jitter
+    rule (queue.jittered_retry_after) — pinned by substitution, not by
+    sampling statistics."""
+    from kindel_tpu.paged import admit as paged_admit
+
+    calls = []
+
+    def fake_jitter(base, *, frac=0.25, floor=0.05, rng=None):
+        calls.append((base, floor))
+        return 0.123
+
+    monkeypatch.setattr(
+        paged_admit, "jittered_retry_after", fake_jitter
+    )
+    hint = paged_admit.wait_hint_s(0.05)
+    assert hint == 0.123
+    assert calls == [(0.05, 0.002)]
+    # and the batcher consults exactly that helper
+    mb = PagedBatcher(CLASSES, max_wait_s=0.07)
+    monkeypatch.setattr(
+        paged_batcher_mod, "wait_hint_s", lambda mw: calls.append(mw) or 0.2
+    )
+    assert mb._wait_hint_s() == 0.2
+    assert calls[-1] == 0.07
+
+
+def test_batcher_seals_tick_and_take_ready_degrades(tmp_path):
+    sam = make_sam(tmp_path / "one.sam", seed=21)
+    mb = PagedBatcher(CLASSES, max_wait_s=0.05)
+    req = ServeRequest(payload=str(sam), opts=BatchOptions())
+    mb.add(req, _decode(str(sam)))
+    flush = mb.poll(timeout=5.0)
+    assert isinstance(flush, PagedFlush)
+    assert [r for r, _ in flush.entries] == [req]
+    assert mb.take_ready(flush, limit=8) == []
+    # the tick's launch reads the resident pool
+    arrays, table, row_of = mb.snapshot_for_launch(flush)
+    assert table.n_segments == 1
+    mb.retire_flush(flush)
+
+
+def test_oversize_falls_back_to_lanes(tmp_path):
+    before = _counter("kindel_ragged_fallback_total")
+    huge = make_sam(tmp_path / "huge.sam", ref="huge", L=9000, seed=3)
+    mb = PagedBatcher(CLASSES, max_wait_s=30.0)
+    mb.add(ServeRequest(payload=str(huge), opts=BatchOptions()),
+           _decode(str(huge)))
+    flushes = mb.flush_all()
+    assert len(flushes) == 1 and not isinstance(flushes[0], PagedFlush)
+    assert _counter("kindel_ragged_fallback_total") == before + 1
+
+
+# ------------------------------------------------- serve path, end to end
+
+
+def _serve_all(sams, mode, *, lane_coalesce=2, ragged_classes=None,
+               **svc_kwargs):
+    results = [None] * len(sams)
+    errors: list = []
+    with ConsensusService(
+        tuning=TuningConfig(batch_mode=mode, lane_coalesce=lane_coalesce,
+                            ragged_classes=ragged_classes),
+        max_wait_s=0.15, decode_workers=4, **svc_kwargs,
+    ) as svc:
+        client = ConsensusClient(svc)
+
+        def one(i):
+            try:
+                results[i] = client.fasta(str(sams[i]), timeout=300)
+            except Exception as e:  # noqa: BLE001
+                errors.append((i, repr(e)))
+
+        threads = [
+            threading.Thread(target=one, args=(i,))
+            for i in range(len(sams))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        health = svc.healthz()
+    assert not errors, errors
+    return results, health
+
+
+def test_paged_equals_lanes_byte_identical_incl_realign(tmp_path):
+    sams = _mixed_sams(tmp_path, 8, seed_base=5)
+    lanes, _h = _serve_all(sams, "lanes")
+    paged, health = _serve_all(sams, "paged")
+    assert paged == lanes, "paged FASTA diverged from the lanes path"
+    assert health["batch_mode"] == "paged"
+    assert health["paged"], "healthz carries no pool residency"
+    lanes_r, _ = _serve_all(sams[:4], "lanes", realign=True)
+    paged_r, _ = _serve_all(sams[:4], "paged", realign=True)
+    assert paged_r == lanes_r, "realign paged diverged from lanes"
+
+
+def test_pool_full_pending_is_served_and_counted(tmp_path):
+    sams = [
+        make_sam(tmp_path / f"p{i}.sam", ref=f"pp{i}", L=900,
+                 n_reads=20, seed=60 + i)
+        for i in range(8)
+    ]
+    waits0 = _counter("kindel_paged_admission_waits_total")
+    paged, _h = _serve_all(
+        sams, "paged", ragged_classes="only:2x2048",
+    )
+    assert _counter("kindel_paged_admission_waits_total") > waits0, (
+        "pool never filled — the pending path was not exercised"
+    )
+    lanes, _h = _serve_all(sams, "lanes")
+    assert paged == lanes
+
+
+def test_panel_cache_dedupes_identical_payloads(tmp_path):
+    payload = make_sam(
+        tmp_path / "amp.sam", ref="amp", L=900, n_reads=30, seed=7
+    ).read_bytes()
+    hits0 = _counter("kindel_paged_panel_hits_total")
+    results = [None] * 10
+    errors: list = []
+    with ConsensusService(
+        tuning=TuningConfig(batch_mode="paged"), max_wait_s=0.03,
+    ) as svc:
+        client = ConsensusClient(svc)
+
+        def one(i):
+            try:
+                results[i] = client.fasta(payload, timeout=300)
+            except Exception as e:  # noqa: BLE001
+                errors.append(repr(e))
+
+        threads = [
+            threading.Thread(target=one, args=(i,)) for i in range(10)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert not errors, errors
+    assert len(set(results)) == 1
+    assert _counter("kindel_paged_panel_hits_total") > hits0, (
+        "identical amplicon payloads shared no panel state"
+    )
+    retire = default_registry().snapshot().get(
+        "kindel_paged_retire_seconds", {}
+    )
+    assert retire.get("count", 0) > 0, "no segment retire latency observed"
+
+
+def test_straggler_isolation_under_flush_stall(tmp_path):
+    """One stalled/large tick must not delay retirement or settlement
+    of completed co-resident segments: the straggler stalls 0.8s in its
+    own executor slot while later ticks launch, settle, and retire
+    around it (latency bound pinned)."""
+    big = make_sam(tmp_path / "big.sam", ref="big", L=5000, n_reads=45,
+                   seed=1)
+    smalls = [
+        make_sam(tmp_path / f"s{i}.sam", ref=f"ss{i}", L=350,
+                 n_reads=15, seed=10 + i)
+        for i in range(5)
+    ]
+    lat: dict = {}
+    errors: list = []
+    with ConsensusService(
+        tuning=TuningConfig(batch_mode="paged"), max_wait_s=0.02,
+        decode_workers=4,
+    ) as svc:
+        client = ConsensusClient(svc)
+        # warm both page-class kernels: the measured phase must see the
+        # straggler, not a cold compile
+        client.fasta(str(big), timeout=300)
+        client.fasta(str(smalls[0]), timeout=300)
+        plan = rfaults.activate(
+            FaultPlan.parse("serve.flush:stall:times=1:delay=0.8")
+        )
+        try:
+            def one(name, payload):
+                t0 = time.perf_counter()
+                try:
+                    client.fasta(str(payload), timeout=300)
+                    lat[name] = time.perf_counter() - t0
+                except Exception as e:  # noqa: BLE001
+                    errors.append((name, repr(e)))
+
+            tb = threading.Thread(target=one, args=("big", big))
+            tb.start()
+            time.sleep(0.3)  # the straggler tick is launched + stalled
+            threads = [
+                threading.Thread(target=one, args=(f"s{i}", p))
+                for i, p in enumerate(smalls)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            tb.join()
+        finally:
+            rfaults.deactivate()
+    assert not errors, errors
+    worst_small = max(v for k, v in lat.items() if k != "big")
+    assert lat["big"] >= 0.7, "the stall missed the straggler tick"
+    assert worst_small < 0.5, (
+        f"completed co-resident segments waited on the straggler "
+        f"({worst_small:.3f}s)"
+    )
+    assert plan.fired == {("serve.flush", "stall"): 1}
+
+
+def test_drain_serves_fresh_and_pending(tmp_path):
+    """Drain with a full pool: fresh ticks launch, never-admitted
+    pending requests seal into classic flushes — every admitted future
+    settles with real bytes."""
+    sams = [
+        make_sam(tmp_path / f"d{i}.sam", ref=f"dd{i}", L=900,
+                 n_reads=20, seed=80 + i)
+        for i in range(8)
+    ]
+    svc = ConsensusService(
+        tuning=TuningConfig(
+            batch_mode="paged", ragged_classes="only:2x2048"
+        ),
+        max_wait_s=5.0,  # ticks/pending sit until drain seals them
+    ).start()
+    futs = [svc.submit(str(p)) for p in sams]
+    time.sleep(0.3)  # decodes land in the batcher
+    svc.drain()
+    results = [f.result(timeout=300) for f in futs]
+    assert all(r.consensuses for r in results)
+
+
+# ----------------------------------------------------- geometry from traffic
+
+
+def test_derive_page_classes_from_histogram():
+    from kindel_tpu import tune
+
+    assert tune.derive_page_classes({}) is None
+    hist = {1024: 80, 2048: 15, 16384: 5}
+    spec = tune.derive_page_classes(hist)
+    classes = parse_classes(spec)
+    assert classes[0].length == 1024  # p50 of the observed strides
+    assert classes[-1].length == 16384  # the max bucket
+    assert all(4 <= c.rows <= 64 for c in classes)
+    # derived spec leads the sweep candidates
+    cands = tune.ragged_class_candidates(hist)
+    assert cands[0] == spec and len(cands) > 1
+    # empty histogram → static ladder unchanged
+    assert tune.ragged_class_candidates({}) == tune.RAGGED_CLASS_CANDIDATES
+
+
+def test_traffic_histogram_persists_and_retunes(tmp_path, monkeypatch):
+    from kindel_tpu import tune
+
+    monkeypatch.setenv(
+        "KINDEL_TPU_TUNE_CACHE", str(tmp_path / "tune.json")
+    )
+    assert tune.record_traffic_histogram({2048: 10, 8192: 2})
+    assert tune.record_traffic_histogram({2048: 5})
+    assert tune.load_traffic_histogram() == {2048: 15, 8192: 2}
+    # online retune: a batcher fed uniform small traffic re-derives its
+    # geometry from the observed histogram and persists the winner
+    mb = PagedBatcher(CLASSES, max_wait_s=30.0, retune_every=8)
+    mb._hist = {1024: 200}
+    mb._admissions = mb.retune_every - 1
+    mb._record_traffic_locked = lambda units: setattr(
+        mb, "_admissions", mb._admissions + 1
+    )
+
+    class _U:
+        L = 200
+    # drive the retune path directly (locked hook)
+    with mb._cond:
+        mb._record_traffic_locked([_U()])
+        mb._maybe_retune_locked(time.monotonic())
+    assert mb.classes[0].length == 1024
+    entry = tune.lookup(tune.ragged_store_key())
+    assert entry and entry.get("source") == "traffic"
+    assert parse_classes(entry["classes"])
+
+
+def test_batch_mode_paged_resolution(monkeypatch):
+    from kindel_tpu import tune
+
+    monkeypatch.setenv("KINDEL_TPU_BATCH_MODE", "paged")
+    assert tune.resolve_batch_mode() == ("paged", "env")
+    assert tune.resolve_batch_mode("paged") == ("paged", "explicit")
+
+
+# ---------------------------------------------------------- the flagship
+
+
+def test_paged_fleet_chaos_mixed_realign_exactly_once(tmp_path):
+    """The flagship: randomized mixed-shape + realign traffic through
+    `--batch-mode paged` against a 3-replica supervised fleet with
+    decode workers, coalescing, an active fault plan, a replica KILL
+    and a DRAIN mid-load. The FASTA of every payload is byte-identical
+    to a single-replica lanes run, every admitted future settles
+    exactly once, and the run compiles at most one segment kernel per
+    (page geometry, wire variant)."""
+    from kindel_tpu.fleet import FleetService
+
+    sams = _mixed_sams(tmp_path, 9, seed_base=31)
+    opts = [
+        {"realign": True} if i % 3 == 0 else {} for i in range(len(sams))
+    ]
+    # single-replica lanes reference
+    reference, _h = _serve_all(sams, "lanes")
+    ref_realign, _h = _serve_all(
+        [s for i, s in enumerate(sams) if opts[i]], "lanes", realign=True
+    )
+    want = list(reference)
+    it = iter(ref_realign)
+    for i in range(len(sams)):
+        if opts[i]:
+            want[i] = next(it)
+
+    cache_before = obs_runtime.jit_cache_sizes().get(
+        "ragged_call_kernel", 0
+    )
+    plan = rfaults.activate(
+        FaultPlan.parse("seed=5,serve.flush:error:times=2:after=1")
+    )
+    results = [None] * len(sams)
+    errors: list = []
+    try:
+        svc = FleetService(
+            replicas=3, probe_interval_s=0.02, max_wait_s=0.05,
+            decode_workers=4,
+            tuning=TuningConfig(batch_mode="paged", lane_coalesce=2),
+        ).start()
+        try:
+            from kindel_tpu.io.fasta import format_fasta
+
+            barrier = threading.Barrier(len(sams) + 1)
+
+            def one(i):
+                barrier.wait()
+                try:
+                    res = svc.request(
+                        str(sams[i]), timeout=300, **opts[i]
+                    )
+                    results[i] = format_fasta(res.consensuses)
+                except Exception as e:  # noqa: BLE001
+                    errors.append((i, repr(e)))
+
+            threads = [
+                threading.Thread(target=one, args=(i,))
+                for i in range(len(sams))
+            ]
+            for t in threads:
+                t.start()
+            barrier.wait()
+            time.sleep(0.15)
+            svc.kill_replica("r1")
+            time.sleep(0.25)
+            svc.drain("r2")
+            for t in threads:
+                t.join()
+        finally:
+            svc.stop()
+    finally:
+        rfaults.deactivate()
+    cache_after = obs_runtime.jit_cache_sizes().get(
+        "ragged_call_kernel", 0
+    )
+    assert not errors, errors
+    # every admitted future settled exactly once, with the right bytes
+    assert results == want, "paged fleet FASTA diverged from lanes"
+    assert plan.fired == {("serve.flush", "error"): 2}
+    # ≤ 1 compile per (page geometry, wire variant): 2 geometries × the
+    # fast + realign variants
+    geometries = len({
+        classify_units(_decode(str(p)), CLASSES) for p in sams
+    })
+    assert cache_after - cache_before <= 2 * max(geometries, 1), (
+        "more segment-kernel compiles than page geometries × variants",
+        cache_after - cache_before,
+    )

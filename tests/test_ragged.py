@@ -170,6 +170,60 @@ def test_kernel_parity_fast_and_masks_paths(tmp_path):
                 assert report == want.refs_reports[ref]
 
 
+def _clip_dominant_sam(dest, ref="cref", L=400, seed=0):
+    """Facing soft-clip pileups around ~position 200 whose projections
+    overlap — clip depth dominates aligned depth, so the CDR triggers
+    fire and a merged patch materializes (a realign test that never
+    triggers would pin nothing)."""
+    rng = np.random.default_rng(seed)
+    lines = ["@HD\tVN:1.6", f"@SQ\tSN:{ref}\tLN:{L}"]
+    novel = "".join("ACGT"[b] for b in rng.integers(0, 4, size=40))
+    body = "".join("ACGT"[b] for b in rng.integers(0, 4, size=60))
+    body2 = "".join("ACGT"[b] for b in rng.integers(0, 4, size=60))
+    for i in range(25):
+        lines.append(
+            f"f{i}\t0\t{ref}\t141\t60\t60M30S\t*\t0\t0\t"
+            f"{body}{novel[:30]}\t*"
+        )
+    for i in range(25):
+        lines.append(
+            f"r{i}\t0\t{ref}\t221\t60\t30S60M\t*\t0\t0\t"
+            f"{novel[10:40]}{body2}\t*"
+        )
+    dest.write_text("\n".join(lines) + "\n")
+    return dest
+
+
+def test_realign_kernel_parity_with_live_cdr_patch(tmp_path):
+    """The clip-channel segment kernel vs the bam_to_consensus oracle on
+    clip-dominant data: the dominance triggers fire, the segment-
+    windowed CDR walk produces a real merged patch, and sequence /
+    changes / report are byte-identical."""
+    sam = _clip_dominant_sam(tmp_path / "clip.sam")
+    opts = BatchOptions(
+        realign=True, build_changes=True, build_reports=True,
+        mask_ends=20,
+    )
+    units = _units_for_sams([sam], realign=True, build_changes=True,
+                            build_reports=True, mask_ends=20)
+    cls = CLASSES[classify_units(units, CLASSES)]
+    table = build_segment_table(units, cls)
+    arrays = pack_superbatch(units, table, realign=True)
+    out = launch_ragged(arrays, cls, opts)
+    pool = ThreadPoolExecutor(2)
+    (res,) = unpack_superbatch(
+        out, table, units, opts, pool, paths=[str(sam)]
+    )
+    seq, changes, report = res
+    patches = units[0].cdr_patches
+    assert patches, "clip-dominant data produced no CDR patch"
+    want = bam_to_consensus(str(sam), realign=True, mask_ends=20)
+    assert seq.sequence == want.consensuses[0].sequence
+    ref = seq.name[: -len("_cns")]
+    assert changes == want.refs_changes[ref]
+    assert report == want.refs_reports[ref]
+
+
 def test_pallas_segment_reduction_matches_xla(tmp_path, monkeypatch):
     """The gated Pallas fast path (interpret mode on CPU) must emit a
     wire byte-identical to the XLA segment-reduction path."""
@@ -229,7 +283,12 @@ def test_ragged_batcher_joins_open_larger_lane(tmp_path):
     assert len(flushes[0].entries) == 2
 
 
-def test_realign_and_oversize_fall_back_to_shape_keyed_lanes(tmp_path):
+def test_only_oversize_falls_back_and_realign_fallback_pinned_zero(tmp_path):
+    """Since the segment kernel learned the clip-channel scatter +
+    windowed CDR fetches, realign rides a superbatch like everything
+    else: `kindel_ragged_fallback_total{reason="realign"}` is a
+    regression tripwire PINNED AT ZERO, and only oversize requests take
+    the shape-keyed lanes path."""
     reg = default_registry()
     before = {
         k: v for k, v in reg.snapshot().items()
@@ -244,7 +303,9 @@ def test_realign_and_oversize_fall_back_to_shape_keyed_lanes(tmp_path):
            _decode(str(huge)))
     flushes = mb.flush_all()
     assert len(flushes) == 2
-    assert not any(isinstance(f, RaggedFlush) for f in flushes)
+    ragged_flushes = [f for f in flushes if isinstance(f, RaggedFlush)]
+    assert len(ragged_flushes) == 1  # the realign request superbatches
+    assert ragged_flushes[0].opts.realign
     snap = reg.snapshot()
     delta = {
         reason: snap.get(
@@ -254,7 +315,7 @@ def test_realign_and_oversize_fall_back_to_shape_keyed_lanes(tmp_path):
         )
         for reason in ("realign", "oversize")
     }
-    assert delta == {"realign": 1, "oversize": 1}
+    assert delta == {"realign": 0, "oversize": 1}
 
 
 def test_take_ready_degrades_to_one_batch_for_superbatches(tmp_path):
@@ -329,6 +390,28 @@ def test_mixed_shape_stream_ragged_equals_lanes_byte_identical(tmp_path):
     )
     assert health["batch_mode"] == "ragged"
     assert geometries >= 2, "stream was not shape-diverse enough"
+
+
+def test_realign_traffic_rides_superbatches_byte_identical(tmp_path):
+    """Realign requests served through ragged mode produce byte-identical
+    FASTA to the lanes path (the clip-channel kernel + segment-windowed
+    CDR fetches), and no request takes the realign fallback — the
+    counter stays a zeroed tripwire end to end."""
+    reg = default_registry()
+
+    def realign_fallbacks():
+        return reg.snapshot().get(
+            'kindel_ragged_fallback_total{reason="realign"}', 0
+        )
+
+    sams = _mixed_sams(tmp_path, 5, seed_base=23)
+    lanes, _s, _h = _serve_all(sams, "lanes", realign=True)
+    before = realign_fallbacks()
+    ragged, _snap, _health = _serve_all(sams, "ragged", realign=True)
+    assert ragged == lanes, "realign ragged FASTA diverged from lanes"
+    assert realign_fallbacks() == before, (
+        "realign traffic fell back to shape-keyed lanes"
+    )
 
 
 def test_mixed_stream_with_faults_still_byte_identical(tmp_path):
