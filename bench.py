@@ -162,6 +162,49 @@ def _fleet_counts(snapshot: dict) -> dict:
     }
 
 
+def _rpc_counts(snapshot: dict) -> dict:
+    """Wire posture (kindel_tpu.fleet.rpc): RPC exchanges by outcome,
+    client call p50/p99, transport resubmissions, server-side dedupe
+    hits, and autoscale events — all 0 outside process-fleet serving.
+    Same rationale as the fleet object: a round that hit its number by
+    resubmitting over a flaky wire must say so."""
+
+    def label_total(prefix: str, **match) -> int:
+        out = 0
+        for k, v in snapshot.items():
+            if not (k == prefix or k.startswith(prefix + "{")):
+                continue
+            if match and not all(
+                f'{mk}="{mv}"' in k for mk, mv in match.items()
+            ):
+                continue
+            if isinstance(v, (int, float)):
+                out += int(v)
+        return out
+
+    seconds = snapshot.get("kindel_rpc_call_seconds", {})
+    if not isinstance(seconds, dict):
+        seconds = {}
+    return {
+        "calls": label_total("kindel_rpc_calls_total"),
+        "call_p50_ms": round(float(seconds.get("p50", 0.0)) * 1e3, 2),
+        "call_p99_ms": round(float(seconds.get("p99", 0.0)) * 1e3, 2),
+        "retries": label_total(
+            "kindel_retry_total", site="rpc.call", outcome="retried"
+        ),
+        "dedup_hits": int(
+            snapshot.get("kindel_rpc_dedup_hits_total", 0)
+        ),
+        "scale_up": label_total(
+            "kindel_fleet_scale_events_total", direction="up"
+        ),
+        "scale_down": label_total(
+            "kindel_fleet_scale_events_total", direction="down"
+        ),
+        "respawns": int(snapshot.get("kindel_fleet_respawns_total", 0)),
+    }
+
+
 def _run_benchmark() -> dict:
     """The measured pipeline. Runs only in a child process (jax imported
     here, never in the parent)."""
@@ -411,6 +454,10 @@ def _run_benchmark() -> dict:
         # fleet posture (kindel_tpu.fleet): replica evictions/failovers/
         # drains during the round (nonzero only under fleet serve load)
         "fleet": _fleet_counts(default_registry().snapshot()),
+        # wire posture (kindel_tpu.fleet.rpc): RPC call p50/p99,
+        # resubmissions, dedupe hits, autoscale events (nonzero only
+        # under process-fleet serve load — KINDEL_TPU_BENCH_SERVE=procs:N)
+        "rpc": _rpc_counts(default_registry().snapshot()),
     }
     if tune:
         result["tune_s"] = {str(k): round(v, 3) for k, v in tune.items()}
@@ -482,16 +529,27 @@ def _run_benchmark() -> dict:
             from benchmarks.serve_load import run_load
 
             # KINDEL_TPU_BENCH_SERVE=N with N>1 runs the loop against a
-            # supervised N-replica fleet (kindel_tpu.fleet) instead of a
-            # single service; any other truthy value keeps the original
-            # single-service loop
-            try:
-                serve_replicas = int(bench_serve)
-            except ValueError:
+            # supervised N-replica fleet (kindel_tpu.fleet);
+            # KINDEL_TPU_BENCH_SERVE=procs:N runs it against N replica
+            # PROCESSES over RPC (kindel_tpu.fleet.procreplica — the
+            # serve report then carries the `rpc` object); any other
+            # truthy value keeps the original single-service loop
+            serve_procs = 0
+            if bench_serve.startswith("procs:"):
+                try:
+                    serve_procs = int(bench_serve.split(":", 1)[1])
+                except ValueError:
+                    serve_procs = 2
                 serve_replicas = 1
+            else:
+                try:
+                    serve_replicas = int(bench_serve)
+                except ValueError:
+                    serve_replicas = 1
             result["serve"] = run_load(
                 clients=4, requests_per_client=8,
                 replicas=serve_replicas if serve_replicas > 1 else 0,
+                procs=serve_procs,
             )
         except Exception as e:  # noqa: BLE001
             result["serve"] = {"error": repr(e)}

@@ -48,21 +48,27 @@ def _synth_sam(dest: Path, ref_len: int = 2048, n_reads: int = 200,
 
 def run_load(bam_path=None, clients: int = 4, requests_per_client: int = 8,
              max_wait_s: float = 0.01, max_batch_rows: int = 64,
-             replicas: int = 0, chaos=None, **service_kwargs) -> dict:
+             replicas: int = 0, procs: int = 0, chaos=None,
+             **service_kwargs) -> dict:
     """Run the closed loop; returns a JSON-able report dict.
 
     `replicas` > 0 runs the loop against a FleetService of that many
     supervised replicas (kindel_tpu.fleet) instead of a single
     ConsensusService, and the report gains a `fleet` object (replica
-    states + the kindel_fleet_* counters). `chaos` is an optional
-    callable invoked on its own thread once the clients start —
-    `chaos(service)` — the fleet chaos suite's hook for killing and
-    draining replicas mid-run. Every completed request's FASTA feeds
-    `fasta_sha256` (digest over the sorted set of distinct outputs), so
-    two runs are byte-comparable without shipping sequences around.
+    states + the kindel_fleet_* counters). `procs` > 0 instead runs it
+    against a ProcessFleetService of that many replica PROCESSES over
+    RPC (kindel_tpu.fleet.procreplica) and the report additionally
+    gains an `rpc` object (call p50/p99, retries, dedupe hits, scale
+    events). `chaos` is an optional callable invoked on its own thread
+    once the clients start — `chaos(service)` — the fleet chaos
+    suite's hook for killing and draining replicas mid-run. Every
+    completed request's FASTA feeds `fasta_sha256` (digest over the
+    sorted set of distinct outputs), so two runs are byte-comparable
+    without shipping sequences around.
     """
     import hashlib
 
+    from kindel_tpu.obs.metrics import default_registry
     from kindel_tpu.serve import ConsensusClient, ConsensusService
 
     tmp = None
@@ -70,6 +76,7 @@ def run_load(bam_path=None, clients: int = 4, requests_per_client: int = 8,
         tmp = tempfile.TemporaryDirectory(prefix="kindel_serve_load_")
         bam_path = _synth_sam(Path(tmp.name) / "load.sam")
     payload = Path(bam_path).read_bytes()
+    global_before = default_registry().snapshot()
 
     latencies: list[float] = []
     lat_lock = threading.Lock()
@@ -80,7 +87,19 @@ def run_load(bam_path=None, clients: int = 4, requests_per_client: int = 8,
     # clients, so the kill/drain sequence begins exactly at load start
     start_barrier = threading.Barrier(clients + 1 + (1 if chaos else 0))
 
-    if replicas:
+    if procs:
+        from kindel_tpu.fleet.procreplica import ProcessFleetService
+
+        replicas = procs  # the fleet-report path below applies as-is
+        service = ProcessFleetService(
+            replicas=procs,
+            service_config=dict(
+                max_wait_s=max_wait_s, max_batch_rows=max_batch_rows,
+                decode_workers=2,
+            ),
+            **service_kwargs,
+        )
+    elif replicas:
         from kindel_tpu.fleet import FleetService
 
         service = FleetService(
@@ -142,8 +161,12 @@ def run_load(bam_path=None, clients: int = 4, requests_per_client: int = 8,
             if replicas:
                 fleet_snap = svc.fleet_snapshot()
                 snap = fleet_snap["totals"]
+                # server-side dedupe lives in the CHILD processes'
+                # registries; /v1/rpc carries it back while they are up
+                remote_rpc = svc.rpc_stats() if procs else None
             else:
                 fleet_snap = None
+                remote_rpc = None
                 snap = svc.metrics.snapshot()
     finally:
         if tmp is not None:
@@ -201,7 +224,83 @@ def run_load(bam_path=None, clients: int = 4, requests_per_client: int = 8,
                 if k.endswith("_total") and isinstance(v, (int, float))
             },
         }
+    if procs:
+        report["rpc"] = rpc_report(
+            global_before, default_registry().snapshot()
+        )
+        if remote_rpc is not None:
+            # the children's own dedupe counts (the local registry only
+            # sees dedupes served in THIS process, i.e. none for procs)
+            report["rpc"]["dedup_hits"] += int(
+                remote_rpc.get("dedup_hits", 0)
+            )
+            report["rpc"]["applied"] = int(remote_rpc.get("applied", 0))
     return report
+
+
+def rpc_report(before: dict, after: dict) -> dict:
+    """The wire posture of one run, as counter DELTAS against a
+    snapshot taken at load start (the registry is process-global, so
+    absolute values would smear runs together): exchanges by outcome,
+    client call p50/p99, transport resubmissions, server-side dedupe
+    hits, and autoscale events — the `rpc` object bench.py attaches
+    alongside the `fleet` counters."""
+
+    def delta(name: str) -> int:
+        return int(after.get(name, 0)) - int(before.get(name, 0))
+
+    def total(prefix: str, snap: dict, **match) -> int:
+        out = 0
+        for k, v in snap.items():
+            if not (k == prefix or k.startswith(prefix + "{")):
+                continue
+            if match and not all(
+                f'{mk}="{mv}"' in k for mk, mv in match.items()
+            ):
+                continue
+            if isinstance(v, (int, float)):
+                out += int(v)
+        return out
+
+    seconds = after.get("kindel_rpc_call_seconds", {})
+    if not isinstance(seconds, dict):
+        seconds = {}
+    return {
+        "calls": {
+            outcome: (
+                total("kindel_rpc_calls_total", after, outcome=outcome)
+                - total("kindel_rpc_calls_total", before, outcome=outcome)
+            )
+            for outcome in ("ok", "shed", "deadline", "bad_request",
+                            "error")
+        },
+        # quantiles over the histogram's recent window (absolute — the
+        # window is bounded and dominated by this run's calls)
+        "call_p50_ms": round(float(seconds.get("p50", 0.0)) * 1e3, 2),
+        "call_p99_ms": round(float(seconds.get("p99", 0.0)) * 1e3, 2),
+        "retries": (
+            total("kindel_retry_total", after, site="rpc.call",
+                  outcome="retried")
+            - total("kindel_retry_total", before, site="rpc.call",
+                    outcome="retried")
+        ),
+        "dedup_hits": delta("kindel_rpc_dedup_hits_total"),
+        "scale_events": {
+            "up": (
+                total("kindel_fleet_scale_events_total", after,
+                      direction="up")
+                - total("kindel_fleet_scale_events_total", before,
+                        direction="up")
+            ),
+            "down": (
+                total("kindel_fleet_scale_events_total", after,
+                      direction="down")
+                - total("kindel_fleet_scale_events_total", before,
+                        direction="down")
+            ),
+        },
+        "respawns": delta("kindel_fleet_respawns_total"),
+    }
 
 
 def main(argv=None) -> int:
@@ -217,12 +316,17 @@ def main(argv=None) -> int:
     ap.add_argument("--replicas", type=int, default=0,
                     help="run against a FleetService of N supervised "
                          "replicas (kindel_tpu.fleet); 0 = single service")
+    ap.add_argument("--procs", type=int, default=0,
+                    help="run against a ProcessFleetService of N replica "
+                         "PROCESSES over RPC "
+                         "(kindel_tpu.fleet.procreplica); 0 = off")
     args = ap.parse_args(argv)
     report = run_load(
         bam_path=args.bam, clients=args.clients,
         requests_per_client=args.requests,
         max_wait_s=args.max_wait_ms / 1e3,
         replicas=args.replicas,
+        procs=args.procs,
     )
     print(json.dumps(report))
     return 0 if report["errors"] == 0 else 1

@@ -514,6 +514,40 @@ def _serve_parser(sub):
              "total queued depth across replicas reaches this (default: "
              "sum of per-replica watermarks; only with --replicas > 1)",
     )
+    p.add_argument(
+        "--replica-mode", choices=["thread", "process"], default="thread",
+        help="where fleet replicas live: 'thread' = supervised "
+             "in-process services (PR 8), 'process' = each replica is "
+             "its own OS process behind RPC "
+             "(kindel_tpu.fleet.procreplica) — the supervisor survives "
+             "process loss, SIGKILLed replicas are respawned warm from "
+             "the shared AOT store. Only with --replicas > 1 or "
+             "autoscaling",
+    )
+    p.add_argument(
+        "--min-replicas", type=int, default=None, metavar="N",
+        help="autoscaler floor: with --max-replicas, the fleet "
+             "spawns/retires replicas between these bounds from the "
+             "router's watermark-shed + occupancy signals (hysteresis "
+             "prevents flapping; DESIGN.md §21). Unset = fixed "
+             "--replicas roster",
+    )
+    p.add_argument(
+        "--max-replicas", type=int, default=None, metavar="N",
+        help="autoscaler ceiling (see --min-replicas)",
+    )
+    p.add_argument(
+        "--rpc-timeout-ms", type=float, default=None, metavar="MS",
+        help="per-call deadline of one fleet RPC exchange under "
+             "--replica-mode process (explicit > "
+             "$KINDEL_TPU_RPC_TIMEOUT_MS > default 30000)",
+    )
+    p.add_argument(
+        "--max-body-mb", type=int, default=None, metavar="MB",
+        help="largest POST body the HTTP front reads; oversized "
+             "requests get 413 + Retry-After before any allocation "
+             "(explicit > $KINDEL_TPU_MAX_BODY_MB > default 1024)",
+    )
 
 
 def install_drain_handlers(stop_event) -> None:
@@ -574,11 +608,15 @@ def cmd_serve(args) -> int:
         warmup=not args.no_warmup,
         warm_payloads=args.warm,
     )
-    if args.replicas > 1:
-        from kindel_tpu.fleet import FleetService
-
-        service = FleetService(
-            replicas=args.replicas,
+    autoscale = (
+        args.min_replicas is not None and args.max_replicas is not None
+    )
+    fleet_wanted = (
+        args.replicas > 1 or autoscale or args.replica_mode == "process"
+    )
+    if fleet_wanted:
+        fleet_kwargs = dict(
+            replicas=max(args.replicas, args.min_replicas or 1),
             http_host=args.host,
             http_port=args.port,
             probe_interval_s=args.probe_interval_ms / 1e3,
@@ -586,14 +624,52 @@ def cmd_serve(args) -> int:
                 args.hedge_ms / 1e3 if args.hedge_ms is not None else None
             ),
             fleet_watermark=args.fleet_watermark,
-            **service_kwargs,
+            min_replicas=args.min_replicas,
+            max_replicas=args.max_replicas,
+            max_body_mb=args.max_body_mb,
         )
-        posture = f"{args.replicas} supervised replicas (kindel_tpu.fleet)"
+        scale_note = (
+            f", autoscaling {args.min_replicas}-{args.max_replicas}"
+            if autoscale else ""
+        )
+        if args.replica_mode == "process":
+            from kindel_tpu.fleet.procreplica import ProcessFleetService
+
+            # children rebuild TuningConfig from a plain dict (the
+            # config crosses a process boundary as JSON)
+            config = {
+                k: v for k, v in service_kwargs.items() if k != "tuning"
+            }
+            if tuning is not None:
+                config["tuning"] = {
+                    "lane_coalesce": args.lane_coalesce,
+                    "batch_mode": args.batch_mode,
+                    "ragged_classes": args.ragged_classes,
+                    "ingest_mode": args.ingest_mode,
+                }
+            service = ProcessFleetService(
+                service_config=config,
+                rpc_timeout_ms=args.rpc_timeout_ms,
+                **fleet_kwargs,
+            )
+            posture = (
+                f"{fleet_kwargs['replicas']} replica processes over RPC "
+                f"(kindel_tpu.fleet.procreplica{scale_note})"
+            )
+        else:
+            from kindel_tpu.fleet import FleetService
+
+            service = FleetService(**fleet_kwargs, **service_kwargs)
+            posture = (
+                f"{fleet_kwargs['replicas']} supervised replicas "
+                f"(kindel_tpu.fleet{scale_note})"
+            )
     else:
         from kindel_tpu.serve import ConsensusService
 
         service = ConsensusService(
-            http_host=args.host, http_port=args.port, **service_kwargs
+            http_host=args.host, http_port=args.port,
+            max_body_mb=args.max_body_mb, **service_kwargs
         )
         posture = "single replica"
     service.start()

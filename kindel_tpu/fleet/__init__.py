@@ -23,11 +23,23 @@ device):
                  scoring (resilience.policy.ProbePolicy), eviction with
                  replay-first ordering, auto warm-restart
   service.py     FleetService facade: N replicas + router + supervisor
-                 + one HTTP front (/v1/consensus, /metrics, /healthz,
-                 /readyz), drain(replica) zero-downtime restart
+                 + autoscaler + one HTTP front (/v1/consensus,
+                 /metrics, /healthz, /readyz), drain(replica)
+                 zero-downtime restart, scale_up/scale_down live
+                 membership
+  rpc.py         the Replica contract over the wire: pooled HTTP
+                 transport with per-call deadlines + bounded idempotent
+                 resubmission (RpcServiceClient), and the server-side
+                 adapter (idempotency dedupe, remote trace parent,
+                 drain/stop routes) — DESIGN.md §21
+  procreplica.py process-backed replicas: spawn/handshake/respawn of
+                 `python -m kindel_tpu.fleet.procreplica` children and
+                 ProcessFleetService, the cross-host fleet assembly
 
-CLI: `kindel serve --replicas N` (kindel_tpu.cli), SIGTERM/SIGINT
-drain. See docs/DESIGN.md §17 (fleet failure model).
+CLI: `kindel serve --replicas N [--replica-mode process]
+[--min-replicas/--max-replicas]` (kindel_tpu.cli), SIGTERM/SIGINT
+drain. See docs/DESIGN.md §17 (fleet failure model) and §21 (the RPC
+contract, idempotency argument, and autoscaler hysteresis).
 """
 
 from kindel_tpu.fleet.replica import Replica  # noqa: F401
@@ -36,5 +48,37 @@ from kindel_tpu.fleet.router import (  # noqa: F401
     rendezvous_score,
     routing_key,
 )
+from kindel_tpu.fleet.rpc import (  # noqa: F401
+    RpcServerAdapter,
+    RpcServiceClient,
+    RpcTransportError,
+)
 from kindel_tpu.fleet.service import FleetService  # noqa: F401
-from kindel_tpu.fleet.supervisor import FleetSupervisor  # noqa: F401
+from kindel_tpu.fleet.supervisor import (  # noqa: F401
+    FleetAutoscaler,
+    FleetSupervisor,
+)
+
+__all__ = [
+    "FleetAutoscaler",
+    "FleetRouter",
+    "FleetService",
+    "FleetSupervisor",
+    "ProcessFleetService",
+    "Replica",
+    "RpcServerAdapter",
+    "RpcServiceClient",
+    "RpcTransportError",
+    "rendezvous_score",
+    "routing_key",
+]
+
+
+def __getattr__(name):
+    # ProcessFleetService lazily: importing the spawn machinery (and
+    # tempfile/subprocess plumbing) only when a process fleet is built
+    if name == "ProcessFleetService":
+        from kindel_tpu.fleet.procreplica import ProcessFleetService
+
+        return ProcessFleetService
+    raise AttributeError(name)

@@ -1,0 +1,382 @@
+"""Process-backed replicas: spawn, address handshake, respawn, and the
+fleet assembly that supervises them.
+
+The failure unit ROADMAP's cross-host item cares about is the *host*,
+and the closest chaos-testable stand-in a single machine offers is the
+OS process: a SIGKILLed replica process loses its sockets, its threads,
+its queue, and every future it ever held — exactly what a machine loss
+does. This module runs each replica as `python -m
+kindel_tpu.fleet.procreplica --config <json>`: a child that builds a
+full ConsensusService (its own queue/batcher/breaker/worker — PR 4's
+self-healing intact), overlays the RPC adapter's idempotency-aware
+routes (fleet/rpc.py) on its HTTP front, writes its bound address to a
+handshake file, and serves until drained or killed.
+
+The parent side is deliberately thin: `ReplicaProcess` (spawn + address
+wait + terminate/kill), a factory that hands `RpcServiceClient`s to the
+UNCHANGED Replica/FleetRouter/FleetSupervisor machinery, and
+`ProcessFleetService` — a FleetService whose replicas happen to live in
+other processes. Probe-scored eviction, ledger replay, zero-downtime
+drain, hedging, and the autoscaler all run the same code paths they run
+in-process, because the RPC client implements the same service contract
+(the shared parametrized contract suite in tests/test_fleet_rpc.py pins
+this). A respawn after process death goes through the same factory —
+with a warm shared AOT store (PR 6) the fresh process loads executables
+instead of compiling, which is what makes host loss cheap enough to be
+routine.
+
+jax-free by construction in the PARENT (tier-1 AST guard): only the
+child process — past the `main()` boundary the guard's import scan
+never reaches at fleet runtime — imports the serve stack.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+from kindel_tpu.fleet.rpc import RpcServiceClient
+from kindel_tpu.fleet.service import FleetService
+from kindel_tpu.obs.metrics import fleet_metrics
+
+#: how long a spawned child may take to bind and write its address
+#: (a cold interpreter + jax import on a loaded CI host is seconds)
+SPAWN_TIMEOUT_S = 120.0
+
+
+class ReplicaSpawnError(RuntimeError):
+    """The child process died or never handshook its address."""
+
+
+class ReplicaProcess:
+    """One spawned replica process: Popen + the address handshake.
+
+    The child writes `{"host", "port", "pid"}` to `addr_file`
+    atomically once its HTTP front is bound; the parent polls for it
+    (bounded) while watching for early death. `kill()` is SIGKILL — the
+    chaos surface; `terminate()` is the graceful SIGTERM → wait →
+    SIGKILL ladder."""
+
+    def __init__(self, argv: list, addr_file: str,
+                 spawn_timeout_s: float = SPAWN_TIMEOUT_S):
+        self.argv = list(argv)
+        self.addr_file = str(addr_file)
+        self.spawn_timeout_s = spawn_timeout_s
+        self.proc: subprocess.Popen | None = None
+        self.address: tuple | None = None
+
+    def start(self) -> "ReplicaProcess":
+        self.proc = subprocess.Popen(self.argv)
+        deadline = time.monotonic() + self.spawn_timeout_s
+        while time.monotonic() < deadline:
+            if self.proc.poll() is not None:
+                raise ReplicaSpawnError(
+                    f"replica process exited rc={self.proc.returncode} "
+                    "before handshaking its address"
+                )
+            try:
+                with open(self.addr_file) as fh:
+                    doc = json.load(fh)
+                self.address = (doc["host"], int(doc["port"]))
+                return self
+            except (OSError, ValueError, KeyError):
+                time.sleep(0.05)
+        self.kill()
+        raise ReplicaSpawnError(
+            f"replica process did not handshake within "
+            f"{self.spawn_timeout_s}s ({self.addr_file})"
+        )
+
+    @property
+    def pid(self) -> int | None:
+        return self.proc.pid if self.proc is not None else None
+
+    @property
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def kill(self) -> None:
+        """SIGKILL — abrupt host-loss chaos; nothing in the child runs
+        again, futures it held are simply gone."""
+        if self.alive:
+            try:
+                self.proc.kill()
+            except OSError:
+                pass  # exited in the race window: already dead is the goal
+        if self.proc is not None:
+            self.proc.wait(timeout=10)
+
+    def terminate(self, timeout_s: float = 10.0) -> None:
+        if self.proc is None:
+            return
+        if self.alive:
+            try:
+                self.proc.terminate()
+            except OSError:
+                pass  # exited in the race window
+        try:
+            self.proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            self.kill()
+
+
+def _spawn_argv(config_path: str) -> list:
+    return [
+        sys.executable, "-m", "kindel_tpu.fleet.procreplica",
+        "--config", config_path,
+    ]
+
+
+class ProcessReplicaFactory:
+    """The factory a process-backed Replica slot calls on start AND on
+    every warm restart: writes the child config once, spawns a fresh
+    process per call, and counts calls past the first as respawns
+    (`kindel_fleet_respawns_total` — the cross-host sibling of the
+    warm-restart counter)."""
+
+    def __init__(self, replica_id: str, workdir: str,
+                 service_config: dict | None = None,
+                 host: str = "127.0.0.1",
+                 rpc_timeout_ms: float | None = None,
+                 spawn_timeout_s: float = SPAWN_TIMEOUT_S,
+                 metrics=None):
+        self.replica_id = replica_id
+        self.workdir = str(workdir)
+        self.host = host
+        self.rpc_timeout_ms = rpc_timeout_ms
+        self.spawn_timeout_s = spawn_timeout_s
+        self.metrics = metrics
+        self._generation = 0
+        self._config = {
+            "replica_id": replica_id,
+            "host": host,
+            "port": 0,
+            "service": dict(service_config or {}),
+        }
+
+    def _spawner(self):
+        gen = self._generation
+        addr_file = os.path.join(
+            self.workdir, f"{self.replica_id}.g{gen}.addr"
+        )
+        config_path = os.path.join(
+            self.workdir, f"{self.replica_id}.g{gen}.json"
+        )
+        doc = dict(self._config, addr_file=addr_file)
+        tmp = config_path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(doc, fh)
+        os.replace(tmp, config_path)
+
+        def spawn():
+            if gen > 0:
+                fleet_metrics().respawns.inc()
+            return ReplicaProcess(
+                _spawn_argv(config_path), addr_file,
+                spawn_timeout_s=self.spawn_timeout_s,
+            ).start()
+
+        return spawn
+
+    def __call__(self) -> RpcServiceClient:
+        spawn = self._spawner()
+        self._generation += 1
+        return RpcServiceClient(
+            spawn=spawn, metrics=self.metrics,
+            rpc_timeout_ms=self.rpc_timeout_ms,
+            label=self.replica_id,
+        )
+
+
+class ProcessFleetService(FleetService):
+    """A FleetService whose replicas are OS processes behind RPC: same
+    router, same supervisor, same drain/kill/replay semantics — the
+    supervisor now survives what none of them could before, the loss of
+    the machine underneath a replica.
+
+    `service_config` holds the ConsensusService knobs shipped to every
+    child (max_wait_s, max_batch_rows, warmup, consensus opts, ...);
+    children inherit this process's environment, so the tune store, the
+    AOT store, and KINDEL_TPU_* pins are shared — a respawned child
+    starts warm from the same stores a restarted thread did."""
+
+    def __init__(self, replicas: int = 2, *,
+                 service_config: dict | None = None,
+                 host: str = "127.0.0.1",
+                 rpc_timeout_ms: float | None = None,
+                 spawn_timeout_s: float = SPAWN_TIMEOUT_S,
+                 workdir: str | None = None,
+                 **fleet_kwargs):
+        self._workdir_obj = (
+            None if workdir is not None
+            else tempfile.TemporaryDirectory(prefix="kindel_fleet_proc_")
+        )
+        self.workdir = (
+            workdir if workdir is not None else self._workdir_obj.name
+        )
+        self._service_config = dict(service_config or {})
+        self._proc_host = host
+        self._rpc_timeout_ms = rpc_timeout_ms
+        self._spawn_timeout_s = spawn_timeout_s
+        #: one ProcessReplicaFactory per replica slot, kept across warm
+        #: restarts so respawns-after-death are counted as such
+        self._makers: dict = {}
+        super().__init__(
+            replicas=replicas,
+            service_factory=self._proc_factory,
+            **fleet_kwargs,
+        )
+
+    def _proc_factory(self, rid: str, registry):
+        maker = self._makers.get(rid)
+        if maker is None:
+            maker = self._makers[rid] = ProcessReplicaFactory(
+                rid, self.workdir,
+                service_config=self._service_config,
+                host=self._proc_host,
+                rpc_timeout_ms=self._rpc_timeout_ms,
+                spawn_timeout_s=self._spawn_timeout_s,
+                metrics=registry,
+            )
+        return maker()
+
+    def _start_replicas(self) -> None:
+        """Concurrent spawn: each child pays a full interpreter boot,
+        so starting N of them serially would stack those walls."""
+        errors: list = []
+
+        def boot(rep):
+            try:
+                rep.start()
+            except Exception as e:  # noqa: BLE001 — collected and re-raised below
+                errors.append((rep.replica_id, e))
+                rep.record_probe_failure(repr(e))
+                rep.set_state("dead")
+
+        threads = [
+            threading.Thread(target=boot, args=(rep,),
+                             name=f"kindel-spawn-{rep.replica_id}")
+            for rep in self.replicas
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors and not any(r.admitting for r in self.replicas):
+            raise ReplicaSpawnError(
+                f"no replica process came up: {errors!r}"
+            )
+
+    def rpc_stats(self) -> dict:
+        """Summed wire posture across live replica processes (each
+        child's dedupe cache lives in ITS registry; /v1/rpc is how the
+        numbers cross back). Dead/retired replicas' counts are gone
+        with their processes — the sum is a floor, not a ledger."""
+        totals = {"applied": 0, "dedup_hits": 0}
+        for rep in self.roster():
+            svc = rep.service
+            if svc is None or not svc.live:
+                continue
+            try:
+                doc = svc.rpc_stats()
+            except Exception as e:  # noqa: BLE001 — a dead wire reports nothing
+                svc.record_failure("rpc_stats", e)
+                continue
+            for k in totals:
+                totals[k] += int(doc.get(k, 0))
+        return totals
+
+    def stop(self, drain: bool = True) -> None:
+        try:
+            super().stop(drain=drain)
+        finally:
+            if self._workdir_obj is not None:
+                self._workdir_obj.cleanup()
+                self._workdir_obj = None
+
+
+# ---------------------------------------------------------- child main
+
+
+def main(argv=None) -> int:
+    """Child entry: build the serve stack, overlay the RPC routes,
+    handshake the address, serve until drained/stopped/killed."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="kindel fleet replica worker process"
+    )
+    ap.add_argument("--config", required=True,
+                    help="JSON config written by the spawning fleet")
+    args = ap.parse_args(argv)
+    with open(args.config) as fh:
+        cfg = json.load(fh)
+
+    # the serve stack (and through it jax) loads only here, in the
+    # child — the parent-side fleet tier stays device-free
+    from kindel_tpu.fleet.rpc import RpcServerAdapter
+    from kindel_tpu.serve import ConsensusService
+
+    stop_event = threading.Event()
+    service_kwargs = dict(cfg.get("service") or {})
+    service_kwargs.setdefault("warmup", False)
+    if isinstance(service_kwargs.get("tuning"), dict):
+        # the config crossed the process boundary as JSON; rebuild the
+        # frozen TuningConfig the serve stack expects
+        from kindel_tpu.tune import TuningConfig
+
+        service_kwargs["tuning"] = TuningConfig(
+            **service_kwargs["tuning"]
+        )
+    service = ConsensusService(
+        http_host=cfg.get("host", "127.0.0.1"),
+        http_port=int(cfg.get("port", 0)),
+        **service_kwargs,
+    )
+    adapter = RpcServerAdapter(service, stop_event=stop_event)
+    service._extra_post_routes.update(adapter.post_routes())
+    service.start()
+    host, port = service.http_address
+
+    addr_file = cfg["addr_file"]
+    tmp = addr_file + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump({"host": host, "port": port, "pid": os.getpid()}, fh)
+    os.replace(tmp, addr_file)
+    print(
+        f"kindel-fleet replica {cfg.get('replica_id', '?')} serving on "
+        f"http://{host}:{port} (pid {os.getpid()})",
+        file=sys.stderr,
+    )
+
+    def _on_signal(signum, frame):
+        stop_event.set()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+    parent = os.getppid()
+    while not stop_event.wait(1.0):
+        # orphan watchdog: if the spawning fleet died without reaping
+        # us (SIGKILLed test runner, crashed supervisor), exit instead
+        # of serving nobody forever
+        if os.getppid() != parent:
+            print(
+                "kindel-fleet replica: parent gone, exiting",
+                file=sys.stderr,
+            )
+            break
+    if service.live:
+        service.drain()
+    else:
+        service.stop(drain=False)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -123,15 +123,22 @@ class Replica:
     def restart(self) -> "Replica":
         """Warm restart: a fresh service from the factory (zero-compile
         when the AOT store is warm — kindel_tpu.aot), a fresh probe
-        ladder, a bumped generation. The old service object is simply
-        dropped: a killed one is already stopped, a drained one already
-        settled everything."""
+        ladder, a bumped generation. The old service handle is reaped
+        before it is dropped: a killed or drained one already settled
+        (or handed back) everything, but an RPC-backed handle still
+        owns a submit pool and a connection pool — dropping those
+        unreaped would leak one pool per restart."""
         self.set_state("restarting")
         self.generation += 1
         fleet_metrics().restarts.inc()
         self._probe_policy = self._probe_policy_factory()
         self._last_probe_error = None
-        self.service = None
+        old, self.service = self.service, None
+        if old is not None:
+            try:
+                old.worker.reap()
+            except Exception as e:  # noqa: BLE001 — already-reaped is the goal
+                self.record_probe_failure(repr(e))
         svc = self._factory()
         svc.start()
         self.service = svc
@@ -169,11 +176,23 @@ class Replica:
             self.set_state("ok")
         return verdict
 
-    def record_probe_failure(self, error: str) -> str:
+    def record_probe_failure(self, error: str,
+                             outcome: str = PROBE_FAILED) -> str:
         """A probe that raised: record it (surfaced on the fleet
-        /healthz document) and fold a failed outcome into the ladder."""
+        /healthz document) and fold `outcome` into the ladder —
+        PROBE_FAILED by default; the supervisor passes PROBE_DEGRADED
+        for transient wire errors (classify_probe_error), so an RPC
+        flap demotes instead of evicting a replica that is still
+        holding admitted work."""
         self._last_probe_error = error
-        return self.score(PROBE_FAILED)
+        return self.score(outcome)
+
+    def classify_probe_error(self, exc: BaseException) -> str:
+        """Probe-exception classification through the replica's own
+        policy (resilience.policy.ProbePolicy.classify_error): a
+        transient wire error counts degraded-ward, anything else —
+        refused ports, protocol breakage — counts toward death."""
+        return self._probe_policy.classify_error(exc)
 
     @property
     def last_probe_error(self) -> str | None:

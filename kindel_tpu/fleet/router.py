@@ -49,6 +49,7 @@ import hashlib
 import threading
 from concurrent.futures import Future, InvalidStateError
 
+from kindel_tpu.fleet.rpc import RpcTransportError
 from kindel_tpu.obs.metrics import fleet_metrics
 from kindel_tpu.resilience.breaker import FlushTimeout
 from kindel_tpu.serve.queue import (
@@ -58,8 +59,12 @@ from kindel_tpu.serve.queue import (
 )
 
 #: inner-failure types that indict the REPLICA, not the request —
-#: the router fails these over instead of surfacing them
-REPLICA_FAILURES = (FlushTimeout, ServiceDegraded)
+#: the router fails these over instead of surfacing them. AdmissionError
+#: (which ServiceDegraded subclasses) joined with the RPC tier: a remote
+#: replica's watermark shed arrives asynchronously on the inner future
+#: (in-process it raises at submit), and RpcTransportError is the wire
+#: itself failing — both mean "this replica, not this request"
+REPLICA_FAILURES = (FlushTimeout, AdmissionError, RpcTransportError)
 
 
 def routing_key(payload, opt_overrides: dict | None = None) -> str:
@@ -121,14 +126,27 @@ class FleetRouter:
     def __init__(self, replicas, fleet_watermark: int | None = None,
                  max_failover: int | None = None,
                  hedge_s: float | None = None):
-        self.replicas = list(replicas)
-        self._by_id = {r.replica_id: r for r in self.replicas}
-        self.fleet_watermark = fleet_watermark
-        #: distinct replicas one ticket may try (placement + failovers)
-        self.max_failover = (
-            max_failover if max_failover is not None else len(self.replicas)
+        # membership is SHARED with the owning FleetService when a list
+        # is passed: the autoscaler grows/shrinks the fleet live, and
+        # router/supervisor must see the same roster — every read here
+        # snapshots, so a concurrent spawn/retire never corrupts a rank
+        self.replicas = (
+            replicas if isinstance(replicas, list) else list(replicas)
         )
+        self.fleet_watermark = fleet_watermark
+        self._max_failover = max_failover
         self.hedge_s = hedge_s
+        #: fleet-watermark rejections since boot — the autoscaler's
+        #: scale-up pressure signal (mirrored on the fleet counter)
+        self.sheds = 0
+
+    @property
+    def max_failover(self) -> int:
+        """Distinct replicas one ticket may try (placement + failovers);
+        tracks live membership unless pinned explicitly."""
+        if self._max_failover is not None:
+            return self._max_failover
+        return len(self.replicas)
 
     # ------------------------------------------------------------- ranking
 
@@ -137,7 +155,7 @@ class FleetRouter:
         strictly before `degraded` ones (a degraded replica sheds most
         submissions — it is a last resort, not a peer)."""
         ranked = sorted(
-            (r for r in self.replicas
+            (r for r in list(self.replicas)
              if r.admitting and r.replica_id not in exclude),
             key=lambda r: rendezvous_score(key, r.replica_id),
             reverse=True,
@@ -152,7 +170,7 @@ class FleetRouter:
             return self.fleet_watermark
         marks = [
             r.service.queue.high_watermark
-            for r in self.replicas if r.service is not None
+            for r in list(self.replicas) if r.service is not None
         ]
         return sum(marks) if marks else None
 
@@ -163,7 +181,7 @@ class FleetRouter:
         """Admit one request into the fleet; returns the outer Future.
         Raises AdmissionError/ServiceDegraded when nothing could be
         placed (fleet watermark, or every replica shed)."""
-        admitting = [r for r in self.replicas if r.admitting]
+        admitting = [r for r in list(self.replicas) if r.admitting]
         if not admitting:
             raise ServiceDegraded(
                 "fleet degraded: no admitting replica",
@@ -172,6 +190,11 @@ class FleetRouter:
         watermark = self._resolved_watermark()
         depth = sum(r.queue_depth for r in admitting)
         if watermark is not None and depth >= watermark:
+            # counted for the autoscaler: sustained sheds here are the
+            # scale-up signal (plain int — GIL-atomic increments, and
+            # the consumer only diffs it)
+            self.sheds += 1
+            fleet_metrics().watermark_sheds.inc()
             est = admitting[0].service.queue.estimated_wait_s(
                 depth - watermark + 1
             )

@@ -1,0 +1,841 @@
+"""The Replica contract over the wire: pooled HTTP transport,
+idempotent resubmission, and the server-side RPC adapter.
+
+PR 8's fleet stretched "no admitted request lost" across replicas, but
+every replica was a thread in one process — a host loss still lost
+everything. This module is the wire half of the cross-host lift: an
+`RpcServiceClient` that looks exactly like a ConsensusService to the
+Replica/FleetRouter machinery (probe/submit/drain/kill, same state
+machine, same typed errors) while the service itself runs in another
+OS process behind the existing serve HTTP surface, and an
+`RpcServerAdapter` that teaches that surface the three things a wire
+needs which a shared address space never did:
+
+  * **idempotency** — a response can be lost AFTER the server applied
+    the request (`rpc.call:drop_response`), so every submission carries
+    an idempotency key (payload digest + per-submission nonce) and the
+    server dedupes resubmissions through a bounded in-progress/complete
+    cache: the retried call waits on (or returns) the FIRST
+    application's response instead of applying twice. Exactly-once
+    settlement on the router's outer future is preserved by PR 8's
+    first-wins rule plus consensus purity — a stale duplicate is
+    byte-identical, and the server-side dedupe keeps it *one* apply,
+    not just one answer.
+  * **deadlines** — every call runs under a per-call deadline
+    (`--rpc-timeout-ms`, resolved through kindel_tpu.tune); the
+    request's own deadline budget rides a header so the remote queue's
+    deadline-infeasibility admission math keeps working.
+  * **trace continuity** — the client's `rpc.call` span ships its
+    (trace_id, span_id) in a header and the server roots its request
+    tree under a remote parent, so one trace covers router → wire →
+    remote worker → device dispatch (DESIGN.md §21).
+
+Transport failures are classified with the same stable status
+vocabulary as device failures (resilience.policy), resubmitted under a
+bounded `resilience.RetryPolicy` (safe BECAUSE of the idempotency key),
+and — when exhausted — surfaced as `RpcTransportError`, which the
+router treats as a replica-level failure (failover, not a caller
+error). The network fault family (`rpc.connect:refused`,
+`rpc.call:timeout|slow|drop_response|garbage|reset` —
+resilience/faults.py) injects at exactly this transport, so every
+chaos plan that exercised the device path has a wire-level sibling.
+
+jax-free by construction (tier-1 AST guard): the client moves bytes
+and futures; only the remote process it talks to touches the device.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import http.client
+import json
+import socket
+import threading
+import time
+import uuid
+from collections import OrderedDict
+from concurrent.futures import Future, ThreadPoolExecutor
+from dataclasses import dataclass, field
+
+from kindel_tpu.io.fasta import parse_fasta
+from kindel_tpu.obs import trace
+from kindel_tpu.obs.metrics import default_registry
+from kindel_tpu.resilience import faults
+from kindel_tpu.resilience.policy import RetryPolicy, is_transient
+from kindel_tpu.serve.queue import (
+    AdmissionError,
+    DeadlineExceeded,
+    ServiceDegraded,
+    jittered_retry_after,
+)
+
+#: wire headers (client → server)
+IDEMPOTENCY_HEADER = "X-Kindel-Idempotency-Key"
+TRACE_HEADER = "X-Kindel-Trace"
+DEADLINE_HEADER = "X-Kindel-Deadline-S"
+OPTS_HEADER = "X-Kindel-Opts"
+
+
+class RpcTransportError(RuntimeError):
+    """The wire to a replica failed past the bounded resubmission
+    budget (connect refused, reset, dropped/garbled responses, call
+    timeouts). A replica-level failure by construction: the router
+    fails the ticket over to the next healthy replica instead of
+    surfacing it — the request itself is fine, the host is not."""
+
+
+class RpcGarbageResponse(RuntimeError):
+    """A 200 arrived whose body is not FASTA — wire corruption between
+    the server's apply and our read. Retry-safe under the idempotency
+    key (the resubmission dedupes into the original apply)."""
+
+    def __init__(self, message: str):
+        # carry the transient marker so the shared classifier retries it
+        super().__init__(f"UNAVAILABLE: {message}")
+
+
+def wire_transient(exc: BaseException) -> bool:
+    """Transport retry classifier: the shared status-vocabulary match
+    plus the stdlib connection failure types an HTTP exchange can
+    surface. Every kind is retry-safe here BECAUSE submissions carry an
+    idempotency key — the server dedupes a resubmission whose original
+    was applied."""
+    if isinstance(exc, (OSError, http.client.HTTPException,
+                        socket.timeout, RpcGarbageResponse)):
+        return True
+    return is_transient(exc)
+
+
+_RPC_METRICS = None
+_rpc_lock = threading.Lock()
+
+
+def rpc_metrics():
+    """Process-global `kindel_rpc_*` family (cached — the transport
+    must not pay a registry lock per call): calls by outcome, call
+    latency (p50/p99 rendered by the histogram), and server-side
+    idempotency dedupe hits."""
+    global _RPC_METRICS
+    if _RPC_METRICS is None:
+        with _rpc_lock:
+            if _RPC_METRICS is None:
+                from types import SimpleNamespace
+
+                reg = default_registry()
+                _RPC_METRICS = SimpleNamespace(
+                    calls=reg.counter(
+                        "kindel_rpc_calls_total",
+                        "fleet RPC exchanges by outcome (ok/shed/"
+                        "deadline/bad_request/error)",
+                    ),
+                    seconds=reg.histogram(
+                        "kindel_rpc_call_seconds",
+                        "wall time of one fleet RPC exchange "
+                        "(send → response read), successful or not",
+                    ),
+                    dedup_hits=reg.counter(
+                        "kindel_rpc_dedup_hits_total",
+                        "resubmitted RPC requests answered from the "
+                        "server-side idempotency cache instead of "
+                        "being applied a second time",
+                    ),
+                )
+    return _RPC_METRICS
+
+
+@dataclass
+class RpcSampleResult:
+    """The service-shaped view of a remote consensus response: the
+    records parsed back from the wire FASTA (format_fasta is the
+    round-trip inverse, so the fleet front re-renders byte-identical
+    text). refs_changes/refs_reports stay empty — report-building
+    requests are served in-process where the dense wire formats live."""
+
+    consensuses: list = field(default_factory=list)
+    refs_changes: dict = field(default_factory=dict)
+    refs_reports: dict = field(default_factory=dict)
+
+
+# ---------------------------------------------------------- transport
+
+
+class RpcTransport:
+    """Pooled `http.client` connections to one replica address with
+    per-call deadlines and fault hooks at the two wire sites.
+
+    The pool is a LIFO free-list: a call takes an idle connection (or
+    dials a new one — `rpc.connect` fires first), runs one exchange
+    (`rpc.call` fires on the response bytes, AFTER the server may have
+    applied the request), and returns it; a connection that saw any
+    failure is closed, never re-pooled (its stream state is
+    unknowable). Thread-safe — the client's submit pool calls from
+    many threads."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 30.0,
+                 pool_size: int = 8):
+        self.host = host
+        self.port = int(port)
+        self.timeout_s = timeout_s
+        self.pool_size = pool_size
+        self._idle: list = []
+        self._lock = threading.Lock()
+        self._closed = False
+
+    def _connect(self):
+        faults.hook("rpc.connect")
+        conn = http.client.HTTPConnection(
+            self.host, self.port, timeout=self.timeout_s
+        )
+        conn.connect()
+        return conn
+
+    def _acquire(self):
+        with self._lock:
+            if self._closed:
+                raise RpcTransportError(
+                    f"transport to {self.host}:{self.port} is closed"
+                )
+            if self._idle:
+                return self._idle.pop()
+        return self._connect()
+
+    def _release(self, conn) -> None:
+        with self._lock:
+            if not self._closed and len(self._idle) < self.pool_size:
+                self._idle.append(conn)
+                return
+        conn.close()
+
+    def call(self, method: str, path: str, body: bytes | None = None,
+             headers: dict | None = None,
+             timeout_s: float | None = None,
+             fault_site: str = "rpc.call") -> tuple:
+        """One exchange: (status, response headers, response bytes).
+        Any failure closes the connection and propagates — the caller's
+        retry policy owns resubmission. `fault_site` names the wire
+        fault hook this exchange fires ("rpc.call" for submissions,
+        "rpc.probe" for control-plane calls)."""
+        conn = self._acquire()
+        try:
+            if timeout_s is not None and conn.sock is not None:
+                conn.sock.settimeout(timeout_s)
+            conn.request(method, path, body=body, headers=dict(headers or {}))
+            resp = conn.getresponse()
+            data = resp.read()
+            status = resp.status
+            rheaders = {k: v for k, v in resp.getheaders()}
+            # the injected network faults fire HERE — response in hand,
+            # request already applied server-side: drop_response/garbage
+            # model exactly the lost-after-apply failure idempotency
+            # exists for
+            data = faults.hook_bytes(fault_site, data)
+        except BaseException:
+            conn.close()
+            raise
+        if timeout_s is not None and conn.sock is not None:
+            conn.sock.settimeout(self.timeout_s)
+        self._release(conn)
+        return status, rheaders, data
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            idle, self._idle = self._idle, []
+        for conn in idle:
+            conn.close()
+
+
+# ------------------------------------------------------------- client
+
+
+class _RemoteQueueView:
+    """The queue surface the router's admission math reads
+    (depth/high_watermark/estimated_wait_s), fed by the last /healthz
+    document instead of a shared address space — the wire carries the
+    estimate (`est_wait_s`, serve/service.py) so fleet-watermark and
+    retry-after hints work unchanged."""
+
+    #: pre-first-probe estimate, matching RequestQueue.DEFAULT_SERVICE_S
+    DEFAULT_SERVICE_S = 0.25
+
+    def __init__(self, client: "RpcServiceClient",
+                 default_watermark: int = 256):
+        self._client = client
+        self._default_watermark = default_watermark
+
+    @property
+    def depth(self) -> int:
+        return int(self._client.last_health.get("queue_depth", 0))
+
+    @property
+    def high_watermark(self) -> int:
+        mark = self._client.last_health.get("watermark")
+        return int(mark) if mark else self._default_watermark
+
+    def estimated_wait_s(self, depth: int | None = None) -> float:
+        doc = self._client.last_health
+        known_depth = max(int(doc.get("queue_depth", 0)), 1)
+        est = float(doc.get("est_wait_s", 0.0)) or (
+            self.DEFAULT_SERVICE_S * known_depth
+        )
+        per_req = est / known_depth
+        d = known_depth if depth is None else max(int(depth), 1)
+        return per_req * d
+
+
+class _RpcWorkerStub:
+    """What the fleet supervisor's eviction path pokes (`worker.reap()`)
+    on a dead replica: for a wire-backed replica there are no local
+    loops to reap — tearing down the submit pool and the connection
+    pool is the whole job."""
+
+    def __init__(self, client: "RpcServiceClient"):
+        self._client = client
+
+    @property
+    def alive(self) -> bool:
+        return self._client.live
+
+    def reap(self) -> None:
+        self._client._teardown()
+
+
+class RpcServiceClient:
+    """A ConsensusService-shaped handle over a replica in another
+    process: the exact surface Replica/FleetRouter/FleetService drive
+    (start/stop/kill/live/healthz/readyz/submit/request/drain/queue/
+    worker), implemented as HTTP exchanges with idempotent resubmission.
+
+    `spawn` (optional) is a zero-arg callable returning a process
+    handle with `.address` (host, port), `.alive`, `.terminate()`, and
+    `.kill()` — fleet/procreplica.py provides it; without `spawn` the
+    client attaches to an already-running address (a replica on another
+    host)."""
+
+    def __init__(self, host: str | None = None, port: int | None = None,
+                 *, spawn=None, metrics=None, rpc_timeout_ms: float | None = None,
+                 retry: RetryPolicy | None = None,
+                 default_watermark: int = 256, pool_size: int = 8,
+                 label: str = "rpc"):
+        if spawn is None and (host is None or port is None):
+            raise ValueError("RpcServiceClient needs host+port or spawn")
+        from kindel_tpu import tune
+
+        self.label = label
+        self.metrics = metrics
+        self._spawn = spawn
+        self._proc = None
+        self._host = host
+        self._port = port
+        timeout_ms, _src = tune.resolve_rpc_timeout_ms(rpc_timeout_ms)
+        self.timeout_s = timeout_ms / 1e3
+        # resubmission budget: bounded, jittered, and safe because every
+        # submit carries an idempotency key (a retried apply dedupes)
+        self._retry = retry if retry is not None else RetryPolicy(
+            max_attempts=4, base_s=0.02, max_s=0.25,
+            classify=wire_transient,
+        )
+        self._transport: RpcTransport | None = None
+        self._executor: ThreadPoolExecutor | None = None
+        self._pool_size = pool_size
+        self._closed = False
+        self._lock = threading.Lock()
+        self.last_health: dict = {}
+        self.queue = _RemoteQueueView(self, default_watermark)
+        self.worker = _RpcWorkerStub(self)
+
+    # ------------------------------------------------------- lifecycle
+
+    def start(self) -> "RpcServiceClient":
+        if self._spawn is not None:
+            self._proc = self._spawn()
+            self._host, self._port = self._proc.address
+        self._transport = RpcTransport(
+            self._host, self._port, timeout_s=self.timeout_s,
+            pool_size=self._pool_size,
+        )
+        self._executor = ThreadPoolExecutor(
+            max_workers=self._pool_size,
+            thread_name_prefix=f"kindel-rpc-{self.label}",
+        )
+        return self
+
+    @property
+    def closed(self) -> bool:
+        with self._lock:
+            return self._closed
+
+    @property
+    def live(self) -> bool:
+        """Can the remote still make progress? False once this handle
+        is torn down, or (process-backed) once the process is gone —
+        the probe ladder sees that immediately after a SIGKILL."""
+        if self.closed:
+            return False
+        if self._proc is not None:
+            return self._proc.alive
+        return self._transport is not None
+
+    def stop(self, drain: bool = True) -> None:
+        """Graceful teardown: drain the remote (unless told not to),
+        ask it to exit, reap the process, drop the pools."""
+        if self.closed:
+            return
+        try:
+            if drain and self.live:
+                self.drain(handback=False)
+                return  # drain reaps: a drained replica process is gone
+        except Exception as e:  # noqa: BLE001 — a dead remote is already stopped
+            self.record_failure("stop.drain", e)
+        self._shutdown_process()
+
+    def _shutdown_process(self) -> None:
+        """Ask the remote to exit, then reap: /v1/stop wakes the child's
+        main loop, terminate() is the SIGTERM → wait → SIGKILL ladder —
+        a replica handle must never leave an orphan process behind."""
+        try:
+            if self.live:
+                self._transport.call(
+                    "POST", "/v1/stop", body=b"{}",
+                    headers={"Content-Length": "2"}, timeout_s=2.0,
+                    fault_site="rpc.probe",
+                )
+        except Exception as e:  # noqa: BLE001 — racing its exit is fine
+            self.record_failure("stop.rpc", e)
+        if self._proc is not None:
+            self._proc.terminate()
+        self._teardown()
+
+    def kill(self) -> None:
+        """Chaos surface: for a process-backed replica this is a real
+        SIGKILL — the OS-level sibling of ConsensusService.kill. The
+        supervisor's next probes see `live` False and evict."""
+        if self._proc is not None:
+            self._proc.kill()
+        self._teardown()
+
+    def _teardown(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        if self._executor is not None:
+            self._executor.shutdown(wait=False)
+        if self._transport is not None:
+            self._transport.close()
+
+    def record_failure(self, where: str, exc: BaseException) -> None:
+        self.last_health = dict(
+            self.last_health, last_error=f"{where}: {exc!r}"
+        )
+
+    # --------------------------------------------------------- probing
+
+    def _call_json(self, method: str, path: str, body: dict | None = None,
+                   timeout_s: float | None = None) -> dict:
+        payload = json.dumps(body).encode() if body is not None else None
+        status, _headers, data = self._transport.call(
+            method, path, body=payload,
+            headers=(
+                {"Content-Type": "application/json"} if payload else {}
+            ),
+            timeout_s=timeout_s if timeout_s is not None else self.timeout_s,
+            fault_site="rpc.probe",
+        )
+        if status != 200:
+            raise RpcTransportError(
+                f"{method} {path} -> HTTP {status}: "
+                f"{data[:200].decode(errors='replace')}"
+            )
+        return json.loads(data)
+
+    def healthz(self) -> dict:
+        """One probe exchange — no retries: the probe ladder *is* the
+        retry policy at this level (consecutive failures score the
+        replica, resilience.policy.ProbePolicy)."""
+        doc = self._call_json("GET", "/healthz")
+        self.last_health = doc
+        return doc
+
+    def readyz(self) -> dict:
+        return self._call_json("GET", "/readyz")
+
+    # -------------------------------------------------------- serving
+
+    def submit(self, payload, deadline_s: float | None = None,
+               **opt_overrides) -> Future:
+        """Admit one request over the wire; Future of RpcSampleResult.
+        The POST runs on the submit pool with an idempotency key and a
+        bounded resubmission policy; remote sheds surface as the same
+        typed errors the in-process service raises, so the router's
+        failover logic never learns it crossed a process boundary."""
+        if self.closed or self._executor is None:
+            raise ServiceDegraded(
+                f"replica {self.label}: rpc client is closed",
+                jittered_retry_after(1.0),
+            )
+        body = self._payload_bytes(payload)
+        key = (
+            hashlib.sha256(body).hexdigest()[:16]
+            + "-" + uuid.uuid4().hex[:16]
+        )
+        parent = self._ambient_span()
+        return self._executor.submit(
+            self._exchange_consensus, body, key, dict(opt_overrides),
+            deadline_s, parent,
+        )
+
+    def request(self, payload, timeout: float | None = None,
+                **opt_overrides):
+        return self.submit(payload, **opt_overrides).result(timeout=timeout)
+
+    @staticmethod
+    def _payload_bytes(payload) -> bytes:
+        if isinstance(payload, (bytes, bytearray)):
+            return bytes(payload)
+        with open(str(payload), "rb") as fh:
+            return fh.read()
+
+    @staticmethod
+    def _ambient_span():
+        tracer = trace.active_tracer()
+        if tracer is None:
+            return None
+        return tracer.current()
+
+    def _exchange_consensus(self, body: bytes, key: str, overrides: dict,
+                            deadline_s, parent):
+        """One submission: POST (+ bounded resubmission under the same
+        idempotency key), response mapped back to the in-process typed
+        vocabulary. Runs on a submit-pool thread; the executor settles
+        the inner future with whatever this returns or raises."""
+        m = rpc_metrics()
+        headers = {IDEMPOTENCY_HEADER: key}
+        if overrides:
+            headers[OPTS_HEADER] = json.dumps(overrides, sort_keys=True)
+        if deadline_s is not None:
+            headers[DEADLINE_HEADER] = repr(float(deadline_s))
+        sp = trace.start_span("rpc.call", parent=parent)
+        if sp is not trace.NOOP_SPAN:
+            sp.set_attribute(
+                replica=self.label, key=key, payload_bytes=len(body)
+            )
+            headers[TRACE_HEADER] = f"{sp.trace_id}:{sp.span_id}"
+        call_timeout = self.timeout_s
+        if deadline_s is not None:
+            call_timeout = min(call_timeout, max(float(deadline_s), 0.05))
+
+        def one_exchange():
+            t0 = time.perf_counter()
+            try:
+                status, rheaders, data = self._transport.call(
+                    "POST", "/v1/consensus", body=body, headers=headers,
+                    timeout_s=call_timeout,
+                )
+            finally:
+                m.seconds.observe(time.perf_counter() - t0)
+            if status == 200 and data and not data.startswith(b">"):
+                raise RpcGarbageResponse(
+                    f"unparseable consensus response ({len(data)} bytes, "
+                    f"head {data[:16]!r})"
+                )
+            return status, rheaders, data
+
+        try:
+            status, rheaders, data = self._retry.run(
+                "rpc.call", one_exchange
+            )
+        except Exception as e:
+            if not wire_transient(e):
+                m.calls.labels(outcome="error").inc()
+                self._finish_span(sp, "error", e)
+                raise
+            m.calls.labels(outcome="error").inc()
+            self._finish_span(sp, "error", e)
+            raise RpcTransportError(
+                f"rpc to replica {self.label} failed after "
+                f"{self._retry.max_attempts} attempt(s): {e!r}"
+            ) from e
+        exc = self._status_error(status, rheaders, data)
+        if exc is not None:
+            outcome = (
+                "shed" if isinstance(exc, AdmissionError)
+                else "deadline" if isinstance(exc, DeadlineExceeded)
+                else "bad_request" if isinstance(exc, ValueError)
+                else "error"
+            )
+            m.calls.labels(outcome=outcome).inc()
+            self._finish_span(sp, outcome, exc)
+            raise exc
+        m.calls.labels(outcome="ok").inc()
+        self._finish_span(sp, "ok", None)
+        return RpcSampleResult(consensuses=parse_fasta(data.decode()))
+
+    @staticmethod
+    def _finish_span(sp, outcome: str, exc) -> None:
+        if sp is not trace.NOOP_SPAN:
+            sp.set_attribute(outcome=outcome)
+            if exc is not None:
+                sp.set_attribute(error=repr(exc))
+        sp.finish()
+
+    @staticmethod
+    def _status_error(status: int, rheaders: dict, data: bytes):
+        """Map the serve surface's status vocabulary back to the typed
+        errors the router dispatches on (consensus_post_response is the
+        forward map)."""
+        if status == 200:
+            return None
+        text = data.decode(errors="replace").strip()
+        retry_after = None
+        try:
+            doc = json.loads(text)
+            retry_after = float(doc.get("retry_after_s"))
+            text = doc.get("error", text)
+        except (ValueError, TypeError):
+            try:
+                retry_after = float(rheaders.get("Retry-After"))
+            except (TypeError, ValueError):
+                retry_after = None
+        if retry_after is None:
+            retry_after = jittered_retry_after(1.0)
+        if status == 503:
+            return ServiceDegraded(text, retry_after)
+        if status in (413, 429):
+            return AdmissionError(text, retry_after)
+        if status == 504:
+            return DeadlineExceeded(text)
+        if status == 400:
+            return ValueError(text)
+        return RpcTransportError(f"HTTP {status}: {text[:200]}")
+
+    # ---------------------------------------------------------- drain
+
+    def drain(self, handback: bool = False) -> list:
+        """Remote drain: stop the replica's admission and finish its
+        in-flight work. With handback=True the remote settles its
+        queued-but-unstarted requests with the handed-back shed error,
+        which this client's in-flight exchanges surface as
+        ServiceDegraded — the router fails those tickets over, which IS
+        the hand-back (futures cannot cross a process boundary; the
+        typed error is the wire encoding of `handback()`). Returns []
+        to keep the ConsensusService.drain shape.
+
+        Matching ConsensusService.drain, a drained service is a STOPPED
+        service — so for a process replica the drained child is then
+        reaped (the restart path builds a whole new client + process;
+        keeping a drained husk around would leak one process per drain)."""
+        try:
+            self._call_json(
+                "POST", "/v1/drain", body={"handback": bool(handback)},
+                timeout_s=max(self.timeout_s, 60.0),
+            )
+        finally:
+            self._shutdown_process()
+        return []
+
+    def rpc_stats(self) -> dict:
+        """The remote adapter's wire posture (/v1/rpc)."""
+        return self._call_json("POST", "/v1/rpc", body={})
+
+    def healthz_or_down(self) -> dict:
+        try:
+            return self.healthz()
+        except Exception as e:  # noqa: BLE001 — a broken probe IS the answer
+            self.record_failure("healthz", e)
+            return {"status": "down", "error": repr(e)}
+
+
+# ------------------------------------------------------------- server
+
+
+class IdempotencyCache:
+    """Bounded key → response cache with in-progress coalescing: the
+    first arrival of a key claims it and applies the request; every
+    resubmission (a retry after a dropped/garbled response, or a racing
+    duplicate) waits on the SAME application and gets the same bytes —
+    at-most-once apply per key, byte-identical answers by construction.
+    Insertion-ordered eviction bounds memory; entries are only evicted
+    once settled (an in-progress future is re-queued at the tail so a
+    slow apply cannot be evicted out from under its waiters)."""
+
+    def __init__(self, cap: int = 1024):
+        if cap < 1:
+            raise ValueError("idempotency cache cap must be >= 1")
+        self.cap = cap
+        self._entries: OrderedDict[str, Future] = OrderedDict()
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def claim(self, key: str) -> tuple[bool, Future]:
+        """(first, future): first=True means the caller owns the apply
+        and MUST settle the future; first=False means wait on it."""
+        with self._lock:
+            fut = self._entries.get(key)
+            if fut is not None:
+                self._entries.move_to_end(key)
+                return False, fut
+            fut = Future()
+            self._entries[key] = fut
+            while len(self._entries) > self.cap:
+                evicted = False
+                for k, f in self._entries.items():
+                    if f.done():
+                        del self._entries[k]
+                        evicted = True
+                        break
+                if not evicted:
+                    break  # every entry in flight: let the cache bulge
+            return True, fut
+
+
+class RpcServerAdapter:
+    """The server half: wraps one ConsensusService's HTTP surface with
+    the wire concerns — idempotent /v1/consensus (dedupe + remote trace
+    parent + deadline header), /v1/drain (handback settles queued
+    futures with the shed error so blocked POST handlers answer 503 and
+    the caller's router re-places them), and /v1/stop (sets the owner's
+    stop event; fleet/procreplica.py's main loop exits on it)."""
+
+    def __init__(self, service, stop_event=None, dedupe_cap: int = 1024):
+        self.service = service
+        self.stop_event = stop_event
+        self.cache = IdempotencyCache(cap=dedupe_cap)
+        #: requests actually applied (not deduped) — what the
+        #: lost-response tests assert at-most-once apply against
+        self.applied = 0
+        #: resubmissions answered from the cache (mirrored on the
+        #: metric; kept here too so /v1/rpc can report across the
+        #: process boundary — the spawning fleet's registry cannot see
+        #: a child's)
+        self.dedup_hits = 0
+
+    def post_routes(self) -> dict:
+        return {
+            "/v1/consensus": self.handle_consensus,
+            "/v1/drain": self.handle_drain,
+            "/v1/stop": self.handle_stop,
+            "/v1/rpc": self.handle_rpc_stats,
+        }
+
+    # ------------------------------------------------------ consensus
+
+    def handle_consensus(self, body: bytes, headers) -> tuple:
+        from kindel_tpu.serve.service import consensus_post_response
+
+        key = headers.get(IDEMPOTENCY_HEADER)
+        parent = _remote_parent(headers.get(TRACE_HEADER))
+        deadline_s = _header_float(headers.get(DEADLINE_HEADER))
+        overrides = _header_opts(headers.get(OPTS_HEADER))
+
+        def apply():
+            self.applied += 1
+            sp = trace.span("rpc.server", parent=parent)
+            with sp:
+                if sp is not trace.NOOP_SPAN:
+                    sp.set_attribute(
+                        key=key or "", payload_bytes=len(body)
+                    )
+
+                def request_fn(payload):
+                    return self.service.request(
+                        payload, deadline_s=deadline_s, **overrides
+                    )
+
+                return consensus_post_response(request_fn, body)
+
+        if not key:
+            return apply()
+        first, fut = self.cache.claim(key)
+        if first:
+            try:
+                resp = apply()
+            except BaseException as e:
+                fut.set_exception(e)
+                raise
+            fut.set_result(resp)
+            return resp
+        self.dedup_hits += 1
+        rpc_metrics().dedup_hits.inc()
+        return fut.result()
+
+    def handle_rpc_stats(self, body: bytes, headers) -> tuple:
+        """Server-side wire posture (applied/deduped/cache size) — how
+        a fleet in ANOTHER process reads this replica's dedupe
+        activity (its own registry cannot see across the boundary)."""
+        doc = {
+            "applied": self.applied,
+            "dedup_hits": self.dedup_hits,
+            "cache_size": len(self.cache),
+        }
+        return 200, "application/json", json.dumps(doc).encode(), {}
+
+    # ---------------------------------------------------------- drain
+
+    def handle_drain(self, body: bytes, headers) -> tuple:
+        from kindel_tpu.serve.worker import _settle
+
+        try:
+            params = json.loads(body) if body else {}
+        except ValueError:
+            params = {}
+        handback = bool(params.get("handback"))
+        handed = self.service.drain(handback=handback)
+        for req in handed or []:
+            # the wire encoding of handback(): the blocked POST handler
+            # holding this future answers 503 + Retry-After, the remote
+            # router fails the ticket over to a survivor — settled here
+            # exactly once (_settle loses gracefully to any racer)
+            _settle(req, exc=ServiceDegraded(
+                "drained: request handed back",
+                jittered_retry_after(0.25),
+            ))
+        doc = {"handed_back": len(handed or [])}
+        return 200, "application/json", json.dumps(doc).encode(), {}
+
+    def handle_stop(self, body: bytes, headers) -> tuple:
+        if self.stop_event is not None:
+            self.stop_event.set()
+        return 200, "application/json", b'{"stopping": true}', {}
+
+
+class _RemoteSpanParent:
+    """A span-shaped parent carrying ids that arrived over the wire —
+    what lets the server-side request tree join the client's trace."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: str, span_id: str):
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+
+def _remote_parent(header_value):
+    if not header_value:
+        return None
+    parts = str(header_value).split(":", 1)
+    if len(parts) != 2 or not parts[0] or not parts[1]:
+        return None
+    return _RemoteSpanParent(parts[0], parts[1])
+
+
+def _header_float(value):
+    if value is None:
+        return None
+    try:
+        return float(value)
+    except (TypeError, ValueError):
+        return None
+
+
+def _header_opts(value) -> dict:
+    if not value:
+        return {}
+    try:
+        doc = json.loads(value)
+    except ValueError:
+        return {}
+    return doc if isinstance(doc, dict) else {}

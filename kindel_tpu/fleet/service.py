@@ -57,19 +57,34 @@ class FleetService:
                  hedge_s: float | None = None,
                  probe_policy_factory=ProbePolicy,
                  supervise: bool = True,
+                 min_replicas: int | None = None,
+                 max_replicas: int | None = None,
+                 autoscale_interval_s: float = 0.25,
+                 max_body_mb: int | None = None,
                  **service_kwargs):
         """`service_kwargs` are ConsensusService knobs applied to every
         replica (max_batch_rows, max_wait_s, warmup, consensus opts,
         ...). `service_factory(replica_id, metrics_registry)` overrides
-        replica construction entirely (tests inject stubs). `hedge_s`
-        arms deadline-aware straggler hedging; `fleet_watermark` bounds
+        replica construction entirely (tests inject stubs;
+        ProcessFleetService injects RPC clients). `hedge_s` arms
+        deadline-aware straggler hedging; `fleet_watermark` bounds
         total queued depth across the fleet (default: the sum of the
         per-replica watermarks); `probe_interval_s` is the supervisor's
-        probe cadence."""
+        probe cadence. `min_replicas`/`max_replicas` (both set) arm the
+        watermark autoscaler (FleetAutoscaler): the fleet spawns and
+        retires replicas between those bounds from the router's
+        shed/occupancy signals, with hysteresis. `max_body_mb` bounds
+        one POST body on the fleet HTTP front (413 + Retry-After past
+        it; resolved through kindel_tpu.tune)."""
         if replicas < 1:
             raise ValueError("a fleet needs at least one replica")
         self._service_kwargs = dict(service_kwargs)
         self._service_kwargs["http_port"] = None
+        self._service_factory = service_factory
+        self._probe_policy_factory = probe_policy_factory
+        #: guards membership mutation (autoscale spawn/retire); readers
+        #: snapshot the list instead of taking it
+        self._membership_lock = threading.RLock()
         self._registries = [MetricsRegistry() for _ in range(replicas)]
         self.replicas: list[Replica] = []
         for i in range(replicas):
@@ -80,6 +95,7 @@ class FleetService:
                 Replica(rid, factory,
                         probe_policy_factory=probe_policy_factory)
             )
+        self._next_index = replicas
         self._by_id = {r.replica_id: r for r in self.replicas}
         self.router = FleetRouter(
             self.replicas, fleet_watermark=fleet_watermark,
@@ -90,6 +106,18 @@ class FleetService:
                             probe_interval_s=probe_interval_s)
             if supervise else None
         )
+        self.autoscaler = None
+        if min_replicas is not None and max_replicas is not None:
+            from kindel_tpu.fleet.supervisor import FleetAutoscaler
+
+            self.autoscaler = FleetAutoscaler(
+                self, min_replicas=min_replicas,
+                max_replicas=max_replicas,
+                interval_s=autoscale_interval_s,
+            )
+        from kindel_tpu import tune
+
+        self.max_body_mb, _mb_src = tune.resolve_max_body_mb(max_body_mb)
         self._http = None
         self._http_host = http_host
         self._http_port = http_port
@@ -115,10 +143,11 @@ class FleetService:
     def start(self) -> "FleetService":
         self._started_at = time.monotonic()
         fleet_metrics()  # the kindel_fleet_* series exist from boot
-        for rep in self.replicas:
-            rep.start()
+        self._start_replicas()
         if self.supervisor is not None:
             self.supervisor.start()
+        if self.autoscaler is not None:
+            self.autoscaler.start()
         if self._http_port is not None:
             from kindel_tpu.obs import runtime as obs_runtime
             from kindel_tpu.serve.metrics import ServeHTTPServer
@@ -129,7 +158,7 @@ class FleetService:
 
             self._http = ServeHTTPServer(
                 MultiRegistry(
-                    *self._registries, default_registry(),
+                    *self.registries(), default_registry(),
                     refresh=obs_runtime.update_device_gauges,
                 ),
                 host=self._http_host, port=self._http_port,
@@ -142,8 +171,25 @@ class FleetService:
                 get_routes={
                     "/readyz": lambda: readyz_response(self.readyz),
                 },
+                max_body_bytes=self.max_body_mb * (1 << 20),
             ).start()
         return self
+
+    def _start_replicas(self) -> None:
+        """Boot hook: serial here; ProcessFleetService overrides with a
+        concurrent spawn (each child pays an interpreter boot)."""
+        for rep in self.roster():
+            rep.start()
+
+    def roster(self) -> list:
+        """Membership snapshot under the lock — what every reader
+        iterates while the autoscaler mutates the live list."""
+        with self._membership_lock:
+            return list(self.replicas)
+
+    def registries(self) -> list:
+        with self._membership_lock:
+            return list(self._registries)
 
     def __enter__(self) -> "FleetService":
         return self.start()
@@ -161,9 +207,10 @@ class FleetService:
         """Resolve a replica by id ("r1") or index (1)."""
         if isinstance(replica, Replica):
             return replica
-        if isinstance(replica, int):
-            return self.replicas[replica]
-        return self._by_id[replica]
+        with self._membership_lock:
+            if isinstance(replica, int):
+                return self.replicas[replica]
+            return self._by_id[replica]
 
     def kill_replica(self, replica) -> None:
         """Chaos surface: abrupt death of one replica (see
@@ -179,17 +226,20 @@ class FleetService:
         if self._stopped:
             return
         self._stopped = True
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
         if self.supervisor is not None:
             self.supervisor.stop()
+        roster = self.roster()
         # replay anything stranded on dead replicas while survivors
         # still admit — after states flip to draining nothing admits
-        for rep in self.replicas:
+        for rep in roster:
             svc = rep.service
             if svc is None or not svc.live:
                 self.router.replay(rep)
-        for rep in self.replicas:
+        for rep in roster:
             rep.set_state("draining")
-        for rep in self.replicas:
+        for rep in roster:
             svc = rep.service
             if svc is None:
                 continue
@@ -223,6 +273,84 @@ class FleetService:
             rep.restart()
         return n
 
+    # --------------------------------------------------------- autoscaling
+
+    def add_replica(self) -> Replica:
+        """Grow the fleet by one replica through the same factory
+        machinery the fixed roster used (for a process fleet this
+        spawns a fresh OS process). The new replica is live and ranked
+        by the router the moment it lands in the shared list."""
+        with self._membership_lock:
+            if self._stopped:
+                raise RuntimeError("fleet is stopped")
+            rid = f"r{self._next_index}"
+            self._next_index += 1
+            registry = MetricsRegistry()
+            self._registries.append(registry)
+            factory = self._make_factory(rid, registry,
+                                         self._service_factory)
+            rep = Replica(rid, factory,
+                          probe_policy_factory=self._probe_policy_factory)
+        rep.start()
+        with self._membership_lock:
+            self.replicas.append(rep)
+            self._by_id[rid] = rep
+        fleet_metrics().spawns.inc()
+        return rep
+
+    def retire_replica(self, replica) -> int:
+        """Shrink the fleet by one replica, zero-downtime: close its
+        admission, finish its in-flight work, hand queued work back to
+        survivors (the existing drain path), then remove it from the
+        roster and stop it for good — the scale-down half of the
+        autoscaler. Returns the number of requests handed back."""
+        rep = self.replica(replica)
+        with self._drain_lock:
+            rep.set_state("draining")
+            svc = rep.service
+            if svc is not None and svc.live:
+                try:
+                    svc.drain(handback=True)
+                except Exception as e:  # noqa: BLE001 — folded into the probe ladder
+                    rep.record_probe_failure(repr(e))
+            n = self.router.replay(rep, counter=fleet_metrics().drained)
+            with self._membership_lock:
+                if rep in self.replicas:
+                    self.replicas.remove(rep)
+                self._by_id.pop(rep.replica_id, None)
+            if svc is not None:
+                try:
+                    svc.stop(drain=False)
+                except Exception as e:  # noqa: BLE001 — already dead is the goal
+                    rep.record_probe_failure(repr(e))
+            rep.set_state("dead")
+        return n
+
+    def scale_up(self) -> Replica:
+        """Autoscaler entry: one more replica, counted as a scale
+        event (`kindel_fleet_scale_events_total{direction="up"}`)."""
+        rep = self.add_replica()
+        fleet_metrics().scale_events.labels(direction="up").inc()
+        return rep
+
+    def scale_down(self) -> int:
+        """Autoscaler entry: drain and retire the LOWEST-occupancy
+        admitting replica (least queued + in-flight work — the
+        cheapest one to move), counted as a scale event."""
+        with self._membership_lock:
+            candidates = [r for r in self.replicas if r.admitting]
+            if len(candidates) < 2:
+                raise RuntimeError(
+                    "scale_down needs at least two admitting replicas"
+                )
+            victim = min(
+                candidates,
+                key=lambda r: (r.queue_depth + r.inflight_count),
+            )
+        n = self.retire_replica(victim)
+        fleet_metrics().scale_events.labels(direction="down").inc()
+        return n
+
     # ------------------------------------------------------------- serving
 
     def submit(self, payload, deadline_s: float | None = None,
@@ -242,10 +370,11 @@ class FleetService:
     # -------------------------------------------------------------- health
 
     def healthz(self) -> dict:
-        states = [r.state for r in self.replicas]
+        roster = self.roster()
+        states = [r.state for r in roster]
         if any(s == "ok" for s in states):
             status = "ok"
-        elif any(r.admitting for r in self.replicas):
+        elif any(r.admitting for r in roster):
             status = "degraded"
         else:
             status = "dead"
@@ -257,7 +386,7 @@ class FleetService:
                     **r.snapshot(),
                     "healthz": self._replica_healthz(r),
                 }
-                for r in self.replicas
+                for r in roster
             },
             "uptime_s": (
                 round(time.monotonic() - self._started_at, 3)
@@ -276,15 +405,14 @@ class FleetService:
             return {"status": "down", "error": repr(e)}
 
     def readyz(self) -> dict:
-        ready = (not self._stopped) and any(
-            r.admitting for r in self.replicas
-        )
+        roster = self.roster()
+        ready = (not self._stopped) and any(r.admitting for r in roster)
         return {
             "ready": ready,
             "status": "ok" if ready else (
                 "stopped" if self._stopped else "no_admitting_replica"
             ),
-            "replicas": {r.replica_id: r.state for r in self.replicas},
+            "replicas": {r.replica_id: r.state for r in roster},
         }
 
     # ------------------------------------------------------------- metrics
@@ -295,7 +423,7 @@ class FleetService:
         kindel_fleet_* counters and per-replica states — what the load
         bench and the chaos suite assert against."""
         totals: dict = {}
-        for reg in self._registries:
+        for reg in self.registries():
             for k, v in reg.snapshot().items():
                 if isinstance(v, (int, float)):
                     totals[k] = totals.get(k, 0) + v
@@ -304,7 +432,9 @@ class FleetService:
             if k.startswith("kindel_fleet_")
         }
         return {
-            "replicas": {r.replica_id: r.snapshot() for r in self.replicas},
+            "replicas": {
+                r.replica_id: r.snapshot() for r in self.roster()
+            },
             "totals": totals,
             "fleet": fleet,
         }

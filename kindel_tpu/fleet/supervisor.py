@@ -35,6 +35,7 @@ import threading
 
 from kindel_tpu.obs import trace
 from kindel_tpu.obs.metrics import fleet_metrics
+from kindel_tpu.resilience.policy import record_degrade
 
 
 class FleetSupervisor:
@@ -66,7 +67,8 @@ class FleetSupervisor:
 
     def _loop(self) -> None:
         while not self._stop_event.wait(self.probe_interval_s):
-            for rep in self.replicas:
+            # snapshot: the autoscaler mutates membership live
+            for rep in list(self.replicas):
                 if self._stop_event.is_set():
                     return
                 self._probe_one(rep)
@@ -77,7 +79,13 @@ class FleetSupervisor:
         try:
             outcome = rep.probe()
         except Exception as e:  # noqa: BLE001 — a probe that raises IS data
-            verdict = rep.record_probe_failure(repr(e))
+            # transient wire errors score degraded-ward (an RPC flap
+            # must not evict a replica holding admitted work); hard
+            # failures — refused ports, dead processes — count toward
+            # the consecutive-failure death run
+            verdict = rep.record_probe_failure(
+                repr(e), outcome=rep.classify_probe_error(e)
+            )
         else:
             verdict = rep.score(outcome)
         if verdict == "dead":
@@ -119,3 +127,137 @@ class FleetSupervisor:
         except Exception as e:  # noqa: BLE001 — restart failure is a probe failure
             rep.record_probe_failure(repr(e))
             rep.set_state("dead")
+
+
+class FleetAutoscaler:
+    """Watermark/occupancy-driven replica count control with hysteresis.
+
+    The router already *measures* overload — fleet-watermark sheds
+    (`router.sheds`) and queued depth against capacity — so the
+    autoscaler is a small controller over those two signals:
+
+      scale-up     `up_after` CONSECUTIVE evaluations showing pressure
+                   (any watermark shed since the last look, or occupancy
+                   ≥ `high_occupancy`) spawn one replica through the
+                   fleet's factory machinery, bounded by `max_replicas`
+      scale-down   `down_after` consecutive idle evaluations (occupancy
+                   ≤ `low_occupancy`, no sheds) drain the
+                   lowest-occupancy replica through the existing
+                   zero-downtime drain and retire it, bounded by
+                   `min_replicas`
+
+    Hysteresis is the point, not a refinement: consecutive-evaluation
+    runs (the ProbePolicy discipline applied to capacity) plus a
+    `cooldown_evals` freeze after every action mean a square-wave load —
+    or chaos killing replicas under it — changes the fleet size at most
+    once per cooldown window instead of flapping spawn/retire on every
+    edge (pinned by tests/test_fleet_rpc.py). Evaluation is a plain
+    method (`evaluate()`) so tests drive it deterministically; `start()`
+    runs it on an interval thread in production. Counted on
+    `kindel_fleet_scale_events_total{direction=}` by the fleet's
+    scale_up/scale_down. jax-free by construction (tier-1 AST guard)."""
+
+    def __init__(self, fleet, min_replicas: int = 1,
+                 max_replicas: int = 4, interval_s: float = 0.25,
+                 high_occupancy: float = 0.75, low_occupancy: float = 0.10,
+                 up_after: int = 2, down_after: int = 4,
+                 cooldown_evals: int = 4):
+        if min_replicas < 1 or max_replicas < min_replicas:
+            raise ValueError(
+                f"bad autoscale bounds [{min_replicas}, {max_replicas}]"
+            )
+        self.fleet = fleet
+        self.min_replicas = min_replicas
+        self.max_replicas = max_replicas
+        self.interval_s = interval_s
+        self.high_occupancy = high_occupancy
+        self.low_occupancy = low_occupancy
+        self.up_after = up_after
+        self.down_after = down_after
+        self.cooldown_evals = cooldown_evals
+        self._up_run = 0
+        self._down_run = 0
+        self._cooldown = 0
+        self._last_sheds = fleet.router.sheds
+        self._stop_event = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def occupancy(self) -> float:
+        """Queued depth across admitting replicas over their summed
+        watermarks — the fraction of admission capacity in use."""
+        admitting = [r for r in list(self.fleet.replicas) if r.admitting]
+        if not admitting:
+            return 1.0  # nothing admits: maximal pressure
+        marks = sum(
+            r.service.queue.high_watermark for r in admitting
+            if r.service is not None
+        )
+        if marks <= 0:
+            return 0.0
+        depth = sum(r.queue_depth for r in admitting)
+        return depth / marks
+
+    def evaluate(self) -> str | None:
+        """One control step; returns "up", "down", or None — the test
+        surface (the interval thread just calls this)."""
+        sheds = self.fleet.router.sheds
+        shed_delta = sheds - self._last_sheds
+        self._last_sheds = sheds
+        occ = self.occupancy()
+        if shed_delta > 0 or occ >= self.high_occupancy:
+            self._up_run += 1
+            self._down_run = 0
+        elif occ <= self.low_occupancy:
+            self._down_run += 1
+            self._up_run = 0
+        else:
+            self._up_run = 0
+            self._down_run = 0
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            return None
+        n = len(self.fleet.replicas)
+        if self._up_run >= self.up_after and n < self.max_replicas:
+            self._up_run = 0
+            self._cooldown = self.cooldown_evals
+            try:
+                self.fleet.scale_up()
+            except Exception as e:  # noqa: BLE001 — a failed spawn must not kill the loop
+                self.record_failure(e)
+                return None
+            return "up"
+        if self._down_run >= self.down_after and n > self.min_replicas:
+            self._down_run = 0
+            self._cooldown = self.cooldown_evals
+            try:
+                self.fleet.scale_down()
+            except Exception as e:  # noqa: BLE001 — a failed retire must not kill the loop
+                self.record_failure(e)
+                return None
+            return "down"
+        return None
+
+    def record_failure(self, exc: BaseException) -> None:
+        """A scale action that raised: record it on the span tree and
+        stderr — the loop carries on at the old fleet size."""
+        record_degrade("fleet.autoscale", "scale_error")
+        print(f"kindel-fleet autoscaler: {exc!r}", file=sys.stderr)
+
+    # ------------------------------------------------------------ thread
+
+    def start(self) -> "FleetAutoscaler":
+        self._thread = threading.Thread(
+            target=self._loop, name="kindel-fleet-autoscaler", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop_event.set()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop_event.wait(self.interval_s):
+            self.evaluate()

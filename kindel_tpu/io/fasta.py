@@ -21,11 +21,13 @@ class Sequence:
         yield self.sequence
 
 
-def read_fasta(path) -> list[Sequence]:
+def parse_fasta(text: str) -> list[Sequence]:
+    """Records from FASTA text (the inverse of format_fasta — what the
+    fleet RPC client applies to a remote replica's response body)."""
     records: list[Sequence] = []
     name = None
     chunks: list[str] = []
-    for line in Path(path).read_text().splitlines():
+    for line in text.splitlines():
         if line.startswith(">"):
             if name is not None:
                 records.append(Sequence(name, "".join(chunks)))
@@ -36,6 +38,10 @@ def read_fasta(path) -> list[Sequence]:
     if name is not None:
         records.append(Sequence(name, "".join(chunks)))
     return records
+
+
+def read_fasta(path) -> list[Sequence]:
+    return parse_fasta(Path(path).read_text())
 
 
 def format_fasta(records) -> str:

@@ -525,6 +525,16 @@ def fleet_metrics():
       replays        admitted requests replayed from a DEAD replica
                      onto survivors (the no-request-lost path)
       restarts       replica warm restarts (eviction or drain)
+      watermark_sheds  fleet-level admission rejections (total queued
+                     depth at/over the fleet watermark) — the
+                     autoscaler's scale-up pressure signal
+      scale_events   autoscaler actions by direction label (up = a
+                     replica spawned, down = one drained and retired)
+      spawns         replicas added to a live fleet (autoscale-up)
+      respawns       replica PROCESSES respawned after host/process
+                     loss (the cross-host sibling of restarts: counted
+                     when a process-backed replica's restart spawns a
+                     fresh OS process)
     """
     global _FLEET_METRICS
     if _FLEET_METRICS is None:
@@ -570,6 +580,30 @@ def fleet_metrics():
                         "kindel_fleet_restarts_total",
                         "replica warm restarts performed by the fleet "
                         "(post-eviction and post-drain)",
+                    ),
+                    watermark_sheds=reg.counter(
+                        "kindel_fleet_watermark_sheds_total",
+                        "requests rejected at the fleet watermark "
+                        "(total queued depth across admitting replicas "
+                        "at/over the bound) — the autoscaler's "
+                        "scale-up pressure signal",
+                    ),
+                    scale_events=reg.counter(
+                        "kindel_fleet_scale_events_total",
+                        "fleet autoscaler actions by direction "
+                        "(up = replica spawned, down = lowest-occupancy "
+                        "replica drained and retired)",
+                    ),
+                    spawns=reg.counter(
+                        "kindel_fleet_spawns_total",
+                        "replicas added to a live fleet by the "
+                        "autoscaler (scale-up spawns)",
+                    ),
+                    respawns=reg.counter(
+                        "kindel_fleet_respawns_total",
+                        "replica OS processes respawned after "
+                        "host/process loss (cross-host sibling of the "
+                        "warm-restart counter)",
                     ),
                 )
     return _FLEET_METRICS

@@ -18,12 +18,35 @@ hits, with what probability), and the hot paths call `hook(site)` /
                     are deterministic for every ingest_workers count
   serve.flush       one micro-batch flush execution (serve/worker.py)
   serve.worker      top of the intake / dispatch loop (serve/worker.py)
+  rpc.connect       dialing a new connection to a remote replica
+                    (fleet/rpc.py — fires before the socket is touched)
+  rpc.call          one consensus-submission RPC exchange against a
+                    remote replica, fired on the RESPONSE (fleet/rpc.py
+                    — the request has already been sent, so a raising
+                    kind models a response lost AFTER the server applied
+                    it: the idempotency-key resubmission path's test
+                    vehicle)
+  rpc.probe         one control-plane RPC exchange (healthz/readyz/
+                    drain/stop) — separated from rpc.call so a chaos
+                    plan can attack submissions without the supervisor's
+                    high-rate probe traffic consuming the spec's
+                    hit budget (and vice versa)
 
 Fault kinds: `error` (synthetic transient RPC error), `oom` (synthetic
 XLA RESOURCE_EXHAUSTED — the retry/degrade policies classify it exactly
 like the real one), `stall` (latency injection), `truncate` (drop the
 tail of an I/O chunk), `kill` (raise through a worker loop so the
 thread dies and the supervisor's auto-restart is exercised).
+
+Network kinds (the wire-level siblings of the device/IO family, fired
+at the fleet RPC transport): `refused` (connection refused before the
+request was sent — retry-safe without idempotency), `timeout` (the
+call's deadline elapsed with the request possibly applied), `slow`
+(latency injection on the response path — `delay` seconds, the wire
+twin of `stall`), `drop_response` (the server applied the request but
+the response bytes never arrived), `garbage` (the response arrived
+corrupted — the wire twin of `truncate`), `reset` (connection reset
+mid-exchange).
 
 Disabled-path overhead is the design constraint (the hooks sit on the
 same hot paths as the obs no-op spans): `hook()` is ONE module-global
@@ -55,8 +78,12 @@ import re
 import threading
 import time
 
-#: the fault kinds a spec may name (see module docstring)
-KINDS = ("error", "oom", "stall", "truncate", "kill")
+#: the fault kinds a spec may name (see module docstring); the second
+#: tuple is the wire-level family fired at the fleet RPC transport
+KINDS = (
+    "error", "oom", "stall", "truncate", "kill",
+    "refused", "timeout", "slow", "drop_response", "garbage", "reset",
+)
 
 #: the hook points threaded through the hot paths (documentation +
 #: parse-time typo guard; custom sites are allowed via FaultSpec(...,
@@ -67,7 +94,15 @@ SITES = (
     "io.read_chunk",
     "serve.flush",
     "serve.worker",
+    "rpc.connect",
+    "rpc.call",
+    "rpc.probe",
 )
+
+#: deterministic corruption the `garbage` kind substitutes for a
+#: response body — short, unparseable as HTTP/JSON/FASTA, and stable so
+#: chaos runs replay byte-for-byte
+GARBAGE_BYTES = b"\x00\xffkindel-injected-garbage\x00\xff"
 
 
 class InjectedFault(RuntimeError):
@@ -209,7 +244,7 @@ class FaultPlan:
                 key = (site, s.kind)
                 self.fired[key] = self.fired.get(key, 0) + 1
                 due.append(s)
-        due.sort(key=lambda s: s.kind != "stall")  # stalls first
+        due.sort(key=lambda s: s.kind not in ("stall", "slow"))  # delays first
         return due
 
     def _raise_for(self, site: str, spec: FaultSpec) -> None:
@@ -223,8 +258,33 @@ class FaultPlan:
                 f"RESOURCE_EXHAUSTED: injected device OOM at {site} "
                 "while attempting to allocate",
             )
-        # "error" (and "truncate" outside a bytes hook, where there is
-        # nothing to truncate) degrade to a generic transient failure
+        # the network family carries the same stable status vocabulary
+        # the transient classifier matches on real RPC failures, so the
+        # transport's resubmit machinery exercises its production path
+        if spec.kind == "refused":
+            raise InjectedFault(
+                site, "refused",
+                f"UNAVAILABLE: injected connection refused at {site} "
+                "(ECONNREFUSED)",
+            )
+        if spec.kind == "timeout":
+            raise InjectedFault(
+                site, "timeout",
+                f"DEADLINE_EXCEEDED: injected rpc call timeout at {site}",
+            )
+        if spec.kind == "reset":
+            raise InjectedFault(
+                site, "reset",
+                f"Connection reset: injected wire reset at {site}",
+            )
+        if spec.kind == "drop_response":
+            raise InjectedFault(
+                site, "drop_response",
+                f"UNAVAILABLE: injected response drop at {site} (the "
+                "request may have been applied; response bytes lost)",
+            )
+        # "error" (and "truncate"/"garbage" outside a bytes hook, where
+        # there is nothing to corrupt) degrade to a generic transient
         raise InjectedFault(
             spec.site, spec.kind,
             f"UNAVAILABLE: injected transient {spec.kind} fault at {site}",
@@ -233,20 +293,24 @@ class FaultPlan:
     def fire(self, site: str) -> None:
         """Apply every due spec at this hook point (called by hook())."""
         for spec in self._match(site):
-            if spec.kind == "stall":
+            if spec.kind in ("stall", "slow"):
                 self._sleep(spec.delay_s)
             else:
                 self._raise_for(site, spec)
 
     def filter_bytes(self, site: str, data: bytes) -> bytes:
         """Bytes-hook variant: `truncate` drops the tail half of the
-        chunk (mid-stream corruption / EOF truncation downstream);
-        other kinds behave as in fire()."""
+        chunk (mid-stream corruption / EOF truncation downstream),
+        `garbage` substitutes a deterministic unparseable body (wire
+        corruption after the server applied the request); other kinds
+        behave as in fire()."""
         for spec in self._match(site):
-            if spec.kind == "stall":
+            if spec.kind in ("stall", "slow"):
                 self._sleep(spec.delay_s)
             elif spec.kind == "truncate":
                 data = data[: len(data) // 2]
+            elif spec.kind == "garbage":
+                data = GARBAGE_BYTES
             else:
                 self._raise_for(site, spec)
         return data

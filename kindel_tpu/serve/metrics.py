@@ -42,10 +42,21 @@ class ServeHTTPServer:
     document; `post_routes` maps a path to `fn(body: bytes) -> (status,
     content_type, body_bytes, extra_headers)` — the consensus ingest
     endpoint plugs in here so the metrics module stays transport-only.
-    `get_routes` maps extra GET paths to `fn() -> (status, content_type,
-    body_bytes, extra_headers)` — the /readyz endpoint plugs in here
-    (readiness must be able to answer 503, which the always-200
-    health_fn cannot).
+    A POST handler declared with two positional parameters instead
+    receives `fn(body, headers)` — the fleet RPC adapter reads its
+    idempotency-key / trace / deadline headers this way without every
+    other route growing a parameter. `get_routes` maps extra GET paths
+    to `fn() -> (status, content_type, body_bytes, extra_headers)` —
+    the /readyz endpoint plugs in here (readiness must be able to
+    answer 503, which the always-200 health_fn cannot).
+
+    `max_body_bytes` bounds what one POST may make the server read
+    (default MAX_BODY_BYTES; `kindel serve --max-body-mb` resolves the
+    operator knob through kindel_tpu.tune): an oversized — or missing —
+    Content-Length is refused with 413 + a jittered Retry-After BEFORE
+    any allocation, the same "no allocation sized by untrusted input"
+    rule the decode surface holds (docs/DESIGN.md §8), which matters
+    exactly when the port stops being loopback-only (cross-host fleet).
     """
 
     #: refuse request bodies past this size before allocating (the serve
@@ -53,16 +64,44 @@ class ServeHTTPServer:
     #: untrusted input" rule — docs/DESIGN.md §8)
     MAX_BODY_BYTES = 1 << 30
 
+    #: on a 413, bodies up to this size are read-and-DISCARDED in fixed
+    #: chunks (O(chunk) memory) so a well-behaved client mid-send gets
+    #: the 413 + Retry-After instead of a broken pipe; anything larger
+    #: gets the abrupt close (an attacker streaming gigabytes is owed
+    #: nothing, least of all bandwidth)
+    DISCARD_CAP_BYTES = 8 << 20
+
     def __init__(self, registry, host: str = "127.0.0.1",
                  port: int = 0, health_fn=None, post_routes: dict | None = None,
-                 get_routes: dict | None = None):
+                 get_routes: dict | None = None,
+                 max_body_bytes: int | None = None):
+        import inspect
+
         self.registry = registry
         self._health_fn = health_fn or (lambda: {"status": "ok"})
-        self._post_routes = dict(post_routes or {})
+        self._post_routes = {}
+        for path, fn in (post_routes or {}).items():
+            try:
+                wants_headers = len(
+                    inspect.signature(fn).parameters
+                ) >= 2
+            except (TypeError, ValueError):
+                wants_headers = False
+            self._post_routes[path] = (fn, wants_headers)
         self._get_routes = dict(get_routes or {})
+        self.max_body_bytes = (
+            int(max_body_bytes) if max_body_bytes is not None
+            else self.MAX_BODY_BYTES
+        )
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
+            # keep-alive: the fleet RPC transport pools connections, and
+            # HTTP/1.0's close-per-exchange would turn every probe and
+            # every pooled call into a fresh dial (Content-Length is set
+            # on every reply, so 1.1 framing is always valid here)
+            protocol_version = "HTTP/1.1"
+
             # one serving process, many probes: keep the access log quiet
             def log_message(self, fmt, *args):
                 pass
@@ -99,19 +138,48 @@ class ServeHTTPServer:
 
             def do_POST(self):
                 path = self.path.split("?", 1)[0]
-                fn = outer._post_routes.get(path)
-                if fn is None:
+                route = outer._post_routes.get(path)
+                if route is None:
+                    # request body unread: the connection cannot be
+                    # reused for 1.1 keep-alive without desyncing
+                    self.close_connection = True
                     self._reply(404, "text/plain", b"not found\n")
                     return
                 try:
                     length = int(self.headers.get("Content-Length", "0"))
                 except ValueError:
                     length = -1
-                if not 0 <= length <= outer.MAX_BODY_BYTES:
-                    self._reply(413, "text/plain", b"body too large\n")
+                if not 0 <= length <= outer.max_body_bytes:
+                    from kindel_tpu.serve.queue import jittered_retry_after
+
+                    retry = jittered_retry_after(1.0)
+                    if 0 <= length <= outer.DISCARD_CAP_BYTES:
+                        # bounded discard (never buffered): the sender
+                        # reads a clean 413 and the connection stays
+                        # framed for keep-alive
+                        remaining = length
+                        while remaining > 0:
+                            chunk = self.rfile.read(min(65536, remaining))
+                            if not chunk:
+                                break
+                            remaining -= len(chunk)
+                    else:
+                        # too big to even drain: the unread body would
+                        # desync a kept-alive connection, so close
+                        self.close_connection = True
+                    self._reply(
+                        413, "text/plain",
+                        f"body too large (limit {outer.max_body_bytes} "
+                        "bytes)\n".encode(),
+                        {"Retry-After": max(1, round(retry))},
+                    )
                     return
                 body = self.rfile.read(length)
-                status, ctype, payload, headers = fn(body)
+                fn, wants_headers = route
+                if wants_headers:
+                    status, ctype, payload, headers = fn(body, self.headers)
+                else:
+                    status, ctype, payload, headers = fn(body)
                 self._reply(status, ctype, payload, headers)
 
         self._server = ThreadingHTTPServer((host, port), Handler)

@@ -111,6 +111,8 @@ class ConsensusService:
         row_bucket: int = 8,
         http_host: str = "127.0.0.1",
         http_port: int | None = None,
+        max_body_mb: int | None = None,
+        extra_post_routes: dict | None = None,
         metrics: MetricsRegistry | None = None,
         warmup: bool = False,
         warm_payloads=(),
@@ -203,6 +205,13 @@ class ConsensusService:
             getattr(tuning, "ingest_mode", None)
         )
         self._m_tune_source.set(knob="ingest_mode", source=im_src)
+        # HTTP body bound (413 + Retry-After past it — serve/metrics.py):
+        # explicit arg > tuning pin > KINDEL_TPU_MAX_BODY_MB > default
+        self.max_body_mb, mb_src = tune.resolve_max_body_mb(
+            max_body_mb if max_body_mb is not None
+            else getattr(tuning, "max_body_mb", None)
+        )
+        self._m_tune_source.set(knob="max_body_mb", source=mb_src)
         obs_runtime.ingest_counters().mode.set(
             mode=self.ingest_mode, source=im_src
         )
@@ -253,6 +262,10 @@ class ConsensusService:
         self._http: ServeHTTPServer | None = None
         self._http_host = http_host
         self._http_port = http_port
+        #: caller-supplied POST routes merged OVER the defaults at
+        #: start() — the fleet RPC adapter (fleet/rpc.py) replaces
+        #: /v1/consensus with its idempotency-aware variant this way
+        self._extra_post_routes = dict(extra_post_routes or {})
         self._started_at: float | None = None
         #: drain posture: /readyz answers 503 while True (admission is
         #: closed on the queue; in-flight work keeps finishing)
@@ -283,8 +296,12 @@ class ConsensusService:
                 ),
                 host=self._http_host, port=self._http_port,
                 health_fn=self.healthz,
-                post_routes={"/v1/consensus": self._handle_consensus_post},
+                post_routes={
+                    "/v1/consensus": self._handle_consensus_post,
+                    **self._extra_post_routes,
+                },
                 get_routes={"/readyz": self._handle_readyz},
+                max_body_bytes=self.max_body_mb * (1 << 20),
             ).start()
         return self
 
@@ -426,6 +443,11 @@ class ConsensusService:
             "queue_depth": self.queue.depth,
             "pending_rows": self.batcher.pending_rows,
             "watermark": self.queue.high_watermark,
+            # EWMA time-to-service at the current depth: what a REMOTE
+            # queue view (fleet/rpc.py) quotes for retry-after hints —
+            # the wire carries the estimate so the router's admission
+            # math works without a shared address space
+            "est_wait_s": round(self.queue.estimated_wait_s(), 4),
             "warmup": self._warm_state,
             "warmup_s": self._m_warm_seconds.value,
             # AOT provenance, mirroring the tune_source convention: did

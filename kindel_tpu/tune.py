@@ -95,6 +95,16 @@ INGEST_MODES = ("host", "device")
 BATCH_MODE_DEFAULT = "lanes"
 BATCH_MODES = ("lanes", "ragged", "paged")
 
+#: per-call deadline of one fleet RPC exchange (fleet/rpc.py transport —
+#: probe GETs and consensus POSTs alike); the env pin is
+#: KINDEL_TPU_RPC_TIMEOUT_MS. A capacity/SLO bound, not measured.
+RPC_TIMEOUT_MS_DEFAULT = 30000
+
+#: largest POST body the serve HTTP front will read (413 + Retry-After
+#: past it — the cross-host port makes an unbounded read a trivially
+#: weaponizable memory hole); the env pin is KINDEL_TPU_MAX_BODY_MB
+MAX_BODY_MB_DEFAULT = 1024
+
 #: default page-class geometry spec (name:ROWSxLENGTH, ascending —
 #: kindel_tpu.ragged.pack.parse_classes is the grammar); the env pin is
 #: KINDEL_TPU_RAGGED_CLASSES, `kindel tune --ragged-budget-s` persists a
@@ -141,6 +151,8 @@ class TuningConfig:
     lane_coalesce: int | None = None
     batch_mode: str | None = None
     ragged_classes: str | None = None
+    rpc_timeout_ms: float | None = None
+    max_body_mb: int | None = None
     sources: tuple = ()
 
 
@@ -622,6 +634,40 @@ def resolve_lane_coalesce(explicit: int | None = None) -> tuple[int, str]:
     return LANE_COALESCE_DEFAULT, "default"
 
 
+def resolve_rpc_timeout_ms(
+    explicit: float | None = None,
+) -> tuple[float, str]:
+    """The fleet RPC per-call deadline (fleet/rpc.py): explicit arg >
+    KINDEL_TPU_RPC_TIMEOUT_MS > default (30000 ms). Not measured — it
+    is an SLO bound, not a latency optimum; a malformed/non-positive
+    pin falls through to the default (an unparseable knob must never
+    take the control plane down)."""
+    if explicit is not None and float(explicit) > 0:
+        return float(explicit), "explicit"
+    env = os.environ.get("KINDEL_TPU_RPC_TIMEOUT_MS")
+    if env:
+        try:
+            v = float(env)
+            if v > 0:
+                return v, "env"
+        except ValueError:
+            pass  # malformed pin: fall through to the default
+    return float(RPC_TIMEOUT_MS_DEFAULT), "default"
+
+
+def resolve_max_body_mb(explicit: int | None = None) -> tuple[int, str]:
+    """The serve HTTP body-size bound (413 + Retry-After past it):
+    explicit arg > KINDEL_TPU_MAX_BODY_MB > default (1024 MB). A
+    capacity bound, not measured; malformed/non-positive pins fall
+    through to the default."""
+    if explicit is not None and int(explicit) > 0:
+        return int(explicit), "explicit"
+    pin, _present = _env_int("KINDEL_TPU_MAX_BODY_MB")
+    if pin is not None and pin > 0:
+        return pin, "env"
+    return MAX_BODY_MB_DEFAULT, "default"
+
+
 def resolve_batch_mode(explicit: str | None = None) -> tuple[str, str]:
     """The serve batching-mode knob: explicit arg > KINDEL_TPU_BATCH_MODE
     > default ("lanes"). A malformed value anywhere falls through to the
@@ -799,6 +845,8 @@ def resolve(explicit: TuningConfig | None = None, backend: str = "cpu",
     batch_mode, s6 = resolve_batch_mode(e.batch_mode)
     ragged_classes, s7 = resolve_ragged_classes(e.ragged_classes)
     ingest_mode, s8 = resolve_ingest_mode(e.ingest_mode)
+    rpc_timeout, s9 = resolve_rpc_timeout_ms(e.rpc_timeout_ms)
+    max_body, s10 = resolve_max_body_mb(e.max_body_mb)
     # knob provenance into the shared exposition: one Info sample per
     # (knob, source, value) — the serve /metrics and bench snapshots show
     # WHERE each performance knob came from, not just its value
@@ -816,15 +864,19 @@ def resolve(explicit: TuningConfig | None = None, backend: str = "cpu",
     info.set(knob="batch_mode", source=s6, value=batch_mode)
     info.set(knob="ragged_classes", source=s7, value=ragged_classes)
     info.set(knob="ingest_mode", source=s8, value=ingest_mode)
+    info.set(knob="rpc_timeout_ms", source=s9, value=str(rpc_timeout))
+    info.set(knob="max_body_mb", source=s10, value=str(max_body))
     return TuningConfig(
         n_slabs=n_slabs, stream_chunk_mb=chunk, cohort_budget_mb=budget,
         ingest_workers=ingest, ingest_mode=ingest_mode,
         lane_coalesce=coalesce,
         batch_mode=batch_mode, ragged_classes=ragged_classes,
+        rpc_timeout_ms=rpc_timeout, max_body_mb=max_body,
         sources=(("n_slabs", s1), ("stream_chunk_mb", s2),
                  ("cohort_budget_mb", s3), ("ingest_workers", s4),
                  ("lane_coalesce", s5), ("batch_mode", s6),
-                 ("ragged_classes", s7), ("ingest_mode", s8)),
+                 ("ragged_classes", s7), ("ingest_mode", s8),
+                 ("rpc_timeout_ms", s9), ("max_body_mb", s10)),
     )
 
 

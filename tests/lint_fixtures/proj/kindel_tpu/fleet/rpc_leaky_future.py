@@ -1,0 +1,17 @@
+"""Known-bad: an inner future leaks on a connect-refused path
+(future-settlement, fleet scope) — the submit created the waiter, the
+dial failed, and no path settles, hands back, or re-raises: the
+router's ticket would block forever on a replica that was never
+reachable."""
+
+from concurrent.futures import Future
+
+
+def submit_over_wire(dial, body):
+    fut = Future()
+    try:
+        conn = dial()
+    except ConnectionRefusedError:
+        return None  # refused: waiter stranded, nothing settled
+    conn.send(body, fut)
+    return fut

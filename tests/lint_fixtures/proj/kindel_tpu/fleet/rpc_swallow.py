@@ -1,0 +1,11 @@
+"""Known-bad: a swallowed transport error in the fleet RPC tier
+(silent-swallow, fleet scope) — a wire failure that neither re-raises,
+settles a future, nor records anything is exactly how an admitted
+request vanishes once replicas live on other hosts."""
+
+
+def call_and_shrug(transport, body):
+    try:
+        return transport.call("POST", "/v1/consensus", body)
+    except Exception:
+        return None  # response lost, caller never told, nothing counted

@@ -229,6 +229,23 @@ def test_fixture_scope_extension_hits_parallel(fixture_results):
     assert any("parallel/" in f.path for f in swallow.findings)
 
 
+def test_fixture_fleet_rpc_scope(fixture_results):
+    """The fleet RPC tier (PR 12 satellite): the wire code paths sit
+    inside both exactly-once disciplines — a swallowed transport error
+    fires silent-swallow, and an inner future leaked on a
+    connect-refused path fires future-settlement — each proven live on
+    a known-bad fixture under fleet/."""
+    by_id = {r.spec.id: r for r in fixture_results}
+    assert any(
+        "fleet/rpc_swallow" in f.path
+        for f in by_id["silent-swallow"].findings
+    )
+    assert any(
+        "fleet/rpc_leaky_future" in f.path
+        for f in by_id["future-settlement"].findings
+    )
+
+
 def test_fixture_scope_extension_hits_devingest(fixture_results):
     """The devingest scope extension (PR 10 satellite): the new package
     is covered by the silent-swallow lint, zlib stays confined to io/

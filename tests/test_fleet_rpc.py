@@ -1,0 +1,1026 @@
+"""Cross-host fleet suite (kindel_tpu.fleet.rpc / .procreplica):
+DESIGN.md §21's claims, asserted.
+
+  * the network fault family is the wire-level sibling of PR 4's —
+    refused/timeout/slow/drop_response/garbage/reset parse, fire
+    deterministically, and carry the transient-classifier vocabulary;
+  * `RpcServiceClient` implements the SAME service contract as the
+    in-process replica service: a shared parametrized suite walks a
+    Replica through probe/submit/kill/drain against both backends;
+  * idempotency: a response lost AFTER the server applied the request
+    (`rpc.call:drop_response`) is resubmitted under the same key and
+    deduped server-side — applied once, settled exactly once,
+    byte-identical FASTA;
+  * one trace covers router → wire → remote worker → device dispatch
+    (deterministic JSONL span-tree, PR 3 style);
+  * the HTTP front refuses oversized bodies with 413 + Retry-After
+    before any allocation (`--max-body-mb` through tune.py);
+  * the autoscaler scales up on sustained watermark sheds, scales down
+    by draining the lowest-occupancy replica, and its hysteresis is
+    pinned: a square-wave load cannot flap the fleet;
+  * the flagship: 3 replica PROCESSES under injected network faults,
+    one SIGKILLed and another autoscale-drained mid-load — every
+    admitted future settled exactly once, FASTA sha256 identical to a
+    single-replica in-process run, the killed slot respawned as a
+    fresh process that serves again.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+from types import SimpleNamespace
+
+import pytest
+
+from kindel_tpu.fleet import FleetRouter, FleetService, Replica, routing_key
+from kindel_tpu.fleet.rpc import (
+    IDEMPOTENCY_HEADER,
+    IdempotencyCache,
+    RpcGarbageResponse,
+    RpcServerAdapter,
+    RpcServiceClient,
+    RpcTransportError,
+    wire_transient,
+)
+from kindel_tpu.fleet.supervisor import FleetAutoscaler
+from kindel_tpu.io.fasta import Sequence, format_fasta, parse_fasta
+from kindel_tpu.obs import trace
+from kindel_tpu.obs.metrics import default_registry
+from kindel_tpu.resilience import faults as rfaults
+from kindel_tpu.resilience import policy as rpolicy
+from kindel_tpu.resilience.faults import GARBAGE_BYTES, FaultPlan
+from kindel_tpu.resilience.policy import RetryPolicy
+from kindel_tpu.serve.metrics import MetricsRegistry, ServeHTTPServer
+from kindel_tpu.serve.queue import (
+    AdmissionError,
+    DeadlineExceeded,
+    ServiceDegraded,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    """Process-global fault plans / policies / tracers must not leak
+    (same hygiene as test_resilience.py)."""
+    rfaults.deactivate()
+    prev = rpolicy.set_default_policy(None)
+    yield
+    rfaults.deactivate()
+    rpolicy.set_default_policy(prev)
+    trace.disable_tracing()
+
+
+def _fleet_delta(before: dict, after: dict, name: str) -> int:
+    return int(after.get(name, 0)) - int(before.get(name, 0))
+
+
+# ------------------------------------------------ network fault family
+
+
+def test_network_fault_specs_parse_and_fire():
+    plan = FaultPlan.parse(
+        "seed=3,rpc.connect:refused,rpc.call:drop_response:times=2,"
+        "rpc.call:garbage:after=2,rpc.probe:reset,rpc.call:timeout:after=3"
+    )
+    with pytest.raises(rfaults.InjectedFault) as exc:
+        plan.fire("rpc.connect")
+    assert "refused" in str(exc.value) and "UNAVAILABLE" in str(exc.value)
+    # drop_response fires on the bytes hook (response in hand)
+    for _ in range(2):
+        with pytest.raises(rfaults.InjectedFault) as exc:
+            plan.filter_bytes("rpc.call", b">x\nACGT\n")
+        assert exc.value.kind == "drop_response"
+    # hit 3: garbage substitutes the deterministic corruption
+    assert plan.filter_bytes("rpc.call", b">x\nACGT\n") == GARBAGE_BYTES
+    # hit 4: timeout carries the deadline vocabulary
+    with pytest.raises(rfaults.InjectedFault) as exc:
+        plan.filter_bytes("rpc.call", b">x\n")
+    assert "DEADLINE_EXCEEDED" in str(exc.value)
+    # probes have their own site — the call specs did not consume it
+    with pytest.raises(rfaults.InjectedFault) as exc:
+        plan.filter_bytes("rpc.probe", b"{}")
+    assert "Connection reset" in str(exc.value)
+    assert plan.fired == {
+        ("rpc.connect", "refused"): 1,
+        ("rpc.call", "drop_response"): 2,
+        ("rpc.call", "garbage"): 1,
+        ("rpc.call", "timeout"): 1,
+        ("rpc.probe", "reset"): 1,
+    }
+
+
+def test_network_faults_classify_as_wire_transient():
+    plan = FaultPlan.parse(
+        "rpc.connect:refused,rpc.call:reset,rpc.probe:drop_response"
+    )
+    for site in ("rpc.connect", "rpc.call", "rpc.probe"):
+        with pytest.raises(rfaults.InjectedFault) as exc:
+            plan.fire(site)
+        assert wire_transient(exc.value), exc.value
+    assert wire_transient(RpcGarbageResponse("mangled"))
+    assert wire_transient(ConnectionRefusedError("dial"))
+    assert not wire_transient(KeyError("request-level bug"))
+
+
+def test_slow_kind_injects_latency_without_failing():
+    slept = []
+    plan = FaultPlan(
+        [rfaults.FaultSpec("rpc.call", "slow", delay_s=0.125)],
+        sleep=slept.append,
+    )
+    assert plan.filter_bytes("rpc.call", b"ok") == b"ok"
+    assert slept == [0.125]
+
+
+# ------------------------------------------- stub remote + HTTP server
+
+
+class _StubRemote:
+    """A ConsensusService-shaped stub the RpcServerAdapter wraps: real
+    enough for the wire (records → FASTA via the real response path),
+    no device anywhere. `mode` selects the behavior; `applied` counts
+    actual request applications (the at-most-once assertion)."""
+
+    def __init__(self):
+        self.mode = "ok"
+        self.records = [Sequence("stub1", "ACGTACGT")]
+        self.applied = 0
+        self.apply_delay_s = 0.0
+        self.seen_opts: list = []
+        self.drained: list = []
+        self.live = True
+        self.queue_depth = 0
+        self.watermark = 64
+
+    def request(self, payload, deadline_s=None, **opts):
+        self.applied += 1
+        self.seen_opts.append(dict(opts, deadline_s=deadline_s))
+        if self.apply_delay_s:
+            time.sleep(self.apply_delay_s)
+        if self.mode == "shed":
+            raise AdmissionError("stub watermark", 0.2)
+        if self.mode == "degraded":
+            raise ServiceDegraded("stub breaker open", 0.2)
+        if self.mode == "deadline":
+            raise DeadlineExceeded("stub deadline passed")
+        if self.mode == "bad":
+            raise ValueError("undecodable stub payload")
+        return SimpleNamespace(consensuses=list(self.records))
+
+    def healthz(self):
+        status = "degraded" if self.mode == "degraded" else "ok"
+        return {
+            "status": status,
+            "queue_depth": self.queue_depth,
+            "watermark": self.watermark,
+            "est_wait_s": 0.25 * max(self.queue_depth, 1),
+        }
+
+    def readyz(self):
+        return {"ready": self.mode == "ok", "status": self.mode}
+
+    def drain(self, handback=False):
+        self.drained.append(handback)
+        return []
+
+
+class _RemoteHarness:
+    """One stub remote behind a real ServeHTTPServer with the real
+    RpcServerAdapter routes — the wire without the device."""
+
+    def __init__(self):
+        self.stub = _StubRemote()
+        self.stop_event = threading.Event()
+        self.adapter = RpcServerAdapter(
+            self.stub, stop_event=self.stop_event
+        )
+        self.server = ServeHTTPServer(
+            MetricsRegistry(),
+            health_fn=self.stub.healthz,
+            post_routes=self.adapter.post_routes(),
+            get_routes={
+                "/readyz": lambda: (
+                    200, "application/json",
+                    json.dumps(self.stub.readyz()).encode(), {},
+                ),
+            },
+        ).start()
+
+    @property
+    def address(self):
+        return self.server.host, self.server.port
+
+    def client(self, **kw) -> RpcServiceClient:
+        host, port = self.address
+        kw.setdefault(
+            "retry",
+            RetryPolicy(max_attempts=4, base_s=0.0, max_s=0.0,
+                        classify=wire_transient, sleep=lambda s: None),
+        )
+        return RpcServiceClient(host, port, **kw).start()
+
+    def close(self):
+        self.server.stop()
+
+
+@pytest.fixture()
+def remote():
+    h = _RemoteHarness()
+    yield h
+    h.close()
+
+
+# ------------------------------------- the shared Replica contract suite
+
+
+class _InprocStub:
+    """The in-process twin of _StubRemote: same surface, no wire."""
+
+    def __init__(self):
+        self.mode = "ok"
+        self.records = [Sequence("stub1", "ACGTACGT")]
+        self.live = True
+        self.queue = SimpleNamespace(
+            depth=0, high_watermark=64,
+            estimated_wait_s=lambda d=None: 0.25,
+        )
+        self.worker = SimpleNamespace(reap=lambda: None)
+
+    def start(self):
+        return self
+
+    def stop(self, drain=True):
+        self.live = False
+
+    def kill(self):
+        self.live = False
+
+    def healthz(self):
+        return {
+            "status": "degraded" if self.mode == "degraded" else "ok"
+        }
+
+    def drain(self, handback=False):
+        return []
+
+    def submit(self, payload, deadline_s=None, **opts):
+        from concurrent.futures import Future
+
+        fut: Future = Future()
+        if self.mode == "shed":
+            fut.set_exception(AdmissionError("stub watermark", 0.2))
+        else:
+            fut.set_result(
+                SimpleNamespace(consensuses=list(self.records))
+            )
+        return fut
+
+
+@pytest.fixture(params=["inproc", "rpc"])
+def contract_replica(request):
+    """One Replica slot over either backend, plus the knobs the
+    contract tests poke — the suite itself cannot tell which transport
+    it is driving, which is the point."""
+    if request.param == "inproc":
+        stub = _InprocStub()
+        rep = Replica("c0", lambda: stub).start()
+
+        def set_mode(mode):
+            stub.mode = mode
+
+        def kill_backend():
+            stub.kill()
+
+        yield SimpleNamespace(
+            rep=rep, set_mode=set_mode, kill_backend=kill_backend,
+            kind="inproc",
+        )
+        rep.stop(drain=False)
+    else:
+        harness = _RemoteHarness()
+        clients: list = []
+
+        def factory():
+            c = harness.client()
+            clients.append(c)
+            return c
+
+        rep = Replica("c0", factory).start()
+
+        def set_mode(mode):
+            harness.stub.mode = mode
+
+        def kill_backend():
+            # host loss: the server vanishes AND the handle knows it
+            # can no longer make progress — same observable as a dead
+            # process (RpcServiceClient.kill on a spawned replica)
+            rep.service.kill()
+            harness.server.stop()
+
+        yield SimpleNamespace(
+            rep=rep, set_mode=set_mode, kill_backend=kill_backend,
+            kind="rpc",
+        )
+        for c in clients:
+            c._teardown()
+        try:
+            harness.close()
+        except Exception:  # noqa: BLE001 — already stopped by kill_backend
+            pass
+
+
+def test_transient_probe_errors_demote_instead_of_evicting():
+    """A wire flap during a probe (UNAVAILABLE vocabulary) scores the
+    replica degraded-ward; a hard failure (refused port) scores toward
+    death — the supervisor routes through classify_probe_error so an
+    RPC blip cannot evict a replica holding admitted work."""
+    stub = _InprocStub()
+    rep = Replica("p0", lambda: stub).start()
+    flap = RuntimeError("UNAVAILABLE: injected transient flap")
+    hard = ConnectionRefusedError("[Errno 111] Connection refused")
+    assert rep.classify_probe_error(flap) == rpolicy.PROBE_DEGRADED
+    assert rep.classify_probe_error(hard) == rpolicy.PROBE_FAILED
+    # degraded-ward run never reaches the death verdict
+    for _ in range(10):
+        verdict = rep.record_probe_failure(
+            repr(flap), outcome=rep.classify_probe_error(flap)
+        )
+    assert verdict == rpolicy.REPLICA_DEGRADED
+    assert rep.state == "degraded"
+    # hard failures do
+    for _ in range(3):
+        verdict = rep.record_probe_failure(
+            repr(hard), outcome=rep.classify_probe_error(hard)
+        )
+    assert verdict == rpolicy.REPLICA_DEAD
+
+
+def _probe_outcome(rep) -> str:
+    """Probe like the supervisor does: an exception IS a failed probe."""
+    try:
+        return rep.probe()
+    except Exception:  # noqa: BLE001 — the supervisor folds this to failed
+        return rpolicy.PROBE_FAILED
+
+
+def test_contract_probe_reflects_remote_health(contract_replica):
+    env = contract_replica
+    assert _probe_outcome(env.rep) == rpolicy.PROBE_OK
+    env.set_mode("degraded")
+    assert _probe_outcome(env.rep) == rpolicy.PROBE_DEGRADED
+    env.set_mode("ok")
+    assert _probe_outcome(env.rep) == rpolicy.PROBE_OK
+
+
+def test_contract_submit_settles_with_records(contract_replica):
+    env = contract_replica
+    fut = env.rep.service.submit(b"payload-bytes")
+    res = fut.result(timeout=10)
+    assert [(r.name, r.sequence) for r in res.consensuses] == [
+        ("stub1", "ACGTACGT")
+    ]
+
+
+def test_contract_kill_fails_probes_until_dead_verdict(contract_replica):
+    env = contract_replica
+    env.kill_backend()
+    policy = rpolicy.ProbePolicy(degraded_after=2, dead_after=3)
+    verdict = None
+    for _ in range(3):
+        verdict = policy.observe(_probe_outcome(env.rep))
+    assert verdict == rpolicy.REPLICA_DEAD
+    assert not env.rep.service.live
+
+
+def test_contract_state_machine_transitions(contract_replica):
+    env = contract_replica
+    rep = env.rep
+    assert rep.state == "ok" and rep.admitting
+    rep.set_state("draining")
+    assert not rep.admitting
+    rep.set_state("ok")
+    assert rep.score(rpolicy.PROBE_FAILED) == "ok"  # one flake: no demotion
+    assert rep.score(rpolicy.PROBE_OK) == "ok"
+
+
+def test_contract_router_integration_shed_fails_over(contract_replica):
+    """The shed surface differs in WHERE it appears (sync raise
+    in-process, async inner failure over RPC) but the router absorbs
+    both: the ticket lands on the healthy replica either way."""
+    env = contract_replica
+    env.set_mode("shed")
+    ok_stub = _InprocStub()
+    ok_stub.records = [Sequence("other", "TTTT")]
+    ok_rep = Replica("c1", lambda: ok_stub).start()
+    router = FleetRouter([env.rep, ok_rep])
+    fut = router.submit(b"payload-bytes")
+    res = fut.result(timeout=10)
+    assert [(r.name, r.sequence) for r in res.consensuses] in (
+        [("other", "TTTT")],
+        [("stub1", "ACGTACGT")],  # rendezvous may prefer the ok replica
+    )
+    # and with BOTH replicas shedding, the outer settles with the shed
+    ok_stub.mode = "shed"
+    with pytest.raises(AdmissionError):
+        router.submit(b"payload-bytes").result(timeout=10)
+
+
+# -------------------------------------------------- transport behavior
+
+
+def test_rpc_client_maps_remote_errors_to_typed_vocabulary(remote):
+    client = remote.client()
+    try:
+        for mode, exc_type in (
+            ("shed", AdmissionError),
+            ("degraded", ServiceDegraded),
+            ("deadline", DeadlineExceeded),
+            ("bad", ValueError),
+        ):
+            remote.stub.mode = mode
+            with pytest.raises(exc_type):
+                client.submit(b"x").result(timeout=10)
+        # typed Retry-After hints survive the wire
+        remote.stub.mode = "shed"
+        try:
+            client.submit(b"x").result(timeout=10)
+        except AdmissionError as e:
+            assert e.retry_after_s > 0
+    finally:
+        client._teardown()
+
+
+def test_rpc_client_retries_connect_refused_then_fails_over_typed(remote):
+    client = remote.client()
+    try:
+        plan = rfaults.activate(
+            FaultPlan.parse("rpc.connect:refused:times=1")
+        )
+        # the refused dial is resubmitted under the retry policy: the
+        # request still lands (probes may or may not have a pooled
+        # connection, so push several to guarantee a fresh dial)
+        futs = [client.submit(b"dial-me") for _ in range(4)]
+        for f in futs:
+            res = f.result(timeout=10)
+            assert res.consensuses
+        assert plan.fired.get(("rpc.connect", "refused"), 0) == 1
+        # exhausted budgets surface as the replica-level transport error
+        rfaults.activate(FaultPlan.parse("rpc.call:reset:times=99"))
+        with pytest.raises(RpcTransportError):
+            client.submit(b"resets-forever").result(timeout=10)
+    finally:
+        client._teardown()
+
+
+def test_rpc_remote_queue_view_feeds_router_admission(remote):
+    remote.stub.queue_depth = 5
+    remote.stub.watermark = 8
+    client = remote.client()
+    try:
+        client.healthz()
+        assert client.queue.depth == 5
+        assert client.queue.high_watermark == 8
+        assert client.queue.estimated_wait_s(4) == pytest.approx(1.0)
+    finally:
+        client._teardown()
+
+
+def test_rpc_drain_handback_settles_remote_queue_with_shed(remote):
+    """The wire encoding of handback(): the remote settles its queued
+    requests with the handed-back shed error (503 on the blocked POST),
+    which the client surfaces as ServiceDegraded — a REPLICA_FAILURES
+    member, so the router re-places the ticket."""
+    from kindel_tpu.serve.queue import ServeRequest
+
+    handed_req = ServeRequest(payload=b"q", opts=None)
+    remote.stub.drain = lambda handback=False: (
+        [handed_req] if handback else []
+    )
+    client = remote.client()
+    try:
+        client.drain(handback=True)
+        with pytest.raises(ServiceDegraded):
+            handed_req.future.result(timeout=0)
+    finally:
+        client._teardown()
+
+
+def test_http_front_rejects_oversized_body_with_413_retry_after(remote):
+    host, port = remote.address
+    remote.server.max_body_bytes = 64
+    body = b"A" * 256
+    req = urllib.request.Request(
+        f"http://{host}:{port}/v1/consensus", data=body, method="POST"
+    )
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        urllib.request.urlopen(req, timeout=30)
+    assert exc.value.code == 413
+    assert int(exc.value.headers["Retry-After"]) >= 1
+
+
+def test_max_body_mb_resolves_through_tune(monkeypatch):
+    from kindel_tpu import tune
+
+    assert tune.resolve_max_body_mb(7) == (7, "explicit")
+    monkeypatch.setenv("KINDEL_TPU_MAX_BODY_MB", "33")
+    assert tune.resolve_max_body_mb(None) == (33, "env")
+    monkeypatch.setenv("KINDEL_TPU_MAX_BODY_MB", "not-a-number")
+    assert tune.resolve_max_body_mb(None) == (
+        tune.MAX_BODY_MB_DEFAULT, "default",
+    )
+    monkeypatch.delenv("KINDEL_TPU_MAX_BODY_MB")
+    assert tune.resolve_rpc_timeout_ms(1500.0) == (1500.0, "explicit")
+    monkeypatch.setenv("KINDEL_TPU_RPC_TIMEOUT_MS", "2500")
+    assert tune.resolve_rpc_timeout_ms(None) == (2500.0, "env")
+    monkeypatch.delenv("KINDEL_TPU_RPC_TIMEOUT_MS")
+    assert tune.resolve_rpc_timeout_ms(None) == (
+        float(tune.RPC_TIMEOUT_MS_DEFAULT), "default",
+    )
+
+
+# ------------------------------------------- idempotency / lost response
+
+
+def test_idempotency_cache_claims_once_and_coalesces():
+    cache = IdempotencyCache(cap=2)
+    first, fut = cache.claim("k1")
+    assert first
+    again, fut2 = cache.claim("k1")
+    assert not again and fut2 is fut
+    fut.set_result(("resp",))
+    # eviction only reaps settled entries
+    cache.claim("k2")
+    cache.claim("k3")
+    assert len(cache) == 2
+    first_again, _ = cache.claim("k1")
+    assert first_again, "settled k1 should have been evicted"
+
+
+def test_lost_response_resubmission_dedupes_server_side(remote):
+    """Satellite: inject `rpc.call:drop_response` AFTER the server
+    applied the request — the resubmission carries the same idempotency
+    key, the server answers from the cache (applied exactly once), and
+    the outer future settles exactly once with byte-identical FASTA."""
+    client = remote.client()
+    try:
+        before_dedup = default_registry().snapshot().get(
+            "kindel_rpc_dedup_hits_total", 0
+        )
+        plan = rfaults.activate(
+            FaultPlan.parse("rpc.call:drop_response:times=1")
+        )
+        fut = client.submit(b"the-one-request")
+        res = fut.result(timeout=10)
+        assert plan.fired == {("rpc.call", "drop_response"): 1}
+        # the server applied ONCE; the retry was answered from cache
+        assert remote.stub.applied == 1
+        assert remote.adapter.applied == 1
+        after_dedup = default_registry().snapshot().get(
+            "kindel_rpc_dedup_hits_total", 0
+        )
+        assert after_dedup - before_dedup == 1
+        # byte-identical to what the server rendered
+        assert format_fasta(res.consensuses) == format_fasta(
+            remote.stub.records
+        )
+        # exactly once: the future is settled, and settled correctly
+        assert fut.done() and not fut.cancelled()
+    finally:
+        client._teardown()
+
+
+def test_garbled_response_resubmits_and_dedupes(remote):
+    client = remote.client()
+    try:
+        plan = rfaults.activate(
+            FaultPlan.parse("rpc.call:garbage:times=1")
+        )
+        res = client.submit(b"garble-me").result(timeout=10)
+        assert plan.fired == {("rpc.call", "garbage"): 1}
+        assert remote.stub.applied == 1
+        assert [r.name for r in res.consensuses] == ["stub1"]
+    finally:
+        client._teardown()
+
+
+def test_concurrent_duplicate_keys_apply_once(remote):
+    """Racing resubmissions (not just serial retries) coalesce on the
+    in-progress future: N simultaneous POSTs with one key → one apply,
+    N identical answers."""
+    remote.stub.apply_delay_s = 0.1
+    host, port = remote.address
+    bodies: list = []
+    errs: list = []
+
+    def post():
+        req = urllib.request.Request(
+            f"http://{host}:{port}/v1/consensus", data=b"same",
+            method="POST", headers={IDEMPOTENCY_HEADER: "race-key"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                bodies.append(resp.read())
+        except Exception as e:  # noqa: BLE001 — collected for the assert
+            errs.append(repr(e))
+
+    threads = [threading.Thread(target=post) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    assert remote.stub.applied == 1
+    assert len(set(bodies)) == 1
+
+
+# -------------------------------------------------- trace propagation
+
+
+def test_trace_id_propagates_over_the_rpc_hop(remote, tmp_path):
+    """Satellite: one trace covers caller → wire → remote apply. The
+    JSONL span tree is deterministic in SHAPE: rpc.call parents to the
+    caller's root, rpc.server carries the SAME trace id and parents to
+    rpc.call's span id — across what is, in production, a process
+    boundary."""
+    out = tmp_path / "spans.jsonl"
+    trace.enable_tracing(str(out))
+    client = remote.client()
+    try:
+        with trace.span("test.root") as root:
+            res = client.submit(b"traced-request").result(timeout=10)
+            assert res.consensuses
+            root_trace = root.trace_id
+    finally:
+        client._teardown()
+        trace.disable_tracing()
+    spans = [json.loads(line) for line in out.read_text().splitlines()]
+    by_name = {}
+    for sp in spans:
+        by_name.setdefault(sp["name"], []).append(sp)
+    (call,) = by_name["rpc.call"]
+    (server,) = by_name["rpc.server"]
+    (root_sp,) = by_name["test.root"]
+    assert call["trace_id"] == root_trace
+    assert call["parent_id"] == root_sp["span_id"]
+    assert server["trace_id"] == root_trace, "trace id lost on the wire"
+    assert server["parent_id"] == call["span_id"]
+    assert call["attrs"]["outcome"] == "ok"
+    assert server["attrs"]["key"] == call["attrs"]["key"]
+
+
+def test_trace_covers_wire_to_device_dispatch(tmp_path):
+    """End-to-end: a REAL ConsensusService behind the RPC adapter — the
+    remote request tree (serve.request → admission/queue/dispatch)
+    roots under rpc.server, so one trace id spans router → wire →
+    remote worker → device dispatch."""
+    from kindel_tpu.serve import ConsensusService
+    from tests.test_serve import make_sam
+
+    sam = make_sam(tmp_path / "t.sam", seed=77)
+    out = tmp_path / "spans.jsonl"
+    stop_event = threading.Event()
+    svc = ConsensusService(max_wait_s=0.01, http_port=0)
+    adapter = RpcServerAdapter(svc, stop_event=stop_event)
+    svc._extra_post_routes.update(adapter.post_routes())
+    svc.start()
+    trace.enable_tracing(str(out))
+    host, port = svc.http_address
+    client = RpcServiceClient(host, port).start()
+    try:
+        with trace.span("test.root") as root:
+            res = client.submit(sam.read_bytes()).result(timeout=120)
+            assert res.consensuses
+            root_trace = root.trace_id
+    finally:
+        client._teardown()
+        trace.disable_tracing()
+        svc.stop()
+    spans = [json.loads(line) for line in out.read_text().splitlines()]
+    named = {}
+    for sp in spans:
+        named.setdefault(sp["name"], []).append(sp)
+    assert all(
+        sp["trace_id"] == root_trace
+        for name in ("rpc.call", "rpc.server", "serve.request")
+        for sp in named[name]
+    ), "a stage fell off the trace"
+    (server,) = named["rpc.server"]
+    request_spans = [
+        sp for sp in named["serve.request"]
+        if sp["parent_id"] == server["span_id"]
+    ]
+    assert request_spans, "serve.request did not root under rpc.server"
+    # the remote request tree kept its own children (queue wait at least)
+    req_ids = {sp["span_id"] for sp in request_spans}
+    assert any(
+        sp.get("parent_id") in req_ids
+        for sp in spans if sp["name"] != "serve.request"
+    )
+
+
+# --------------------------------------------------------- autoscaler
+
+
+class _ScaleStub(_InprocStub):
+    def __init__(self, depth=0, watermark=10):
+        super().__init__()
+        self.queue = SimpleNamespace(
+            depth=depth, high_watermark=watermark,
+            estimated_wait_s=lambda d=None: 0.1,
+        )
+
+
+def _scale_fleet(**kw):
+    stubs: dict = {}
+
+    def factory(rid, registry):
+        stubs[rid] = _ScaleStub()
+        return stubs[rid]
+
+    fleet = FleetService(
+        replicas=2, service_factory=factory, supervise=False, **kw
+    )
+    fleet.start()
+    return fleet, stubs
+
+
+def test_autoscaler_scales_up_on_sustained_sheds_only():
+    fleet, stubs = _scale_fleet()
+    try:
+        scaler = FleetAutoscaler(
+            fleet, min_replicas=1, max_replicas=4,
+            up_after=2, down_after=3, cooldown_evals=2,
+        )
+        # one shed is a blip, not a trend
+        fleet.router.sheds += 1
+        assert scaler.evaluate() is None
+        assert scaler.evaluate() is None  # no new sheds: run reset
+        # sustained sheds: two consecutive pressured evaluations
+        fleet.router.sheds += 1
+        assert scaler.evaluate() is None
+        fleet.router.sheds += 1
+        assert scaler.evaluate() == "up"
+        assert len(fleet.replicas) == 3
+        assert "r2" in [r.replica_id for r in fleet.replicas]
+        # the new replica admits and is ranked by the router
+        assert any(
+            r.replica_id == "r2"
+            for r in fleet.router.rank(routing_key(b"x", {}))
+        )
+    finally:
+        fleet.stop(drain=False)
+
+
+def test_autoscaler_scales_down_lowest_occupancy_via_drain():
+    before = default_registry().snapshot()
+    fleet, stubs = _scale_fleet()
+    try:
+        fleet.scale_up()
+        assert len(fleet.replicas) == 3
+        # r1 is the busy one; r0/r2 idle — lowest occupancy retires
+        stubs["r1"].queue.depth = 9
+        busy = fleet.replica("r1")
+        scaler = FleetAutoscaler(
+            fleet, min_replicas=2, max_replicas=4,
+            up_after=2, down_after=2, cooldown_evals=0,
+        )
+        stubs["r1"].queue.depth = 0  # now everyone idle: down pressure
+        assert scaler.evaluate() is None
+        assert scaler.evaluate() == "down"
+        assert len(fleet.replicas) == 2
+        assert busy in fleet.replicas, "the busy replica was retired"
+        # floor respected forever after
+        for _ in range(10):
+            scaler.evaluate()
+        assert len(fleet.replicas) == 2
+    finally:
+        fleet.stop(drain=False)
+    after = default_registry().snapshot()
+    assert _fleet_delta(
+        before, after,
+        'kindel_fleet_scale_events_total{direction="down"}',
+    ) == 1
+
+
+def test_autoscaler_hysteresis_square_wave_does_not_flap():
+    """The pinned no-flapping claim: a square-wave load (alternating
+    pressured/idle evaluations) produces NO scale events — consecutive
+    runs never accumulate — and even a slow square wave is bounded by
+    the cooldown to at most one event per window."""
+    fleet, stubs = _scale_fleet()
+    try:
+        scaler = FleetAutoscaler(
+            fleet, min_replicas=1, max_replicas=4,
+            up_after=2, down_after=2, cooldown_evals=3,
+        )
+        events = []
+        # fast square wave: period 2 evaluations
+        for i in range(20):
+            if i % 2 == 0:
+                fleet.router.sheds += 1  # pressured edge
+            ev = scaler.evaluate()
+            if ev:
+                events.append(ev)
+        assert events == [], f"fast square wave flapped: {events}"
+        assert len(fleet.replicas) == 2
+        # slow square wave (4 pressured, 4 idle, repeated): tracking a
+        # genuinely slow load IS the job, but hysteresis bounds it to
+        # at most ONE action per half-period, strictly alternating —
+        # never a spawn/retire churn inside one edge
+        events = []
+        for cycle in range(3):
+            for half in range(2):
+                half_events = []
+                for i in range(4):
+                    if half == 0:
+                        fleet.router.sheds += 1
+                    ev = scaler.evaluate()
+                    if ev:
+                        half_events.append(ev)
+                assert len(half_events) <= 1, (
+                    f"multiple actions in one half-period: {half_events}"
+                )
+                events.extend(half_events)
+        assert all(
+            a != b for a, b in zip(events, events[1:])
+        ), f"same-direction churn: {events}"
+        assert 1 <= len(fleet.replicas) <= 4
+    finally:
+        fleet.stop(drain=False)
+
+
+def test_fleet_watermark_sheds_feed_the_counter():
+    before = default_registry().snapshot()
+    fleet, stubs = _scale_fleet(fleet_watermark=1)
+    try:
+        for s in stubs.values():
+            s.queue.depth = 2
+        with pytest.raises(AdmissionError):
+            fleet.submit(b"over")
+        assert fleet.router.sheds >= 1
+    finally:
+        fleet.stop(drain=False)
+    after = default_registry().snapshot()
+    assert _fleet_delta(
+        before, after, "kindel_fleet_watermark_sheds_total"
+    ) >= 1
+
+
+# ----------------------------------------------------- process replicas
+
+
+def _names_seqs(records) -> list:
+    return [(r.name, r.sequence) for r in records]
+
+
+@pytest.mark.parametrize("payload_kind", ["bytes", "path"])
+def test_process_replica_serves_byte_identical(tmp_path, payload_kind):
+    """One spawned replica process, driven through the full contract:
+    byte-identical consensus over the wire for both payload kinds."""
+    from kindel_tpu.fleet.procreplica import ProcessFleetService
+    from kindel_tpu.workloads import bam_to_consensus
+    from tests.test_serve import make_sam
+
+    sam = make_sam(tmp_path / "proc.sam", seed=91)
+    want = _names_seqs(bam_to_consensus(str(sam)).consensuses)
+    payload = sam.read_bytes() if payload_kind == "bytes" else str(sam)
+    with ProcessFleetService(
+        replicas=1,
+        service_config={"max_wait_s": 0.01, "decode_workers": 2},
+        probe_interval_s=0.05,
+    ) as fleet:
+        got = _names_seqs(fleet.request(payload, timeout=120).consensuses)
+        assert got == want
+        health = fleet.healthz()
+        assert health["status"] == "ok"
+        # the wire carried the remote health document, aot provenance
+        # included (the §15 store is what makes respawns warm)
+        (doc,) = [d["healthz"] for d in health["replicas"].values()]
+        assert "aot" in doc and "est_wait_s" in doc
+
+
+def test_process_replica_dedupes_lost_response(tmp_path):
+    """The lost-response guarantee ACROSS a real process boundary: the
+    response to an applied request is dropped on the wire, the
+    resubmission dedupes in the child (applied once — /v1/rpc carries
+    the child-side count back), and the caller sees one byte-identical
+    settle."""
+    from kindel_tpu.fleet.procreplica import ProcessFleetService
+    from kindel_tpu.workloads import bam_to_consensus
+    from tests.test_serve import make_sam
+
+    sam = make_sam(tmp_path / "dedup.sam", seed=23)
+    want = _names_seqs(bam_to_consensus(str(sam)).consensuses)
+    with ProcessFleetService(
+        replicas=1,
+        service_config={"max_wait_s": 0.01, "decode_workers": 2},
+        probe_interval_s=0.05,
+    ) as fleet:
+        baseline = fleet.rpc_stats()
+        plan = rfaults.activate(
+            FaultPlan.parse("rpc.call:drop_response:times=1")
+        )
+        fut = fleet.submit(sam.read_bytes())
+        res = fut.result(timeout=120)
+        rfaults.deactivate()
+        assert plan.fired == {("rpc.call", "drop_response"): 1}
+        assert _names_seqs(res.consensuses) == want
+        stats = fleet.rpc_stats()
+        # one request, one apply, one cache-served resubmission
+        assert stats["applied"] - baseline["applied"] == 1
+        assert stats["dedup_hits"] - baseline["dedup_hits"] == 1
+
+
+# ---------------------------------------------------------- the flagship
+
+
+def test_flagship_proc_fleet_chaos_sigkill_and_autoscale_drain():
+    """The flagship: 3 replica PROCESSES under injected network faults
+    (dropped responses, slow wire, garbage, a refused dial), one
+    replica SIGKILLed and another autoscale-drained mid-load. Every
+    admitted future settles exactly once, the FASTA sha256 equals a
+    single-replica in-process run, the killed slot is respawned as a
+    fresh process, and the fault ledger records exactly the injected
+    plan."""
+    from benchmarks.serve_load import run_load
+
+    # single-replica in-process reference: the byte-identity anchor
+    reference = run_load(clients=2, requests_per_client=3)
+    assert reference["errors"] == 0
+    assert reference["fasta_distinct"] == 1
+
+    plan = rfaults.activate(FaultPlan.parse(
+        "seed=11,"
+        "rpc.call:drop_response:times=2:after=1,"
+        "rpc.call:slow:times=2:delay=0.02,"
+        "rpc.call:garbage:times=1:after=4,"
+        "rpc.connect:refused:times=1"
+    ))
+    before = default_registry().snapshot()
+    killed: dict = {}
+
+    def chaos(svc):
+        time.sleep(0.2)
+        victim = svc.replica("r1")
+        killed["gen"] = victim.generation
+        svc.kill_replica("r1")
+        time.sleep(0.4)
+        # the autoscaler's scale-down path, forced deterministically:
+        # drain the lowest-occupancy replica and retire it
+        svc.scale_down()
+        killed["victim"] = victim
+        # hold the report until the killed slot's respawn completes —
+        # chaos is a joined load thread, so the final fleet state in
+        # the report is the steady state, not a mid-respawn snapshot
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            roster_states = {r.state for r in svc.roster()}
+            if roster_states == {"ok"}:
+                return
+            time.sleep(0.05)
+        raise AssertionError(
+            f"fleet never converged after chaos: "
+            f"{[(r.replica_id, r.state) for r in svc.roster()]}"
+        )
+
+    report = run_load(
+        clients=3, requests_per_client=3, procs=3,
+        probe_interval_s=0.02, chaos=chaos,
+    )
+    after = default_registry().snapshot()
+
+    # exactly once: every admitted request resolved, none errored,
+    # none duplicated
+    assert "chaos_errors" not in report, report.get("chaos_errors")
+    assert report["errors"] == 0
+    assert report["completed"] == report["requests"] == 9
+    # byte-identical to the in-process single-replica reference,
+    # across the wire, under faults, through a kill and a retire
+    assert report["fasta_distinct"] == 1
+    assert report["fasta_sha256"] == reference["fasta_sha256"]
+    # the injected network plan fired exactly as written (the refused
+    # dial is opportunistic — it needs a fresh connect after
+    # activation — but every response-path fault is deterministic)
+    assert plan.fired[("rpc.call", "drop_response")] == 2
+    assert plan.fired[("rpc.call", "slow")] == 2
+    assert plan.fired[("rpc.call", "garbage")] == 1
+    # dropped/garbled responses were resubmitted (the client-side retry
+    # counter lives in THIS process, so it is deterministic); whether a
+    # given resubmission hit the dedupe cache or failed over depends on
+    # which replica the chaos killed — the process-level dedupe
+    # guarantee is pinned deterministically in
+    # test_process_replica_dedupes_lost_response
+    assert report["rpc"]["retries"] >= 3
+    # the SIGKILL was detected and the process respawned
+    assert _fleet_delta(before, after, "kindel_fleet_evictions_total") >= 1
+    assert _fleet_delta(before, after, "kindel_fleet_respawns_total") >= 1
+    assert report["rpc"]["scale_events"]["down"] == 1
+    # the fleet ended at 2 live replicas (3 - retired), all ok, and the
+    # killed slot came back as a NEW process generation
+    assert killed["victim"].generation == killed["gen"] + 1
+    states = set(report["fleet"]["replicas"].values())
+    assert states == {"ok"}, report["fleet"]["replicas"]
+    assert len(report["fleet"]["replicas"]) == 2
