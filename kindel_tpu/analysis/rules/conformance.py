@@ -40,6 +40,7 @@ NON_TUNING_KNOBS = {
     "KINDEL_TPU_DENSE_STATS": "stats engine selection gate",
     "KINDEL_TPU_COMPACT_STATS": "stats engine selection gate",
     "KINDEL_TPU_COMPACT_WIRE": "compact wire-format gate",
+    "KINDEL_TPU_PAGED_DELTA": "paged donated-residency gate",
 }
 
 #: knobs documented in usage.md but read outside the package (bench

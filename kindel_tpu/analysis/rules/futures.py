@@ -28,8 +28,9 @@ from kindel_tpu.analysis.engine import Finding, rule
 from kindel_tpu.analysis.model import ProjectModel
 
 #: packages holding the settled-exactly-once contract (paged joined in
-#: PR 11: a launch tick owns its entries' futures until settle/recover)
-FUTURE_SCOPE = ("serve", "fleet", "paged")
+#: PR 11: a launch tick owns its entries' futures until settle/recover;
+#: emit in PR 13: emission decode runs inside the settle path)
+FUTURE_SCOPE = ("serve", "fleet", "paged", "emit")
 
 #: constructors whose result is (or owns) a fresh unsettled Future
 _CREATORS = {"Future", "ServeRequest"}

@@ -341,6 +341,120 @@ def ragged_pack_vectorized(model: ProjectModel):
     return findings, len(found)
 
 
+#: reviewed device→host download (and host-materialization) sites,
+#: keyed (module-path-under-package, function). A download is where
+#: transfer bytes get counted, where blocking on the device happens,
+#: and — on a tunneled link — where a round trip is paid; every one of
+#: these was reviewed when the rule landed (PR 13) and a NEW
+#: `np.asarray` / `jax.device_get` / `.block_until_ready()` in a
+#: jax-importing module must either live in one of these functions or
+#: be added here with the same review (is it counted? is it bounded?).
+DOWNLOAD_SITES = {
+    # AOT export parity check blocks on both executables by design
+    ("aot.py", "export_executable"),
+    # cohort wire download + realign CDR window fetches (d2h counted)
+    ("batch.py", "_assemble_outputs"),
+    ("batch.py", "_fetch"),
+    # the fused/compact/fast wire decoders + packed-arg host helpers
+    ("call_jax.py", "unpack_wire"),
+    ("call_jax.py", "unpack_depth_scalars"),
+    ("call_jax.py", "masks_from_wire"),
+    ("call_jax.py", "decode_fast"),
+    ("call_jax.py", "decode_compact"),
+    ("call_jax.py", "pack_kernel_args"),
+    ("call_jax.py", "__init__"),  # CallUnit host-array normalization
+    # tune's ragged geometry probe blocks on the launch deliberately
+    ("cli.py", "ragged_pass"),
+    # devingest downloads O(records) metadata planes (DESIGN.md §19)
+    ("devingest/__init__.py", "_expand_chunk"),
+    ("devingest/expand.py", "_np64"),
+    ("devingest/expand.py", "fam"),
+    ("devingest/expand.py", "cat"),
+    ("devingest/fields.py", "<module>"),
+    ("devingest/scan.py", "scan_records_device"),
+    # mesh construction / sharded gather paths materialize by contract
+    ("parallel/distributed.py", "make_global_mesh"),
+    ("parallel/mesh.py", "make_mesh"),
+    ("parallel/mesh.py", "sharded_call"),
+    ("parallel/mesh.py", "batched_sharded_call"),
+    ("parallel/product.py", "_host_global"),
+    # explicit *_host fetch helpers (named as downloads)
+    ("pileup_jax.py", "fetch_counts_host"),
+    ("stats_jax.py", "entropy_rows_host"),
+    ("stats_jax.py", "jeffreys_interval_host"),
+    ("pipeline.py", "_pipelined_consensus_impl"),
+    # ragged launch counts upload bytes; unpack is THE superbatch
+    # download site (whole-wire, emission prefix, per-segment windows)
+    ("ragged/kernel.py", "launch_ragged"),
+    ("ragged/unpack.py", "_fetch"),
+    ("ragged/unpack.py", "unpack_rows"),
+    ("ragged/unpack.py", "plane_for"),
+    # streamed accumulation uploads/downloads at its reduce boundary
+    ("streaming.py", "add_events"),
+    ("streaming.py", "host"),
+    ("workloads.py", "_jeffreys_ci"),
+    ("workloads.py", "plot_clips"),
+}
+
+
+@rule("download-confinement", min_sites=20)
+def download_confinement(model: ProjectModel):
+    """Device→host downloads only inside declared download sites: in
+    any jax-importing module, `np.asarray(...)`, `jax.device_get(...)`,
+    and `.block_until_ready()` must sit in a DOWNLOAD_SITES function.
+    An undeclared materialization is how transfer accounting goes
+    silently wrong (bench's `transfers` object under-reports) and how a
+    tunneled link grows an unbudgeted round trip — exactly the
+    regression class the emit tier (kindel_tpu.emit, DESIGN.md §22)
+    exists to eliminate."""
+    findings, declared = [], 0
+    for rel, mod in model.modules.items():
+        imports_jax = False
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                if any(
+                    a.name == "jax" or a.name.startswith("jax.")
+                    for a in node.names
+                ):
+                    imports_jax = True
+            elif isinstance(node, ast.ImportFrom):
+                if (node.module or "").split(".")[0] == "jax":
+                    imports_jax = True
+        if not imports_jax:
+            continue
+        sub_rel = "/".join(rel.split("/")[1:])
+        owners = _enclosing_functions(mod.tree)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            hit = None
+            if isinstance(f, ast.Attribute):
+                if f.attr == "device_get":
+                    hit = "jax.device_get"
+                elif f.attr == "asarray" and (
+                    isinstance(f.value, ast.Name)
+                    and f.value.id in ("np", "numpy")
+                ):
+                    hit = "np.asarray"
+                elif f.attr == "block_until_ready":
+                    hit = ".block_until_ready()"
+            if hit is None:
+                continue
+            owner = owners.get(node, "<module>")
+            if (sub_rel, owner) in DOWNLOAD_SITES:
+                declared += 1
+                continue
+            findings.append(Finding(
+                "download-confinement", "error", rel, node.lineno,
+                f"{hit} in {owner} is not a declared download site — "
+                "route the materialization through one (transfer bytes "
+                "counted, blocking bounded) or extend DOWNLOAD_SITES "
+                "with a review",
+            ))
+    return findings, declared
+
+
 #: handler calls that count as "the failure was handled, not swallowed"
 FAILURE_HANDLERS = {
     "_fail", "fail", "_settle", "set_exception", "record_failure",
@@ -360,12 +474,14 @@ SWALLOW_ALLOWLIST = {
 #: serve/resilience/fleet (original scope) plus ragged/parallel (the
 #: two other layers that sit on the admitted-request path), devingest
 #: (its oracle-fallback discipline uses TYPED excepts only; a broad
-#: swallow there would hide a device/host divergence), and paged (the
+#: swallow there would hide a device/host divergence), paged (the
 #: continuous-superbatching tier holds admitted futures AND page
-#: references — a swallowed failure leaks both)
+#: references — a swallowed failure leaks both), and emit (the
+#: device-rendered emission decode sits on the same admitted-request
+#: settle path as the classic wire decoders)
 SWALLOW_SCOPE = (
     "serve", "resilience", "fleet", "ragged", "parallel", "devingest",
-    "paged",
+    "paged", "emit",
 )
 
 

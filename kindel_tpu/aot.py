@@ -156,18 +156,20 @@ def _package_version() -> str:
 
 
 def cohort_sig(n_rows: int, shapes: tuple, length: int, realign: bool,
-               want_masks: bool) -> tuple:
+               want_masks: bool, emit: bool = False) -> tuple:
     """Static signature of one batched-cohort executable: the lane key
-    (pad shapes) + padded row count + the two compile-time switches."""
+    (pad shapes) + padded row count + the compile-time switches
+    (realign, masks wire, device-rendered emission — DESIGN.md §22)."""
     return ("cohort", int(n_rows), tuple(shapes), int(length),
-            bool(realign), bool(want_masks))
+            bool(realign), bool(want_masks), bool(emit))
 
 
 def fused_sig(pads: tuple, length: int, want_masks: bool,
-              c_pad: int | None) -> tuple:
+              c_pad: int | None, emit: bool = False) -> tuple:
     """Static signature of one fused single-sample executable
     (call_jax.fused_call_kernel_packed)."""
-    return ("fused", tuple(pads), int(length), bool(want_masks), c_pad)
+    return ("fused", tuple(pads), int(length), bool(want_masks), c_pad,
+            bool(emit))
 
 
 def store_digest(sig: tuple) -> str:
@@ -510,6 +512,7 @@ def cohort_sig_for(arrays, length: int, opts) -> tuple:
         int(arrays[0].shape[0]),
         tuple(int(a.shape[1]) for a in arrays if a.ndim == 2),
         length, bool(opts.realign), bool(opts.want_masks),
+        bool(opts.emit_device),
     )
 
 
@@ -540,7 +543,9 @@ def export_cohort(arrays, meta, opts, verify: bool = True) -> bool:
     )
     return export_executable(
         kernel, cohort_args(arrays, opts),
-        {"length": L, "want_masks": opts.want_masks}, sig, verify=verify,
+        {"length": L, "want_masks": opts.want_masks,
+         "emit": opts.emit_device},
+        sig, verify=verify,
     )
 
 
@@ -551,13 +556,15 @@ def load_cohort(arrays, meta, opts):
 
 
 def ragged_sig(class_key: tuple, want_masks: bool,
-               realign: bool = False) -> tuple:
+               realign: bool = False, emit: bool = False) -> tuple:
     """Static signature of one ragged superbatch executable: the page
     class's geometry key (kindel_tpu.ragged.pack.PageClass.key()) + the
-    wire variant + the realign (clip-channel) dimension. ONE executable
-    per (class, variant) serves every request shape the class admits —
-    that is the point of the ragged tier (DESIGN.md §16)."""
-    return ("ragged", tuple(class_key), bool(want_masks), bool(realign))
+    wire variant + the realign (clip-channel) and emit (device-rendered
+    emission, DESIGN.md §22) dimensions. ONE executable per (class,
+    variant) serves every request shape the class admits — that is the
+    point of the ragged tier (DESIGN.md §16)."""
+    return ("ragged", tuple(class_key), bool(want_masks), bool(realign),
+            bool(emit))
 
 
 def ragged_args(arrays, opts) -> tuple:
@@ -583,7 +590,8 @@ def export_ragged(arrays, page_class, opts, verify: bool = True) -> bool:
         use_pallas_segments,
     )
 
-    sig = ragged_sig(page_class.key(), opts.want_masks, opts.realign)
+    sig = ragged_sig(page_class.key(), opts.want_masks, opts.realign,
+                     opts.emit_device)
     return export_executable(
         ragged_call_kernel, ragged_args(arrays, opts),
         {
@@ -591,6 +599,7 @@ def export_ragged(arrays, page_class, opts, verify: bool = True) -> bool:
             "s_pad": page_class.s_pad,
             "want_masks": opts.want_masks,
             "realign": opts.realign,
+            "emit": opts.emit_device,
             "pallas_segments": use_pallas_segments(),
         },
         sig, verify=verify,
@@ -601,7 +610,8 @@ def load_ragged(page_class, opts):
     """Load (or fetch from the registry) the executable for one page
     class; None → caller runs the jit kernel."""
     return load_executable(
-        ragged_sig(page_class.key(), opts.want_masks, opts.realign)
+        ragged_sig(page_class.key(), opts.want_masks, opts.realign,
+                   opts.emit_device)
     )
 
 
@@ -642,7 +652,8 @@ def load_ingest_scan(data_pad: int):
 
 
 def export_fused(buf, pads: tuple, length: int, want_masks: bool,
-                 c_pad: int | None, verify: bool = True) -> bool:
+                 c_pad: int | None, verify: bool = True,
+                 emit: bool = False) -> bool:
     """AOT-export the fused single-sample kernel for one upload-buffer
     geometry (`kindel tune --export-aot` on the representative BAM)."""
     import jax.numpy as jnp
@@ -650,11 +661,11 @@ def export_fused(buf, pads: tuple, length: int, want_masks: bool,
     from kindel_tpu.call_jax import fused_call_kernel_packed
 
     o_pad, b_pad, nn_pad, d_pad, i_pad = pads
-    sig = fused_sig(pads, length, want_masks, c_pad)
+    sig = fused_sig(pads, length, want_masks, c_pad, emit)
     return export_executable(
         fused_call_kernel_packed, (jnp.asarray(buf),),
         dict(o_pad=o_pad, b_pad=b_pad, nn_pad=nn_pad, d_pad=d_pad,
              i_pad=i_pad, length=length, want_masks=want_masks,
-             c_pad=c_pad),
+             c_pad=c_pad, emit=emit),
         sig, verify=verify,
     )

@@ -74,12 +74,24 @@ class BatchOptions:
     #: through kindel_tpu.tune (env pin KINDEL_TPU_COHORT_BUDGET_MB, then
     #: the 512 MB default) at group-build time — never at trace time
     cohort_budget_mb: int | None = None
+    #: emission mode (DESIGN.md §22): "device" renders the final ASCII
+    #: base plane on the accelerator (kindel_tpu.emit; fast path only —
+    #: masks traffic needs the dense wire regardless); None = "host"
+    #: unless an entry point resolved the knob through kindel_tpu.tune
+    emit_mode: str | None = None
 
     @property
     def want_masks(self) -> bool:
         """Reports need change-site lists; change lists need the dense
         mask wire format. The 2-bit fast path can't carry either."""
         return self.build_reports or self.build_changes
+
+    @property
+    def emit_device(self) -> bool:
+        """Does this option set run the device-rendered emission wire?
+        Only the fast path can (the masks wire carries decisions the
+        emission plane deliberately collapses)."""
+        return self.emit_mode == "device" and not self.want_masks
 
 
 @dataclass
@@ -128,6 +140,7 @@ def batch_bam_to_results(
     build_reports: bool = True,
     build_changes: bool = True,
     num_workers: int = 8,
+    emit_mode: str | None = None,
 ) -> dict:
     """Cohort consensus with full per-sample results.
 
@@ -135,12 +148,15 @@ def batch_bam_to_results(
     in input order. References of different lengths are padded to the
     cohort maximum (positions past a sample's own reference produce zero
     counts and are sliced off)."""
+    from kindel_tpu import tune
+
     opts = BatchOptions(
         realign=realign, min_depth=min_depth, min_overlap=min_overlap,
         clip_decay_threshold=clip_decay_threshold, mask_ends=mask_ends,
         cdr_gap=cdr_gap, fix_clip_artifacts=fix_clip_artifacts,
         trim_ends=trim_ends, uppercase=uppercase,
         build_reports=build_reports, build_changes=build_changes,
+        emit_mode=tune.resolve_emit_mode(emit_mode)[0],
     )
     bam_paths = list(bam_paths)
     with ThreadPoolExecutor(max_workers=num_workers) as pool:
@@ -372,6 +388,7 @@ def launch_cohort_kernel(arrays, meta, opts: BatchOptions, sharding=None):
             )
             out = kernel(
                 *dev_arrays, length=L, want_masks=opts.want_masks,
+                emit=opts.emit_device,
             )
         if sp is not obs_trace.NOOP_SPAN:
             # span covers upload + async dispatch, not device completion
@@ -426,10 +443,12 @@ class _RowCdrFetcher(LazyCdrWindows):
     def _fetch(self, key: str, start: int) -> np.ndarray:
         arr = self._arrs[key]
         fetch = _fetch_row2d if arr.ndim == 3 else _fetch_row1d
-        return np.asarray(
+        win = np.asarray(
             fetch(arr, jnp.int32(self.row), jnp.int32(start),
                   chunk=self._chunk)
         )
+        obs_runtime.transfer_counters()[1].inc(int(win.nbytes))
+        return win
 
     def _empty(self, key: str) -> np.ndarray:
         return np.empty(
@@ -450,9 +469,11 @@ def _assemble_outputs(units, device_out, opts: BatchOptions, pool,
         wire, dense = out, None
     # ONE d2h transfer for the whole chunk's call wire
     wire = np.asarray(wire)
+    obs_runtime.transfer_counters()[1].inc(int(wire.nbytes))
     sizes = _wire_sizes(
         L_pad, d_pad, i_pad, opts.want_masks,
         extra_bitmasks=2 if opts.realign else 0,  # CDR trigger planes
+        emit=opts.emit_device,
     )
     offs = np.cumsum([0] + sizes)
 
@@ -475,7 +496,13 @@ def _assemble_outputs(units, device_out, opts: BatchOptions, pool,
                 flank_dedup=opts.fix_clip_artifacts,
                 min_depth=opts.min_depth,
             )
-        if opts.want_masks:
+        if opts.emit_device:
+            from kindel_tpu.emit import masks_from_emit_plane
+
+            masks = masks_from_emit_plane(
+                segs[0], segs[1], u.L, u.ins_pos
+            )
+        elif opts.want_masks:
             _emit, masks = masks_from_wire(
                 segs[0], (segs[1], segs[2], segs[3]), u.L
             )
@@ -617,8 +644,12 @@ def stream_bam_to_results(
     chunk k's batched kernel, host threads are already decoding chunk k+1,
     and chunk k-1's outputs are being spliced/yielded. Bounded memory:
     at most three chunks of units are alive at once."""
+    from kindel_tpu import tune
     from kindel_tpu.utils.progress import Progress
 
+    opt_kwargs.setdefault(
+        "emit_mode", tune.resolve_emit_mode(None)[0]
+    )
     opts = BatchOptions(**opt_kwargs)
     bam_paths = list(bam_paths)
     prog = Progress("cohort", total=len(bam_paths), unit="samples")

@@ -85,6 +85,7 @@ def _call_core(
     keep_dense: bool = False,
     c_pad: int | None = None,  # static: compact-covered wire width
     flags=None,  # traced int32 scalar: bit 0 = strict insertions
+    emit_ascii: bool = False,  # static: device-rendered ASCII emission
 ):
     """Reconstruct match events, scatter counts, call every position.
 
@@ -101,7 +102,7 @@ def _call_core(
     return _call_core_codes(
         op_r_start, op_off, base, del_pos, ins_pos, ins_cnt, n_events,
         min_depth, length, want_masks, valid_len, keep_dense, c_pad,
-        flags,
+        flags, emit_ascii,
     )
 
 
@@ -109,6 +110,7 @@ def _call_core_codes(
     op_r_start, op_off, base, del_pos, ins_pos, ins_cnt, n_events,
     min_depth, length: int, want_masks: bool, valid_len=None,
     keep_dense: bool = False, c_pad: int | None = None, flags=None,
+    emit_ascii: bool = False,
 ):
     """_call_core after base-code unpacking — entry point for upload
     formats that decode their own codes (the 2-bit + sparse-N packed
@@ -138,6 +140,7 @@ def _call_core_codes(
     out = _decide(
         weights, deletions, ins_totals, del_pos, ins_pos, min_depth,
         want_masks, valid_len, c_pad=c_pad, flags=flags,
+        emit_ascii=emit_ascii,
     )
     if keep_dense:
         return out + (weights, deletions)
@@ -146,7 +149,7 @@ def _call_core_codes(
 
 def _decide(weights, deletions, ins_totals, del_pos, ins_pos, min_depth,
             want_masks: bool, valid_len=None, c_pad: int | None = None,
-            flags=None):
+            flags=None, emit_ascii: bool = False):
     """Per-position call decisions + wire-format packing over count
     tensors — the second half of _call_core, shared with the streamed
     counts-input kernel (counts_call_kernel). del_pos/ins_pos feed the
@@ -155,7 +158,12 @@ def _decide(weights, deletions, ins_totals, del_pos, ins_pos, min_depth,
     true reference length when the position axis is padded to a batch
     maximum (kindel_tpu.batch). `flags` is a traced int32 scalar (no
     recompile per mode): bit 0 = strict insertions — see
-    call.compute_masks(strict_ins=...)."""
+    call.compute_masks(strict_ins=...). `emit_ascii` (static; fast path
+    only) renders the final per-position ASCII base plane on device —
+    byte 0 = deletion-skip, otherwise the exact character the host
+    assembler would emit — so the wire carries [plane L | ins_flags]
+    and the host decode shrinks to insertion-string splicing
+    (kindel_tpu.emit; DESIGN.md §22)."""
     length = weights.shape[0]
     acgt_depth = weights[:, :4].sum(axis=1)
     depth_next = jnp.concatenate([acgt_depth[1:], jnp.zeros(1, jnp.int32)])
@@ -181,6 +189,20 @@ def _decide(weights, deletions, ins_totals, del_pos, ins_pos, min_depth,
     if flags is not None:
         strict_ins = (flags & 1) != 0
         ins_mask &= ~(strict_ins & (floor == 0))
+
+    if emit_ascii:
+        # device-rendered emission: the SAME 0..5 codes the masks path
+        # packs (0 = deletion-skip; N covers low-depth AND ties), looked
+        # up straight to ASCII — kindel_tpu.emit rebuilds CallMasks from
+        # this plane plus the sparse insertion flags alone, and the rest
+        # of the fast-path wire (2-bit plane, exception/deletion flag
+        # bitmasks) never ships
+        emit_codes = jnp.where(
+            del_mask, 0, jnp.where(n_mask, N_CHANNELS, base_code)
+        )
+        plane = jnp.asarray(EMIT_ASCII)[emit_codes]
+        ins_flags = ins_mask[jnp.where(ins_pos < length, ins_pos, 0)]
+        return plane, (ins_flags,), dmin, dmax
 
     if want_masks:
         emit = jnp.where(
@@ -399,11 +421,12 @@ def _unpack_kernel_args(buf, o_pad: int, b_pad: int, nn_pad: int,
 @partial(
     jax.jit,
     static_argnames=("o_pad", "b_pad", "nn_pad", "d_pad", "i_pad",
-                     "length", "want_masks", "c_pad"),
+                     "length", "want_masks", "c_pad", "emit"),
 )
 def fused_call_kernel_packed(buf, *, o_pad: int, b_pad: int, nn_pad: int,
                              d_pad: int, i_pad: int, length: int,
-                             want_masks: bool, c_pad: int | None = None):
+                             want_masks: bool, c_pad: int | None = None,
+                             emit: bool = False):
     """Single-buffer-in, single-buffer-out fused call: unpack the
     uint8 upload (pack_kernel_args), run the call core, pack the wire.
     Result layout — masks path:
@@ -412,17 +435,19 @@ def fused_call_kernel_packed(buf, *, o_pad: int, b_pad: int, nn_pad: int,
     [plane ⌈L/4⌉ | exc ⌈L/8⌉ | del_flags ⌈D/8⌉ | ins_flags ⌈I/8⌉ | 8B]
     with D/I the padded sparse-event widths; compact path (c_pad set,
     the covered-position count bucketed):
-    [comp_plane C/4 | exc_cov C/8 | del_flags ⌈D/8⌉ | ins_flags ⌈I/8⌉ | 8B]
+    [comp_plane C/4 | exc_cov C/8 | del_flags ⌈D/8⌉ | ins_flags ⌈I/8⌉ | 8B];
+    emit path (--emit-mode device, kindel_tpu.emit):
+    [ascii L | ins_flags ⌈I/8⌉ | 8B]
     (_wire_sizes is the single source of truth for these offsets;
     unpack_wire decodes)."""
     return _call_from_packed_buf(
         buf, o_pad, b_pad, nn_pad, d_pad, i_pad, length, want_masks,
-        c_pad,
+        c_pad, emit,
     )
 
 
 def _call_from_packed_buf(buf, o_pad, b_pad, nn_pad, d_pad, i_pad,
-                          length, want_masks, c_pad):
+                          length, want_masks, c_pad, emit=False):
     """Traced body shared by the whole-buffer kernel above and the
     slab-sweep kernel below."""
     (op_r_start, op_off, base, del_pos, ins_pos, ins_cnt, n_events,
@@ -432,7 +457,7 @@ def _call_from_packed_buf(buf, o_pad, b_pad, nn_pad, d_pad, i_pad,
     main, parts, dmin, dmax = _call_core_codes(
         op_r_start, op_off, base, del_pos, ins_pos, ins_cnt, n_events,
         min_depth, length, want_masks, valid_len=valid_len, c_pad=c_pad,
-        flags=flags,
+        flags=flags, emit_ascii=emit,
     )
     return _pack_wire(main, parts, dmin, dmax)
 
@@ -458,13 +483,19 @@ def fused_call_kernel_slab(big_buf, offset, *, size: int, o_pad: int,
 
 
 def _wire_sizes(length: int, d_pad: int, i_pad: int, want_masks: bool,
-                extra_bitmasks: int = 0, c_pad: int | None = None):
+                extra_bitmasks: int = 0, c_pad: int | None = None,
+                emit: bool = False):
     """Byte sizes of each packed-wire segment, in producer order — the
     single source of truth for every decoder. extra_bitmasks appends
     that many ⌈L/8⌉ segments (the batched realign kernel's two CDR
-    trigger planes)."""
+    trigger planes). `emit` is the device-rendered emission variant:
+    one ASCII byte per position plus the sparse insertion flags
+    (kindel_tpu.emit decodes; deletion skips are 0 bytes IN the plane,
+    so no exception/deletion-flag segments ship)."""
     l8 = -(-length // 8)
-    if want_masks:
+    if emit:
+        sizes = [length, -(-i_pad // 8)]
+    elif want_masks:
         sizes = [-(-length // 2), l8, l8, l8]
     elif c_pad is not None:
         sizes = [c_pad // 4, c_pad // 8, -(-d_pad // 8), -(-i_pad // 8)]
@@ -474,13 +505,15 @@ def _wire_sizes(length: int, d_pad: int, i_pad: int, want_masks: bool,
 
 
 def unpack_wire(buf: np.ndarray, length: int, d_pad: int, i_pad: int,
-                want_masks: bool, c_pad: int | None = None):
+                want_masks: bool, c_pad: int | None = None,
+                emit: bool = False):
     """Split the packed wire buffer back into (main, parts, dmin, dmax).
     Bool flag segments come back bit-packed; decode_fast/masks_from_wire
     accept the packed forms via np.unpackbits below."""
     buf = np.asarray(buf)  # blocks on the device→host copy
     obs_runtime.transfer_counters()[1].inc(int(buf.nbytes))
-    sizes = _wire_sizes(length, d_pad, i_pad, want_masks, c_pad=c_pad)
+    sizes = _wire_sizes(length, d_pad, i_pad, want_masks, c_pad=c_pad,
+                        emit=emit)
     offs = np.cumsum([0] + sizes)
     segs = [buf[offs[i]: offs[i + 1]] for i in range(len(sizes))]
     dmin, dmax = unpack_depth_scalars(buf[offs[-1]: offs[-1] + 8])
@@ -501,10 +534,11 @@ def counts_call_kernel(weights, deletions, ins_totals, min_depth,
     )
 
 
-@partial(jax.jit, static_argnames=("length", "want_masks"))
+@partial(jax.jit, static_argnames=("length", "want_masks", "emit"))
 def batched_call_kernel(op_r_start, op_off, base_packed, del_pos, ins_pos,
                         ins_cnt, n_events, ref_lens, min_depth, flags=0, *,
-                        length: int, want_masks: bool = False):
+                        length: int, want_masks: bool = False,
+                        emit: bool = False):
     """vmapped fused call over a batch of samples (leading axis B).
 
     Data-parallel by construction: under a mesh with the batch axis sharded
@@ -514,13 +548,14 @@ def batched_call_kernel(op_r_start, op_off, base_packed, del_pos, ins_pos,
     per-sample fast-path outputs (plane_packed, (exc_bits, del_flags,
     ins_flags), dmin, dmax), or the masks wire format when want_masks
     (emit codes + del/n/ins bitmasks — needed for per-sample change lists
-    and reports).
+    and reports), or — under `emit` (--emit-mode device) — the
+    device-rendered ASCII emission wire per row (kindel_tpu.emit).
     """
 
     def one(ors, oo, bp, dp, ip, ic, ne, rl):
         main, parts, dmin, dmax = _call_core(
             ors, oo, bp, dp, ip, ic, ne, min_depth, length, want_masks,
-            valid_len=rl, flags=flags,
+            valid_len=rl, flags=flags, emit_ascii=emit,
         )
         return _pack_wire(main, parts, dmin, dmax)
 
@@ -530,11 +565,12 @@ def batched_call_kernel(op_r_start, op_off, base_packed, del_pos, ins_pos,
     )
 
 
-@partial(jax.jit, static_argnames=("length", "want_masks"))
+@partial(jax.jit, static_argnames=("length", "want_masks", "emit"))
 def batched_realign_call_kernel(
     op_r_start, op_off, base_packed, del_pos, ins_pos, ins_cnt,
     n_events, ref_lens, csw_pos, csw_base, cew_pos, cew_base, min_depth,
     flags=0, *, length: int, want_masks: bool = False,
+    emit: bool = False,
 ):
     """Batched call + on-device CDR trigger computation (cohort --realign).
 
@@ -551,7 +587,7 @@ def batched_realign_call_kernel(
     def one_full(ors, oo, bp, dp, ip, ic, ne, rl, cswp, cswb, cewp, cewb):
         out = _call_core(
             ors, oo, bp, dp, ip, ic, ne, min_depth, length, want_masks,
-            valid_len=rl, keep_dense=True, flags=flags,
+            valid_len=rl, keep_dense=True, flags=flags, emit_ascii=emit,
         )
         (main, parts, dmin, dmax), (weights, deletions) = out[:4], out[4:]
 
@@ -823,13 +859,16 @@ class CallUnit:
 
 
 def device_call(ev: EventSet, rid: int, min_depth: int = 1,
-                want_masks: bool = True, flags: int = 0):
+                want_masks: bool = True, flags: int = 0,
+                emit: bool = False):
     """Run the fused kernel for one reference.
 
     Returns (emit_codes, masks, depth_min, depth_max). With want_masks,
     emit_codes is uint8[L] (0=skip, 1..5=ATGCN) and masks carries the
     dense decision masks; on the fast path emit_codes is None and masks
-    is rebuilt from the 2-bit wire format (see decode_fast)."""
+    is rebuilt from the 2-bit wire format (see decode_fast), or — under
+    `emit` (--emit-mode device) — from the device-rendered ASCII plane
+    (kindel_tpu.emit)."""
     from kindel_tpu import aot
 
     u = CallUnit(ev, rid)
@@ -837,29 +876,38 @@ def device_call(ev: EventSet, rid: int, min_depth: int = 1,
     up, (o_pad, b_pad, nn_pad, d_pad, i_pad) = pack_kernel_args(
         u, min_depth, flags=flags
     )
+    emit = emit and not want_masks
     c_pad = None
     covered_idx = None
-    if not want_masks and _use_compact_wire():
+    if not want_masks and not emit and _use_compact_wire():
         covered_idx = covered_index(u.op_r_start, u.op_lens())
         c_pad = _compact_bucket(len(covered_idx))
     pads = (o_pad, b_pad, nn_pad, d_pad, i_pad)
     up_dev = jnp.asarray(up)
     # AOT registry first (kindel tune --export-aot pre-baked this host);
     # a miss or a rejected call runs the jit kernel — identical output
-    buf = aot.call(aot.fused_sig(pads, L, want_masks, c_pad), (up_dev,))
+    buf = aot.call(
+        aot.fused_sig(pads, L, want_masks, c_pad, emit), (up_dev,)
+    )
     if buf is None:
         buf = fused_call_kernel_packed(
             up_dev, o_pad=o_pad, b_pad=b_pad, nn_pad=nn_pad,
             d_pad=d_pad, i_pad=i_pad, length=L, want_masks=want_masks,
-            c_pad=c_pad,
+            c_pad=c_pad, emit=emit,
         )
     main_out, parts, dmin, dmax = unpack_wire(
-        buf, L, d_pad, i_pad, want_masks, c_pad=c_pad
+        buf, L, d_pad, i_pad, want_masks, c_pad=c_pad, emit=emit
     )
 
     if want_masks:
-        emit, masks = masks_from_wire(main_out, parts, L)
-        return emit, masks, dmin, dmax
+        emit_codes, masks = masks_from_wire(main_out, parts, L)
+        return emit_codes, masks, dmin, dmax
+
+    if emit:
+        from kindel_tpu.emit import masks_from_emit_plane
+
+        masks = masks_from_emit_plane(main_out, parts[0], L, ip)
+        return None, masks, dmin, dmax
 
     exc_bits, del_bits, ins_bits = parts
     if covered_idx is not None:
@@ -905,9 +953,14 @@ def call_consensus_fused(
         traced = sp is not obs_trace.NOOP_SPAN
         if traced:
             sp.set_attribute(ref=ev.ref_names[rid], L=int(ev.ref_lens[rid]))
+        emit = False
         if not build_changes:
             from kindel_tpu import tune
 
+            emit_mode, emit_src = tune.resolve_emit_mode(
+                getattr(tuning, "emit_mode", None)
+            )
+            emit = emit_mode == "device"
             max_contig = int(ev.ref_lens[rid])
             n_slabs, _src = tune.resolve_slabs(
                 explicit=getattr(tuning, "n_slabs", None),
@@ -917,8 +970,13 @@ def call_consensus_fused(
             # tiny contigs: slabbing buys nothing below ~64k positions a slab
             n_slabs = max(1, min(n_slabs, tune.slab_clamp(max_contig)))
             if traced:
-                sp.set_attribute(n_slabs=n_slabs, slab_source=_src)
-            if n_slabs > 1:
+                sp.set_attribute(n_slabs=n_slabs, slab_source=_src,
+                                 emit_mode=emit_mode, emit_source=emit_src)
+            # device emission replaces the slab sweep on this path: the
+            # ASCII plane IS the output, so there is no wire+decode work
+            # left for the pipeline to overlap (the tune probe picks the
+            # faster of the two per host)
+            if n_slabs > 1 and not emit:
                 from kindel_tpu.pipeline import pipelined_consensus
 
                 return pipelined_consensus(
@@ -928,7 +986,7 @@ def call_consensus_fused(
                 )
         _emit, masks, dmin, dmax = device_call(
             ev, rid, min_depth, want_masks=build_changes,
-            flags=1 if strict_ins else 0,
+            flags=1 if strict_ins else 0, emit=emit,
         )
         ins_calls = {}
         if masks.ins_mask.any():

@@ -151,6 +151,16 @@ def _consensus_parser(sub):
              "$KINDEL_TPU_INGEST_MODE > tune store > host order; "
              "`kindel tune --ingest-mode-budget-s` measures a winner)",
     )
+    p.add_argument(
+        "--emit-mode", choices=["host", "device"], default=None,
+        help="where the final per-position base plane renders: 'host' "
+             "decodes the packed call wire (the oracle), 'device' "
+             "renders the ASCII emission plane on the accelerator and "
+             "ships only it + sparse insertion flags — byte-identical "
+             "output (explicit > $KINDEL_TPU_EMIT_MODE > tune store > "
+             "host; `kindel tune --emit-mode-budget-s` measures a "
+             "winner). Applies to the fast (no-changes) path",
+    )
     _add_backend(p)
 
 
@@ -166,12 +176,13 @@ def cmd_consensus(args) -> int:
         args.slabs is not None
         or args.ingest_workers is not None
         or args.ingest_mode is not None
+        or args.emit_mode is not None
     ):
         from kindel_tpu.tune import TuningConfig
 
         tuning = TuningConfig(
             n_slabs=args.slabs, ingest_workers=args.ingest_workers,
-            ingest_mode=args.ingest_mode,
+            ingest_mode=args.ingest_mode, emit_mode=args.emit_mode,
         )
     try:
         res = workloads.bam_to_consensus(
@@ -488,6 +499,15 @@ def _serve_parser(sub):
              "$KINDEL_TPU_INGEST_MODE > tune store > host)",
     )
     p.add_argument(
+        "--emit-mode", choices=["host", "device"], default=None,
+        help="where the final per-position base plane renders: 'host' "
+             "wire decode or the device-rendered ASCII plane "
+             "(kindel_tpu.emit — byte-identical; ragged/paged "
+             "extraction then downloads O(consensus length) per "
+             "request). Explicit > $KINDEL_TPU_EMIT_MODE > tune store "
+             "> host",
+    )
+    p.add_argument(
         "--replicas", type=int, default=1, metavar="N",
         help="run N supervised in-process replicas behind a failover "
              "router (kindel_tpu.fleet): rendezvous-hash placement, "
@@ -580,6 +600,7 @@ def cmd_serve(args) -> int:
         or args.batch_mode is not None
         or args.ragged_classes is not None
         or args.ingest_mode is not None
+        or args.emit_mode is not None
     ):
         from kindel_tpu.tune import TuningConfig
 
@@ -588,6 +609,7 @@ def cmd_serve(args) -> int:
             batch_mode=args.batch_mode,
             ragged_classes=args.ragged_classes,
             ingest_mode=args.ingest_mode,
+            emit_mode=args.emit_mode,
         )
     service_kwargs = dict(
         tuning=tuning,
@@ -646,6 +668,7 @@ def cmd_serve(args) -> int:
                     "batch_mode": args.batch_mode,
                     "ragged_classes": args.ragged_classes,
                     "ingest_mode": args.ingest_mode,
+                    "emit_mode": args.emit_mode,
                 }
             service = ProcessFleetService(
                 service_config=config,
@@ -751,6 +774,14 @@ def _tune_parser(sub):
              "persists host-keyed so `kindel serve --batch-mode "
              "ragged|paged` starts with measured geometry. 0 (default) "
              "skips it",
+    )
+    p.add_argument(
+        "--emit-mode-budget-s", type=float, default=0.0,
+        help="wall budget for the emission-mode sweep (one no-changes "
+             "consensus pass per mode: host wire decode vs the "
+             "device-rendered ASCII plane, kindel_tpu.emit); the winner "
+             "persists host-keyed so every fast-path entry point starts "
+             "in the measured mode. 0 (default) skips it",
     )
     p.add_argument(
         "--dry-run", action="store_true",
@@ -887,6 +918,37 @@ def cmd_tune(args) -> int:
                     "bam_path": str(args.bam_path),
                 },
             )
+    # emission-mode sweep (kindel_tpu.emit): one no-changes consensus
+    # pass per mode, mode explicit (no env mutation); the winner
+    # persists host-keyed so the serve fast path and the cohort API
+    # start in the measured mode
+    emit_chosen, emit_timings, emit_persisted = None, {}, False
+    if args.emit_mode_budget_s > 0:
+        def emit_pass(mode: str) -> float:
+            t = _time.perf_counter()
+            for rid in ev.present_ref_ids:
+                res, _dmin, _dmax = call_consensus_fused(
+                    ev, rid, build_changes=False,
+                    tuning=tune.TuningConfig(emit_mode=mode),
+                )
+                assert len(res.sequence) > 0
+            return _time.perf_counter() - t
+
+        emit_chosen, emit_timings = tune.search_emit_mode(
+            emit_pass, budget_s=args.emit_mode_budget_s
+        )
+        if not args.dry_run and emit_timings:
+            emit_persisted = tune.record(
+                tune.emit_store_key(),
+                {
+                    "emit_mode": emit_chosen,
+                    "mode_timings_s": {
+                        k: round(v, 4) for k, v in emit_timings.items()
+                        if v != float("inf")
+                    },
+                    "bam_path": str(args.bam_path),
+                },
+            )
     # page-class geometry sweep (kindel_tpu.ragged): pack this BAM's
     # units into each candidate class set, time one superbatch launch,
     # persist the winning spec host-keyed
@@ -969,6 +1031,13 @@ def cmd_tune(args) -> int:
             if v != float("inf")
         }
         doc["ingest_mode_persisted"] = mode_persisted
+    if emit_chosen is not None:
+        doc["emit_mode"] = emit_chosen
+        doc["emit_mode_timings_s"] = {
+            k: round(v, 4) for k, v in emit_timings.items()
+            if v != float("inf")
+        }
+        doc["emit_mode_persisted"] = emit_persisted
     if ragged_chosen is not None:
         doc["ragged_classes"] = ragged_chosen
         doc["ragged_timings_s"] = {
@@ -1004,9 +1073,18 @@ def _export_aot(bam_path: str, ev, dry_run: bool = False) -> dict:
                 "note": "tune store disabled (KINDEL_TPU_TUNE_CACHE=off)"}
     if dry_run:
         return {"enabled": True, "note": "skipped (--dry-run)"}
+    # BOTH emission variants pre-bake (the emit keying dimension of
+    # cohort_sig/fused_sig/ragged_sig): zero-compile replica startup
+    # must cover --emit-mode host AND device, so flipping the knob on a
+    # warm fleet never compiles
     shapes = serve_warmup.warm_shapes(
-        BatchOptions(), payloads=[bam_path]
+        BatchOptions(emit_mode="host"), payloads=[bam_path]
     )
+    shapes.update({
+        f"{label}:emit": t for label, t in serve_warmup.warm_shapes(
+            BatchOptions(emit_mode="device"), payloads=[bam_path]
+        ).items()
+    })
     fused = 0
     for rid in ev.present_ref_ids:
         u = CallUnit(ev, rid)
@@ -1017,6 +1095,8 @@ def _export_aot(bam_path: str, ev, dry_run: bool = False) -> dict:
                 len(covered_index(u.op_r_start, u.op_lens()))
             )
         if aot.export_fused(buf, pads, u.L, False, c_pad):
+            fused += 1
+        if aot.export_fused(buf, pads, u.L, False, None, emit=True):
             fused += 1
     # the ingest-mode dimension: under device ingest, pre-bake the
     # devingest record-scan executables for the chunk-buffer buckets a
@@ -1039,10 +1119,21 @@ def _export_aot(bam_path: str, ev, dry_run: bool = False) -> dict:
         for pad in sorted(pads):
             if aot.export_ingest_scan(pad):
                 ingest_exported += 1
+    ragged_shapes = {}
+    if _tune.resolve_batch_mode()[0] in ("ragged", "paged"):
+        from kindel_tpu.ragged import parse_classes
+
+        spec, _src = _tune.resolve_ragged_classes()
+        ragged_shapes = serve_warmup.warm_ragged(
+            BatchOptions(), parse_classes(spec)
+        )
     return {
         "enabled": True,
         "cohort_shapes": {
             label: t.get("source") for label, t in shapes.items()
+        },
+        "ragged_shapes": {
+            label: t.get("source") for label, t in ragged_shapes.items()
         },
         "fused_exported": fused,
         "ingest_scan_exported": ingest_exported,

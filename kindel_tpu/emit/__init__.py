@@ -1,0 +1,39 @@
+"""kindel_tpu.emit — device-rendered consensus emission (DESIGN.md §22).
+
+Closing the wire at the output end: on the classic fast path the device
+ships a 2-bit ACGT plane plus exception/deletion/insertion flag
+bitmasks, and the host reconstructs per-position decisions
+(`call_jax.decode_fast`) before splicing the final sequence. Under
+``--emit-mode device`` the argmax/threshold decision code that already
+runs on device *renders the final per-position ASCII base plane there*
+— byte 0 for a deletion skip, ``N`` for low coverage and ties,
+``A``/``T``/``G``/``C`` otherwise, exactly the characters
+`call.assemble` would emit — and the wire carries only that plane plus
+the sparse insertion flags. Host work shrinks to insertion-string
+splicing and FASTA headers/line-wrap.
+
+The decode here is deliberately thin: `masks_from_emit_plane` rebuilds
+a `CallMasks` whose `base_char` IS the device plane (``del_mask`` is
+the zero bytes, ``n_mask`` is already folded into the plane) and hands
+it to the SAME `call.assemble` the host oracle runs — so byte-identity
+with ``--emit-mode host`` follows from the device rendering the same
+0..5 emission codes the masks wire packs (`call_jax._decide` shares the
+code between both paths), not from a parallel reimplementation.
+
+Why this is a transfer win where it matters: the emission plane is one
+byte per *slot*, so a ragged superbatch downloads only its payload
+prefix and a paged launch tick fetches only the retiring segments'
+slices (`ragged.unpack`) — d2h per request becomes O(consensus length)
+instead of O(page grid) wire planes. On the dense lanes/cohort path the
+plane is larger than the packed 2-bit wire, which is exactly why the
+knob resolves per host through `kindel_tpu.tune`
+(``kindel tune --emit-mode-budget-s`` measures both) and defaults to
+the host oracle.
+"""
+
+from kindel_tpu.emit.decode import (
+    emit_plane_wire_bytes,
+    masks_from_emit_plane,
+)
+
+__all__ = ["masks_from_emit_plane", "emit_plane_wire_bytes"]

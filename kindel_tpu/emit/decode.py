@@ -1,0 +1,53 @@
+"""Host decode of the device-rendered emission wire (see package doc).
+
+jax-free on purpose: everything here runs on host threads after the
+download already happened at a declared download site (call_jax.
+unpack_wire, ragged.unpack, batch._assemble_outputs) — the
+download-confinement lint (kindel_tpu.analysis) holds this module to
+the same discipline as io/.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from kindel_tpu.call import CallMasks
+
+
+def emit_plane_wire_bytes(length: int, i_pad: int) -> int:
+    """Bytes one unit's emission wire carries (plane + packed insertion
+    flags) — the per-request d2h cost the bench's `transfers` object
+    compares against the wire-plane formats."""
+    return int(length) + -(-int(i_pad) // 8)
+
+
+def masks_from_emit_plane(plane: np.ndarray, ins_flag_bits: np.ndarray,
+                          L: int, ins_pos: np.ndarray) -> CallMasks:
+    """Rebuild assembler inputs from the device-rendered ASCII plane:
+    `base_char` is the plane verbatim (the device already resolved
+    argmax/tie/low-coverage to the final character), deletion skips are
+    its zero bytes, and the insertion mask gathers from the bit-packed
+    flags at the (host-known) sparse insertion positions — the same
+    sparse-gather contract as `call_jax.decode_fast`. `n_mask` stays
+    empty: the plane already carries N where the host path would have
+    folded it in."""
+    plane = np.asarray(plane)
+    if plane.shape[0] < L:
+        # a short plane must fail loudly, same contract as decode_fast —
+        # silent truncation would emit a shorter consensus, not an error
+        raise ValueError(
+            f"emission plane too short for L={L}: {plane.shape[0]} bytes"
+        )
+    base_char = plane[:L]
+    ins_flags = np.unpackbits(
+        np.asarray(ins_flag_bits)
+    )[: len(ins_pos)].astype(bool)
+    ins_mask = np.zeros(L, dtype=bool)
+    if len(ins_pos):
+        ins_mask[ins_pos[(ins_pos < L) & ins_flags]] = True
+    return CallMasks(
+        base_char=base_char,
+        del_mask=base_char == 0,
+        n_mask=np.zeros(L, dtype=bool),
+        ins_mask=ins_mask,
+    )

@@ -146,6 +146,24 @@ def transfer_counters(registry=None):
     )
 
 
+_EMIT_INFO = None
+
+
+def emit_mode_info(registry=None):
+    """The resolved emission-mode Info metric (kindel_tpu.emit,
+    DESIGN.md §22) — cached on the default registry like the transfer
+    counters; the serve service and bench both stamp it."""
+    global _EMIT_INFO
+    if registry is None:
+        if _EMIT_INFO is None:
+            _EMIT_INFO = emit_mode_info(default_registry())
+        return _EMIT_INFO
+    return registry.info(
+        "kindel_emit_mode",
+        "resolved emission mode (host|device) and where it came from",
+    )
+
+
 _INGEST: "_IngestCounters | None" = None
 
 

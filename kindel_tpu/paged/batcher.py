@@ -34,6 +34,8 @@ from __future__ import annotations
 from collections import deque
 from dataclasses import dataclass, field
 
+import numpy as np
+
 from kindel_tpu.obs import trace as obs_trace
 from kindel_tpu.ragged import pack as rpack
 from kindel_tpu.ragged.batcher import _fallback_counter
@@ -166,13 +168,23 @@ class PagedBatcher(MicroBatcher):
         key = (okey, cls.key())
         lane = self._lanes_paged.get(key)
         if lane is None:
-            lane = self._lanes_paged[key] = _PooledLane(
-                opts, PagePool(
-                    cls, clock=self._clock, page_slots=min(
-                        self.page_slots, cls.n_slots
-                    ),
-                )
+            pool = PagePool(
+                cls, clock=self._clock, page_slots=min(
+                    self.page_slots, cls.n_slots
+                ),
             )
+            from kindel_tpu.paged.residency import (
+                DeviceResidency,
+                use_delta_residency,
+            )
+
+            if use_delta_residency():
+                res = DeviceResidency(
+                    cls, pool.page_slots, bool(opts.realign)
+                )
+                if res.supported:
+                    pool.residency = res
+            lane = self._lanes_paged[key] = _PooledLane(opts, pool)
         return lane
 
     def _admit_locked(self, lane: _PooledLane, req, units,
@@ -383,6 +395,36 @@ class PagedBatcher(MicroBatcher):
             page_class=flush.lane.pool.page_class.name
         ).inc()
         return arrays, table, row_of
+
+    def dispatch_tick(self, flush: PagedFlush):
+        """Launch one tick over the flush's resident pool. With active
+        device residency (kindel_tpu.paged.residency) the dispatch runs
+        UNDER the batcher lock over the persistent donated arrays —
+        zero per-tick upload, and no admission patch can interleave
+        between snapshot and dispatch; otherwise the classic host
+        re-assembly path (snapshot_for_launch + launch_ragged) runs,
+        byte-identically. Returns (out, table, row_of)."""
+        from kindel_tpu.ragged.kernel import launch_ragged
+
+        with self._cond:
+            pool = flush.lane.pool
+            res = pool.residency
+            if res is not None and res.active:
+                units, table, row_of = res.table(pool)
+                out = res.launch(flush.opts)
+                frac = pool.pages_in_use / pool.n_pages
+                m = paged_metrics()
+                m["residency"].observe(frac)
+                m["launches"].labels(
+                    page_class=pool.page_class.name
+                ).inc()
+                return out, table, row_of
+        arrays, table, row_of = self.snapshot_for_launch(flush)
+        paged_metrics()["launch_h2d"].inc(
+            sum(int(np.asarray(a).nbytes) for a in arrays)
+        )
+        out = launch_ragged(arrays, flush.page_class, flush.opts)
+        return out, table, row_of
 
     # ------------------------------------------------------------ retirement
 

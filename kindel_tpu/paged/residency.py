@@ -1,0 +1,514 @@
+"""Donated device residency: the paged pool's kernel inputs live ON the
+device and admissions upload only their own delta.
+
+Before this module every launch tick re-assembled the WHOLE resident
+set's kernel arguments on the host (`PagePool.assemble` →
+`ragged.pack_superbatch`) and re-uploaded them — slot placement was
+persistent but the h2d wire paid full freight per tick even when one
+amplicon joined a seven-segment pool. Here the flat stream arrays
+(op spans, packed base codes, deletion/insertion events, the segment
+table, and the realign clip channels) are allocated ONCE as device
+buffers and updated in place by a donated `dynamic_update_slice`
+admission kernel: per-tick h2d is proportional to newly-admitted
+segments only, and the launch dispatches over the already-resident
+arrays with zero upload (PAPERS.md "Ragged Paged Attention" — per-page
+delta updates over persistent paged state).
+
+Layout invariants (what makes in-place deltas *correct*):
+
+  * every stream extent is tied to the segment's page run via per-page
+    quotas (``opp`` spans, ``epp`` events, … per page), so stream
+    extents are ordered exactly like page runs and the kernel's
+    rank-based span→event and slot→segment attributions (sorted-offset
+    cumsum tricks) stay valid under arbitrary admit/retire order;
+  * a free page's span slots carry ``op_r_start = PAD_POS`` with
+    ``op_off`` = that page's event-extent start, so every hole event
+    attributes to a PAD span and scatter-drops — the admission patch
+    and the retirement clear both maintain this coverage;
+  * one pad span per extent is always reserved (quota check), so a
+    segment's unused event tail can never attribute to its last real
+    span and scatter past its own positions.
+
+The jit/AOT launch signature is untouched — the SAME
+`ragged_call_kernel` executable (page-geometry-only `aot.ragged_sig`
+keying, PR 6 zero-compile warmup) runs over the persistent arrays; only
+the tiny patch/clear kernels here are new, and they are keyed by
+run-page-count like the dynamic-slice fetch kernels, not tracked
+compile-cache entries.
+
+Donation: the state tuple is donated to the patch/clear kernels off-CPU
+(in-place buffer reuse; device program order serializes patches against
+in-flight launches). On the CPU backend — where donation is unsupported
+and a copy is a memcpy — the kernels run un-donated, byte-identically.
+
+Every mutation here happens under the owning PagedBatcher's condition
+lock (the same serialization contract PagePool documents), including
+the launch dispatch itself — so a patch can never interleave between a
+tick's snapshot and its dispatch.
+
+Fallback, not failure: geometry whose caps do not divide into per-page
+quotas (`supports_delta`), a segment whose streams overflow its run's
+quota, or a patch kernel error all mark the residency stale — launches
+fall back to the classic host re-assembly path until the pool next
+empties, and output stays byte-identical throughout
+(``KINDEL_TPU_PAGED_DELTA=0`` forces the fallback everywhere).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+from kindel_tpu.utils.jax_cache import ensure_compilation_cache
+
+ensure_compilation_cache()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kindel_tpu.obs import runtime as obs_runtime
+from kindel_tpu.ragged.pack import PAD_POS, SegmentTable
+from kindel_tpu.resilience import policy as rpolicy
+
+from kindel_tpu.paged.state import paged_metrics
+
+
+def use_delta_residency() -> bool:
+    """Gate of the donated-residency path: KINDEL_TPU_PAGED_DELTA=1/0
+    overrides; default on (the fallback to host re-assembly is
+    byte-identical, so the gate exists as an escape hatch and a test
+    pin, not a correctness switch)."""
+    import os
+
+    override = os.environ.get("KINDEL_TPU_PAGED_DELTA")
+    if override is not None:
+        return override not in ("0", "")
+    return True
+
+
+def quotas_for(page_class, page_slots: int):
+    """Per-page stream quotas (spans, events, dels, inss, clips) when
+    the class's caps divide evenly over its pages — None when they do
+    not (non-pow2 lengths, span quota below one per page, or a grid
+    large enough for the PAD_POS+delta scatter arithmetic to wrap):
+    those geometries run the classic full-upload path."""
+    n_pages = page_class.n_slots // page_slots
+    caps = (page_class.o_cap, page_class.e_cap, page_class.d_cap,
+            page_class.i_cap, page_class.c_cap)
+    if any(c % n_pages for c in caps):
+        return None
+    opp, epp, dpp, ipp, cpp = (c // n_pages for c in caps)
+    if opp < 1 or epp % 2:
+        return None
+    # hole events compute PAD_POS + (k - extent_start) before the
+    # drop; the wrapped flat index must stay out of scatter range
+    if 20 * page_class.n_slots >= 2**30:
+        return None
+    return opp, epp, dpp, ipp, cpp
+
+
+@partial(jax.jit, static_argnames=("sizes",))
+def _patch_state(state, patch, offs, *, sizes):
+    return _patch_impl(state, patch, offs, sizes)
+
+
+@partial(jax.jit, static_argnames=("sizes",), donate_argnums=(0,))
+def _patch_state_donated(state, patch, offs, *, sizes):
+    return _patch_impl(state, patch, offs, sizes)
+
+
+def _i32(seg):
+    return jax.lax.bitcast_convert_type(seg.reshape(-1, 4), jnp.int32)
+
+
+def _patch_impl(state, patch, offs, sizes):
+    """Write one admitted segment's full stream extents (real data +
+    PAD tail) plus the refreshed segment table into the persistent
+    arrays. `patch` is ONE uint8 upload (the pack_kernel_args idiom —
+    a tunneled link pays a round trip per array); `offs` is
+    int32[5] = (span, event-byte, del, ins, clip) extent starts."""
+    po, pb, pd, pi, pc, s_pad = sizes
+    realign = len(state) > 8
+    cut = np.cumsum(
+        [0, 4 * po, 4 * po, pb, 4 * pd, 4 * pi, 4 * pi]
+        + ([4 * pc] * 4 if realign else [])
+        + [8 * s_pad]
+    )
+    segs = [patch[cut[i]: cut[i + 1]] for i in range(len(cut) - 1)]
+    upd = jax.lax.dynamic_update_slice
+    out = [
+        upd(state[0], _i32(segs[0]), (offs[0],)),
+        upd(state[1], _i32(segs[1]), (offs[0],)),
+        upd(state[2], segs[2], (offs[1],)),
+        upd(state[3], _i32(segs[3]), (offs[2],)),
+        upd(state[4], _i32(segs[4]), (offs[3],)),
+        upd(state[5], _i32(segs[5]), (offs[3],)),
+    ]
+    i = 6
+    if realign:
+        out += [
+            upd(state[6], _i32(segs[6]), (offs[4],)),
+            upd(state[7], _i32(segs[7]), (offs[4],)),
+            upd(state[8], _i32(segs[8]), (offs[4],)),
+            upd(state[9], _i32(segs[9]), (offs[4],)),
+        ]
+        i = 10
+    tab = _i32(segs[i])
+    out.append(tab[:s_pad])
+    out.append(tab[s_pad:])
+    return tuple(out)
+
+
+@partial(jax.jit, static_argnames=("sizes", "quota"))
+def _clear_state(state, tab_patch, offs, *, sizes, quota):
+    return _clear_impl(state, tab_patch, offs, sizes, quota)
+
+
+@partial(jax.jit, static_argnames=("sizes", "quota"), donate_argnums=(0,))
+def _clear_state_donated(state, tab_patch, offs, *, sizes, quota):
+    return _clear_impl(state, tab_patch, offs, sizes, quota)
+
+
+def _clear_impl(state, tab_patch, offs, sizes, quota):
+    """Retirement: restore the free-page coverage over one segment's
+    extents (PAD spans whose op_off points at each page's event-extent
+    start — see module doc) and install the refreshed segment table.
+    No stream upload at all: the constants materialize on device, only
+    the tiny table patch crosses the link."""
+    po, pb, pd, pi, pc, s_pad = sizes
+    opp, epp = quota
+    realign = len(state) > 8
+    upd = jax.lax.dynamic_update_slice
+    k = jnp.arange(po, dtype=jnp.int32)
+    cover = ((offs[0] + k) // opp) * epp
+    out = [
+        upd(state[0], jnp.full((po,), PAD_POS, jnp.int32), (offs[0],)),
+        upd(state[1], cover, (offs[0],)),
+        state[2],  # stale base codes scatter-drop via the PAD spans
+        upd(state[3], jnp.full((pd,), PAD_POS, jnp.int32), (offs[2],)),
+        upd(state[4], jnp.full((pi,), PAD_POS, jnp.int32), (offs[3],)),
+        upd(state[5], jnp.zeros((pi,), jnp.int32), (offs[3],)),
+    ]
+    if realign:
+        pad_c = jnp.full((pc,), PAD_POS, jnp.int32)
+        zero_c = jnp.zeros((pc,), jnp.int32)
+        out += [
+            upd(state[6], pad_c, (offs[4],)),
+            upd(state[7], zero_c, (offs[4],)),
+            upd(state[8], pad_c, (offs[4],)),
+            upd(state[9], zero_c, (offs[4],)),
+        ]
+    tab = _i32(tab_patch)
+    out.append(tab[:s_pad])
+    out.append(tab[s_pad:])
+    return tuple(out)
+
+
+class DeviceResidency:
+    """Persistent device-side kernel inputs of ONE PagePool (see module
+    doc). All methods run under the owning batcher's condition lock."""
+
+    def __init__(self, page_class, page_slots: int, realign: bool):
+        self.page_class = page_class
+        self.page_slots = page_slots
+        self.realign = realign
+        self.quotas = quotas_for(page_class, page_slots)
+        self._state: tuple | None = None
+        self._stale = False
+        self._broken = False
+        self._overflow: set[int] = set()
+
+    # ------------------------------------------------------------ status
+
+    @property
+    def supported(self) -> bool:
+        return self.quotas is not None
+
+    @property
+    def active(self) -> bool:
+        """Can the next launch run over the persistent arrays? False
+        while any overflow segment is resident or after a patch error —
+        launches then fall back to classic host re-assembly,
+        byte-identically."""
+        return (
+            self.supported
+            and self._state is not None
+            and not self._stale
+            and not self._broken
+            and not self._overflow
+        )
+
+    # ----------------------------------------------------------- extents
+
+    def _extents(self, seg):
+        opp, epp, dpp, ipp, cpp = self.quotas
+        p0, n = seg.page0, seg.n_pages
+        return {
+            "span": (p0 * opp, n * opp),
+            "ev": (p0 * epp, n * epp),
+            "del": (p0 * dpp, n * dpp),
+            "ins": (p0 * ipp, n * ipp),
+            "clip": (p0 * cpp, n * cpp),
+        }
+
+    def fits(self, seg, unit) -> bool:
+        """Does the segment's stream footprint fit its run's quotas?
+        (One pad span is always reserved so an unused event tail can
+        never attribute to the last real span.)"""
+        if not self.supported:
+            return False
+        ext = self._extents(seg)
+        csw = getattr(unit, "csw_pos", None)
+        cew = getattr(unit, "cew_pos", None)
+        return (
+            len(unit.op_r_start) <= ext["span"][1] - 1
+            and unit.n_events <= ext["ev"][1]
+            and len(unit.del_pos) <= ext["del"][1]
+            and len(unit.ins_pos) <= ext["ins"][1]
+            and (csw is None or len(csw) <= ext["clip"][1])
+            and (cew is None or len(cew) <= ext["clip"][1])
+        )
+
+    # ------------------------------------------------------------- state
+
+    def _counters(self):
+        m = paged_metrics()
+        return obs_runtime.transfer_counters()[0], m["admit_h2d"]
+
+    def ensure_state(self) -> None:
+        if self._state is not None or not self.supported:
+            return
+        c = self.page_class
+        opp, epp, dpp, ipp, cpp = self.quotas
+        n_pages = c.n_slots // self.page_slots
+        op_off0 = (
+            (np.arange(c.o_cap, dtype=np.int32) // opp) * epp
+        ).astype(np.int32)
+        host = [
+            np.full(c.o_cap, PAD_POS, np.int32),
+            op_off0,
+            np.zeros(c.b_cap, np.uint8),
+            np.full(c.d_cap, PAD_POS, np.int32),
+            np.full(c.i_cap, PAD_POS, np.int32),
+            np.zeros(c.i_cap, np.int32),
+        ]
+        if self.realign:
+            host += [
+                np.full(c.c_cap, PAD_POS, np.int32),
+                np.zeros(c.c_cap, np.int32),
+                np.full(c.c_cap, PAD_POS, np.int32),
+                np.zeros(c.c_cap, np.int32),
+            ]
+        host += [
+            np.full(c.s_pad, PAD_POS, np.int32),
+            np.zeros(c.s_pad, np.int32),
+        ]
+        h2d, admit_h2d = self._counters()
+        h2d.inc(sum(int(a.nbytes) for a in host))
+        self._state = tuple(jnp.asarray(a) for a in host)
+        self._stale = False
+        self._overflow.clear()
+
+    def _sizes_for(self, seg) -> tuple:
+        ext = self._extents(seg)
+        return (
+            ext["span"][1], ext["ev"][1] // 2, ext["del"][1],
+            ext["ins"][1], ext["clip"][1], self.page_class.s_pad,
+        )
+
+    def _table_patch(self, pool) -> np.ndarray:
+        """The refreshed segment table as one int32→uint8 patch —
+        seg_starts then seg_lens, sorted by page run (the order the
+        kernel's rank attribution requires)."""
+        c = self.page_class
+        starts = np.full(c.s_pad, PAD_POS, np.int32)
+        lens = np.zeros(c.s_pad, np.int32)
+        segs = sorted(pool.segments.values(), key=lambda s: s.page0)
+        for i, s in enumerate(segs):
+            starts[i] = s.slot_start
+            lens[i] = s.unit.L
+        return np.concatenate([starts, lens]).view(np.uint8)
+
+    def _run_kernel(self, fn, fn_donated, *args, **kw):
+        donated = jax.default_backend() != "cpu"
+        return (fn_donated if donated else fn)(*args, **kw)
+
+    def admit(self, pool, seg, unit) -> None:
+        """Upload one admitted segment's extent patch (the delta — the
+        only per-admission h2d) and install it in place."""
+        if self._broken or not self.supported:
+            return
+        if not self.fits(seg, unit):
+            self._overflow.add(seg.seg_id)
+            self._stale = True
+            return
+        if self._stale:
+            return  # stale until the pool empties; launches run classic
+        self.ensure_state()
+        try:
+            sizes = self._sizes_for(seg)
+            po, pb, pd, pi, pc, s_pad = sizes
+            ext = self._extents(seg)
+            s0 = seg.slot_start
+            ev0 = ext["ev"][0]
+
+            def pad32(arr, size, fill):
+                out = np.full(size, fill, np.int32)
+                out[: len(arr)] = arr
+                return out.view(np.uint8)
+
+            fill_off = np.int32(ev0 + unit.n_events)
+            parts = [
+                pad32(unit.op_r_start + s0, po, PAD_POS),
+                pad32(unit.op_off + ev0, po, fill_off),
+                np.pad(unit.base_packed,
+                       (0, pb - len(unit.base_packed))),
+                pad32(unit.del_pos + s0, pd, PAD_POS),
+                pad32(unit.ins_pos + s0, pi, PAD_POS),
+                pad32(unit.ins_cnt, pi, 0),
+            ]
+            if self.realign:
+                for pos_attr, base_attr in (
+                    ("csw_pos", "csw_base"), ("cew_pos", "cew_base")
+                ):
+                    p = getattr(unit, pos_attr, None)
+                    b = getattr(unit, base_attr, None)
+                    if p is None:
+                        p = np.empty(0, np.int32)
+                        b = np.empty(0, np.int32)
+                    keep = p < unit.L  # see pack_superbatch clip_pair
+                    parts.append(pad32(p[keep] + s0, pc, PAD_POS))
+                    parts.append(pad32(b[keep], pc, 0))
+            parts.append(self._table_patch(pool))
+            patch = np.concatenate(parts)
+            offs = jnp.asarray(
+                [ext["span"][0], ext["ev"][0] // 2, ext["del"][0],
+                 ext["ins"][0], ext["clip"][0]],
+                jnp.int32,
+            )
+            h2d, admit_h2d = self._counters()
+            h2d.inc(int(patch.nbytes))
+            admit_h2d.inc(int(patch.nbytes))
+            self._state = self._run_kernel(
+                _patch_state, _patch_state_donated,
+                self._state, jnp.asarray(patch), offs, sizes=sizes,
+            )
+        except Exception:  # noqa: BLE001 — isolation boundary
+            # a failing patch must never fail the admission (the ledger
+            # is already updated); the pool falls back to classic
+            # re-assembly launches until it empties
+            self._broken = True
+            rpolicy.record_degrade("paged.residency", "patch_failed", 1)
+
+    def clear(self, pool, seg) -> None:
+        """Retirement: restore free-page coverage over the segment's
+        extents (no stream upload — only the refreshed table patch
+        crosses the link)."""
+        self._overflow.discard(seg.seg_id)
+        if self._broken or not self.supported:
+            return
+        if self._stale:
+            if not pool.segments and not self._overflow:
+                # pool drained: next admission starts from a fresh,
+                # consistent device image
+                self._state = None
+                self._stale = False
+            return
+        if self._state is None:
+            return
+        try:
+            sizes = self._sizes_for(seg)
+            ext = self._extents(seg)
+            offs = jnp.asarray(
+                [ext["span"][0], ext["ev"][0] // 2, ext["del"][0],
+                 ext["ins"][0], ext["clip"][0]],
+                jnp.int32,
+            )
+            tab = self._table_patch(pool)
+            h2d, admit_h2d = self._counters()
+            h2d.inc(int(tab.nbytes))
+            self._state = self._run_kernel(
+                _clear_state, _clear_state_donated,
+                self._state, jnp.asarray(tab), offs, sizes=sizes,
+                quota=(self.quotas[0], self.quotas[1]),
+            )
+        except Exception:  # noqa: BLE001 — isolation boundary
+            self._broken = True
+            rpolicy.record_degrade("paged.residency", "clear_failed", 1)
+
+    # ------------------------------------------------------------- launch
+
+    def table(self, pool):
+        """(units, SegmentTable, {seg_id: row}) over the CURRENT
+        resident set with EXTENT-based stream offsets — the extraction
+        coordinates of a persistent launch (`ragged.unpack` slices the
+        sparse flag planes by these; classic cumulative offsets belong
+        to `PagePool.assemble`'s re-packed uploads only)."""
+        opp, epp, dpp, ipp, cpp = self.quotas
+        segs = sorted(pool.segments.values(), key=lambda s: s.page0)
+        if not segs:
+            raise ValueError("an empty pool has nothing to launch")
+        units = [s.unit for s in segs]
+        n = len(units)
+
+        def col(get, dtype=np.int32):
+            return np.fromiter(
+                (get(s) for s in segs), np.int64, count=n
+            ).astype(dtype)
+
+        table = SegmentTable(
+            page_class=self.page_class,
+            entry_idx=np.zeros(n, np.int32),
+            seg_start=col(lambda s: s.slot_start),
+            seg_len=col(lambda s: s.unit.L),
+            ev_off=col(lambda s: s.page0 * epp),
+            ev_len=col(lambda s: s.unit.n_events),
+            del_off=col(lambda s: s.page0 * dpp),
+            del_len=col(lambda s: len(s.unit.del_pos)),
+            ins_off=col(lambda s: s.page0 * ipp),
+            ins_len=col(lambda s: len(s.unit.ins_pos)),
+        )
+        row_of = {s.seg_id: i for i, s in enumerate(segs)}
+        return units, table, row_of
+
+    def launch(self, opts):
+        """Dispatch the segment kernel over the persistent arrays —
+        zero upload beyond the two call scalars, same executable (and
+        `aot.ragged_sig` key) as every ragged/paged launch. The caller
+        holds the batcher lock, so no patch can interleave before the
+        dispatch is in device program order."""
+        from kindel_tpu import aot
+        from kindel_tpu.ragged.kernel import (
+            ragged_call_kernel,
+            use_pallas_segments,
+        )
+        from kindel_tpu.resilience import faults as rfaults
+
+        rfaults.hook("device.dispatch")
+        c = self.page_class
+        st = self._state
+        scalars = (
+            jnp.int32(opts.min_depth),
+            jnp.int32(1 if opts.fix_clip_artifacts else 0),
+        )
+        # arg order mirrors aot.ragged_args: 6 stream arrays + the
+        # segment table pair + n_events, scalars, then clip channels.
+        # n_events = e_cap: hole events are dropped by the PAD-span
+        # coverage, not the contiguous-tail mask (traced scalar — no
+        # recompile, no signature change)
+        dev = st[:6] + (st[-2], st[-1], jnp.int32(c.e_cap)) + scalars
+        if self.realign:
+            dev = dev + st[6:10]
+        out = aot.call(
+            aot.ragged_sig(c.key(), opts.want_masks, opts.realign,
+                           opts.emit_device),
+            dev,
+        )
+        if out is None:
+            out = ragged_call_kernel(
+                *dev, n_slots=c.n_slots, s_pad=c.s_pad,
+                want_masks=opts.want_masks, realign=opts.realign,
+                emit=opts.emit_device,
+                pallas_segments=use_pallas_segments(),
+            )
+        return out

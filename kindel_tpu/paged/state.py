@@ -94,6 +94,19 @@ def _paged_metrics():
             "request admissions deferred because the page pool was full "
             "(retried on retirement with a jittered wait hint)",
         ),
+        "admit_h2d": reg.counter(
+            "kindel_paged_admit_h2d_bytes_total",
+            "bytes uploaded by donated delta-admission patches (one "
+            "extent patch per newly-admitted segment plus the refreshed "
+            "segment table — the paged tier's ONLY per-tick h2d when "
+            "device residency is active)",
+        ),
+        "launch_h2d": reg.counter(
+            "kindel_paged_launch_h2d_bytes_total",
+            "bytes uploaded by classic full re-assembly paged launches "
+            "(the pre-delta path; ~0 while device residency serves the "
+            "pool)",
+        ),
     }
 
 
@@ -177,6 +190,10 @@ class PagePool:
     panel_index: dict = field(default_factory=dict)
     reclaimable: OrderedDict = field(default_factory=OrderedDict)
     totals: PoolCounters = field(default_factory=PoolCounters)
+    #: optional DeviceResidency (kindel_tpu.paged.residency): when set,
+    #: _place/_free mirror every ledger mutation into the persistent
+    #: device arrays (delta patch on admit, coverage clear on retire)
+    residency: object | None = None
     _next_id: int = 0
     _used: np.ndarray = None
 
@@ -253,6 +270,8 @@ class PagePool:
         self.totals.add(need)
         self.segments[seg.seg_id] = seg
         self.panel_index[seg.panel] = seg.seg_id
+        if self.residency is not None:
+            self.residency.admit(self, seg, unit)
         m = paged_metrics()
         m["pages_in_use"].set(self.pages_in_use)
         m["resident"].set(self.n_resident)
@@ -310,6 +329,8 @@ class PagePool:
             del self.panel_index[seg.panel]
         self._used[seg.page0: seg.page0 + seg.n_pages] = False
         self.totals.add(seg.need, sign=-1)
+        if self.residency is not None:
+            self.residency.clear(self, seg)
         m = paged_metrics()
         m["pages_in_use"].set(self.pages_in_use)
         m["resident"].set(self.n_resident)

@@ -149,13 +149,14 @@ def _segment_depth_pallas(acgt, slot_seg, in_ref, s_pad: int):
 @partial(
     jax.jit,
     static_argnames=("n_slots", "s_pad", "want_masks", "realign",
-                     "pallas_segments"),
+                     "emit", "pallas_segments"),
 )
 def ragged_call_kernel(op_r_start, op_off, base_packed, del_pos, ins_pos,
                        ins_cnt, seg_starts, seg_lens, n_events, min_depth,
                        flags=0, csw_pos=None, csw_base=None, cew_pos=None,
                        cew_base=None, *, n_slots: int, s_pad: int,
                        want_masks: bool = False, realign: bool = False,
+                       emit: bool = False,
                        pallas_segments: bool = False):
     """Scatter + call every packed segment of one superbatch; see the
     module docstring for the wire layout. Static only in the page-class
@@ -167,11 +168,17 @@ def ragged_call_kernel(op_r_start, op_off, base_packed, del_pos, ins_pos,
     2·csd > w+d+1 apply per slot), two trigger bitplanes join the wire,
     and the dense (weights, deletions, csw, cew) tensors are returned
     device-resident for the segment-windowed CDR fetches — the output
-    tuple mirrors `batched_realign_call_kernel`."""
+    tuple mirrors `batched_realign_call_kernel`.
+
+    Under `emit` (--emit-mode device, kindel_tpu.emit) the wire's call
+    segments are [ascii n_slots | ins_flags i_cap/8] — one rendered
+    byte per slot, so per-request extraction is a plain (dynamic-slice)
+    fetch of O(consensus length) instead of a whole-grid wire
+    download."""
     out = _call_core(
         op_r_start, op_off, base_packed, del_pos, ins_pos, ins_cnt,
         n_events, min_depth, n_slots, want_masks, keep_dense=True,
-        flags=flags,
+        flags=flags, emit_ascii=emit,
     )
     (main, parts, _dmin, _dmax), (weights, deletions) = out[:4], out[4:]
 
@@ -231,13 +238,17 @@ def ragged_call_kernel(op_r_start, op_off, base_packed, del_pos, ins_pos,
 
 
 def wire_sizes(page_class, want_masks: bool,
-               realign: bool = False) -> list[int]:
+               realign: bool = False, emit: bool = False) -> list[int]:
     """Byte sizes of the ragged wire's segments, in producer order —
     the single source of truth `unpack.py` slices by. Under `realign`
     two n_slots/8 trigger bitplanes ride between the call segments and
-    the per-segment depth scalars."""
+    the per-segment depth scalars; under `emit` the call segments are
+    the rendered ASCII plane + packed insertion flags
+    (kindel_tpu.emit)."""
     n = page_class.n_slots
-    if want_masks:
+    if emit:
+        sizes = [n, -(-page_class.i_cap // 8)]
+    elif want_masks:
         sizes = [n // 2, n // 8, n // 8, n // 8]
     else:
         sizes = [n // 4, n // 8, -(-page_class.d_cap // 8),
@@ -265,7 +276,7 @@ def launch_ragged(arrays, page_class, opts):
         dev = aot.ragged_args(arrays, opts)
         out = aot.call(
             aot.ragged_sig(page_class.key(), opts.want_masks,
-                           opts.realign),
+                           opts.realign, opts.emit_device),
             dev,
         )
         aot_hit = out is not None
@@ -273,12 +284,12 @@ def launch_ragged(arrays, page_class, opts):
             out = ragged_call_kernel(
                 *dev, n_slots=page_class.n_slots, s_pad=page_class.s_pad,
                 want_masks=opts.want_masks, realign=opts.realign,
-                pallas_segments=pallas,
+                emit=opts.emit_device, pallas_segments=pallas,
             )
         if sp is not obs_trace.NOOP_SPAN:
             sp.set_attribute(
                 page_class=page_class.label(), n_slots=page_class.n_slots,
                 h2d_bytes=h2d_bytes, aot=aot_hit, pallas=pallas,
-                realign=opts.realign,
+                realign=opts.realign, emit=opts.emit_device,
             )
     return out

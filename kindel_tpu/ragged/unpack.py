@@ -38,8 +38,10 @@ import numpy as np
 
 from kindel_tpu.call import _insertion_calls, assemble
 from kindel_tpu.call_jax import decode_fast, masks_from_wire
+from kindel_tpu.emit import masks_from_emit_plane
 from kindel_tpu.io.fasta import Sequence
 from kindel_tpu.obs import runtime as obs_runtime
+from kindel_tpu.pileup_jax import _bucket
 from kindel_tpu.ragged.kernel import wire_sizes
 from kindel_tpu.realign import LazyCdrWindows
 
@@ -75,9 +77,11 @@ class SegmentCdrFetcher(LazyCdrWindows):
     def _fetch(self, key: str, start: int) -> np.ndarray:
         arr = self._arrs[key]
         fetch = _fetch_flat2d if arr.ndim == 2 else _fetch_flat1d
-        return np.asarray(
+        win = np.asarray(
             fetch(arr, jnp.int32(self._base + start), chunk=self._chunk)
         )
+        obs_runtime.transfer_counters()[1].inc(int(win.nbytes))
+        return win
 
     def _empty(self, key: str) -> np.ndarray:
         return np.empty((0,) + self._arrs[key].shape[1:], np.int32)
@@ -89,22 +93,73 @@ def unpack_rows(out, table, row_units, opts, pool, paths=None) -> list:
     `unpack_superbatch`, returning the same (Sequence, changes|None,
     report|None) per pair, in pair order. `out` is launch_ragged's
     result: the wire buffer, or the (wire, weights, deletions, csw,
-    cew) tuple under realign."""
+    cew) tuple under realign.
+
+    With no pairs to read, NOTHING crosses the link: a paged tick whose
+    resident set is all cached panel segments (amplicon replays) must
+    not pay a whole-grid wire download for a result nobody extracts.
+
+    Under device emission (--emit-mode device, kindel_tpu.emit) the
+    ASCII plane downloads per segment (subset ticks) or as one payload
+    prefix (whole-superbatch unpack) plus the small sparse tail — d2h
+    is O(extracted consensus length), never the page grid's wire
+    planes."""
+    if not row_units:
+        return []
     if opts.realign:
         wire, *dense = out
     else:
         wire, dense = out, None
-    buf = np.asarray(wire)  # blocks on the device→host copy
-    obs_runtime.transfer_counters()[1].inc(int(buf.nbytes))
     cls = table.page_class
-    sizes = wire_sizes(cls, opts.want_masks, opts.realign)
+    emit = opts.emit_device
+    sizes = wire_sizes(cls, opts.want_masks, opts.realign, emit)
     offs = np.cumsum([0] + sizes)
-    segs = [buf[offs[k]: offs[k + 1]] for k in range(len(sizes))]
+    d2h = obs_runtime.transfer_counters()[1]
+    if emit:
+        n = cls.n_slots
+        # sparse tail (packed insertion flags [+ trigger planes] + the
+        # per-segment depth scalars) in ONE fetch; segs[0] (the plane)
+        # never downloads whole — plane_for below fetches windows
+        tail = np.asarray(wire[n:])
+        d2h.inc(int(tail.nbytes))
+        segs = [None] + [
+            tail[offs[k] - n: offs[k + 1] - n]
+            for k in range(1, len(sizes))
+        ]
+        subset = len(row_units) < table.n_segments
+        prefix = None
+        if not subset:
+            end = int((table.seg_start + table.seg_len).max())
+            chunk = min(_bucket(max(end, 8), 8), n)
+            prefix = np.asarray(
+                _fetch_flat1d(wire, jnp.int32(0), chunk=chunk)
+            )
+            d2h.inc(int(prefix.nbytes))
+
+        def plane_for(o: int, L: int) -> np.ndarray:
+            if prefix is not None:
+                return prefix[o: o + L]
+            # dynamic_slice clamps the start so the window always fits
+            # the grid — index the segment's bytes relative to the
+            # clamped origin
+            chunk = min(_bucket(max(L, 8), 8), n)
+            eff = min(o, n - chunk)
+            win = np.asarray(
+                _fetch_flat1d(wire, jnp.int32(eff), chunk=chunk)
+            )
+            d2h.inc(int(win.nbytes))
+            return win[o - eff: o - eff + L]
+    else:
+        buf = np.asarray(wire)  # blocks on the device→host copy
+        d2h.inc(int(buf.nbytes))
+        segs = [buf[offs[k]: offs[k + 1]] for k in range(len(sizes))]
     seg_dmin = np.frombuffer(segs[-2].tobytes(), np.int32)
     seg_dmax = np.frombuffer(segs[-1].tobytes(), np.int32)
     if opts.realign:
         trig_f_w, trig_r_w = segs[-4], segs[-3]
-    if opts.want_masks:
+    if emit:
+        ins_bits = np.unpackbits(segs[1])
+    elif opts.want_masks:
         emit_w, del_b, n_b, ins_b = segs[:4]
     else:
         plane_w, exc_w, del_f, ins_f = segs[:4]
@@ -136,7 +191,13 @@ def unpack_rows(out, table, row_units, opts, pool, paths=None) -> list:
                 flank_dedup=opts.fix_clip_artifacts,
                 min_depth=opts.min_depth,
             )
-        if opts.want_masks:
+        if emit:
+            i0, inn = int(table.ins_off[i]), int(table.ins_len[i])
+            masks = masks_from_emit_plane(
+                plane_for(o, L), np.packbits(ins_bits[i0: i0 + inn]),
+                L, u.ins_pos,
+            )
+        elif opts.want_masks:
             emit_s = emit_w[o // 2: o // 2 + -(-L // 2)]
             masks_s = tuple(
                 b[o // 8: o // 8 + -(-L // 8)] for b in (del_b, n_b, ins_b)

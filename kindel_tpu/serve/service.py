@@ -205,6 +205,19 @@ class ConsensusService:
             getattr(tuning, "ingest_mode", None)
         )
         self._m_tune_source.set(knob="ingest_mode", source=im_src)
+        # emission mode (DESIGN.md §22): where the final per-position
+        # base plane renders — host wire decode (the oracle) or the
+        # device-rendered ASCII plane (kindel_tpu.emit); byte-identical
+        # either way, stamped onto every request's options so lanes /
+        # superbatch kernels and the warmup all key on the same variant
+        em_explicit = self.default_opts.emit_mode
+        if em_explicit is None:
+            em_explicit = getattr(tuning, "emit_mode", None)
+        emit_mode, em_src = tune.resolve_emit_mode(em_explicit)
+        self.default_opts = replace(self.default_opts, emit_mode=emit_mode)
+        self.emit_mode = emit_mode
+        self._m_tune_source.set(knob="emit_mode", source=em_src)
+        obs_runtime.emit_mode_info().set(mode=emit_mode, source=em_src)
         # HTTP body bound (413 + Retry-After past it — serve/metrics.py):
         # explicit arg > tuning pin > KINDEL_TPU_MAX_BODY_MB > default
         self.max_body_mb, mb_src = tune.resolve_max_body_mb(
@@ -458,6 +471,9 @@ class ConsensusService:
             # dispatch path this replica runs, and (under ragged) the
             # page-class geometries its executables are warmed for
             "batch_mode": self.batch_mode,
+            # emission provenance (DESIGN.md §22): host wire decode or
+            # the device-rendered ASCII plane
+            "emit_mode": self.emit_mode,
         }
         if self._ragged_classes:
             doc["ragged"] = {

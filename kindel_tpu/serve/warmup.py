@@ -165,15 +165,25 @@ def warm_ragged(opts, classes) -> dict[str, dict]:
 
     obs_runtime.install()
     # every wire variant warms: the fast path, the masks path
-    # (build_changes/build_reports requests), and the realign variant —
+    # (build_changes/build_reports requests), the realign variant —
     # since the segment kernel learned the clip channels, realign
     # traffic rides superbatches too and must not compile post-startup
+    # — and BOTH emission modes (kindel_tpu.emit): a page class's
+    # geometry is fixed, so pre-baking the emit-variant executables
+    # here (and via `kindel tune --export-aot`) means flipping
+    # --emit-mode never compiles on a warm host
     base = replace(opts, realign=False)
     variants = (
-        ("", replace(base, build_changes=False, build_reports=False)),
+        ("", replace(base, build_changes=False, build_reports=False,
+                     emit_mode="host")),
         (":masks", replace(base, build_changes=True)),
         (":realign", replace(base, realign=True, build_changes=False,
-                             build_reports=False)),
+                             build_reports=False, emit_mode="host")),
+        (":emit", replace(base, build_changes=False, build_reports=False,
+                          emit_mode="device")),
+        (":realign-emit", replace(base, realign=True, build_changes=False,
+                                  build_reports=False,
+                                  emit_mode="device")),
     )
     units = decode_payload(_SYNTH_SAM, base)
     realign_units = decode_payload(

@@ -800,21 +800,24 @@ class ServeWorker:
             self._m_pending_rows.set(self.batcher.pending_rows)
 
     def _paged_run(self, flush):
-        """Snapshot + launch + extract one tick (retried as a unit by
-        the §13 retry policy; references release only on the final
-        outcome, so a retry re-reads a consistent resident set)."""
+        """Launch + extract one tick (retried as a unit by the §13
+        retry policy; references release only on the final outcome, so
+        a retry re-reads a consistent resident set). The launch itself
+        runs through the batcher (PagedBatcher.dispatch_tick): zero
+        per-tick upload over the persistent donated arrays when device
+        residency is active, classic snapshot+re-upload otherwise."""
         from kindel_tpu.paged.retire import extract_flush
-        from kindel_tpu.ragged.kernel import launch_ragged
 
         rfaults.hook("serve.flush")
-        arrays, table, row_of = self.batcher.snapshot_for_launch(flush)
         cls = flush.page_class
         with trace.span("paged.launch") as sp:
-            out = launch_ragged(arrays, cls, flush.opts)
+            out, table, row_of = self.batcher.dispatch_tick(flush)
             if sp is not trace.NOOP_SPAN:
+                delta = getattr(flush.lane.pool, "residency", None)
                 sp.set_attribute(
                     page_class=cls.label(), resident=table.n_segments,
                     tick_entries=len(flush.entries),
+                    delta_resident=bool(delta is not None and delta.active),
                 )
         payload, padded = _padding_counters()
         payload.inc(sum(u.L for _r, units in flush.entries for u in units))

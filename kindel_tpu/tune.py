@@ -86,6 +86,18 @@ LANE_COALESCE_DEFAULT = 4
 INGEST_MODE_DEFAULT = "host"
 INGEST_MODES = ("host", "device")
 
+#: emission mode: "host" = download the packed call wire and decode on
+#: host (decode_fast — the oracle), "device" = render the final
+#: per-position ASCII base plane on the accelerator and DMA only that
+#: plane + sparse insertion flags (kindel_tpu.emit — byte-identical
+#: output; ragged/paged extraction then downloads O(consensus length)
+#: per request instead of whole wire planes); the env pin is
+#: KINDEL_TPU_EMIT_MODE, `kindel tune --emit-mode-budget-s` persists a
+#: measured winner host-keyed. Only the fast (no-changes) path gates on
+#: it — masks traffic needs the dense decision wire regardless.
+EMIT_MODE_DEFAULT = "host"
+EMIT_MODES = ("host", "device")
+
 #: serve batching mode: "lanes" = the shape-keyed micro-batcher (one
 #: compiled kernel per lane shape), "ragged" = page-class superbatching
 #: (kindel_tpu.ragged — one compiled kernel per page class serves all
@@ -148,6 +160,7 @@ class TuningConfig:
     cohort_budget_mb: int | None = None
     ingest_workers: int | None = None
     ingest_mode: str | None = None
+    emit_mode: str | None = None
     lane_coalesce: int | None = None
     batch_mode: str | None = None
     ragged_classes: str | None = None
@@ -605,6 +618,69 @@ def search_ingest_mode(measure, budget_s: float = 30.0,
     usable = {k: v for k, v in timings.items() if v != float("inf")}
     if not usable:
         return INGEST_MODE_DEFAULT, timings
+    return min(usable, key=usable.get), timings
+
+
+def emit_store_key() -> str:
+    """Emission mode is a property of the host↔device link (how much a
+    downloaded byte costs vs a device-rendered one) — host-keyed like
+    the ingest knobs, backend included via the host fingerprint's
+    stability only; the probe measures the whole round trip."""
+    return "emit|" + host_fingerprint()
+
+
+def resolve_emit_mode(explicit: str | None = None) -> tuple[str, str]:
+    """The emission-mode knob (host wire decode vs the device-rendered
+    ASCII plane — byte-identical output, kindel_tpu.emit): explicit arg
+    > KINDEL_TPU_EMIT_MODE > host-keyed store > host default. A
+    malformed env/store value falls through to the default; an unknown
+    EXPLICIT mode is caller error and raises (same contract as
+    resolve_ingest_mode)."""
+    if explicit is not None:
+        mode = str(explicit).strip().lower()
+        if mode in EMIT_MODES:
+            return mode, "explicit"
+        raise ValueError(
+            f"unknown emit mode {explicit!r} (expected one of "
+            f"{'/'.join(EMIT_MODES)})"
+        )
+    env = os.environ.get("KINDEL_TPU_EMIT_MODE", "").strip().lower()
+    if env in EMIT_MODES:
+        return env, "env"
+    entry = lookup(emit_store_key())
+    if entry and entry.get("emit_mode") in EMIT_MODES:
+        return entry["emit_mode"], "cache"
+    return EMIT_MODE_DEFAULT, "default"
+
+
+def search_emit_mode(measure, budget_s: float = 30.0,
+                     clock=time.perf_counter):
+    """Measure host vs device emission on this host and pick the
+    faster: `measure(mode) -> wall seconds` receives the mode
+    EXPLICITLY (no env mutation — the shared search contract); a mode
+    whose probe raises scores unusable (inf) rather than failing the
+    sweep. `kindel tune --emit-mode-budget-s` persists the winner under
+    emit_store_key()."""
+    from kindel_tpu.obs import trace as obs_trace
+
+    timings: dict[str, float] = {}
+    t0 = clock()
+    for mode in EMIT_MODES:
+        with obs_trace.span("tune.emit_mode_probe") as sp:
+            try:
+                wall = measure(mode)
+            except Exception as exc:
+                wall = float("inf")
+                if sp is not obs_trace.NOOP_SPAN:
+                    sp.set_attribute(error=repr(exc))
+            if sp is not obs_trace.NOOP_SPAN:
+                sp.set_attribute(mode=mode, wall_s=round(wall, 4))
+        timings[mode] = wall
+        if clock() - t0 > budget_s:
+            break
+    usable = {k: v for k, v in timings.items() if v != float("inf")}
+    if not usable:
+        return EMIT_MODE_DEFAULT, timings
     return min(usable, key=usable.get), timings
 
 

@@ -1,0 +1,13 @@
+"""Known-bad: a created Future leaks on the emission decode's failure
+path (future-settlement, emit scope)."""
+
+from concurrent.futures import Future
+
+
+def emit_leaky(decode, plane):
+    fut = Future()
+    try:
+        fut.set_result(decode(plane))
+    except Exception:
+        pass  # waiter stranded forever
+    return None

@@ -1,0 +1,20 @@
+"""Known-bad: an undeclared device→host download (download-confinement)
+— a jax-importing module materializing a kernel result outside the
+declared download sites under-reports transfer bytes and hides a
+tunneled round trip."""
+
+import jax
+import numpy as np
+
+
+def undeclared_fetch(kernel, buf):
+    out = kernel(buf)
+    return np.asarray(out)  # downloads outside every declared site
+
+
+def undeclared_block(kernel, buf):
+    return kernel(buf).block_until_ready()
+
+
+def undeclared_get(out):
+    return jax.device_get(out)

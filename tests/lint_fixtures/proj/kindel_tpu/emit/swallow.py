@@ -1,0 +1,10 @@
+"""Known-bad: silent swallow in emit/ — the emission decode sits on the
+admitted-request settle path; a swallowed decode failure strands the
+request's future exactly like a swallowed wire-decode failure."""
+
+
+def decode_or_forget(decode, plane):
+    try:
+        return decode(plane)
+    except Exception:
+        return None

@@ -283,6 +283,27 @@ def test_fixture_scope_extension_hits_paged(fixture_results):
     assert purity and all("_page_slots" in f.message for f in purity)
 
 
+def test_fixture_scope_extension_hits_emit(fixture_results):
+    """The emit scope extension (PR 13 satellite): the device-rendered
+    emission tier is covered by the silent-swallow lint and the
+    future-settlement contract, and the new download-confinement rule
+    fires on an undeclared np.asarray/device_get/block_until_ready in
+    a jax-importing module — one known-bad fixture per rule scope."""
+    by_id = {r.spec.id: r for r in fixture_results}
+    assert any(
+        "emit/" in f.path for f in by_id["silent-swallow"].findings
+    )
+    assert any(
+        "emit/" in f.path for f in by_id["future-settlement"].findings
+    )
+    dl = [
+        f for f in by_id["download-confinement"].findings
+        if "emit/sneaky_download" in f.path
+    ]
+    # all three undeclared-materialization spellings fire
+    assert len(dl) == 3, dl
+
+
 def test_purity_fixture_needs_the_closure(fixture_results):
     """The chained fixture's jit body is clean — only the call-graph
     walk sees the env read two calls deep, which is exactly what the

@@ -1,0 +1,496 @@
+"""Device-rendered emission (kindel_tpu.emit) + donated paged residency
+(kindel_tpu.paged.residency) — the PR 13 parity and transfer harness.
+
+The contract: ``--emit-mode device`` and the paged tier's delta
+residency are invisible optimizations. FASTA bytes are identical to the
+host oracle across batch modes, worker counts, realign, trim/N-run/gap
+edges, and randomized fuzz; what changes is only WHERE the final base
+plane renders (device) and WHAT crosses the link (an extent patch per
+admission, O(consensus length) per extraction) — both pinned here by
+the transfer counters, not by prose.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from test_ingest import require_data  # shared golden-corpus gate
+from test_serve import make_sam
+
+from kindel_tpu.batch import BatchOptions, batch_bam_to_results
+from kindel_tpu.obs import runtime as obs_runtime
+from kindel_tpu.obs.metrics import default_registry
+from kindel_tpu.serve.queue import ServeRequest
+from kindel_tpu.serve.worker import decode_request
+
+WORKER_COUNTS = (1, 2, 8)
+
+
+@pytest.fixture(autouse=True)
+def _single_device(monkeypatch):
+    """conftest forces 8 fake CPU devices; the cohort API then shards
+    batch-leading arrays over a dp mesh, and the realign path's lazy
+    CDR window fetches against SHARDED dense tensors crawl on the
+    fake-device backend. The documented single-chip pin keeps these
+    parity tests about emission, not sharding."""
+    monkeypatch.setenv("KINDEL_TPU_FORCE_FUSED", "1")
+
+
+def _counter(name: str) -> float:
+    snap = default_registry().snapshot()
+    return sum(
+        float(v) for k, v in snap.items()
+        if (k == name or k.startswith(name + "{"))
+        and not isinstance(v, dict)
+    )
+
+
+def _fasta(results: dict) -> list:
+    return [
+        (str(p), s.name, s.sequence)
+        for p, res in results.items()
+        for s in res.consensuses
+    ]
+
+
+def _decode(payload, **opt_kwargs):
+    return decode_request(
+        ServeRequest(payload=payload, opts=BatchOptions(**opt_kwargs))
+    )
+
+
+@pytest.fixture(scope="module")
+def synth_sams(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("emit")
+    rng = np.random.default_rng(13)
+    return [
+        make_sam(
+            tmp / f"e{i}.sam", ref=f"eref{i}",
+            L=int(rng.integers(260, 2400)),
+            n_reads=int(rng.integers(8, 40)), seed=100 + i,
+        )
+        for i in range(5)
+    ]
+
+
+# ------------------------------------------------------- FASTA identity
+
+
+@pytest.mark.parametrize("workers", WORKER_COUNTS)
+def test_batch_identity_device_vs_host(synth_sams, workers):
+    want = _fasta(batch_bam_to_results(
+        synth_sams, build_reports=False, build_changes=False,
+        emit_mode="host", num_workers=workers,
+    ))
+    got = _fasta(batch_bam_to_results(
+        synth_sams, build_reports=False, build_changes=False,
+        emit_mode="device", num_workers=workers,
+    ))
+    assert got == want
+
+
+def test_batch_identity_realign_and_flags(synth_sams):
+    for kw in (
+        {"realign": True},
+        {"trim_ends": True, "uppercase": True},
+        {"realign": True, "trim_ends": True, "min_depth": 3},
+    ):
+        want = _fasta(batch_bam_to_results(
+            synth_sams, build_reports=False, build_changes=False,
+            emit_mode="host", **kw,
+        ))
+        got = _fasta(batch_bam_to_results(
+            synth_sams, build_reports=False, build_changes=False,
+            emit_mode="device", **kw,
+        ))
+        assert got == want, kw
+
+
+def test_masks_variant_ignores_emit_mode(synth_sams):
+    """Change lists need the dense masks wire, so the knob must gate
+    OFF there (opts.emit_device is False under want_masks) — output
+    including the change lists stays identical."""
+    want = batch_bam_to_results(
+        synth_sams[:2], build_changes=True, emit_mode="host",
+    )
+    got = batch_bam_to_results(
+        synth_sams[:2], build_changes=True, emit_mode="device",
+    )
+    assert _fasta(want) == _fasta(got)
+    for p in synth_sams[:2]:
+        assert want[p].refs_changes == got[p].refs_changes
+    assert not BatchOptions(
+        emit_mode="device", build_changes=True
+    ).emit_device
+    assert BatchOptions(emit_mode="device").emit_device
+
+
+@pytest.mark.parametrize(
+    "rel",
+    [
+        ("data_bwa_mem", "1.1.sub_test.bam"),
+        ("data_minimap2", "1.1.multi.bam"),
+    ],
+)
+def test_refsuite_identity(rel):
+    path = require_data(*rel)
+    want = _fasta(batch_bam_to_results(
+        [path], build_reports=False, build_changes=False,
+        emit_mode="host",
+    ))
+    got = _fasta(batch_bam_to_results(
+        [path], build_reports=False, build_changes=False,
+        emit_mode="device",
+    ))
+    assert got == want
+    # realign too (acceptance: sha-pinned identity including realign)
+    want = _fasta(batch_bam_to_results(
+        [path], build_reports=False, build_changes=False,
+        emit_mode="host", realign=True,
+    ))
+    got = _fasta(batch_bam_to_results(
+        [path], build_reports=False, build_changes=False,
+        emit_mode="device", realign=True,
+    ))
+    assert got == want
+
+
+def _edge_sam(dest, rng, L):
+    """A consensus full of the awkward cases the emission plane must
+    reproduce: uncovered interior runs (N), deletion-dominant spans at
+    both sequence edges (trim interacts with leading/trailing emission),
+    insertions adjacent to deletions, and tie positions."""
+    lines = ["@HD\tVN:1.6", f"@SQ\tSN:edge\tLN:{L}"]
+    n = 0
+
+    def read(pos, cigar, span):
+        nonlocal n
+        seq = "".join("ACGT"[b] for b in rng.integers(0, 4, size=span))
+        lines.append(
+            f"x{n}\t0\tedge\t{pos + 1}\t60\t{cigar}\t*\t0\t0\t{seq}\t*"
+        )
+        n += 1
+
+    # deletion-dominant right at position 0 and at the tail
+    for _ in range(3):
+        read(0, "4M6D26M", 30)
+        read(L - 40, "30M8D2M", 32)
+    # an interior island leaving uncovered (N) runs on both sides
+    island = int(rng.integers(L // 3, L // 2))
+    for _ in range(int(rng.integers(1, 4))):
+        read(island, "12M2I10M3D8M", 32)
+    # two overlapping reads engineered to tie at their overlap
+    read(island + 60, "20M", 20)
+    read(island + 60, "20M", 20)
+    dest.write_text("\n".join(lines) + "\n")
+    return dest
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3, 4])
+def test_fuzz_trim_n_run_gap_edges(tmp_path, seed):
+    rng = np.random.default_rng(seed)
+    sams = [
+        _edge_sam(tmp_path / f"f{seed}_{i}.sam", rng,
+                  int(rng.integers(160, 900)))
+        for i in range(3)
+    ]
+    for kw in ({}, {"trim_ends": True}, {"trim_ends": True,
+                                         "uppercase": True}):
+        want = _fasta(batch_bam_to_results(
+            sams, build_reports=False, build_changes=False,
+            emit_mode="host", **kw,
+        ))
+        got = _fasta(batch_bam_to_results(
+            sams, build_reports=False, build_changes=False,
+            emit_mode="device", **kw,
+        ))
+        assert got == want, (seed, kw)
+
+
+# ------------------------------------------------------- emission decode
+
+
+def test_emit_plane_short_raises():
+    from kindel_tpu.emit import masks_from_emit_plane
+
+    with pytest.raises(ValueError):
+        masks_from_emit_plane(
+            np.zeros(4, np.uint8), np.zeros(1, np.uint8), 10,
+            np.empty(0, np.int32),
+        )
+
+
+def test_emit_wire_bytes_helper():
+    from kindel_tpu.emit import emit_plane_wire_bytes
+
+    assert emit_plane_wire_bytes(100, 16) == 102
+
+
+# --------------------------------------------------------- knob plumbing
+
+
+def test_resolve_emit_mode_precedence(tmp_path, monkeypatch):
+    from kindel_tpu import tune
+
+    store = tmp_path / "tune.json"
+    monkeypatch.setenv("KINDEL_TPU_TUNE_CACHE", str(store))
+    monkeypatch.delenv("KINDEL_TPU_EMIT_MODE", raising=False)
+
+    assert tune.resolve_emit_mode() == ("host", "default")
+    assert tune.record(tune.emit_store_key(), {"emit_mode": "device"})
+    assert tune.resolve_emit_mode() == ("device", "cache")
+    monkeypatch.setenv("KINDEL_TPU_EMIT_MODE", "host")
+    assert tune.resolve_emit_mode() == ("host", "env")
+    assert tune.resolve_emit_mode("device") == ("device", "explicit")
+    # malformed env falls through (store next in line)
+    monkeypatch.setenv("KINDEL_TPU_EMIT_MODE", "banana")
+    assert tune.resolve_emit_mode() == ("device", "cache")
+    with pytest.raises(ValueError):
+        tune.resolve_emit_mode("banana")
+    # malformed store entry falls through to the default
+    assert tune.record(tune.emit_store_key(), {"emit_mode": "tpu9"})
+    monkeypatch.delenv("KINDEL_TPU_EMIT_MODE")
+    assert tune.resolve_emit_mode() == ("host", "default")
+
+
+def test_search_emit_mode_picks_faster_and_survives_probe_error():
+    from kindel_tpu import tune
+
+    chosen, timings = tune.search_emit_mode(
+        {"host": 3.0, "device": 1.1}.__getitem__, budget_s=100.0
+    )
+    assert chosen == "device" and set(timings) == {"host", "device"}
+
+    def half_broken(mode):
+        if mode == "device":
+            raise RuntimeError("no accelerator")
+        return 2.0
+
+    chosen, timings = tune.search_emit_mode(half_broken, budget_s=100.0)
+    assert chosen == "host"
+    assert timings["device"] == float("inf")
+
+
+def test_sig_emit_dimension():
+    from kindel_tpu import aot
+    from kindel_tpu.ragged import parse_classes
+
+    (cls,) = parse_classes("small:32x2048")
+    assert aot.ragged_sig(cls.key(), False, False, True) != aot.ragged_sig(
+        cls.key(), False, False, False
+    )
+    assert aot.fused_sig((1, 2, 3, 4, 5), 100, False, None, True) != (
+        aot.fused_sig((1, 2, 3, 4, 5), 100, False, None, False)
+    )
+    assert aot.cohort_sig(8, (1,), 100, False, False, True) != (
+        aot.cohort_sig(8, (1,), 100, False, False, False)
+    )
+
+
+# ----------------------------------------- transfer-side wins, measured
+
+
+def test_unpack_rows_empty_retiring_set_downloads_nothing(tmp_path):
+    """Satellite: a tick with nothing to extract must not pay ANY d2h
+    — cached panel segments ride the launch unread."""
+    from kindel_tpu.ragged import build_segment_table, pack_superbatch
+    from kindel_tpu.ragged import parse_classes
+    from kindel_tpu.ragged.kernel import launch_ragged
+    from kindel_tpu.ragged.unpack import unpack_rows
+
+    sam = make_sam(tmp_path / "r.sam", ref="rr", L=500, seed=3)
+    units = _decode(str(sam))
+    (cls,) = parse_classes("small:32x2048")
+    table = build_segment_table(units, cls)
+    arrays = pack_superbatch(units, table)
+    opts = BatchOptions()
+    out = launch_ragged(arrays, cls, opts)
+    d2h0 = _counter("kindel_device_d2h_bytes_total")
+    assert unpack_rows(out, table, [], opts, None) == []
+    assert _counter("kindel_device_d2h_bytes_total") == d2h0
+
+
+def test_paged_delta_admission_uploads_only_the_newcomer(tmp_path):
+    """Acceptance (b), unit form: admit 1 segment into a 7-resident
+    pool — the upload is ONE extent patch (+ the refreshed segment
+    table), not the resident set, and it is byte-exact against the
+    newcomer's quota extents."""
+    from kindel_tpu.paged.residency import DeviceResidency
+    from kindel_tpu.paged.state import PAGE_SLOTS, PagePool
+    from kindel_tpu.ragged import pack as rpack
+    from kindel_tpu.ragged import parse_classes
+
+    (cls,) = parse_classes("small:32x2048")
+    pool = PagePool(cls, clock=time.monotonic)
+    res = DeviceResidency(cls, PAGE_SLOTS, realign=False)
+    assert res.supported
+    pool.residency = res
+    units = []
+    for i in range(8):
+        sam = make_sam(tmp_path / f"d{i}.sam", ref=f"dr{i}",
+                       L=380 + 16 * i, seed=50 + i, n_reads=12)
+        units.extend(_decode(str(sam)))
+    for u in units[:7]:
+        assert pool.admit_unit(u, rpack.consumption([u])) is not None
+    h2d0 = _counter("kindel_paged_admit_h2d_bytes_total")
+    seg = pool.admit_unit(units[7], rpack.consumption([units[7]]))
+    assert seg is not None and res.active
+    patched = _counter("kindel_paged_admit_h2d_bytes_total") - h2d0
+    po, pb, pd, pi, pc, s_pad = res._sizes_for(seg)
+    expected = 4 * po * 2 + pb + 4 * pd + 4 * pi * 2 + 8 * s_pad
+    assert patched == expected
+    # ~2 pages' extents, nowhere near the 7-resident set's streams
+    full_set = sum(
+        u.n_events // 2 + 4 * (len(u.op_r_start) * 2 + len(u.del_pos)
+                               + 2 * len(u.ins_pos))
+        for u in units[:7]
+    )
+    assert patched < full_set
+
+
+def test_residency_launch_identical_to_legacy_after_churn(tmp_path):
+    """The donated-residency wire decodes to the SAME per-segment
+    results as a classic full re-assembly launch over the same resident
+    set — including after retire/re-admit churn fragments the page grid
+    (the layout-invariant argument, pinned end to end)."""
+    from kindel_tpu.paged.residency import DeviceResidency
+    from kindel_tpu.paged.retire import _InlineMap
+    from kindel_tpu.paged.state import PAGE_SLOTS, PagePool
+    from kindel_tpu.ragged import pack as rpack
+    from kindel_tpu.ragged import parse_classes
+    from kindel_tpu.ragged.kernel import launch_ragged
+    from kindel_tpu.ragged.unpack import unpack_rows
+
+    (cls,) = parse_classes("small:32x2048")
+    pool = PagePool(cls, clock=time.monotonic)
+    res = DeviceResidency(cls, PAGE_SLOTS, realign=False)
+    pool.residency = res
+    segs = []
+    for i in range(5):
+        sam = make_sam(tmp_path / f"c{i}.sam", ref=f"cr{i}",
+                       L=300 + 210 * i, seed=80 + i, n_reads=14 + i)
+        (u,) = _decode(str(sam))
+        s = pool.admit_unit(u, rpack.consumption([u]))
+        assert s is not None
+        segs.append(s)
+    # churn: retire two non-adjacent segments, admit a replacement into
+    # the freed (fragmented) space
+    for s in (segs[1], segs[3]):
+        s.panel = None  # force a real free, not a panel park
+        pool.release(s)
+    sam = make_sam(tmp_path / "c9.sam", ref="cr9", L=340, seed=99,
+                   n_reads=10)
+    (u9,) = _decode(str(sam))
+    assert pool.admit_unit(u9, rpack.consumption([u9])) is not None
+    assert res.active, "churn must not deactivate the residency"
+
+    opts = BatchOptions()
+    units, table, row_of = res.table(pool)
+    out_res = res.launch(opts)
+    got = [
+        seq.sequence for seq, _c, _r in unpack_rows(
+            out_res, table, list(enumerate(units)), opts, _InlineMap()
+        )
+    ]
+    # legacy oracle over the SAME ledger
+    units2, table2, _row2 = pool.assemble()
+    arrays = rpack.pack_superbatch(units2, table2)
+    out_legacy = launch_ragged(arrays, cls, opts)
+    want = [
+        seq.sequence for seq, _c, _r in unpack_rows(
+            out_legacy, table2, list(enumerate(units2)), opts,
+            _InlineMap()
+        )
+    ]
+    assert got == want
+
+
+def test_residency_quota_overflow_falls_back_and_recovers(tmp_path):
+    """A segment whose span footprint overflows its page run's quota
+    deactivates the residency (classic launches, byte-identical) until
+    the pool empties — then a fresh admission reactivates it."""
+    from kindel_tpu.paged.residency import DeviceResidency
+    from kindel_tpu.paged.state import PAGE_SLOTS, PagePool
+    from kindel_tpu.ragged import pack as rpack
+    from kindel_tpu.ragged import parse_classes
+
+    (cls,) = parse_classes("small:32x2048")
+    pool = PagePool(cls, clock=time.monotonic)
+    res = DeviceResidency(cls, PAGE_SLOTS, realign=False)
+    pool.residency = res
+    # many short scattered reads → far more op spans than the ~2-page
+    # run's quota (opp = o_cap/n_pages = 32 spans/page here, so >64
+    # spans on an L≈400 unit overflows)
+    rng = np.random.default_rng(5)
+    lines = ["@HD\tVN:1.6", "@SQ\tSN:frag\tLN:420"]
+    for j in range(90):
+        pos = int(rng.integers(0, 400))
+        seq = "".join("ACGT"[b] for b in rng.integers(0, 4, size=8))
+        lines.append(
+            f"q{j}\t0\tfrag\t{pos + 1}\t60\t8M\t*\t0\t0\t{seq}\t*"
+        )
+    sam = tmp_path / "frag.sam"
+    sam.write_text("\n".join(lines) + "\n")
+    (u,) = _decode(str(sam))
+    assert len(u.op_r_start) > 64
+    s = pool.admit_unit(u, rpack.consumption([u]))
+    assert s is not None, "quota overflow must not refuse admission"
+    assert not res.active
+    s.panel = None
+    pool.release(s)
+    # pool drained: the next well-behaved admission reactivates
+    sam2 = make_sam(tmp_path / "ok.sam", ref="ok", L=400, seed=7,
+                     n_reads=10)
+    (u2,) = _decode(str(sam2))
+    assert pool.admit_unit(u2, rpack.consumption([u2])) is not None
+    assert res.active
+
+
+def test_delta_gate_env_override(monkeypatch):
+    from kindel_tpu.paged.residency import use_delta_residency
+
+    monkeypatch.delenv("KINDEL_TPU_PAGED_DELTA", raising=False)
+    assert use_delta_residency()
+    monkeypatch.setenv("KINDEL_TPU_PAGED_DELTA", "0")
+    assert not use_delta_residency()
+    monkeypatch.setenv("KINDEL_TPU_PAGED_DELTA", "1")
+    assert use_delta_residency()
+
+
+# ------------------------------------------------- warmup / compile pins
+
+
+def test_warm_ragged_covers_emit_and_first_request_compiles_nothing():
+    """Acceptance: zero new jit entries beyond the emit variant per
+    geometry — warmup covers BOTH emission modes, so the first
+    device-emit request after warmup compiles nothing (pinned by the
+    tracked jit-cache counter)."""
+    from kindel_tpu.serve import ConsensusService
+    from kindel_tpu.tune import TuningConfig
+
+    sam = make_sam(
+        __import__("pathlib").Path(
+            __import__("tempfile").mkdtemp()
+        ) / "w.sam",
+        ref="warm1", L=420, seed=11,
+    )
+    with ConsensusService(
+        tuning=TuningConfig(batch_mode="ragged",
+                            ragged_classes="small:32x2048"),
+        max_wait_s=0.05, warmup=True, http_port=None,
+    ) as svc:
+        deadline = time.monotonic() + 600
+        while svc.healthz()["warmup"] in ("pending", "warming"):
+            assert time.monotonic() < deadline, "warmup wedged"
+            time.sleep(0.05)
+        assert svc.healthz()["warmup"] == "ok"
+        before = obs_runtime.jit_cache_entries()
+        res = svc.request(str(sam), timeout=300, emit_mode="device")
+        assert res.consensuses
+        assert obs_runtime.jit_cache_entries() == before, (
+            "first device-emit request compiled a tracked kernel after "
+            "warmup"
+        )
