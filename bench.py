@@ -535,6 +535,26 @@ def _run_benchmark() -> dict:
         except Exception as e:  # noqa: BLE001
             result["paged"] = {"error": repr(e)}
 
+    # Mesh sweep (kindel_tpu.parallel.meshexec): the shape-diverse
+    # request set served once per mesh width dp∈{1,2,4,8} (clamped to
+    # the visible devices) with byte-identity asserted across widths;
+    # the `mesh` object reports per-dp wall/occupancy/launch/transfer
+    # deltas (MULTICHIP_r06 records one run). Same gating rule as the
+    # ragged scenario (KINDEL_TPU_BENCH_MESH overrides; default-on only
+    # for CPU children). Failure never voids the headline metric.
+    mesh_pin = os.environ.get("KINDEL_TPU_BENCH_MESH")
+    want_mesh = (
+        jax.default_backend() == "cpu" if mesh_pin is None
+        else mesh_pin not in ("", "0")
+    )
+    if want_mesh:
+        try:
+            from benchmarks.mesh_sweep import run_mesh_sweep
+
+            result["mesh"] = run_mesh_sweep(requests=8)
+        except Exception as e:  # noqa: BLE001
+            result["mesh"] = {"error": repr(e)}
+
     # Optional serving metrics (KINDEL_TPU_BENCH_SERVE=1): a small
     # closed-loop load run against the in-process service, so rounds can
     # track online throughput / p99 latency / batch occupancy alongside

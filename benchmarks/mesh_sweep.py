@@ -1,0 +1,154 @@
+"""Mesh sweep: one flush fanned across dp local devices, per-width.
+
+The per-replica mesh executor (kindel_tpu.parallel.meshexec, DESIGN.md
+§23) shards every dispatch tier over a dp device mesh. This scenario
+replays the shape-diverse request set (`ragged_load.make_mixed_sams`)
+through the serve path at each candidate width and reports, per dp:
+wall time, device dispatch count, pad-slot occupancy, h2d/d2h transfer
+deltas, and the jit-cache entries the width cost — with byte-identity
+asserted against the dp=1 run (a sweep that silently changed the answer
+would be worse than no sweep). `bench.py` attaches the report as its
+`mesh` object; `MULTICHIP_r06.json` records one run.
+
+Standalone:
+
+    python -m benchmarks.mesh_sweep --requests 10
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import tempfile
+import threading
+import time
+from pathlib import Path
+
+from benchmarks.ragged_load import (
+    _counter_totals,
+    _global_snapshot,
+    make_mixed_sams,
+)
+
+#: candidate widths; each clamps to the devices actually visible
+SWEEP_DPS = (1, 2, 4, 8)
+
+
+def run_mesh_sweep(requests: int = 10, seed: int = 0,
+                   batch_mode: str = "ragged",
+                   max_wait_s: float = 0.15,
+                   dps=SWEEP_DPS) -> dict:
+    """Serve the mixed-shape request set once per mesh width; returns
+    {"identical": ..., "batch_mode": ..., "widths": {dp: report}}."""
+    import jax
+
+    from kindel_tpu.obs import runtime as obs_runtime
+    from kindel_tpu.serve import ConsensusClient, ConsensusService
+    from kindel_tpu.tune import TuningConfig
+
+    n_dev = len(jax.devices())
+    widths = sorted({min(d, n_dev) for d in dps})
+    tmp = tempfile.TemporaryDirectory(prefix="kindel_mesh_sweep_")
+    try:
+        payloads = [
+            p.read_bytes()
+            for p in make_mixed_sams(Path(tmp.name), requests, seed)
+        ]
+
+        def run_width(dp: int):
+            snap0 = _global_snapshot()
+            cache0 = obs_runtime.jit_cache_sizes()
+            h2d_c, d2h_c = obs_runtime.transfer_counters()
+            tr0 = (int(h2d_c.value), int(d2h_c.value))
+            results: list = [None] * len(payloads)
+            errors: list = []
+            t0 = time.perf_counter()
+            with ConsensusService(
+                tuning=TuningConfig(batch_mode=batch_mode, mesh=dp),
+                max_wait_s=max_wait_s, decode_workers=4,
+            ) as svc:
+                client = ConsensusClient(svc)
+
+                def one(i):
+                    try:
+                        results[i] = client.fasta(payloads[i], timeout=600)
+                    except Exception as e:  # noqa: BLE001
+                        errors.append(repr(e))
+
+                threads = [
+                    threading.Thread(target=one, args=(i,))
+                    for i in range(len(payloads))
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                svc_snap = svc.metrics.snapshot()
+            wall = time.perf_counter() - t0
+            snap1 = _global_snapshot()
+            cache1 = obs_runtime.jit_cache_sizes()
+
+            def delta(prefix):
+                return _counter_totals(snap1, prefix) - _counter_totals(
+                    snap0, prefix
+                )
+
+            payload = delta("kindel_dispatch_payload_bases_total")
+            padded = delta("kindel_dispatch_padded_bases_total")
+            report = {
+                "errors": len(errors),
+                "wall_s": round(wall, 3),
+                "dispatches": int(
+                    svc_snap.get("kindel_serve_device_dispatches_total", 0)
+                ),
+                "payload_bases": payload,
+                "padded_bases": padded,
+                "occupancy": round(payload / padded, 4) if padded else 0.0,
+                "h2d_bytes": int(h2d_c.value) - tr0[0],
+                "d2h_bytes": int(d2h_c.value) - tr0[1],
+                "jit_cache_entries": sum(cache1.values())
+                - sum(cache0.values()),
+            }
+            return results, report
+
+        reports: dict = {}
+        base_results = None
+        identical = True
+        for dp in widths:
+            results, report = run_width(dp)
+            reports[str(dp)] = report
+            if base_results is None:
+                base_results = results
+            elif results != base_results:
+                identical = False
+        return {
+            "requests": requests,
+            "batch_mode": batch_mode,
+            "devices": n_dev,
+            "identical": identical,
+            "widths": reports,
+        }
+    finally:
+        tmp.cleanup()
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--requests", type=int, default=10)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--batch-mode", default="ragged",
+                    choices=("lanes", "ragged", "paged"))
+    args = ap.parse_args(argv)
+    report = run_mesh_sweep(
+        requests=args.requests, seed=args.seed,
+        batch_mode=args.batch_mode,
+    )
+    json.dump(report, sys.stdout, indent=1)
+    print()
+    return 0 if report["identical"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

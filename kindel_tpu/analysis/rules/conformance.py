@@ -49,6 +49,7 @@ DOC_ONLY_KNOBS = {
     "KINDEL_TPU_BENCH_SERVE": "bench.py serve-load opt-in",
     "KINDEL_TPU_BENCH_RAGGED": "bench.py ragged-scenario opt-in",
     "KINDEL_TPU_BENCH_PAGED": "bench.py paged-scenario opt-in",
+    "KINDEL_TPU_BENCH_MESH": "bench.py mesh-sweep opt-in",
 }
 
 #: suffixes a doc token may add to a registered histogram name

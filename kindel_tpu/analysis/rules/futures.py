@@ -29,8 +29,10 @@ from kindel_tpu.analysis.model import ProjectModel
 
 #: packages holding the settled-exactly-once contract (paged joined in
 #: PR 11: a launch tick owns its entries' futures until settle/recover;
-#: emit in PR 13: emission decode runs inside the settle path)
-FUTURE_SCOPE = ("serve", "fleet", "paged", "emit")
+#: emit in PR 13: emission decode runs inside the settle path; parallel
+#: in PR 14: the mesh executor's sharded launch/unpack sits inside the
+#: serve dispatch path that owns admitted futures)
+FUTURE_SCOPE = ("serve", "fleet", "paged", "emit", "parallel")
 
 #: constructors whose result is (or owns) a fresh unsettled Future
 _CREATORS = {"Future", "ServeRequest"}

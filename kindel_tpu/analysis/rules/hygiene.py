@@ -355,6 +355,11 @@ DOWNLOAD_SITES = {
     # cohort wire download + realign CDR window fetches (d2h counted)
     ("batch.py", "_assemble_outputs"),
     ("batch.py", "_fetch"),
+    # the CDR fetchers' single-device fallback closures (PR 14): same
+    # one-window dynamic-slice download the _fetch sites always were,
+    # d2h counted by the enclosing fetcher
+    ("batch.py", "classic"),
+    ("ragged/unpack.py", "classic"),
     # the fused/compact/fast wire decoders + packed-arg host helpers
     ("call_jax.py", "unpack_wire"),
     ("call_jax.py", "unpack_depth_scalars"),
@@ -378,6 +383,15 @@ DOWNLOAD_SITES = {
     ("parallel/mesh.py", "sharded_call"),
     ("parallel/mesh.py", "batched_sharded_call"),
     ("parallel/product.py", "_host_global"),
+    # meshexec (PR 14): owning-shard CDR-window fetches (d2h counted by
+    # the calling fetcher; bounded to one window), mesh/device-list
+    # construction, and host-side shard stacking ahead of placement
+    ("parallel/meshexec.py", "fetch_window_rows"),
+    ("parallel/meshexec.py", "fetch_window_flat"),
+    ("parallel/meshexec.py", "_shard_block"),
+    ("parallel/meshexec.py", "mesh_for"),
+    ("parallel/meshexec.py", "place_stacked"),
+    ("parallel/meshexec.py", "stack_shards"),
     # explicit *_host fetch helpers (named as downloads)
     ("pileup_jax.py", "fetch_counts_host"),
     ("stats_jax.py", "entropy_rows_host"),

@@ -156,20 +156,27 @@ def _package_version() -> str:
 
 
 def cohort_sig(n_rows: int, shapes: tuple, length: int, realign: bool,
-               want_masks: bool, emit: bool = False) -> tuple:
+               want_masks: bool, emit: bool = False,
+               mesh: int = 1) -> tuple:
     """Static signature of one batched-cohort executable: the lane key
     (pad shapes) + padded row count + the compile-time switches
-    (realign, masks wire, device-rendered emission — DESIGN.md §22)."""
+    (realign, masks wire, device-rendered emission — DESIGN.md §22) +
+    the mesh width (DESIGN.md §23 — a dp-sharded program and a
+    single-device one are different executables even at equal
+    avals, because the input layout differs)."""
     return ("cohort", int(n_rows), tuple(shapes), int(length),
-            bool(realign), bool(want_masks), bool(emit))
+            bool(realign), bool(want_masks), bool(emit), int(mesh))
 
 
 def fused_sig(pads: tuple, length: int, want_masks: bool,
-              c_pad: int | None, emit: bool = False) -> tuple:
+              c_pad: int | None, emit: bool = False,
+              mesh: int = 1) -> tuple:
     """Static signature of one fused single-sample executable
-    (call_jax.fused_call_kernel_packed)."""
+    (call_jax.fused_call_kernel_packed). The mesh dimension exists for
+    keying-table uniformity (DESIGN.md §23); the single-sample kernel
+    itself always runs single-device, so callers pass 1."""
     return ("fused", tuple(pads), int(length), bool(want_masks), c_pad,
-            bool(emit))
+            bool(emit), int(mesh))
 
 
 def store_digest(sig: tuple) -> str:
@@ -505,66 +512,117 @@ def gc_store(cap_bytes: int | None = None) -> dict:
 
 # ------------------------------------------------- cohort/fused frontends
 
-def cohort_sig_for(arrays, length: int, opts) -> tuple:
+def cohort_sig_for(arrays, length: int, opts, mesh: int = 1) -> tuple:
     """The cohort signature of one packed flush (what the dispatch site
     and the warmup both key on)."""
     return cohort_sig(
         int(arrays[0].shape[0]),
         tuple(int(a.shape[1]) for a in arrays if a.ndim == 2),
         length, bool(opts.realign), bool(opts.want_masks),
-        bool(opts.emit_device),
+        bool(opts.emit_device), mesh,
     )
 
 
-def cohort_args(arrays, opts) -> tuple:
+def cohort_args(arrays, opts, sharding=None) -> tuple:
     """Device args exactly as batch.launch_cohort_kernel builds them —
-    lowering, export parity, and dispatch must agree on avals or the
-    loaded executable rejects its own traffic."""
+    lowering, export parity, and dispatch must agree on avals (and,
+    under a mesh plan, shardings — `sharding(ndim)` places each
+    batch-leading array on the dp axis) or the loaded executable
+    rejects its own traffic."""
+    import jax
     import jax.numpy as jnp
 
-    return tuple(jnp.asarray(a) for a in arrays) + (
+    if sharding is None:
+        dev = tuple(jnp.asarray(a) for a in arrays)
+    else:
+        dev = tuple(jax.device_put(a, sharding(a.ndim)) for a in arrays)
+    return dev + (
         jnp.int32(opts.min_depth),
         jnp.int32(1 if opts.fix_clip_artifacts else 0),
     )
 
 
-def export_cohort(arrays, meta, opts, verify: bool = True) -> bool:
+def export_cohort(arrays, meta, opts, verify: bool = True,
+                  sharding=None, mesh: int = 1) -> bool:
     """AOT-export the batched cohort kernel for one packed flush's
-    shapes (serve warmup miss path; `kindel tune --export-aot`)."""
+    shapes (serve warmup miss path; `kindel tune --export-aot`). With
+    a mesh sharding the lowered program is the dp-partitioned one and
+    registers under the mesh-keyed signature."""
     from kindel_tpu.call_jax import (
         batched_call_kernel,
         batched_realign_call_kernel,
     )
 
     L = meta[0]
-    sig = cohort_sig_for(arrays, L, opts)
+    sig = cohort_sig_for(arrays, L, opts, mesh=mesh)
     kernel = (
         batched_realign_call_kernel if opts.realign else batched_call_kernel
     )
     return export_executable(
-        kernel, cohort_args(arrays, opts),
+        kernel, cohort_args(arrays, opts, sharding=sharding),
         {"length": L, "want_masks": opts.want_masks,
          "emit": opts.emit_device},
         sig, verify=verify,
     )
 
 
-def load_cohort(arrays, meta, opts):
+def load_cohort(arrays, meta, opts, mesh: int = 1):
     """Load (or fetch from the registry) the executable for one packed
     flush's shapes; None → caller runs the jit kernel."""
-    return load_executable(cohort_sig_for(arrays, meta[0], opts))
+    return load_executable(cohort_sig_for(arrays, meta[0], opts, mesh=mesh))
 
 
 def ragged_sig(class_key: tuple, want_masks: bool,
-               realign: bool = False, emit: bool = False) -> tuple:
+               realign: bool = False, emit: bool = False,
+               mesh: int = 1) -> tuple:
     """Static signature of one ragged superbatch executable: the page
     class's geometry key (kindel_tpu.ragged.pack.PageClass.key()) + the
-    wire variant + the realign (clip-channel) and emit (device-rendered
-    emission, DESIGN.md §22) dimensions. ONE executable per (class,
-    variant) serves every request shape the class admits — that is the
-    point of the ragged tier (DESIGN.md §16)."""
+    wire variant + the realign (clip-channel), emit (device-rendered
+    emission, DESIGN.md §22), and mesh (DESIGN.md §23) dimensions. ONE
+    executable per (class, variant) serves every request shape the
+    class admits — that is the point of the ragged tier (DESIGN.md
+    §16). Mesh-sharded superbatches key through `sharded_ragged_sig`
+    (the vmapped program carries its sub-geometry too); the dimension
+    here keeps single-device entries disjoint from any mesh layout."""
     return ("ragged", tuple(class_key), bool(want_masks), bool(realign),
-            bool(emit))
+            bool(emit), int(mesh))
+
+
+def sharded_ragged_sig(class_key: tuple, sub_key: tuple, want_masks: bool,
+                       realign: bool, emit: bool, dp: int) -> tuple:
+    """Static signature of one MESH-sharded ragged executable
+    (kindel_tpu.parallel.meshexec.sharded_ragged_kernel): the parent
+    class key + the per-shard sub-geometry key + the wire variant + the
+    mesh width. Page-geometry-only with the mesh as the one new keying
+    dimension — every request shape the class admits still re-runs the
+    same compiled program."""
+    return ("ragged-mesh", tuple(class_key), tuple(sub_key),
+            bool(want_masks), bool(realign), bool(emit), int(dp))
+
+
+def export_sharded_ragged(dev_args: tuple, page_class, sub, opts,
+                          dp: int, statics: dict,
+                          verify: bool = True) -> bool:
+    """AOT-export the mesh-sharded segment kernel for one (class, dp)
+    pair (serve warmup miss path under an active mesh plan)."""
+    from kindel_tpu.parallel.meshexec import sharded_ragged_kernel
+
+    sig = sharded_ragged_sig(
+        page_class.key(), sub.key(), opts.want_masks, opts.realign,
+        opts.emit_device, dp,
+    )
+    return export_executable(
+        sharded_ragged_kernel, dev_args, statics, sig, verify=verify,
+    )
+
+
+def load_sharded_ragged(page_class, sub, opts, dp: int):
+    """Load (or fetch from the registry) the mesh-sharded executable
+    for one (class, dp) pair; None → caller runs the jit kernel."""
+    return load_executable(
+        sharded_ragged_sig(page_class.key(), sub.key(), opts.want_masks,
+                           opts.realign, opts.emit_device, dp)
+    )
 
 
 def ragged_args(arrays, opts) -> tuple:

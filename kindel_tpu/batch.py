@@ -199,33 +199,19 @@ def batch_bam_to_consensus(
     return {p: r.consensuses for p, r in rich.items()}
 
 
-def _dp_sharding(n_rows: int):
-    """A NamedSharding over all devices for batch-leading arrays, or None
-    single-device. The batch axis is embarrassingly parallel, so laying
-    rows across a dp mesh makes XLA partition the vmapped kernel with
-    zero collectives."""
-    import os
+def _dp_sharding(n_rows: int, plan=None):
+    """(sharding_fn, dp) for batch-leading arrays — the cohort
+    row-sharding now resolved through the per-replica MeshPlan
+    (kindel_tpu.parallel.meshexec, DESIGN.md §23): explicit plan >
+    KINDEL_TPU_MESH > host-keyed store > all-local-devices default,
+    with KINDEL_TPU_FORCE_FUSED still pinning single-device. The batch
+    axis is embarrassingly parallel, so laying rows across a dp mesh
+    makes XLA partition the vmapped kernel with zero collectives."""
+    from kindel_tpu.parallel import meshexec
 
-    import jax
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    # the documented single-device pin (README: "benchmark one chip in
-    # isolation") must hold on this path too
-    if os.environ.get("KINDEL_TPU_FORCE_FUSED"):
-        return None, 1
-    n_dev = len(jax.devices())
-    if n_dev <= 1:
-        return None, 1
-    from kindel_tpu.parallel import make_mesh
-
-    dp = min(n_dev, n_rows) if n_rows else 1
-    if dp <= 1:
-        return None, 1
-    mesh = make_mesh({"dp": dp})
-    return (
-        lambda ndim: NamedSharding(mesh, P("dp", *([None] * (ndim - 1)))),
-        dp,
-    )
+    if plan is None:
+        plan = meshexec.plan()
+    return plan.row_sharding_for(n_rows)
 
 
 # Per padded row the batched kernel materializes weights [Lb,5] +
@@ -347,20 +333,21 @@ def pack_cohort(units, opts: BatchOptions, n_rows: int | None = None,
     return arrays, (L, D_pad, I_pad)
 
 
-def launch_cohort_kernel(arrays, meta, opts: BatchOptions, sharding=None):
+def launch_cohort_kernel(arrays, meta, opts: BatchOptions, sharding=None,
+                         mesh_dp: int = 1):
     """Upload packed cohort arrays and launch the batched kernel
     (asynchronously — jax dispatch returns before the device finishes).
     Returns the (out, meta) pair _assemble_outputs consumes.
 
     When the AOT registry (kindel_tpu.aot) holds an executable for this
-    flush's shape signature — loaded from the store by the serve warmup,
-    or exported by `kindel tune --export-aot` — the launch runs it
-    directly and the jit cache is never consulted; any registry failure
-    falls back to the jit kernel transparently (warned once, output
-    identical). Sharded multi-device launches always take the jit path
-    (AOT executables are single-device programs)."""
-    import jax
-
+    flush's mesh-keyed shape signature — loaded from the store by the
+    serve warmup, or exported by `kindel tune --export-aot` — the
+    launch runs it directly and the jit cache is never consulted; any
+    registry failure falls back to the jit kernel transparently (warned
+    once, output identical). Sharded launches (`sharding` set,
+    `mesh_dp` > 1) place the batch-leading arrays on the dp mesh and
+    key the registry under the mesh dimension, so a single-device
+    program is never handed mesh traffic or vice versa."""
     from kindel_tpu import aot
 
     rfaults.hook("device.dispatch")
@@ -368,32 +355,37 @@ def launch_cohort_kernel(arrays, meta, opts: BatchOptions, sharding=None):
     h2d_bytes = sum(int(a.nbytes) for a in arrays)
     obs_runtime.transfer_counters()[0].inc(h2d_bytes)
     with obs_trace.span("cohort.launch") as sp:
-        out = None
-        aot_hit = False
-        if sharding is None:
-            dev_arrays = aot.cohort_args(arrays, opts)
-            out = aot.call(aot.cohort_sig_for(arrays, L, opts), dev_arrays)
-            aot_hit = out is not None
+        if mesh_dp > 1:
+            # multi-device enqueue serializes process-wide (see
+            # meshexec.dispatch_guard — two concurrent mesh launches
+            # can deadlock a rendezvousing backend)
+            from kindel_tpu.parallel import meshexec
+
+            guard = meshexec.dispatch_guard()
         else:
-            dev_arrays = tuple(
-                jax.device_put(a, sharding(a.ndim)) for a in arrays
-            ) + (
-                jnp.int32(opts.min_depth),
-                jnp.int32(1 if opts.fix_clip_artifacts else 0),
+            import contextlib
+
+            guard = contextlib.nullcontext()
+        with guard:
+            dev_arrays = aot.cohort_args(arrays, opts, sharding=sharding)
+            out = aot.call(
+                aot.cohort_sig_for(arrays, L, opts, mesh=mesh_dp),
+                dev_arrays,
             )
-        if out is None:
-            kernel = (
-                batched_realign_call_kernel if opts.realign
-                else batched_call_kernel
-            )
-            out = kernel(
-                *dev_arrays, length=L, want_masks=opts.want_masks,
-                emit=opts.emit_device,
-            )
+            aot_hit = out is not None
+            if out is None:
+                kernel = (
+                    batched_realign_call_kernel if opts.realign
+                    else batched_call_kernel
+                )
+                out = kernel(
+                    *dev_arrays, length=L, want_masks=opts.want_masks,
+                    emit=opts.emit_device,
+                )
         if sp is not obs_trace.NOOP_SPAN:
             # span covers upload + async dispatch, not device completion
             sp.set_attribute(
-                rows=int(arrays[0].shape[0]), L=L,
+                rows=int(arrays[0].shape[0]), L=L, mesh_dp=mesh_dp,
                 realign=opts.realign, h2d_bytes=h2d_bytes, aot=aot_hit,
             )
     # meta the host decoder needs to slice each row's packed wire
@@ -402,13 +394,19 @@ def launch_cohort_kernel(arrays, meta, opts: BatchOptions, sharding=None):
 
 def _dispatch_device_call(units, opts: BatchOptions):
     """Pad + upload a cohort's units and launch the batched kernel.
-    With multiple visible devices, rows are sharded over a dp mesh."""
-    sharding, dp = _dp_sharding(len(units))
+    With multiple visible devices, rows are sharded over the replica's
+    dp mesh (kindel_tpu.parallel.meshexec)."""
+    from kindel_tpu.parallel import meshexec
+
+    plan = meshexec.plan()
+    dp = plan.row_dp(len(units))
     # pad the row count to a dp multiple with empty dummy units (the
     # caller only reads the first len(units) rows)
     B = -(-len(units) // dp) * dp
+    sharding, dp = plan.row_sharding_for(B)
     arrays, meta = pack_cohort(units, opts, n_rows=B)
-    return launch_cohort_kernel(arrays, meta, opts, sharding=sharding)
+    return launch_cohort_kernel(arrays, meta, opts, sharding=sharding,
+                                mesh_dp=dp)
 
 
 @partial(jax.jit, static_argnames=("chunk",))
@@ -441,11 +439,22 @@ class _RowCdrFetcher(LazyCdrWindows):
         self._chunk = min(4096, self.Lp)
 
     def _fetch(self, key: str, start: int) -> np.ndarray:
+        from kindel_tpu.parallel import meshexec
+
         arr = self._arrs[key]
-        fetch = _fetch_row2d if arr.ndim == 3 else _fetch_row1d
-        win = np.asarray(
-            fetch(arr, jnp.int32(self.row), jnp.int32(start),
-                  chunk=self._chunk)
+
+        def classic():
+            fetch = _fetch_row2d if arr.ndim == 3 else _fetch_row1d
+            return np.asarray(
+                fetch(arr, jnp.int32(self.row), jnp.int32(start),
+                      chunk=self._chunk)
+            )
+
+        # dp-sharded dense tensors: read the window from the OWNING
+        # shard's buffer — the jit dynamic-slice path reshards the whole
+        # tensor per window and made sharded realign take minutes
+        win = meshexec.fetch_window_rows(
+            arr, self.row, start, self._chunk, classic
         )
         obs_runtime.transfer_counters()[1].inc(int(win.nbytes))
         return win

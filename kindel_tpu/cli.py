@@ -161,6 +161,14 @@ def _consensus_parser(sub):
              "host; `kindel tune --emit-mode-budget-s` measures a "
              "winner). Applies to the fast (no-changes) path",
     )
+    p.add_argument(
+        "--mesh", type=int, default=None, metavar="N",
+        help="device-mesh width: fan the call across up to N local "
+             "devices (1 pins single-device; top of the explicit > "
+             "$KINDEL_TPU_MESH > tune store > all-local-devices order; "
+             "`kindel tune --mesh-budget-s` measures a winner). "
+             "Byte-identical output at every width",
+    )
     _add_backend(p)
 
 
@@ -177,12 +185,14 @@ def cmd_consensus(args) -> int:
         or args.ingest_workers is not None
         or args.ingest_mode is not None
         or args.emit_mode is not None
+        or args.mesh is not None
     ):
         from kindel_tpu.tune import TuningConfig
 
         tuning = TuningConfig(
             n_slabs=args.slabs, ingest_workers=args.ingest_workers,
             ingest_mode=args.ingest_mode, emit_mode=args.emit_mode,
+            mesh=args.mesh,
         )
     try:
         res = workloads.bam_to_consensus(
@@ -508,6 +518,15 @@ def _serve_parser(sub):
              "> host",
     )
     p.add_argument(
+        "--mesh", type=int, default=None, metavar="N",
+        help="per-replica device-mesh width: every dispatch tier "
+             "(lanes, ragged, paged) fans one flush across up to N "
+             "local devices (kindel_tpu.parallel.meshexec, DESIGN.md "
+             "§23). 1 pins single-device; top of the explicit > "
+             "$KINDEL_TPU_MESH > tune store > all-local-devices order. "
+             "Byte-identical output at every width",
+    )
+    p.add_argument(
         "--replicas", type=int, default=1, metavar="N",
         help="run N supervised in-process replicas behind a failover "
              "router (kindel_tpu.fleet): rendezvous-hash placement, "
@@ -601,6 +620,7 @@ def cmd_serve(args) -> int:
         or args.ragged_classes is not None
         or args.ingest_mode is not None
         or args.emit_mode is not None
+        or args.mesh is not None
     ):
         from kindel_tpu.tune import TuningConfig
 
@@ -610,6 +630,7 @@ def cmd_serve(args) -> int:
             ragged_classes=args.ragged_classes,
             ingest_mode=args.ingest_mode,
             emit_mode=args.emit_mode,
+            mesh=args.mesh,
         )
     service_kwargs = dict(
         tuning=tuning,
@@ -669,6 +690,7 @@ def cmd_serve(args) -> int:
                     "ragged_classes": args.ragged_classes,
                     "ingest_mode": args.ingest_mode,
                     "emit_mode": args.emit_mode,
+                    "mesh": args.mesh,
                 }
             service = ProcessFleetService(
                 service_config=config,
@@ -782,6 +804,15 @@ def _tune_parser(sub):
              "device-rendered ASCII plane, kindel_tpu.emit); the winner "
              "persists host-keyed so every fast-path entry point starts "
              "in the measured mode. 0 (default) skips it",
+    )
+    p.add_argument(
+        "--mesh-budget-s", type=float, default=0.0,
+        help="wall budget for the device-mesh width sweep (one cohort "
+             "pass per candidate dp over this BAM's units — the width "
+             "every dispatch tier fans one flush across, "
+             "kindel_tpu.parallel.meshexec); the winner persists "
+             "host-keyed so `kindel serve`/`consensus` start on the "
+             "measured mesh. 0 (default) skips it",
     )
     p.add_argument(
         "--dry-run", action="store_true",
@@ -1005,6 +1036,66 @@ def cmd_tune(args) -> int:
                 },
             )
 
+    # device-mesh width sweep (kindel_tpu.parallel.meshexec): one
+    # sharded cohort pass per candidate dp, width explicit (no env
+    # mutation — the shared search contract); the winner persists
+    # host-keyed so every dispatch tier starts on the measured mesh
+    mesh_chosen, mesh_timings, mesh_persisted = None, {}, False
+    if args.mesh_budget_s > 0:
+        import numpy as _np
+
+        from kindel_tpu.batch import (
+            BatchOptions,
+            launch_cohort_kernel,
+            pack_cohort,
+        )
+        from kindel_tpu.call_jax import CallUnit
+        from kindel_tpu.parallel import meshexec
+
+        mesh_opts = BatchOptions()
+        mesh_units = [
+            CallUnit(ev, rid, with_ins_table=True)
+            for rid in ev.present_ref_ids
+        ]
+        n_dev = meshexec.visible_devices()
+        candidates = tuple(
+            d for d in (1, 2, 4, 8, 16, 32) if d <= n_dev
+        ) or (1,)
+
+        def mesh_pass(dp: int) -> float:
+            plan = meshexec.MeshPlan(dp=dp, source="probe")
+            n_rows = plan.pad_rows(max(len(mesh_units), dp))
+            sharding, eff = plan.row_sharding_for(n_rows)
+            arrays, meta = pack_cohort(mesh_units, mesh_opts,
+                                       n_rows=n_rows)
+            # warm/compile, then one timed blocked pass
+            _np.asarray(launch_cohort_kernel(
+                arrays, meta, mesh_opts, sharding=sharding, mesh_dp=eff
+            )[0])
+            t = _time.perf_counter()
+            _np.asarray(launch_cohort_kernel(
+                arrays, meta, mesh_opts, sharding=sharding, mesh_dp=eff
+            )[0])
+            return _time.perf_counter() - t
+
+        mesh_chosen, mesh_timings = tune.search_mesh_dp(
+            mesh_pass, candidates=candidates,
+            budget_s=args.mesh_budget_s,
+        )
+        if not args.dry_run and mesh_timings:
+            mesh_persisted = tune.record(
+                tune.mesh_store_key(),
+                {
+                    "mesh_dp": mesh_chosen,
+                    "timings_s": {
+                        str(k): round(v, 4)
+                        for k, v in mesh_timings.items()
+                        if v != float("inf")
+                    },
+                    "bam_path": str(args.bam_path),
+                },
+            )
+
     aot_report = None
     if args.export_aot:
         aot_report = _export_aot(args.bam_path, ev, dry_run=args.dry_run)
@@ -1038,6 +1129,13 @@ def cmd_tune(args) -> int:
             if v != float("inf")
         }
         doc["emit_mode_persisted"] = emit_persisted
+    if mesh_chosen is not None:
+        doc["mesh_dp"] = mesh_chosen
+        doc["mesh_timings_s"] = {
+            str(k): round(v, 4) for k, v in mesh_timings.items()
+            if v != float("inf")
+        }
+        doc["mesh_persisted"] = mesh_persisted
     if ragged_chosen is not None:
         doc["ragged_classes"] = ragged_chosen
         doc["ragged_timings_s"] = {
@@ -1076,13 +1174,20 @@ def _export_aot(bam_path: str, ev, dry_run: bool = False) -> dict:
     # BOTH emission variants pre-bake (the emit keying dimension of
     # cohort_sig/fused_sig/ragged_sig): zero-compile replica startup
     # must cover --emit-mode host AND device, so flipping the knob on a
-    # warm fleet never compiles
+    # warm fleet never compiles. The bake runs under the host's
+    # resolved mesh plan (DESIGN.md §23) so the SHARDED executables a
+    # serving replica will actually dispatch are the ones persisted.
+    from kindel_tpu.parallel import meshexec
+
+    mesh_plan = meshexec.plan()
     shapes = serve_warmup.warm_shapes(
-        BatchOptions(emit_mode="host"), payloads=[bam_path]
+        BatchOptions(emit_mode="host"), payloads=[bam_path],
+        mesh_plan=mesh_plan,
     )
     shapes.update({
         f"{label}:emit": t for label, t in serve_warmup.warm_shapes(
-            BatchOptions(emit_mode="device"), payloads=[bam_path]
+            BatchOptions(emit_mode="device"), payloads=[bam_path],
+            mesh_plan=mesh_plan,
         ).items()
     })
     fused = 0
@@ -1125,7 +1230,7 @@ def _export_aot(bam_path: str, ev, dry_run: bool = False) -> dict:
 
         spec, _src = _tune.resolve_ragged_classes()
         ragged_shapes = serve_warmup.warm_ragged(
-            BatchOptions(), parse_classes(spec)
+            BatchOptions(), parse_classes(spec), mesh_plan=mesh_plan
         )
     return {
         "enabled": True,

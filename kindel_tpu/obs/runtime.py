@@ -41,6 +41,7 @@ _TRACKED_KERNELS = (
     ("kindel_tpu.call_jax", "counts_call_kernel"),
     ("kindel_tpu.call_jax", "fused_call_kernel_slab"),
     ("kindel_tpu.ragged.kernel", "ragged_call_kernel"),
+    ("kindel_tpu.parallel.meshexec", "sharded_ragged_kernel"),
 )
 
 _install_lock = threading.Lock()

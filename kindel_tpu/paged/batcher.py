@@ -96,7 +96,7 @@ class PagedBatcher(MicroBatcher):
     def __init__(self, classes, max_batch_rows: int = 64,
                  max_wait_s: float = 0.02, clock=None,
                  page_slots: int = PAGE_SLOTS,
-                 retune_every: int = RETUNE_EVERY):
+                 retune_every: int = RETUNE_EVERY, mesh_plan=None):
         import time
 
         super().__init__(
@@ -107,6 +107,10 @@ class PagedBatcher(MicroBatcher):
         if not self.classes:
             raise ValueError("PagedBatcher needs at least one page class")
         self.page_slots = page_slots
+        #: per-replica mesh plan (kindel_tpu.parallel.meshexec): handed
+        #: to each pool's DeviceResidency so the persistent donated
+        #: buffers place sharded at pool creation (DESIGN.md §23)
+        self.mesh_plan = mesh_plan
         self.retune_every = retune_every
         self._lanes_paged: dict[tuple, _PooledLane] = {}
         self._hist: dict[int, int] = {}
@@ -180,10 +184,16 @@ class PagedBatcher(MicroBatcher):
 
             if use_delta_residency():
                 res = DeviceResidency(
-                    cls, pool.page_slots, bool(opts.realign)
+                    cls, pool.page_slots, bool(opts.realign),
+                    mesh_plan=self.mesh_plan,
                 )
                 if res.supported:
                     pool.residency = res
+                    if res.mesh_dp > 1:
+                        # page-aligned mesh invariant: no segment's page
+                        # run may cross a shard block, so every stream
+                        # extent stays device-local under the patches
+                        pool.shard_pages = res.pages_per_shard
             lane = self._lanes_paged[key] = _PooledLane(opts, pool)
         return lane
 

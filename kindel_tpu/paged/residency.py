@@ -117,6 +117,16 @@ def _patch_state_donated(state, patch, offs, *, sizes):
     return _patch_impl(state, patch, offs, sizes)
 
 
+@partial(jax.jit, static_argnames=("sizes",))
+def _patch_state_mesh(state, patch, offs, shard, *, sizes):
+    return _patch_impl_mesh(state, patch, offs, shard, sizes)
+
+
+@partial(jax.jit, static_argnames=("sizes",), donate_argnums=(0,))
+def _patch_state_mesh_donated(state, patch, offs, shard, *, sizes):
+    return _patch_impl_mesh(state, patch, offs, shard, sizes)
+
+
 def _i32(seg):
     return jax.lax.bitcast_convert_type(seg.reshape(-1, 4), jnp.int32)
 
@@ -169,6 +179,17 @@ def _clear_state_donated(state, tab_patch, offs, *, sizes, quota):
     return _clear_impl(state, tab_patch, offs, sizes, quota)
 
 
+@partial(jax.jit, static_argnames=("sizes", "quota"))
+def _clear_state_mesh(state, tab_patch, offs, shard, *, sizes, quota):
+    return _clear_impl_mesh(state, tab_patch, offs, shard, sizes, quota)
+
+
+@partial(jax.jit, static_argnames=("sizes", "quota"), donate_argnums=(0,))
+def _clear_state_mesh_donated(state, tab_patch, offs, shard, *, sizes,
+                              quota):
+    return _clear_impl_mesh(state, tab_patch, offs, shard, sizes, quota)
+
+
 def _clear_impl(state, tab_patch, offs, sizes, quota):
     """Retirement: restore the free-page coverage over one segment's
     extents (PAD spans whose op_off points at each page's event-extent
@@ -204,19 +225,150 @@ def _clear_impl(state, tab_patch, offs, sizes, quota):
     return tuple(out)
 
 
+def _patch_impl_mesh(state, patch, offs, shard, sizes):
+    """Mesh layout of `_patch_impl` (DESIGN.md §23): the persistent
+    arrays are ``[dp, shard-block]`` placed on the mesh axis; a
+    segment's extents live wholly inside one shard block (the pool's
+    shard-aligned placement), so the patch is a 2-D
+    ``dynamic_update_slice`` at (shard, local-offset) — the SPMD
+    partitioner resolves it to a device-local write on the owning
+    shard, zero collectives. `offs` are shard-LOCAL extent starts; the
+    refreshed table patch is that shard's table alone."""
+    po, pb, pd, pi, pc, s_pad = sizes
+    realign = len(state) > 8
+    cut = np.cumsum(
+        [0, 4 * po, 4 * po, pb, 4 * pd, 4 * pi, 4 * pi]
+        + ([4 * pc] * 4 if realign else [])
+        + [8 * s_pad]
+    )
+    segs = [patch[cut[i]: cut[i + 1]] for i in range(len(cut) - 1)]
+
+    def upd(st, seg, off):
+        return jax.lax.dynamic_update_slice(st, seg[None], (shard, off))
+
+    out = [
+        upd(state[0], _i32(segs[0]), offs[0]),
+        upd(state[1], _i32(segs[1]), offs[0]),
+        upd(state[2], segs[2], offs[1]),
+        upd(state[3], _i32(segs[3]), offs[2]),
+        upd(state[4], _i32(segs[4]), offs[3]),
+        upd(state[5], _i32(segs[5]), offs[3]),
+    ]
+    i = 6
+    if realign:
+        out += [
+            upd(state[6], _i32(segs[6]), offs[4]),
+            upd(state[7], _i32(segs[7]), offs[4]),
+            upd(state[8], _i32(segs[8]), offs[4]),
+            upd(state[9], _i32(segs[9]), offs[4]),
+        ]
+        i = 10
+    tab = _i32(segs[i])
+    zero = jnp.int32(0)
+    out.append(upd(state[i], tab[:s_pad], zero))
+    out.append(upd(state[i + 1], tab[s_pad:], zero))
+    return tuple(out)
+
+
+def _clear_impl_mesh(state, tab_patch, offs, shard, sizes, quota):
+    """Mesh layout of `_clear_impl`: restore free-page coverage over
+    one segment's (shard-local) extents and install the owning shard's
+    refreshed table — same zero-upload contract, 2-D updates at
+    (shard, local-offset)."""
+    po, pb, pd, pi, pc, s_pad = sizes
+    opp, epp = quota
+    realign = len(state) > 8
+
+    def upd(st, seg, off):
+        return jax.lax.dynamic_update_slice(st, seg[None], (shard, off))
+
+    k = jnp.arange(po, dtype=jnp.int32)
+    cover = ((offs[0] + k) // opp) * epp
+    out = [
+        upd(state[0], jnp.full((po,), PAD_POS, jnp.int32), offs[0]),
+        upd(state[1], cover, offs[0]),
+        state[2],  # stale base codes scatter-drop via the PAD spans
+        upd(state[3], jnp.full((pd,), PAD_POS, jnp.int32), offs[2]),
+        upd(state[4], jnp.full((pi,), PAD_POS, jnp.int32), offs[3]),
+        upd(state[5], jnp.zeros((pi,), jnp.int32), offs[3]),
+    ]
+    i = 6
+    if realign:
+        pad_c = jnp.full((pc,), PAD_POS, jnp.int32)
+        zero_c = jnp.zeros((pc,), jnp.int32)
+        out += [
+            upd(state[6], pad_c, offs[4]),
+            upd(state[7], zero_c, offs[4]),
+            upd(state[8], pad_c, offs[4]),
+            upd(state[9], zero_c, offs[4]),
+        ]
+        i = 10
+    tab = _i32(tab_patch)
+    zero = jnp.int32(0)
+    out.append(upd(state[i], tab[:s_pad], zero))
+    out.append(upd(state[i + 1], tab[s_pad:], zero))
+    return tuple(out)
+
+
 class DeviceResidency:
     """Persistent device-side kernel inputs of ONE PagePool (see module
     doc). All methods run under the owning batcher's condition lock."""
 
-    def __init__(self, page_class, page_slots: int, realign: bool):
+    def __init__(self, page_class, page_slots: int, realign: bool,
+                 mesh_plan=None):
         self.page_class = page_class
         self.page_slots = page_slots
         self.realign = realign
         self.quotas = quotas_for(page_class, page_slots)
+        #: mesh width of the persistent arrays (DESIGN.md §23): >1 lays
+        #: every stream out [dp, shard-block] placed on the dp axis —
+        #: admission patches update the owning shard in place and the
+        #: launch runs the vmapped sharded kernel; 1 = classic layout
+        self.mesh_dp = 1
+        if (
+            mesh_plan is not None
+            and getattr(mesh_plan, "active", False)
+            and self.quotas is not None
+        ):
+            from kindel_tpu.parallel import meshexec
+
+            self.mesh_dp = meshexec.paged_dp(
+                page_class, page_slots, mesh_plan.dp
+            )
         self._state: tuple | None = None
         self._stale = False
         self._broken = False
         self._overflow: set[int] = set()
+
+    # ------------------------------------------------------------- mesh
+
+    @property
+    def _n_pages(self) -> int:
+        return self.page_class.n_slots // self.page_slots
+
+    @property
+    def pages_per_shard(self) -> int:
+        return self._n_pages // self.mesh_dp
+
+    @property
+    def _s_pad_shard(self) -> int:
+        """Per-shard segment-table capacity: a shard cannot hold more
+        segments than pages (every segment occupies ≥ 1 page)."""
+        return self.pages_per_shard
+
+    def _shard_of(self, seg) -> int:
+        return seg.page0 // self.pages_per_shard
+
+    def sub_geometry(self):
+        """The per-shard kernel geometry of a mesh-resident launch."""
+        from kindel_tpu.parallel.meshexec import SubGeometry
+
+        opp, epp, dpp, ipp, cpp = self.quotas
+        pps = self.pages_per_shard
+        return SubGeometry(
+            n_slots=pps * self.page_slots, s_pad=self._s_pad_shard,
+            d_cap=dpp * pps, i_cap=ipp * pps,
+        )
 
     # ------------------------------------------------------------ status
 
@@ -280,7 +432,46 @@ class DeviceResidency:
             return
         c = self.page_class
         opp, epp, dpp, ipp, cpp = self.quotas
-        n_pages = c.n_slots // self.page_slots
+        if self.mesh_dp > 1:
+            # [dp, shard-block] layout placed on the mesh axis: every
+            # per-page extent lives wholly inside one shard block, so
+            # every later patch is a device-local write (DESIGN.md §23)
+            from kindel_tpu.parallel import meshexec
+
+            dp, pps = self.mesh_dp, self.pages_per_shard
+            o_sub, e_sub = opp * pps, epp * pps
+            op_off0 = (
+                (np.arange(o_sub, dtype=np.int32) // opp) * epp
+            ).astype(np.int32)
+
+            def tile(row):
+                return np.broadcast_to(row, (dp,) + row.shape).copy()
+
+            host = [
+                tile(np.full(o_sub, PAD_POS, np.int32)),
+                tile(op_off0),
+                tile(np.zeros(e_sub // 2, np.uint8)),
+                tile(np.full(dpp * pps, PAD_POS, np.int32)),
+                tile(np.full(ipp * pps, PAD_POS, np.int32)),
+                tile(np.zeros(ipp * pps, np.int32)),
+            ]
+            if self.realign:
+                host += [
+                    tile(np.full(cpp * pps, PAD_POS, np.int32)),
+                    tile(np.zeros(cpp * pps, np.int32)),
+                    tile(np.full(cpp * pps, PAD_POS, np.int32)),
+                    tile(np.zeros(cpp * pps, np.int32)),
+                ]
+            host += [
+                tile(np.full(self._s_pad_shard, PAD_POS, np.int32)),
+                tile(np.zeros(self._s_pad_shard, np.int32)),
+            ]
+            h2d, _admit_h2d = self._counters()
+            h2d.inc(sum(int(a.nbytes) for a in host))
+            self._state = meshexec.place_stacked(self.mesh_dp, host)
+            self._stale = False
+            self._overflow.clear()
+            return
         op_off0 = (
             (np.arange(c.o_cap, dtype=np.int32) // opp) * epp
         ).astype(np.int32)
@@ -311,16 +502,53 @@ class DeviceResidency:
 
     def _sizes_for(self, seg) -> tuple:
         ext = self._extents(seg)
+        s_pad = (
+            self._s_pad_shard if self.mesh_dp > 1 else self.page_class.s_pad
+        )
         return (
             ext["span"][1], ext["ev"][1] // 2, ext["del"][1],
-            ext["ins"][1], ext["clip"][1], self.page_class.s_pad,
+            ext["ins"][1], ext["clip"][1], s_pad,
         )
 
-    def _table_patch(self, pool) -> np.ndarray:
+    def _local(self, seg) -> tuple:
+        """(shard, local extent starts dict, local slot start) of one
+        segment — identical to the global view at mesh_dp 1. A
+        segment's run never crosses a shard block (pool placement), so
+        the local view is always a single shard's coordinates."""
+        ext = self._extents(seg)
+        if self.mesh_dp <= 1:
+            return 0, {k: v[0] for k, v in ext.items()}, seg.slot_start
+        opp, epp, dpp, ipp, cpp = self.quotas
+        shard, pps = self._shard_of(seg), self.pages_per_shard
+        base = {
+            "span": shard * opp * pps, "ev": shard * epp * pps,
+            "del": shard * dpp * pps, "ins": shard * ipp * pps,
+            "clip": shard * cpp * pps,
+        }
+        local = {k: ext[k][0] - base[k] for k in ext}
+        return shard, local, seg.slot_start - shard * pps * self.page_slots
+
+    def _table_patch(self, pool, shard: int = 0) -> np.ndarray:
         """The refreshed segment table as one int32→uint8 patch —
         seg_starts then seg_lens, sorted by page run (the order the
-        kernel's rank attribution requires)."""
+        kernel's rank attribution requires). Under the mesh layout the
+        patch is ONE shard's table with shard-local slot starts (only
+        the owning shard's table changes on an admit/retire)."""
         c = self.page_class
+        if self.mesh_dp > 1:
+            pps = self.pages_per_shard
+            starts = np.full(self._s_pad_shard, PAD_POS, np.int32)
+            lens = np.zeros(self._s_pad_shard, np.int32)
+            segs = sorted(
+                (s for s in pool.segments.values()
+                 if s.page0 // pps == shard),
+                key=lambda s: s.page0,
+            )
+            slot_base = shard * pps * self.page_slots
+            for i, s in enumerate(segs):
+                starts[i] = s.slot_start - slot_base
+                lens[i] = s.unit.L
+            return np.concatenate([starts, lens]).view(np.uint8)
         starts = np.full(c.s_pad, PAD_POS, np.int32)
         lens = np.zeros(c.s_pad, np.int32)
         segs = sorted(pool.segments.values(), key=lambda s: s.page0)
@@ -331,6 +559,14 @@ class DeviceResidency:
 
     def _run_kernel(self, fn, fn_donated, *args, **kw):
         donated = jax.default_backend() != "cpu"
+        if self.mesh_dp > 1:
+            # multi-device patch/clear enqueue serializes process-wide
+            # (meshexec.dispatch_guard — concurrent mesh launches can
+            # deadlock a rendezvousing backend)
+            from kindel_tpu.parallel import meshexec
+
+            with meshexec.dispatch_guard():
+                return (fn_donated if donated else fn)(*args, **kw)
         return (fn_donated if donated else fn)(*args, **kw)
 
     def admit(self, pool, seg, unit) -> None:
@@ -348,9 +584,8 @@ class DeviceResidency:
         try:
             sizes = self._sizes_for(seg)
             po, pb, pd, pi, pc, s_pad = sizes
-            ext = self._extents(seg)
-            s0 = seg.slot_start
-            ev0 = ext["ev"][0]
+            shard, local, s0 = self._local(seg)
+            ev0 = local["ev"]
 
             def pad32(arr, size, fill):
                 out = np.full(size, fill, np.int32)
@@ -379,20 +614,27 @@ class DeviceResidency:
                     keep = p < unit.L  # see pack_superbatch clip_pair
                     parts.append(pad32(p[keep] + s0, pc, PAD_POS))
                     parts.append(pad32(b[keep], pc, 0))
-            parts.append(self._table_patch(pool))
+            parts.append(self._table_patch(pool, shard))
             patch = np.concatenate(parts)
             offs = jnp.asarray(
-                [ext["span"][0], ext["ev"][0] // 2, ext["del"][0],
-                 ext["ins"][0], ext["clip"][0]],
+                [local["span"], local["ev"] // 2, local["del"],
+                 local["ins"], local["clip"]],
                 jnp.int32,
             )
             h2d, admit_h2d = self._counters()
             h2d.inc(int(patch.nbytes))
             admit_h2d.inc(int(patch.nbytes))
-            self._state = self._run_kernel(
-                _patch_state, _patch_state_donated,
-                self._state, jnp.asarray(patch), offs, sizes=sizes,
-            )
+            if self.mesh_dp > 1:
+                self._state = self._run_kernel(
+                    _patch_state_mesh, _patch_state_mesh_donated,
+                    self._state, jnp.asarray(patch), offs,
+                    jnp.int32(shard), sizes=sizes,
+                )
+            else:
+                self._state = self._run_kernel(
+                    _patch_state, _patch_state_donated,
+                    self._state, jnp.asarray(patch), offs, sizes=sizes,
+                )
         except Exception:  # noqa: BLE001 — isolation boundary
             # a failing patch must never fail the admission (the ledger
             # is already updated); the pool falls back to classic
@@ -418,20 +660,28 @@ class DeviceResidency:
             return
         try:
             sizes = self._sizes_for(seg)
-            ext = self._extents(seg)
+            shard, local, _s0 = self._local(seg)
             offs = jnp.asarray(
-                [ext["span"][0], ext["ev"][0] // 2, ext["del"][0],
-                 ext["ins"][0], ext["clip"][0]],
+                [local["span"], local["ev"] // 2, local["del"],
+                 local["ins"], local["clip"]],
                 jnp.int32,
             )
-            tab = self._table_patch(pool)
+            tab = self._table_patch(pool, shard)
             h2d, admit_h2d = self._counters()
             h2d.inc(int(tab.nbytes))
-            self._state = self._run_kernel(
-                _clear_state, _clear_state_donated,
-                self._state, jnp.asarray(tab), offs, sizes=sizes,
-                quota=(self.quotas[0], self.quotas[1]),
-            )
+            if self.mesh_dp > 1:
+                self._state = self._run_kernel(
+                    _clear_state_mesh, _clear_state_mesh_donated,
+                    self._state, jnp.asarray(tab), offs,
+                    jnp.int32(shard), sizes=sizes,
+                    quota=(self.quotas[0], self.quotas[1]),
+                )
+            else:
+                self._state = self._run_kernel(
+                    _clear_state, _clear_state_donated,
+                    self._state, jnp.asarray(tab), offs, sizes=sizes,
+                    quota=(self.quotas[0], self.quotas[1]),
+                )
         except Exception:  # noqa: BLE001 — isolation boundary
             self._broken = True
             rpolicy.record_degrade("paged.residency", "clear_failed", 1)
@@ -443,11 +693,15 @@ class DeviceResidency:
         resident set with EXTENT-based stream offsets — the extraction
         coordinates of a persistent launch (`ragged.unpack` slices the
         sparse flag planes by these; classic cumulative offsets belong
-        to `PagePool.assemble`'s re-packed uploads only)."""
+        to `PagePool.assemble`'s re-packed uploads only). Under the
+        mesh layout the table is per shard (ShardedPagedTables,
+        shard-LOCAL offsets) and row ids are (shard, row) pairs."""
         opp, epp, dpp, ipp, cpp = self.quotas
         segs = sorted(pool.segments.values(), key=lambda s: s.page0)
         if not segs:
             raise ValueError("an empty pool has nothing to launch")
+        if self.mesh_dp > 1:
+            return self._table_mesh(segs)
         units = [s.unit for s in segs]
         n = len(units)
 
@@ -471,6 +725,45 @@ class DeviceResidency:
         row_of = {s.seg_id: i for i, s in enumerate(segs)}
         return units, table, row_of
 
+    def _table_mesh(self, segs):
+        """Per-shard extraction tables of the mesh layout: every offset
+        is shard-local (the kernel computed each shard's wire in local
+        coordinates), rows are (shard, row) pairs."""
+        from kindel_tpu.parallel.meshexec import ShardedPagedTables
+
+        opp, epp, dpp, ipp, cpp = self.quotas
+        pps = self.pages_per_shard
+        sub = self.sub_geometry()
+        units: list = []
+        tables: list = []
+        row_of: dict = {}
+        for shard in range(self.mesh_dp):
+            mine = [s for s in segs if s.page0 // pps == shard]
+            slot_base = shard * pps * self.page_slots
+            n = len(mine)
+
+            def col(get, dtype=np.int32):
+                return np.fromiter(
+                    (get(s) for s in mine), np.int64, count=n
+                ).astype(dtype)
+
+            tables.append(SegmentTable(
+                page_class=sub,
+                entry_idx=np.zeros(n, np.int32),
+                seg_start=col(lambda s: s.slot_start - slot_base),
+                seg_len=col(lambda s: s.unit.L),
+                ev_off=col(lambda s: (s.page0 - shard * pps) * epp),
+                ev_len=col(lambda s: s.unit.n_events),
+                del_off=col(lambda s: (s.page0 - shard * pps) * dpp),
+                del_len=col(lambda s: len(s.unit.del_pos)),
+                ins_off=col(lambda s: (s.page0 - shard * pps) * ipp),
+                ins_len=col(lambda s: len(s.unit.ins_pos)),
+            ))
+            for i, s in enumerate(mine):
+                row_of[s.seg_id] = (shard, i)
+                units.append(s.unit)
+        return units, ShardedPagedTables(sub, tables), row_of
+
     def launch(self, opts):
         """Dispatch the segment kernel over the persistent arrays —
         zero upload beyond the two call scalars, same executable (and
@@ -491,6 +784,33 @@ class DeviceResidency:
             jnp.int32(opts.min_depth),
             jnp.int32(1 if opts.fix_clip_artifacts else 0),
         )
+        if self.mesh_dp > 1:
+            # mesh layout: the vmapped sharded kernel runs each shard's
+            # block on its own device — zero per-tick upload beyond the
+            # scalars, zero collectives (DESIGN.md §23)
+            from kindel_tpu.parallel import meshexec
+
+            sub = self.sub_geometry()
+            opp, epp, *_rest = self.quotas
+            n_ev = jnp.full((self.mesh_dp,), epp * self.pages_per_shard,
+                            jnp.int32)
+            dev = st[:6] + (st[-2], st[-1], n_ev) + scalars
+            if self.realign:
+                dev = dev + st[6:10]
+            sig = aot.sharded_ragged_sig(
+                c.key() + ("pagedmesh", self.page_slots), sub.key(),
+                opts.want_masks, opts.realign, opts.emit_device,
+                self.mesh_dp,
+            )
+            with meshexec.dispatch_guard():
+                out = aot.call(sig, dev)
+                if out is None:
+                    out = meshexec.sharded_ragged_kernel(
+                        *dev, n_slots=sub.n_slots, s_pad=sub.s_pad,
+                        want_masks=opts.want_masks, realign=opts.realign,
+                        emit=opts.emit_device,
+                    )
+            return out
         # arg order mirrors aot.ragged_args: 6 stream arrays + the
         # segment table pair + n_events, scalars, then clip channels.
         # n_events = e_cap: hole events are dropped by the PAD-span

@@ -39,9 +39,18 @@ def extract_flush(out, table, row_of, flush, opts) -> list:
             unit.sample_idx = idx
             row_units.append((row_of[seg.seg_id], unit))
             units_flat.append(unit)
-    outputs = unpack_rows(
-        out, table, row_units, opts, _InlineMap(), paths=paths
-    )
+    if hasattr(table, "shard_tables"):
+        # mesh-resident launch (DESIGN.md §23): rows are (shard, row)
+        # pairs against per-shard local tables
+        from kindel_tpu.parallel import meshexec
+
+        outputs = meshexec.unpack_sharded_rows(
+            out, table, row_units, opts, _InlineMap(), paths=paths
+        )
+    else:
+        outputs = unpack_rows(
+            out, table, row_units, opts, _InlineMap(), paths=paths
+        )
     grouped = _fold_results(units_flat, outputs, len(flush.bindings))
     return [
         (req, grouped[idx])

@@ -194,6 +194,12 @@ class PagePool:
     #: _place/_free mirror every ledger mutation into the persistent
     #: device arrays (delta patch on admit, coverage clear on retire)
     residency: object | None = None
+    #: mesh shard block width in pages (kindel_tpu.parallel.meshexec,
+    #: DESIGN.md §23): when > 0, no segment's page run may cross a
+    #: block boundary, so every stream extent lives wholly inside one
+    #: mesh shard and the residency's in-place patches stay
+    #: device-local. 0 = unconstrained (single-device layout)
+    shard_pages: int = 0
     _next_id: int = 0
     _used: np.ndarray = None
 
@@ -222,10 +228,15 @@ class PagePool:
     def _find_run(self, n: int) -> int | None:
         """First-fit contiguous free page run (None when fragmented or
         full). n_pages is small (≤ a few hundred), so a linear scan is
-        cheaper than maintaining a buddy structure."""
+        cheaper than maintaining a buddy structure. With `shard_pages`
+        set, the run additionally may not cross a mesh shard-block
+        boundary (the run resets at each block start) — the placement
+        half of the page-aligned sharding invariant."""
         free = ~self._used
         run = 0
         for i in range(self.n_pages):
+            if self.shard_pages and i % self.shard_pages == 0:
+                run = 0
             run = run + 1 if free[i] else 0
             if run >= n:
                 return i - n + 1

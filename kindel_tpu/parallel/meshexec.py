@@ -1,0 +1,605 @@
+"""Per-replica device mesh executor: one flush fans across every chip.
+
+PR 12 stretched the replica contract across process boundaries, but
+inside each replica every serve-path flush still launched on a single
+device. This module is the missing half (ROADMAP "Cross-host fleet,
+half two (a)"): a single **MeshPlan** — the replica's data-parallel
+device mesh, resolved like every knob (explicit > ``KINDEL_TPU_MESH`` >
+host-keyed tune store > all-local-devices default, with
+``KINDEL_TPU_FORCE_FUSED`` still pinning single-device everywhere) —
+hands shardings to the three dispatch tiers:
+
+  * **cohort rows** (`batch.launch_cohort_kernel`, the serve worker's
+    lane dispatch): batch-leading arrays are placed with a
+    ``NamedSharding`` over the ``dp`` axis. Rows are independent under
+    vmap, so XLA partitions the batched kernel with **zero
+    collectives** — the mesh generalization of the offline
+    `_dp_sharding` row split, now wired through the serve path too.
+  * **ragged slot axis** (`ragged.kernel` traffic): the flat slot axis
+    shards **page-aligned** — the superbatch splits into ``dp``
+    sub-superbatches of a 1/dp-rows page class, stacked on a leading
+    mesh axis and launched as ONE vmapped program whose inputs are
+    placed ``P("dp")``. Shard boundaries fall on page-class length
+    multiples, so every segment (and therefore every slot→segment
+    rank-cumsum attribution and every stream-extent slice) lives wholly
+    inside one shard: zero collectives again, which is what makes this
+    layout fast where naive GSPMD input sharding of the scatter drowns
+    in all-gathers. The jit/AOT signature stays page-geometry-only with
+    the mesh width as one new keying dimension
+    (`aot.sharded_ragged_sig`).
+  * **paged residency** (`paged/residency`): the persistent donated
+    buffers are laid out ``[dp, extent-block]`` and placed with the
+    mesh sharding at pool creation; the pool's page allocator keeps
+    every segment's page run inside one shard block, so delta-admission
+    ``dynamic_update_slice`` patches update the owning shard in place —
+    no per-tick reshard, per-tick h2d still ∝ newly-admitted segments.
+
+Byte-identity is the contract at every tier: the sharded layouts run
+the SAME kernel math over the same integer scatters (associative,
+order-independent), so FASTA out is identical for every dp — pinned by
+tests/test_meshexec.py across lanes/ragged/paged × realign × emit.
+
+The CDR-window fetch fix rides here too: `fetch_window_rows` /
+`fetch_window_flat` read a lazy realign window from the **owning
+shard's** host buffer (one small device→host copy) instead of the jit
+dynamic-slice path, which on a dp-sharded dense tensor resharded the
+whole tensor per window and made realign assembly wall-clock-dominate.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+from kindel_tpu.utils.jax_cache import ensure_compilation_cache
+
+ensure_compilation_cache()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kindel_tpu.obs import runtime as obs_runtime
+from kindel_tpu.obs import trace as obs_trace
+from kindel_tpu.resilience import faults as rfaults
+
+#: mesh axis name of the per-replica data-parallel fan-out
+DP_AXIS = "dp"
+
+#: process-wide multi-device dispatch serialization: two mesh programs
+#: issued concurrently from different serve threads (3 replicas × paged
+#: executor slots) can deadlock a backend whose multi-device execution
+#: rendezvouses per launch — observed on XLA:CPU in the 3-replica chaos
+#: suite as two launches each holding half the device pool. The lock
+#: covers ENQUEUE only (dispatch is async; device completion overlaps
+#: freely), so the cost is a few µs per sharded launch. Single-device
+#: dispatches never take it.
+import threading as _threading
+
+_DISPATCH_LOCK = _threading.Lock()
+
+
+def dispatch_guard():
+    """The process-wide mesh dispatch lock — every multi-device launch
+    site (sharded cohort, sharded ragged, residency patch/clear/launch)
+    enqueues under it."""
+    return _DISPATCH_LOCK
+
+
+_PLAN_INFO = None
+
+
+def _plan_info():
+    """The resolved mesh-plan Info metric (dp + source), cached on the
+    default registry like the transfer counters."""
+    global _PLAN_INFO
+    if _PLAN_INFO is None:
+        from kindel_tpu.obs.metrics import default_registry
+
+        _PLAN_INFO = default_registry().info(
+            "kindel_mesh_plan",
+            "resolved per-replica mesh width (dp) and where it came from",
+        )
+    return _PLAN_INFO
+
+
+@dataclass(frozen=True)
+class MeshPlan:
+    """One replica's resolved device-mesh plan. ``dp == 1`` means the
+    exact pre-mesh single-device dispatch everywhere (no mesh object,
+    no shardings, no new jit keys)."""
+
+    dp: int
+    source: str
+
+    @property
+    def active(self) -> bool:
+        return self.dp > 1
+
+    def key(self) -> int:
+        """The AOT-signature mesh dimension."""
+        return int(self.dp)
+
+    def mesh_for(self, dp: int) -> Mesh:
+        devices = np.asarray(jax.devices()[:dp])
+        return Mesh(devices, (DP_AXIS,))
+
+    # ------------------------------------------------------ cohort rows
+
+    def row_dp(self, n_rows: int) -> int:
+        """Effective row-sharding width for one cohort flush: the plan
+        width clamped to the row count (a 2-row flush on an 8-chip mesh
+        shards 2-wide; the caller pads rows to a dp multiple)."""
+        if not self.active or n_rows <= 1:
+            return 1
+        return min(self.dp, int(n_rows))
+
+    def pad_rows(self, n_rows: int) -> int:
+        """Round a padded row count up to a row_dp multiple so the
+        batch axis divides evenly over the mesh."""
+        dp = self.row_dp(max(1, n_rows))
+        return -(-int(n_rows) // dp) * dp
+
+    def row_sharding_for(self, n_rows: int):
+        """(sharding_fn, dp) for one cohort flush of ``n_rows`` padded
+        rows — sharding_fn(ndim) is the NamedSharding of one
+        batch-leading array, or None single-device. The documented
+        ``KINDEL_TPU_FORCE_FUSED`` single-chip pin is honored at plan
+        build, so it needs no re-check here."""
+        dp = self.row_dp(n_rows)
+        if dp <= 1 or n_rows % dp:
+            return None, 1
+        mesh = self.mesh_for(dp)
+        return (
+            lambda ndim: NamedSharding(
+                mesh, P(DP_AXIS, *([None] * (ndim - 1)))
+            ),
+            dp,
+        )
+
+
+def visible_devices() -> int:
+    return len(jax.devices())
+
+
+def plan(explicit: int | None = None) -> MeshPlan:
+    """Build this replica's MeshPlan: resolve the width knob
+    (kindel_tpu.tune — explicit > env > store > all-local-devices
+    default), clamp it to the devices actually visible, and honor the
+    documented single-chip pin. The result is stamped on the
+    ``kindel_mesh_plan`` Info metric so /metrics and bench both show
+    the serving mesh posture."""
+    import os
+
+    from kindel_tpu import tune
+
+    requested, source = tune.resolve_mesh_dp(explicit)
+    if os.environ.get("KINDEL_TPU_FORCE_FUSED"):
+        # README: "benchmark one chip in isolation" — the pin outranks
+        # every resolution source, exactly as it does in batch/workloads
+        p = MeshPlan(dp=1, source="forced-single")
+        _plan_info().set(dp="1", source=p.source)
+        return p
+    n_dev = visible_devices()
+    dp = n_dev if requested is None else min(int(requested), n_dev)
+    p = MeshPlan(dp=max(1, dp), source=source)
+    _plan_info().set(dp=str(p.dp), source=p.source)
+    return p
+
+
+# --------------------------------------------------------------------------
+# Ragged tier: page-aligned slot-axis sharding via dp sub-superbatches
+# --------------------------------------------------------------------------
+
+def ragged_dp(page_class, dp: int, n_units: int | None = None) -> int:
+    """Largest mesh width ``d ≤ dp`` the class's slot axis shards to,
+    page-aligned: ``d`` must divide the class's rows so each shard is a
+    whole-page-run block (rows/d × length slots — a multiple of the
+    class length, hence of the 8-slot granule and of every per-page
+    wire plane boundary). With fewer units than shards a narrower width
+    is used (an empty shard packs nothing)."""
+    if dp <= 1:
+        return 1
+    cap = min(int(dp), int(page_class.rows))
+    if n_units is not None:
+        cap = min(cap, max(1, int(n_units)))
+    for d in range(cap, 1, -1):
+        if page_class.rows % d == 0:
+            return d
+    return 1
+
+
+def sub_class(page_class, d: int):
+    """The 1/d-rows view of a page class — the per-shard geometry of a
+    sharded superbatch (same length, rows/d rows)."""
+    from kindel_tpu.ragged.pack import PageClass
+
+    return PageClass(page_class.name, page_class.rows // d,
+                     page_class.length)
+
+
+@dataclass
+class ShardedSuperbatch:
+    """One flush's units partitioned into dp page-aligned shards."""
+
+    page_class: object
+    sub: object
+    dp: int
+    groups: list  # per-shard unit lists
+    orders: list  # per-shard original unit indices
+    tables: list  # per-shard SegmentTable (sub-class geometry)
+
+    @property
+    def payload_slots(self) -> int:
+        return sum(int(t.payload_slots) for t in self.tables)
+
+    @property
+    def n_segments(self) -> int:
+        return sum(int(t.n_segments) for t in self.tables)
+
+    @property
+    def occupancy(self) -> float:
+        return self.payload_slots / float(self.page_class.n_slots)
+
+
+def shard_superbatch(units, page_class, plan_: MeshPlan,
+                     realign: bool = False) -> ShardedSuperbatch | None:
+    """Partition one flush's units into plan.dp page-aligned shards
+    (least-loaded-first by slots, largest stride first), or None when
+    the flush does not shard — one unit, a width that does not divide
+    the class rows, or a shard overflowing the sub-class capacities.
+    None is a fallback, not a failure: the caller launches the classic
+    single-device superbatch, byte-identically."""
+    from kindel_tpu.ragged import pack as rpack
+
+    d = ragged_dp(page_class, plan_.dp, n_units=len(units))
+    if d <= 1:
+        return None
+    sub = sub_class(page_class, d)
+    order = sorted(
+        range(len(units)),
+        key=lambda i: rpack.stride_for(units[i].L), reverse=True,
+    )
+    groups: list[list] = [[] for _ in range(d)]
+    idxs: list[list[int]] = [[] for _ in range(d)]
+    loads = [0] * d
+    for i in order:
+        u = units[i]
+        placed = False
+        for s in sorted(range(d), key=lambda k: loads[k]):
+            if rpack.fits(rpack.consumption(groups[s] + [u]), sub):
+                groups[s].append(u)
+                idxs[s].append(i)
+                loads[s] += rpack.stride_for(u.L)
+                placed = True
+                break
+        if not placed:
+            return None
+    if any(not g for g in groups):
+        return None
+    tables = [rpack.build_segment_table(g, sub) for g in groups]
+    return ShardedSuperbatch(
+        page_class=page_class, sub=sub, dp=d,
+        groups=groups, orders=idxs, tables=tables,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("n_slots", "s_pad", "want_masks", "realign", "emit"),
+)
+def sharded_ragged_kernel(*args, n_slots: int, s_pad: int,
+                          want_masks: bool = False, realign: bool = False,
+                          emit: bool = False):
+    """The mesh-sharded segment kernel: `ragged_call_kernel` vmapped
+    over a leading shard axis whose inputs are placed ``P("dp")`` — XLA
+    partitions the map embarrassingly parallel (each device runs its
+    own sub-superbatch; zero collectives by construction). Statics are
+    the SUB-geometry plus the wire variant: page-geometry-only with the
+    mesh width implied by the leading axis — one executable per
+    (class, variant, dp). The Pallas segment fast path stays
+    single-device; the sharded variant always runs the XLA segment
+    reduction (byte-identical by the shared-wire contract)."""
+    from kindel_tpu.ragged.kernel import ragged_call_kernel
+
+    core, scalars, clips = args[:9], args[9:11], args[11:]
+
+    def one(*xs):
+        return ragged_call_kernel(
+            *xs[:9], *scalars, *xs[9:],
+            n_slots=n_slots, s_pad=s_pad, want_masks=want_masks,
+            realign=realign, emit=emit, pallas_segments=False,
+        )
+
+    return jax.vmap(one)(*core, *clips)
+
+
+def stack_shards(per_shard_arrays) -> tuple:
+    """Stack dp per-shard array tuples into leading-axis host arrays."""
+    n = len(per_shard_arrays[0])
+    return tuple(
+        np.stack([np.asarray(a[k]) for a in per_shard_arrays])
+        for k in range(n)
+    )
+
+
+def place_stacked(plan_or_dp, arrays) -> tuple:
+    """Place arrays on a dp mesh, sharded along axis 0 (the leading
+    axis must divide by dp — stacked ``[dp, ...]`` shard layouts and
+    dp-divisible flat axes alike)."""
+    if isinstance(plan_or_dp, MeshPlan):
+        dp = plan_or_dp.dp
+        mesh = plan_or_dp.mesh_for(dp)
+    else:
+        dp = int(plan_or_dp)
+        mesh = Mesh(np.asarray(jax.devices()[:dp]), (DP_AXIS,))
+    return tuple(
+        jax.device_put(
+            a, NamedSharding(mesh, P(DP_AXIS, *([None] * (a.ndim - 1))))
+        )
+        for a in arrays
+    )
+
+
+def launch_sharded_superbatch(ssb: ShardedSuperbatch, opts):
+    """Pack + upload + launch one sharded superbatch (async like every
+    dispatch site): per-shard packs stack on the mesh axis, the AOT
+    registry is consulted under the mesh-keyed signature
+    (`aot.sharded_ragged_sig`), and a miss runs the jit kernel —
+    byte-identical either way. Upload bytes feed the same h2d counter
+    as every launch site."""
+    from kindel_tpu import aot
+    from kindel_tpu.ragged import pack as rpack
+
+    rfaults.hook("device.dispatch")
+    packs = [
+        rpack.pack_superbatch(g, t, realign=opts.realign)
+        for g, t in zip(ssb.groups, ssb.tables)
+    ]
+    stacked = stack_shards(packs)
+    h2d_bytes = sum(int(a.nbytes) for a in stacked)
+    obs_runtime.transfer_counters()[0].inc(h2d_bytes)
+    with obs_trace.span("ragged.mesh_launch") as sp:
+        sig = aot.sharded_ragged_sig(
+            ssb.page_class.key(), ssb.sub.key(), opts.want_masks,
+            opts.realign, opts.emit_device, ssb.dp,
+        )
+        with dispatch_guard():
+            dev = aot.ragged_args(place_stacked(ssb.dp, stacked), opts)
+            out = aot.call(sig, dev)
+            aot_hit = out is not None
+            if out is None:
+                out = sharded_ragged_kernel(
+                    *dev, n_slots=ssb.sub.n_slots, s_pad=ssb.sub.s_pad,
+                    want_masks=opts.want_masks, realign=opts.realign,
+                    emit=opts.emit_device,
+                )
+        if sp is not obs_trace.NOOP_SPAN:
+            sp.set_attribute(
+                page_class=ssb.page_class.label(), dp=ssb.dp,
+                n_slots=ssb.sub.n_slots, h2d_bytes=h2d_bytes,
+                aot=aot_hit, realign=opts.realign, emit=opts.emit_device,
+            )
+    return out
+
+
+def export_sharded(ssb: ShardedSuperbatch, opts, verify: bool = True):
+    """AOT-export the sharded segment kernel for one (class, dp) pair
+    (warmup miss path) — packs the shards exactly as the launch does so
+    lowering and dispatch agree on avals AND shardings."""
+    from kindel_tpu import aot
+    from kindel_tpu.ragged import pack as rpack
+
+    packs = [
+        rpack.pack_superbatch(g, t, realign=opts.realign)
+        for g, t in zip(ssb.groups, ssb.tables)
+    ]
+    dev = aot.ragged_args(
+        place_stacked(ssb.dp, stack_shards(packs)), opts
+    )
+    statics = {
+        "n_slots": ssb.sub.n_slots, "s_pad": ssb.sub.s_pad,
+        "want_masks": opts.want_masks, "realign": opts.realign,
+        "emit": opts.emit_device,
+    }
+    return aot.export_sharded_ragged(
+        dev, ssb.page_class, ssb.sub, opts, ssb.dp, statics,
+        verify=verify,
+    )
+
+
+def _shard_block(arr, shard: int):
+    """The owning device's block of a ``[dp, ...]`` mesh-sharded array,
+    as a SINGLE-device array. Never indexes the sharded array itself:
+    ``arr[shard]`` compiles a cross-device gather, and two such
+    programs racing from different serve threads deadlock the
+    backend's multi-device rendezvous (observed on XLA:CPU under the
+    3-replica chaos suite). `addressable_shards` reads are device-local
+    by construction."""
+    shard = int(shard)
+    for s in arr.addressable_shards:
+        idx = s.index[0]
+        lo = idx.start or 0
+        hi = idx.stop if idx.stop is not None else int(arr.shape[0])
+        if lo <= shard < hi:
+            return s.data[shard - lo]
+    # replicated or oddly-placed layout: host materialization is the
+    # safe (single owner) fallback
+    return np.asarray(arr)[shard]
+
+
+def shard_out_view(out, shard: int, realign: bool):
+    """One shard's slice of a sharded launch result, in the exact shape
+    `ragged.unpack.unpack_rows` consumes: the wire row alone, or the
+    (wire, dense...) tuple under realign — every piece a single-device
+    array on the owning device (see `_shard_block`)."""
+    if realign:
+        wire, *dense = out
+        return (_shard_block(wire, shard),) + tuple(
+            _shard_block(d, shard) for d in dense
+        )
+    return _shard_block(out, shard)
+
+
+def unpack_sharded_superbatch(out, ssb: ShardedSuperbatch, opts, pool,
+                              paths=None) -> list:
+    """Per-unit extraction of every shard, restored to the ORIGINAL
+    unit order (a multi-reference request's consensuses must fold in
+    the order its units arrived, exactly as the single-device path
+    emits them)."""
+    from kindel_tpu.ragged.unpack import unpack_superbatch
+
+    n_total = sum(len(g) for g in ssb.groups)
+    results: list = [None] * n_total
+    for s in range(ssb.dp):
+        view = shard_out_view(out, s, opts.realign)
+        outs = unpack_superbatch(
+            view, ssb.tables[s], ssb.groups[s], opts, pool, paths=paths
+        )
+        for orig, r in zip(ssb.orders[s], outs):
+            results[orig] = r
+    return results
+
+
+# --------------------------------------------------------------------------
+# Paged tier: mesh geometry of the persistent residency arrays
+# --------------------------------------------------------------------------
+
+def paged_dp(page_class, page_slots: int, dp: int) -> int:
+    """Largest mesh width ``d ≤ dp`` the paged pool's page grid shards
+    to: ``d`` must divide the page count so each shard is a whole block
+    of pages (quotas are per-page, so every stream extent then lives
+    wholly inside one shard block — the page-aligned invariant the
+    in-place patches rely on)."""
+    if dp <= 1:
+        return 1
+    n_pages = page_class.n_slots // page_slots
+    max_run = -(-int(page_class.length) // page_slots)
+    for d in range(min(int(dp), n_pages), 1, -1):
+        # each shard block must hold the largest admissible page run
+        # (class length), or an oversize unit could never place
+        if n_pages % d == 0 and (n_pages // d) >= max_run:
+            return d
+    return 1
+
+
+@dataclass(frozen=True)
+class SubGeometry:
+    """Per-shard geometry of a mesh-sharded paged launch — duck-typed
+    to the `PageClass` surface `wire_sizes` and the kernel statics
+    read (n_slots / s_pad / d_cap / i_cap)."""
+
+    n_slots: int
+    s_pad: int
+    d_cap: int
+    i_cap: int
+
+    def key(self) -> tuple:
+        return ("pagedsub", self.n_slots, self.s_pad, self.d_cap,
+                self.i_cap)
+
+
+class ShardedPagedTables:
+    """Per-shard extraction tables of one mesh-resident paged launch.
+    `shard_tables[k]` carries shard-LOCAL slot/stream offsets; row ids
+    are (shard, row) pairs."""
+
+    def __init__(self, sub: SubGeometry, shard_tables: list):
+        self.sub = sub
+        self.shard_tables = shard_tables
+
+    @property
+    def n_segments(self) -> int:
+        return sum(int(t.n_segments) for t in self.shard_tables)
+
+
+def unpack_sharded_rows(out, stables: ShardedPagedTables, row_units, opts,
+                        pool, paths=None) -> list:
+    """`ragged.unpack.unpack_rows` over a mesh-sharded paged launch:
+    pairs carry (shard, row) ids; each shard's pairs extract against
+    that shard's wire view and LOCAL table, results re-assembled in
+    pair order (the subset semantics — cached panel segments ride along
+    unread — carry over per shard)."""
+    per_shard: dict[int, list] = {}
+    for pos, ((shard, row), unit) in enumerate(row_units):
+        per_shard.setdefault(int(shard), []).append((pos, int(row), unit))
+    results: list = [None] * len(row_units)
+    from kindel_tpu.ragged.unpack import unpack_rows
+
+    for shard, items in per_shard.items():
+        view = shard_out_view(out, shard, opts.realign)
+        outs = unpack_rows(
+            view, stables.shard_tables[shard],
+            [(row, unit) for _pos, row, unit in items],
+            opts, pool, paths=paths,
+        )
+        for (pos, _row, _unit), r in zip(items, outs):
+            results[pos] = r
+    return results
+
+
+# --------------------------------------------------------------------------
+# Owning-shard window fetches (the sharded-CDR-fetch fix)
+# --------------------------------------------------------------------------
+
+def _is_multi_device(arr) -> bool:
+    sharding = getattr(arr, "sharding", None)
+    if sharding is None:
+        return False
+    try:
+        return len(sharding.device_set) > 1
+    except (AttributeError, TypeError):
+        # sharding object without a device set (e.g. a tracer's): the
+        # callers' classic fetch path is always correct
+        return False
+
+
+def fetch_window_rows(arr, row: int, start: int, chunk: int, fallback):
+    """One row's ``[start, start+chunk)`` window of a (possibly
+    row-sharded) dense tensor, as a host array. On a dp-sharded tensor
+    the window reads from the OWNING shard's device buffer — one small
+    d2h copy — instead of the jit dynamic-slice path, which reshards
+    the whole tensor per window and made sharded realign assembly take
+    minutes. `fallback()` runs the classic fetch on single-device (or
+    oddly-sharded) tensors."""
+    if not _is_multi_device(arr):
+        return fallback()
+    row = int(row)
+    for shard in arr.addressable_shards:
+        idx = shard.index[0]
+        lo = idx.start or 0
+        hi = idx.stop if idx.stop is not None else int(arr.shape[0])
+        if lo <= row < hi:
+            # slice the on-device shard lazily, then download only the
+            # window (declared download site: bytes are counted by the
+            # calling fetcher)
+            return np.asarray(shard.data[row - lo, start: start + chunk])
+    return fallback()
+
+
+def fetch_window_flat(arr, start: int, chunk: int, fallback):
+    """``[start, start+chunk)`` of a (possibly axis-0-sharded) flat
+    dense tensor, stitched from the owning shard(s) — the flat-axis
+    counterpart of `fetch_window_rows` (a window may touch two shards
+    when a segment sits at a page-run boundary)."""
+    if not _is_multi_device(arr):
+        return fallback()
+    start, chunk = int(start), int(chunk)
+    n = int(arr.shape[0])
+    start = max(0, min(start, n - chunk))  # dynamic_slice clamp semantics
+    pieces = []
+    for shard in arr.addressable_shards:
+        idx = shard.index[0]
+        lo = idx.start or 0
+        hi = idx.stop if idx.stop is not None else n
+        a, b = max(lo, start), min(hi, start + chunk)
+        if a < b:
+            pieces.append((a, np.asarray(shard.data[a - lo: b - lo])))
+    if not pieces:
+        return fallback()
+    pieces.sort(key=lambda t: t[0])
+    out = np.concatenate([p for _a, p in pieces])
+    if len(out) != chunk:
+        return fallback()
+    return out

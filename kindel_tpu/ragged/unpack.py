@@ -75,10 +75,22 @@ class SegmentCdrFetcher(LazyCdrWindows):
         self._chunk = min(4096, self.Lp)
 
     def _fetch(self, key: str, start: int) -> np.ndarray:
+        from kindel_tpu.parallel import meshexec
+
         arr = self._arrs[key]
-        fetch = _fetch_flat2d if arr.ndim == 2 else _fetch_flat1d
-        win = np.asarray(
-            fetch(arr, jnp.int32(self._base + start), chunk=self._chunk)
+
+        def classic():
+            fetch = _fetch_flat2d if arr.ndim == 2 else _fetch_flat1d
+            return np.asarray(
+                fetch(arr, jnp.int32(self._base + start),
+                      chunk=self._chunk)
+            )
+
+        # dp-sharded flat tensors: stitch the window from the owning
+        # shard(s) instead of the whole-tensor-resharding jit slice
+        # (kindel_tpu.parallel.meshexec — the sharded-CDR-fetch fix)
+        win = meshexec.fetch_window_flat(
+            arr, self._base + start, self._chunk, classic
         )
         obs_runtime.transfer_counters()[1].inc(int(win.nbytes))
         return win

@@ -228,6 +228,15 @@ class ConsensusService:
         obs_runtime.ingest_counters().mode.set(
             mode=self.ingest_mode, source=im_src
         )
+        # per-replica device mesh (DESIGN.md §23): one flush fans
+        # across every local device; resolved like every knob (explicit
+        # > KINDEL_TPU_MESH > host-keyed store > all-local-devices) and
+        # handed to the worker, the paged batcher, and the warmup so
+        # all three dispatch tiers run the same plan
+        from kindel_tpu.parallel import meshexec
+
+        self.mesh_plan = meshexec.plan(getattr(tuning, "mesh", None))
+        self._m_tune_source.set(knob="mesh", source=self.mesh_plan.source)
         self._ragged_classes: tuple = ()
         self.queue = RequestQueue(
             max_depth=max_depth, high_watermark=high_watermark,
@@ -246,7 +255,7 @@ class ConsensusService:
 
                 self.batcher = PagedBatcher(
                     self._ragged_classes, max_batch_rows=max_batch_rows,
-                    max_wait_s=max_wait_s,
+                    max_wait_s=max_wait_s, mesh_plan=self.mesh_plan,
                 )
             else:
                 self.batcher = RaggedBatcher(
@@ -270,7 +279,7 @@ class ConsensusService:
             decode_workers=decode_workers, row_bucket=row_bucket,
             breaker=self.breaker, retry=retry, watchdog_s=watchdog_s,
             numpy_fallback=numpy_fallback, lane_coalesce=lane_coalesce,
-            ingest_mode=self.ingest_mode,
+            ingest_mode=self.ingest_mode, mesh_plan=self.mesh_plan,
         )
         self._http: ServeHTTPServer | None = None
         self._http_host = http_host
@@ -380,6 +389,7 @@ class ConsensusService:
                 self.default_opts, row_bucket=self.worker.row_bucket,
                 payloads=self._warm_payloads,
                 ingest_mode=self.ingest_mode,
+                mesh_plan=self.mesh_plan,
             )
             if (
                 self.batch_mode in ("ragged", "paged")
@@ -394,7 +404,8 @@ class ConsensusService:
                 from kindel_tpu.serve.warmup import warm_ragged
 
                 timings.update(
-                    warm_ragged(self.default_opts, self._ragged_classes)
+                    warm_ragged(self.default_opts, self._ragged_classes,
+                                mesh_plan=self.mesh_plan)
                 )
             self._m_warm_shapes.inc(len(timings))
             for label, t in timings.items():

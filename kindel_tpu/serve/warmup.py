@@ -63,7 +63,8 @@ def shape_label(shapes: tuple, n_rows: int) -> str:
 
 def warm_shapes(opts, row_bucket: int = 8, payloads=(),
                 include_synthetic: bool = True,
-                ingest_mode: str = "host") -> dict[str, dict]:
+                ingest_mode: str = "host",
+                mesh_plan=None) -> dict[str, dict]:
     """Ready the batched cohort kernel for every lane shape the given
     payloads (plus the minimal synthetic cohort) land in — by loading a
     stored AOT executable when the store is warm, by compiling (and
@@ -106,7 +107,17 @@ def warm_shapes(opts, row_bucket: int = 8, payloads=(),
             continue
         shapes = cohort_pad_shapes(units, opts)
         n_rows = _bucket(len(units), row_bucket)
+        sharding, mesh_dp = None, 1
+        if mesh_plan is not None and getattr(mesh_plan, "active", False):
+            # warm the SAME sharded layout the worker will dispatch
+            # (DESIGN.md §23): a warm mesh must serve unseen traffic
+            # with zero new compiles, so the warmed avals/shardings and
+            # the served ones have to agree exactly
+            n_rows = mesh_plan.pad_rows(n_rows)
+            sharding, mesh_dp = mesh_plan.row_sharding_for(n_rows)
         label = shape_label(shapes, n_rows)
+        if mesh_dp > 1:
+            label += f":dp{mesh_dp}"
         if label in timings:
             continue
         rfaults.hook("device.compile")
@@ -114,7 +125,7 @@ def warm_shapes(opts, row_bucket: int = 8, payloads=(),
         _c0, compile_wall0 = obs_runtime.compile_totals()
         arrays, meta = pack_cohort(units, opts, n_rows=n_rows, shapes=shapes)
         if aot.enabled():
-            loaded = aot.load_cohort(arrays, meta, opts)
+            loaded = aot.load_cohort(arrays, meta, opts, mesh=mesh_dp)
             if loaded is not None:
                 source = "store"
             else:
@@ -123,10 +134,13 @@ def warm_shapes(opts, row_bucket: int = 8, payloads=(),
                 # registers either way, so dispatch below — and every
                 # later flush of this lane — skips the jit cache
                 source = "fresh"
-                aot.export_cohort(arrays, meta, opts)
+                aot.export_cohort(arrays, meta, opts, sharding=sharding,
+                                  mesh=mesh_dp)
         else:
             source = "disabled"
-        out, _meta = launch_cohort_kernel(arrays, meta, opts)
+        out, _meta = launch_cohort_kernel(arrays, meta, opts,
+                                          sharding=sharding,
+                                          mesh_dp=mesh_dp)
         wire = out[0] if opts.realign else out
         np.asarray(wire)  # block: load/compile + execute must be done
         total = time.monotonic() - t0
@@ -141,7 +155,7 @@ def warm_shapes(opts, row_bucket: int = 8, payloads=(),
     return timings
 
 
-def warm_ragged(opts, classes) -> dict[str, dict]:
+def warm_ragged(opts, classes, mesh_plan=None) -> dict[str, dict]:
     """Ready the ragged superbatch kernel for every page class — the
     `--batch-mode ragged` counterpart of `warm_shapes`, with one
     decisive difference: a page class's geometry is fixed, so warming
@@ -221,4 +235,65 @@ def warm_ragged(opts, classes) -> dict[str, dict]:
                 "execute_s": max(0.0, total - compile_s),
                 "source": source,
             }
+        if mesh_plan is not None and getattr(mesh_plan, "active", False):
+            timings.update(
+                _warm_ragged_mesh(cls, variants, units, realign_units,
+                                  mesh_plan)
+            )
+    return timings
+
+
+def _warm_ragged_mesh(cls, variants, units, realign_units,
+                      mesh_plan) -> dict[str, dict]:
+    """Mesh-sharded counterpart of the per-class warm loop: one
+    dp-replicated synthetic superbatch per wire variant readies the
+    vmapped sharded executable (kindel_tpu.parallel.meshexec) — the
+    sub-geometry is fixed per (class, dp), so arbitrary traffic on a
+    warm mesh compiles nothing, exactly the page-class contract."""
+    import numpy as np
+
+    from kindel_tpu import aot
+    from kindel_tpu.obs import runtime as obs_runtime
+    from kindel_tpu.parallel import meshexec
+    from kindel_tpu.resilience import faults as rfaults
+
+    timings: dict[str, dict] = {}
+    for suffix, vopts in variants:
+        vunits = realign_units if vopts.realign else units
+        d = meshexec.ragged_dp(cls, mesh_plan.dp, n_units=None)
+        if d <= 1:
+            continue
+        # one unit per shard: the synthetic cohort replicated wide
+        # enough that every shard packs something
+        wide = (vunits * d)[: max(d, len(vunits))]
+        ssb = meshexec.shard_superbatch(
+            wide, cls, mesh_plan, realign=vopts.realign
+        )
+        if ssb is None:
+            continue
+        label = f"ragged:{cls.label()}{suffix}:dp{ssb.dp}"
+        rfaults.hook("device.compile")
+        t0 = time.monotonic()
+        _c0, compile_wall0 = obs_runtime.compile_totals()
+        if aot.enabled():
+            if aot.load_sharded_ragged(cls, ssb.sub, vopts,
+                                       ssb.dp) is not None:
+                source = "store"
+            else:
+                source = "fresh"
+                meshexec.export_sharded(ssb, vopts)
+        else:
+            source = "disabled"
+        out = meshexec.launch_sharded_superbatch(ssb, vopts)
+        wire = out[0] if vopts.realign else out
+        np.asarray(wire)  # block: load/compile + execute must be done
+        total = time.monotonic() - t0
+        _c1, compile_wall1 = obs_runtime.compile_totals()
+        compile_s = max(0.0, compile_wall1 - compile_wall0)
+        timings[label] = {
+            "total_s": total,
+            "compile_s": compile_s,
+            "execute_s": max(0.0, total - compile_s),
+            "source": source,
+        }
     return timings

@@ -289,10 +289,15 @@ class ServeWorker:
                  watchdog_s: float | None = None,
                  numpy_fallback: bool = True, supervise: bool = True,
                  supervise_interval_s: float = 0.1,
-                 lane_coalesce: int = 1, ingest_mode: str = "host"):
+                 lane_coalesce: int = 1, ingest_mode: str = "host",
+                 mesh_plan=None):
         self.queue = queue
         self.batcher = batcher
         self._clock = clock
+        #: per-replica device mesh plan (kindel_tpu.parallel.meshexec,
+        #: DESIGN.md §23): one flush fans across every local device.
+        #: None = single-device dispatch, the exact pre-mesh behavior
+        self.mesh_plan = mesh_plan
         #: where request decode's scan/expand run (resolved once by the
         #: service through kindel_tpu.tune): "device" routes payloads
         #: through kindel_tpu.devingest, byte-identically
@@ -975,34 +980,74 @@ class ServeWorker:
         if probing:
             cache_before = obs_runtime.jit_cache_entries()
             launch_window["t0"] = time.perf_counter()
+        plan = self.mesh_plan
         if page_class is not None:
             from kindel_tpu.ragged import build_segment_table, pack_superbatch
             from kindel_tpu.ragged.kernel import launch_ragged
             from kindel_tpu.ragged.unpack import unpack_superbatch
 
-            table = build_segment_table(units, page_class)
-            arrays = pack_superbatch(units, table, realign=opts.realign)
-            wire = launch_ragged(arrays, page_class, opts)
-            if probing:
-                launch_window["t1"] = time.perf_counter()
-                launch_window["compiled_new"] = (
-                    obs_runtime.jit_cache_entries() - cache_before
+            # mesh-sharded superbatch (DESIGN.md §23): the flush splits
+            # into dp page-aligned sub-superbatches launched as ONE
+            # vmapped program over the dp axis — byte-identical FASTA;
+            # a flush that does not shard (one unit, shard overflow)
+            # falls through to the classic single-device launch
+            ssb = None
+            if plan is not None and plan.active:
+                from kindel_tpu.parallel import meshexec
+
+                ssb = meshexec.shard_superbatch(
+                    units, page_class, plan, realign=opts.realign
                 )
-                launch_window["h2d_bytes"] = sum(a.nbytes for a in arrays)
+            if ssb is not None:
+                out = meshexec.launch_sharded_superbatch(ssb, opts)
+                if probing:
+                    launch_window["t1"] = time.perf_counter()
+                    launch_window["compiled_new"] = (
+                        obs_runtime.jit_cache_entries() - cache_before
+                    )
+                payload_slots = ssb.payload_slots
+                occupancy = ssb.occupancy
+                n_segments = ssb.n_segments
+            else:
+                table = build_segment_table(units, page_class)
+                arrays = pack_superbatch(units, table, realign=opts.realign)
+                out = launch_ragged(arrays, page_class, opts)
+                if probing:
+                    launch_window["t1"] = time.perf_counter()
+                    launch_window["compiled_new"] = (
+                        obs_runtime.jit_cache_entries() - cache_before
+                    )
+                    launch_window["h2d_bytes"] = sum(
+                        a.nbytes for a in arrays
+                    )
+                payload_slots = table.payload_slots
+                occupancy = table.occupancy
+                n_segments = table.n_segments
             payload, padded = _padding_counters()
-            payload.inc(table.payload_slots)
+            payload.inc(payload_slots)
             padded.inc(page_class.n_slots)
             m_occ, m_segs, m_super = _ragged_metrics()
-            m_occ.observe(table.occupancy)
-            m_segs.observe(table.n_segments)
+            m_occ.observe(occupancy)
+            m_segs.observe(n_segments)
             m_super.labels(page_class=page_class.name).inc()
-            outputs = unpack_superbatch(
-                wire, table, units, opts, self._assemble_pool, paths
-            )
+            if ssb is not None:
+                outputs = meshexec.unpack_sharded_superbatch(
+                    out, ssb, opts, self._assemble_pool, paths
+                )
+            else:
+                outputs = unpack_superbatch(
+                    out, table, units, opts, self._assemble_pool, paths
+                )
             return outputs, units
         n_rows = _bucket(len(units), self.row_bucket)
+        sharding, mesh_dp = None, 1
+        if plan is not None and plan.active:
+            n_rows = plan.pad_rows(n_rows)
+            sharding, mesh_dp = plan.row_sharding_for(n_rows)
         arrays, meta = pack_cohort(units, opts, n_rows=n_rows, shapes=shapes)
-        device_out = launch_cohort_kernel(arrays, meta, opts)
+        device_out = launch_cohort_kernel(
+            arrays, meta, opts, sharding=sharding, mesh_dp=mesh_dp
+        )
         if probing:
             launch_window["t1"] = time.perf_counter()
             launch_window["compiled_new"] = (

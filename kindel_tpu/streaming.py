@@ -470,7 +470,7 @@ def streamed_consensus(
     from kindel_tpu.realign import cdrp_consensuses, merge_cdrps
     from kindel_tpu.workloads import _shardable_device_count, build_report, result
 
-    n_dev = _shardable_device_count() if backend == "jax" else 0
+    n_dev = _shardable_device_count(tuning) if backend == "jax" else 0
     if backend == "jax" and (n_dev > 1 or realign):
         # streamed × sharded: chunks reduce into position-sharded device
         # state, the close runs the product kernel — bounded RSS *and*

@@ -98,6 +98,14 @@ INGEST_MODES = ("host", "device")
 EMIT_MODE_DEFAULT = "host"
 EMIT_MODES = ("host", "device")
 
+#: per-replica device-mesh width (data-parallel fan-out of one flush
+#: across the replica's visible devices — kindel_tpu.parallel.meshexec):
+#: None = "auto" (all local devices); the env pin is KINDEL_TPU_MESH,
+#: `kindel serve/consensus --mesh N` pins it explicitly, and `kindel
+#: tune --mesh-budget-s` persists a measured winner host-keyed. dp=1
+#: disables sharding (the exact pre-mesh single-device dispatch).
+MESH_DP_DEFAULT = None
+
 #: serve batching mode: "lanes" = the shape-keyed micro-batcher (one
 #: compiled kernel per lane shape), "ragged" = page-class superbatching
 #: (kindel_tpu.ragged — one compiled kernel per page class serves all
@@ -161,6 +169,7 @@ class TuningConfig:
     ingest_workers: int | None = None
     ingest_mode: str | None = None
     emit_mode: str | None = None
+    mesh: int | None = None
     lane_coalesce: int | None = None
     batch_mode: str | None = None
     ragged_classes: str | None = None
@@ -684,6 +693,70 @@ def search_emit_mode(measure, budget_s: float = 30.0,
     return min(usable, key=usable.get), timings
 
 
+def mesh_store_key() -> str:
+    """The mesh width is a property of this host's device topology and
+    link (how many chips one flush profitably fans across) — host-keyed
+    like the ingest/emit knobs; the device count itself re-validates at
+    plan-build time (kindel_tpu.parallel.meshexec clamps to what is
+    actually visible)."""
+    return "mesh|" + host_fingerprint()
+
+
+def resolve_mesh_dp(explicit: int | None = None) -> tuple[int | None, str]:
+    """The per-replica mesh-width knob (data-parallel fan-out of one
+    flush — kindel_tpu.parallel.meshexec): explicit arg > KINDEL_TPU_MESH
+    > host-keyed store > default (None = all local devices). Returns
+    (dp | None, source); None means "auto" — the plan builder resolves
+    it to the visible device count. A malformed env pin is explicit
+    operator intent to override the store and falls through to the
+    default; a malformed store entry is ignored. The value here is a
+    REQUEST: meshexec clamps it to the devices actually present, and
+    KINDEL_TPU_FORCE_FUSED still pins single-device everywhere."""
+    if explicit is not None:
+        return max(1, int(explicit)), "explicit"
+    pin, present = _env_int("KINDEL_TPU_MESH")
+    if pin is not None:
+        return max(1, pin), "env"
+    if present:  # malformed pin — explicit operator intent to override
+        return MESH_DP_DEFAULT, "default"
+    entry = lookup(mesh_store_key())
+    if entry and isinstance(entry.get("mesh_dp"), int):
+        return max(1, entry["mesh_dp"]), "cache"
+    return MESH_DP_DEFAULT, "default"
+
+
+def search_mesh_dp(measure, candidates=(1, 2, 4, 8),
+                   budget_s: float = 30.0, clock=time.perf_counter):
+    """Budget-bounded mesh-width search: probe each candidate dp while
+    the wall budget lasts and return (best_dp, {dp: seconds}).
+    `measure(dp) -> wall seconds` receives the width EXPLICITLY (no env
+    mutation — the shared search contract); a width whose probe raises
+    scores unusable (inf) rather than failing the sweep, so a host
+    whose backend rejects a layout still tunes. `kindel tune
+    --mesh-budget-s` persists the winner under mesh_store_key()."""
+    from kindel_tpu.obs import trace as obs_trace
+
+    timings: dict[int, float] = {}
+    t0 = clock()
+    for dp in candidates:
+        with obs_trace.span("tune.mesh_probe") as sp:
+            try:
+                wall = measure(dp)
+            except Exception as exc:
+                wall = float("inf")
+                if sp is not obs_trace.NOOP_SPAN:
+                    sp.set_attribute(error=repr(exc))
+            if sp is not obs_trace.NOOP_SPAN:
+                sp.set_attribute(dp=dp, wall_s=round(wall, 4))
+        timings[dp] = wall
+        if clock() - t0 > budget_s:
+            break
+    usable = {k: v for k, v in timings.items() if v != float("inf")}
+    if not usable:
+        return 1, timings
+    return min(usable, key=usable.get), timings
+
+
 def resolve_cohort_budget_mb(explicit: int | None = None) -> tuple[int, str]:
     """The cohort device-footprint budget: explicit arg >
     KINDEL_TPU_COHORT_BUDGET_MB > default (512 MB). Not measured — it is
@@ -923,6 +996,7 @@ def resolve(explicit: TuningConfig | None = None, backend: str = "cpu",
     ingest_mode, s8 = resolve_ingest_mode(e.ingest_mode)
     rpc_timeout, s9 = resolve_rpc_timeout_ms(e.rpc_timeout_ms)
     max_body, s10 = resolve_max_body_mb(e.max_body_mb)
+    mesh_dp, s11 = resolve_mesh_dp(e.mesh)
     # knob provenance into the shared exposition: one Info sample per
     # (knob, source, value) — the serve /metrics and bench snapshots show
     # WHERE each performance knob came from, not just its value
@@ -942,17 +1016,22 @@ def resolve(explicit: TuningConfig | None = None, backend: str = "cpu",
     info.set(knob="ingest_mode", source=s8, value=ingest_mode)
     info.set(knob="rpc_timeout_ms", source=s9, value=str(rpc_timeout))
     info.set(knob="max_body_mb", source=s10, value=str(max_body))
+    info.set(
+        knob="mesh", source=s11,
+        value="auto" if mesh_dp is None else str(mesh_dp),
+    )
     return TuningConfig(
         n_slabs=n_slabs, stream_chunk_mb=chunk, cohort_budget_mb=budget,
         ingest_workers=ingest, ingest_mode=ingest_mode,
-        lane_coalesce=coalesce,
+        mesh=mesh_dp, lane_coalesce=coalesce,
         batch_mode=batch_mode, ragged_classes=ragged_classes,
         rpc_timeout_ms=rpc_timeout, max_body_mb=max_body,
         sources=(("n_slabs", s1), ("stream_chunk_mb", s2),
                  ("cohort_budget_mb", s3), ("ingest_workers", s4),
                  ("lane_coalesce", s5), ("batch_mode", s6),
                  ("ragged_classes", s7), ("ingest_mode", s8),
-                 ("rpc_timeout_ms", s9), ("max_body_mb", s10)),
+                 ("rpc_timeout_ms", s9), ("max_body_mb", s10),
+                 ("mesh", s11)),
     )
 
 

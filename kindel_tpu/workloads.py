@@ -41,16 +41,25 @@ def consensus_result(sample_result) -> result:
     )
 
 
-def _shardable_device_count() -> int:
-    """Visible jax devices for auto-sharding the position axis; 0 disables
-    (KINDEL_TPU_FORCE_FUSED=1 keeps the single-device fused kernel)."""
+def _shardable_device_count(tuning=None) -> int:
+    """Visible jax devices for auto-sharding the position axis, bounded
+    by the resolved mesh-width knob (`--mesh` / KINDEL_TPU_MESH /
+    host-keyed store — kindel_tpu.parallel.meshexec); 0 disables
+    (KINDEL_TPU_FORCE_FUSED=1 keeps the single-device fused kernel, and
+    a mesh width of 1 pins single-device the same way)."""
     import os
 
     if os.environ.get("KINDEL_TPU_FORCE_FUSED"):
         return 0
+    from kindel_tpu import tune
+
+    requested, _src = tune.resolve_mesh_dp(getattr(tuning, "mesh", None))
     import jax
 
-    return len(jax.devices())
+    n_dev = len(jax.devices())
+    if requested is not None:
+        n_dev = min(n_dev, max(1, int(requested)))
+    return 0 if n_dev <= 1 else n_dev
 
 
 def _resolve_stream_chunk(bam_path, stream_chunk_mb,
@@ -92,7 +101,7 @@ def _load_pileups(bam_path, backend: str,
     chunk_mb = _resolve_stream_chunk(
         bam_path, stream_chunk_mb, backend, tuning=tuning
     )
-    sharded = backend == "jax" and _shardable_device_count() > 1
+    sharded = backend == "jax" and _shardable_device_count(tuning) > 1
     if chunk_mb is not None:
         if sharded:
             # per-base channels reduce on the position-sharded mesh,
@@ -320,7 +329,7 @@ def _bam_to_consensus(
     with maybe_phase("event extraction"):
         ev = extract_events(batch)
 
-    n_dev = _shardable_device_count() if backend == "jax" else 0
+    n_dev = _shardable_device_count(tuning) if backend == "jax" else 0
 
     def _shard_ok(rid):
         return n_dev > 1 and int(ev.ref_lens[rid]) >= n_dev

@@ -1,0 +1,19 @@
+"""Known-bad: a mesh kernel whose helper reads env one call deep
+(trace-purity, parallel scope — PR 14): the mesh width must resolve at
+plan-build time (kindel_tpu.tune / meshexec.plan), never inside a
+traced body — a traced read bakes one width into the compiled program
+and the knob silently stops responding."""
+
+import os
+from functools import partial
+
+import jax
+
+
+def _mesh_width():
+    return int(os.environ.get("KINDEL_TPU_MESH", "1"))
+
+
+@partial(jax.jit, static_argnames=())
+def bad_mesh_kernel(state):
+    return state[:: _mesh_width()]

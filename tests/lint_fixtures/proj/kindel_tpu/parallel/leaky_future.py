@@ -1,0 +1,14 @@
+"""Known-bad: a created Future leaks on a sharded-launch failure path
+(future-settlement, parallel scope — PR 14) — the handler logs the
+shard failure but forgets the waiter."""
+
+from concurrent.futures import Future
+
+
+def sharded_launch_leaky(launch, log):
+    fut = Future()
+    try:
+        fut.set_result(launch())
+    except Exception:
+        log("shard launch failed")  # waiter stranded forever
+    return None

@@ -229,6 +229,24 @@ def test_fixture_scope_extension_hits_parallel(fixture_results):
     assert any("parallel/" in f.path for f in swallow.findings)
 
 
+def test_fixture_scope_extension_hits_meshexec(fixture_results):
+    """The meshexec scope extension (PR 14 satellite): the parallel/
+    tier now sits inside the future-settlement exactly-once contract
+    (the sharded launch/unpack path owns admitted futures) and its jit
+    kernels inside the trace-purity closure — one known-bad fixture per
+    rule scope."""
+    by_id = {r.spec.id: r for r in fixture_results}
+    assert any(
+        "parallel/leaky_future" in f.path
+        for f in by_id["future-settlement"].findings
+    )
+    purity = [
+        f for f in by_id["trace-purity"].findings
+        if "parallel/" in f.path
+    ]
+    assert purity and all("_mesh_width" in f.message for f in purity)
+
+
 def test_fixture_fleet_rpc_scope(fixture_results):
     """The fleet RPC tier (PR 12 satellite): the wire code paths sit
     inside both exactly-once disciplines — a swallowed transport error

@@ -309,8 +309,14 @@ def test_zero_compile_replica_start(tmp_path, monkeypatch):
 
     # -- replica 0: cold host. Warmup compiles (via the AOT surface,
     # parity-checked) and bakes the store — `kindel tune --export-aot`
-    # in miniature.
-    baked = warm_shapes(BatchOptions(), payloads=[str(sam)])
+    # in miniature. The bake runs under the host's resolved mesh plan
+    # (DESIGN.md §23), exactly as --export-aot does, so the sharded
+    # executables the serving replica dispatches are the ones baked.
+    from kindel_tpu.parallel import meshexec
+
+    baked = warm_shapes(
+        BatchOptions(), payloads=[str(sam)], mesh_plan=meshexec.plan()
+    )
     assert baked and all(t["source"] == "fresh" for t in baked.values())
 
     # -- replica 1: fresh process stand-in — empty registry, empty jit

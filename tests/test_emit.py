@@ -29,14 +29,13 @@ from kindel_tpu.serve.worker import decode_request
 WORKER_COUNTS = (1, 2, 8)
 
 
-@pytest.fixture(autouse=True)
-def _single_device(monkeypatch):
-    """conftest forces 8 fake CPU devices; the cohort API then shards
-    batch-leading arrays over a dp mesh, and the realign path's lazy
-    CDR window fetches against SHARDED dense tensors crawl on the
-    fake-device backend. The documented single-chip pin keeps these
-    parity tests about emission, not sharding."""
-    monkeypatch.setenv("KINDEL_TPU_FORCE_FUSED", "1")
+# (PR 14) These parity tests previously pinned KINDEL_TPU_FORCE_FUSED=1
+# because the realign path's lazy CDR window fetches against dp-sharded
+# dense tensors crawled (each jit dynamic-slice resharded the whole
+# tensor). The mesh executor's owning-shard window fetch
+# (kindel_tpu.parallel.meshexec.fetch_window_rows) removed the crawl,
+# so emission parity now runs on the conftest-forced 8-device mesh —
+# the sharded layout IS the served layout.
 
 
 def _counter(name: str) -> float:

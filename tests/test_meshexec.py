@@ -1,0 +1,407 @@
+"""kindel_tpu.parallel.meshexec — per-replica mesh-sharded dispatch.
+
+Covers: knob precedence (explicit > env > host-keyed store >
+all-local-devices default, malformed env/store fallback, the
+FORCE_FUSED pin), the page-alignment properties of the ragged slot-axis
+and paged page-grid sharding, the byte-identity matrix (dispatch tier ×
+dp × realign × emit mode) on the conftest-forced 8-device CPU mesh,
+sharded paged admit/retire churn parity against the single-device
+oracle, the zero-compile warm-mesh pin, the owning-shard CDR-window
+fetch (content parity + a wall-time budget — the jit dynamic-slice path
+resharded the whole dp-sharded tensor per window), and the flagship:
+mixed traffic through a 3-replica fleet on an active mesh under faults
+with a kill + drain, FASTA identical to single-device lanes.
+"""
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from kindel_tpu import tune
+from kindel_tpu.batch import BatchOptions, _RowCdrFetcher, _dispatch_device_call
+from kindel_tpu.obs import runtime as obs_runtime
+from kindel_tpu.parallel import meshexec
+from kindel_tpu.ragged import parse_classes
+from kindel_tpu.ragged import pack as rpack
+from kindel_tpu.resilience import FaultPlan
+from kindel_tpu.resilience import faults as rfaults
+from kindel_tpu.serve import ConsensusClient, ConsensusService
+from kindel_tpu.serve.queue import ServeRequest
+from kindel_tpu.serve.worker import decode_request
+from kindel_tpu.tune import TuningConfig
+
+from tests.test_paged import _mixed_sams
+from tests.test_serve import make_sam
+
+CLASSES = parse_classes("small:32x2048,medium:16x8192")
+
+
+def _decode(payload, **opt_kwargs):
+    return decode_request(
+        ServeRequest(payload=payload, opts=BatchOptions(**opt_kwargs))
+    )
+
+
+# --------------------------------------------------------------- knob
+
+
+def test_mesh_knob_precedence(monkeypatch, tmp_path):
+    monkeypatch.setenv("KINDEL_TPU_TUNE_CACHE", str(tmp_path / "t.json"))
+    # default: auto (all local devices at plan build)
+    assert tune.resolve_mesh_dp() == (None, "default")
+    assert meshexec.plan().dp == meshexec.visible_devices()
+    # store
+    tune.record(tune.mesh_store_key(), {"mesh_dp": 2})
+    assert tune.resolve_mesh_dp() == (2, "cache")
+    assert meshexec.plan().dp == 2
+    # env beats store
+    monkeypatch.setenv("KINDEL_TPU_MESH", "4")
+    assert tune.resolve_mesh_dp() == (4, "env")
+    # explicit beats env
+    assert tune.resolve_mesh_dp(2) == (2, "explicit")
+    assert meshexec.plan(2).dp == 2
+    # malformed env: operator intent to override the store → default
+    monkeypatch.setenv("KINDEL_TPU_MESH", "bogus")
+    assert tune.resolve_mesh_dp() == (None, "default")
+    # malformed store entry is ignored → default
+    monkeypatch.delenv("KINDEL_TPU_MESH")
+    tune.record(tune.mesh_store_key(), {"mesh_dp": "three"})
+    assert tune.resolve_mesh_dp() == (None, "default")
+    # a request wider than the host clamps to the visible devices
+    assert meshexec.plan(64).dp == meshexec.visible_devices()
+
+
+def test_force_fused_pins_single_device(monkeypatch):
+    monkeypatch.setenv("KINDEL_TPU_FORCE_FUSED", "1")
+    p = meshexec.plan(8)
+    assert p.dp == 1 and p.source == "forced-single"
+    assert p.row_sharding_for(8) == (None, 1)
+
+
+# ----------------------------------------------------- page alignment
+
+
+def test_ragged_shard_page_alignment_property():
+    """Every width ragged_dp offers splits the slot axis on page-class
+    length multiples (hence 8-slot granule / wire-byte boundaries), and
+    shard_superbatch never lets a segment cross a shard boundary —
+    segments live wholly inside one sub-superbatch by construction."""
+    from kindel_tpu.ragged.pack import GRANULE, PageClass
+
+    for rows, length in ((32, 2048), (16, 8192), (24, 1024), (8, 65536)):
+        cls = PageClass("t", rows, length)
+        for dp in (1, 2, 3, 4, 5, 8, 16):
+            d = meshexec.ragged_dp(cls, dp)
+            assert d >= 1 and cls.rows % d == 0
+            if d > 1:
+                sub = meshexec.sub_class(cls, d)
+                assert sub.n_slots * d == cls.n_slots
+                # shard boundary = a whole number of class lengths →
+                # page- and granule-aligned
+                assert sub.n_slots % cls.length == 0
+                assert sub.n_slots % GRANULE == 0
+
+
+def test_paged_shard_alignment_property():
+    """paged_dp only offers widths whose shard blocks are whole page
+    runs large enough for the largest admissible segment, and the
+    shard-constrained pool never places a run across a block."""
+    from kindel_tpu.paged import PAGE_SLOTS, PagePool
+
+    for cls in CLASSES:
+        for dp in (2, 4, 8):
+            d = meshexec.paged_dp(cls, PAGE_SLOTS, dp)
+            n_pages = cls.n_slots // PAGE_SLOTS
+            assert d >= 1 and n_pages % max(d, 1) == 0
+            if d > 1:
+                pps = n_pages // d
+                assert pps * PAGE_SLOTS >= cls.length
+    pool = PagePool(CLASSES[0], clock=time.monotonic)
+    pool.shard_pages = 4
+    # pages 0-2 used, 3 free: a 2-page run may NOT start at page 3
+    # (it would cross the block boundary at page 4) — it lands at 4
+    pool._used[:3] = True
+    assert pool._find_run(2) == 4
+    assert pool._find_run(1) == 3  # a 1-page run still fits the tail
+
+
+# ------------------------------------------------------ byte identity
+
+
+def _serve_all(sams, mode, mesh, **opt_kwargs):
+    results = [None] * len(sams)
+    errors: list = []
+    with ConsensusService(
+        tuning=TuningConfig(batch_mode=mode, mesh=mesh),
+        max_wait_s=0.1, decode_workers=4, **opt_kwargs,
+    ) as svc:
+        client = ConsensusClient(svc)
+
+        def one(i):
+            try:
+                results[i] = client.fasta(str(sams[i]), timeout=300)
+            except Exception as e:  # noqa: BLE001
+                errors.append((i, repr(e)))
+
+        threads = [
+            threading.Thread(target=one, args=(i,))
+            for i in range(len(sams))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert not errors, errors
+    return results
+
+
+def test_byte_identity_matrix_tier_by_dp(tmp_path):
+    """The acceptance bar: dp∈{1,2,4,8} on the forced 8-device mesh
+    produces identical FASTA through lanes, ragged, and paged modes."""
+    sams = _mixed_sams(tmp_path, 6, seed_base=77)
+    base = _serve_all(sams, "lanes", 1)
+    for mode in ("lanes", "ragged", "paged"):
+        for dp in (2, 4, 8):
+            got = _serve_all(sams, mode, dp)
+            assert got == base, (mode, dp)
+
+
+def test_byte_identity_realign_and_emit_modes(tmp_path):
+    """Realign traffic and both emit modes ride the mesh byte-
+    identically (the realign CDR walk reads dp-sharded dense tensors
+    through the owning-shard fetch; device emission extracts per-shard
+    ASCII planes)."""
+    sams = _mixed_sams(tmp_path, 5, seed_base=13)
+    base_r = _serve_all(sams, "lanes", 1, realign=True)
+    base_e = _serve_all(sams, "lanes", 1, emit_mode="device")
+    for mode in ("lanes", "ragged", "paged"):
+        assert _serve_all(sams, mode, 4, realign=True) == base_r, mode
+        assert _serve_all(sams, mode, 4, emit_mode="device") == base_e, mode
+
+
+# --------------------------------------------- paged residency churn
+
+
+def test_sharded_paged_admit_retire_churn_parity(tmp_path):
+    """Admit/retire churn over a mesh-resident pool (in-place patches
+    on the [dp, block] donated arrays) stays byte-identical to the
+    single-device oracle across launches."""
+    from kindel_tpu.paged import PagedBatcher
+    from kindel_tpu.paged.retire import _InlineMap
+    from kindel_tpu.workloads import bam_to_consensus
+
+    plan = meshexec.plan(4)
+    b = PagedBatcher(CLASSES[:1], mesh_plan=plan, max_wait_s=0.01)
+    opts = BatchOptions()
+    lane = b._lane_for(("k",), CLASSES[0], opts)
+    res = lane.pool.residency
+    assert res is not None and res.mesh_dp == 4
+    assert lane.pool.shard_pages == res.pages_per_shard
+
+    def admit(i):
+        sam = make_sam(tmp_path / f"u{i}.sam", ref=f"r{i}",
+                       L=380 + 83 * i, n_reads=12, seed=i)
+        (u,) = _decode(str(sam))
+        seg = lane.pool.admit_unit(u, rpack.consumption([u]))
+        assert seg is not None
+        return seg, u, sam
+
+    def check(trips):
+        u2, stables, row_of = res.table(lane.pool)
+        out = res.launch(opts)
+        pairs = [(row_of[s.seg_id], u) for s, u, _p in trips]
+        outs = meshexec.unpack_sharded_rows(
+            out, stables, pairs, opts, _InlineMap()
+        )
+        for (_s, u, sam), r in zip(trips, outs):
+            want = bam_to_consensus(str(sam), backend="numpy")
+            seq = (
+                want.consensuses[0].sequence
+                if hasattr(want, "consensuses") else want[0][0].sequence
+            )
+            assert r[0].sequence == seq, u.ref_id
+
+    trips = [admit(i) for i in range(5)]
+    assert res.active
+    check(trips)
+    # churn: retire two, admit three more, launch again
+    for seg, _u, _p in trips[:2]:
+        seg.panel = None
+        lane.pool.release(seg)
+    trips = trips[2:] + [admit(i) for i in range(5, 8)]
+    assert res.active
+    check(trips)
+
+
+# ------------------------------------------------- zero-compile pin
+
+
+def test_zero_compile_warm_mesh(tmp_path, monkeypatch):
+    monkeypatch.setenv(
+        "KINDEL_TPU_TUNE_CACHE", str(tmp_path / "tune.json")
+    )
+    _zero_compile_warm_mesh(tmp_path)
+
+
+def _zero_compile_warm_mesh(tmp_path):
+    """Changing traffic on a warm mesh compiles nothing: after warmup
+    of the synthetic lane + the page classes under an active plan,
+    unseen requests that land in warmed lane shapes / page classes add
+    zero jit-cache entries — cohort and sharded ragged alike."""
+    from kindel_tpu.batch import (
+        cohort_pad_shapes,
+        launch_cohort_kernel,
+        pack_cohort,
+    )
+    from kindel_tpu.pileup_jax import _bucket
+    from kindel_tpu.serve import warmup
+
+    plan = meshexec.plan(4)
+    opts = BatchOptions()
+    warmup.warm_shapes(opts, mesh_plan=plan)
+    warmup.warm_ragged(opts, CLASSES[:1], mesh_plan=plan)
+
+    before = obs_runtime.jit_cache_entries()
+    # cohort traffic landing in the warmed synthetic lane shapes
+    synth = warmup.decode_payload(warmup._SYNTH_SAM, opts)
+    shapes = cohort_pad_shapes(synth, opts)
+    sam = make_sam(tmp_path / "w.sam", ref="w", L=333, n_reads=2, seed=9)
+    units = _decode(str(sam))
+    n_rows = plan.pad_rows(_bucket(len(units), 8))
+    sharding, dp = plan.row_sharding_for(n_rows)
+    arrays, meta = pack_cohort(units, opts, n_rows=n_rows, shapes=shapes)
+    out, _ = launch_cohort_kernel(arrays, meta, opts, sharding=sharding,
+                                  mesh_dp=dp)
+    np.asarray(out)
+    # sharded ragged traffic of a different unit mix, same class
+    mix = []
+    for i in range(4):
+        s = make_sam(tmp_path / f"w{i}.sam", ref=f"w{i}",
+                     L=200 + 50 * i, n_reads=6, seed=40 + i)
+        mix.extend(_decode(str(s)))
+    ssb = meshexec.shard_superbatch(mix, CLASSES[0], plan)
+    assert ssb is not None and ssb.dp == 4
+    np.asarray(meshexec.launch_sharded_superbatch(ssb, opts))
+    assert obs_runtime.jit_cache_entries() == before, (
+        "warm mesh compiled on unseen traffic"
+    )
+
+
+# ------------------------------------------------- sharded CDR fetch
+
+
+def test_sharded_cdr_fetch_window_parity_and_budget(tmp_path):
+    """The owning-shard window fetch returns exactly what the full
+    download holds, and a burst of window fetches against a dp-sharded
+    dense tensor stays far from the minutes the resharding jit path
+    cost (generous wall bound — the fix is orders of magnitude under
+    it)."""
+    optsr = BatchOptions(realign=True)
+    units = []
+    for i in range(8):
+        s = make_sam(tmp_path / f"c{i}.sam", ref=f"c{i}", L=900,
+                     n_reads=30, seed=i)
+        units.extend(_decode(str(s), realign=True))
+    with tune.env_pin("KINDEL_TPU_MESH", "4"):
+        out, meta = _dispatch_device_call(units, optsr)
+    wire, *dense = out
+    np.asarray(wire)
+    assert len(getattr(dense[0], "sharding").device_set) > 1
+    f = _RowCdrFetcher(dense, 3, 900)
+    t0 = time.perf_counter()
+    for _ in range(40):
+        win = f._fetch("weights", 0)
+    wall = time.perf_counter() - t0
+    assert np.array_equal(win, np.asarray(dense[0])[3][: f._chunk])
+    assert wall < 5.0, f"sharded CDR window fetches took {wall:.1f}s"
+
+
+# ----------------------------------------------------- flagship fleet
+
+
+def test_flagship_fleet_chaos_on_mesh_sha_identical(tmp_path):
+    """Mixed-shape traffic through a 3-replica supervised fleet on an
+    active mesh (dp=2) under injected flush faults with a replica kill
+    and a drain mid-load: every request settles exactly once and the
+    FASTA is identical to a single-device lanes run."""
+    from kindel_tpu.fleet import FleetService
+    from kindel_tpu.io.fasta import format_fasta
+
+    sams = _mixed_sams(tmp_path, 8, seed_base=53)
+    want = _serve_all(sams, "lanes", 1)
+    plan_ = rfaults.activate(
+        FaultPlan.parse("seed=9,serve.flush:error:times=2:after=1")
+    )
+    results = [None] * len(sams)
+    errors: list = []
+    try:
+        svc = FleetService(
+            replicas=3, probe_interval_s=0.02, max_wait_s=0.05,
+            decode_workers=4,
+            tuning=TuningConfig(batch_mode="ragged", mesh=2),
+        ).start()
+        try:
+            barrier = threading.Barrier(len(sams) + 1)
+
+            def one(i):
+                barrier.wait()
+                try:
+                    res = svc.request(str(sams[i]), timeout=300)
+                    results[i] = format_fasta(res.consensuses)
+                except Exception as e:  # noqa: BLE001
+                    errors.append((i, repr(e)))
+
+            threads = [
+                threading.Thread(target=one, args=(i,))
+                for i in range(len(sams))
+            ]
+            for t in threads:
+                t.start()
+            barrier.wait()
+            time.sleep(0.15)
+            svc.kill_replica("r1")
+            time.sleep(0.25)
+            svc.drain("r2")
+            for t in threads:
+                t.join()
+        finally:
+            svc.stop()
+    finally:
+        rfaults.deactivate()
+    assert not errors, errors
+    assert results == want, "mesh fleet FASTA diverged from lanes"
+    assert plan_.fired == {("serve.flush", "error"): 2}
+
+
+# --------------------------------------------------------- misc bits
+
+
+def test_shard_superbatch_falls_back_cleanly():
+    """A flush that cannot shard (single unit) returns None — the
+    caller launches the classic single-device superbatch."""
+    plan = meshexec.plan(8)
+    synth_units = _decode_synth()
+    assert meshexec.shard_superbatch(synth_units[:1], CLASSES[0], plan) \
+        is None
+
+
+def _decode_synth():
+    from kindel_tpu.serve.warmup import _SYNTH_SAM
+
+    return _decode(bytes(_SYNTH_SAM))
+
+
+def test_fetch_window_flat_stitches_across_shards():
+    """A flat window that straddles a shard boundary stitches from
+    both owning shards, byte-for-byte equal to the full download."""
+    arr = np.arange(4096, dtype=np.int32)
+    # place_stacked shards axis 0: a flat [4096] array splits into 4
+    # contiguous 1024-element shard blocks
+    flat = meshexec.place_stacked(4, [arr])[0]
+    win = meshexec.fetch_window_flat(
+        flat, 1000, 128, lambda: pytest.fail("fallback taken")
+    )
+    assert np.array_equal(win, arr[1000:1128])
