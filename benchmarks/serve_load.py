@@ -49,7 +49,7 @@ def _synth_sam(dest: Path, ref_len: int = 2048, n_reads: int = 200,
 def run_load(bam_path=None, clients: int = 4, requests_per_client: int = 8,
              max_wait_s: float = 0.01, max_batch_rows: int = 64,
              replicas: int = 0, procs: int = 0, chaos=None,
-             **service_kwargs) -> dict:
+             service_config=None, **service_kwargs) -> dict:
     """Run the closed loop; returns a JSON-able report dict.
 
     `replicas` > 0 runs the loop against a FleetService of that many
@@ -61,7 +61,10 @@ def run_load(bam_path=None, clients: int = 4, requests_per_client: int = 8,
     gains an `rpc` object (call p50/p99, retries, dedupe hits, scale
     events). `chaos` is an optional callable invoked on its own thread
     once the clients start — `chaos(service)` — the fleet chaos
-    suite's hook for killing and draining replicas mid-run. Every
+    suite's hook for killing and draining replicas mid-run.
+    `service_config` merges extra ConsensusService knobs into each
+    replica process's config (procs mode only — the durable-journal
+    chaos suite passes journal_dir/quarantine_after through it). Every
     completed request's FASTA feeds `fasta_sha256` (digest over the
     sorted set of distinct outputs), so two runs are byte-comparable
     without shipping sequences around.
@@ -95,7 +98,7 @@ def run_load(bam_path=None, clients: int = 4, requests_per_client: int = 8,
             replicas=procs,
             service_config=dict(
                 max_wait_s=max_wait_s, max_batch_rows=max_batch_rows,
-                decode_workers=2,
+                decode_workers=2, **(service_config or {}),
             ),
             **service_kwargs,
         )
@@ -265,6 +268,9 @@ def rpc_report(before: dict, after: dict) -> dict:
     seconds = after.get("kindel_rpc_call_seconds", {})
     if not isinstance(seconds, dict):
         seconds = {}
+    respawn_s = after.get("kindel_fleet_respawn_seconds", {})
+    if not isinstance(respawn_s, dict):
+        respawn_s = {}
     return {
         "calls": {
             outcome: (
@@ -300,6 +306,11 @@ def rpc_report(before: dict, after: dict) -> dict:
             ),
         },
         "respawns": delta("kindel_fleet_respawns_total"),
+        # spawn→ready wall per process generation (the respawn-latency
+        # satellite): how long a recovery-from-host-loss actually takes,
+        # from the same recent-window quantiles as the call latencies
+        "respawn_p50_ms": round(float(respawn_s.get("p50", 0.0)) * 1e3, 2),
+        "respawn_p99_ms": round(float(respawn_s.get("p99", 0.0)) * 1e3, 2),
     }
 
 

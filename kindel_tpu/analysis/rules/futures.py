@@ -31,8 +31,11 @@ from kindel_tpu.analysis.model import ProjectModel
 #: PR 11: a launch tick owns its entries' futures until settle/recover;
 #: emit in PR 13: emission decode runs inside the settle path; parallel
 #: in PR 14: the mesh executor's sharded launch/unpack sits inside the
-#: serve dispatch path that owns admitted futures)
-FUTURE_SCOPE = ("serve", "fleet", "paged", "emit", "parallel")
+#: serve dispatch path that owns admitted futures; durable in PR 15:
+#: journal replay re-creates admitted requests and pre-claims
+#: idempotency-cache futures — a leaked claim strands every wire
+#: resubmission of that key forever)
+FUTURE_SCOPE = ("serve", "fleet", "paged", "emit", "parallel", "durable")
 
 #: constructors whose result is (or owns) a fresh unsettled Future
 _CREATORS = {"Future", "ServeRequest"}

@@ -493,9 +493,13 @@ SWALLOW_ALLOWLIST = {
 #: references — a swallowed failure leaks both), and emit (the
 #: device-rendered emission decode sits on the same admitted-request
 #: settle path as the classic wire decoders)
+#: ... and durable (PR 15): the admission journal is the crash-recovery
+#: source of truth — a swallowed journal write error silently converts
+#: "durable" into "best effort", which is the one lie the subsystem
+#: must never tell
 SWALLOW_SCOPE = (
     "serve", "resilience", "fleet", "ragged", "parallel", "devingest",
-    "paged", "emit",
+    "paged", "emit", "durable",
 )
 
 

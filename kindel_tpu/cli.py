@@ -587,6 +587,31 @@ def _serve_parser(sub):
              "requests get 413 + Retry-After before any allocation "
              "(explicit > $KINDEL_TPU_MAX_BODY_MB > default 1024)",
     )
+    p.add_argument(
+        "--journal-dir", default=None, metavar="DIR",
+        help="durable admission journal (DESIGN.md §24): WAL every "
+             "admitted request under DIR so a SIGKILLed replica "
+             "process replays its orphans at respawn instead of losing "
+             "them; fleet modes give each replica slot its own "
+             "subdirectory (explicit > $KINDEL_TPU_JOURNAL_DIR > off)",
+    )
+    p.add_argument(
+        "--quarantine-after", type=int, default=None, metavar="K",
+        help="quarantine a journal entry blamed for K process crashes "
+             "instead of replaying it again — the poison request then "
+             "fails typed (HTTP 422, no retry) while healthy traffic "
+             "serves (explicit > $KINDEL_TPU_QUARANTINE_AFTER > 3)",
+    )
+    p.add_argument(
+        "--replica-addrs", default=None, metavar="HOST:PORT,...",
+        help="static fleet roster: drive PRE-SPAWNED remote replicas "
+             "(each running python -m kindel_tpu.fleet.procreplica, or "
+             "any serve stack with the RPC adapter routes) at these "
+             "addresses over RPC — spawn/respawn disabled, probe/"
+             "evict/drain/failover unchanged; the multi-host leg "
+             "(overrides --replicas/--replica-mode; incompatible with "
+             "autoscaling)",
+    )
 
 
 def install_drain_handlers(stop_event) -> None:
@@ -650,6 +675,8 @@ def cmd_serve(args) -> int:
         uppercase=args.uppercase,
         warmup=not args.no_warmup,
         warm_payloads=args.warm,
+        journal_dir=args.journal_dir,
+        quarantine_after=args.quarantine_after,
     )
     autoscale = (
         args.min_replicas is not None and args.max_replicas is not None
@@ -657,7 +684,29 @@ def cmd_serve(args) -> int:
     fleet_wanted = (
         args.replicas > 1 or autoscale or args.replica_mode == "process"
     )
-    if fleet_wanted:
+    if args.replica_addrs:
+        # static roster (DESIGN.md §24 / ROADMAP multi-host leg b):
+        # pre-spawned remote replicas join the fleet by address —
+        # spawn/respawn disabled, probe/evict/drain/failover unchanged
+        from kindel_tpu.fleet import static_fleet
+
+        service = static_fleet(
+            args.replica_addrs,
+            rpc_timeout_ms=args.rpc_timeout_ms,
+            http_host=args.host,
+            http_port=args.port,
+            probe_interval_s=args.probe_interval_ms / 1e3,
+            hedge_s=(
+                args.hedge_ms / 1e3 if args.hedge_ms is not None else None
+            ),
+            fleet_watermark=args.fleet_watermark,
+            max_body_mb=args.max_body_mb,
+        )
+        posture = (
+            f"static roster of {len(service.replicas)} remote "
+            "replicas over RPC (spawn/respawn disabled)"
+        )
+    elif fleet_wanted:
         fleet_kwargs = dict(
             replicas=max(args.replicas, args.min_replicas or 1),
             http_host=args.host,

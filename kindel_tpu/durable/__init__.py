@@ -1,0 +1,49 @@
+"""kindel_tpu.durable — crash-consistent serving state (DESIGN.md §24).
+
+The serve/fleet tiers guarantee "no admitted request lost" across flush
+faults, replica death, and wire loss — but only while *some* process
+still holds the admitted future. A SIGKILLed replica process abandons
+every request it had admitted, and the router can replay only what the
+dead process handed back, which a kill never does. This package closes
+that gap with three pieces:
+
+  * `journal` — a per-replica append-only admission journal (CRC-framed
+    records, fsync-batched group commit, segment rotation + retired-
+    entry GC): admit writes ``{key, payload digest, spooled request
+    bytes, opts}`` before the queue accepts, settle writes a tombstone,
+    dispatch stamps an in-flight marker of the launching tick's member
+    keys so a crash mid-flush is attributable on replay.
+  * `recovery` — the startup scan + replay state machine: torn tails
+    and CRC-failed records truncate cleanly (never crash), unsettled
+    entries re-submit through the normal admission path under their
+    original idempotency keys (the fleet dedupe cache makes replay
+    at-most-once by construction), and entries blamed for
+    ``--quarantine-after`` crashes are quarantined instead of replayed.
+  * `PoisonRequestError` — the typed verdict for a quarantined payload
+    (HTTP 422, no retry-after): one malformed request can no longer
+    crash-loop a replica while healthy traffic starves.
+
+jax-free by construction: the journal moves bytes and dicts; only the
+service it protects touches the device.
+"""
+
+from kindel_tpu.durable.journal import (
+    Journal,
+    PoisonRequestError,
+    journal_metrics,
+    mark_if_active,
+    settle_if_active,
+)
+from kindel_tpu.durable.recovery import ScanResult, gc_segments, replay, scan
+
+__all__ = [
+    "Journal",
+    "PoisonRequestError",
+    "ScanResult",
+    "gc_segments",
+    "journal_metrics",
+    "mark_if_active",
+    "replay",
+    "scan",
+    "settle_if_active",
+]

@@ -1,0 +1,487 @@
+"""Append-only admission journal: the write-ahead log under the serve
+queue (DESIGN.md §24).
+
+Frame format (little-endian), one record per frame::
+
+    "KJ" | type:u8 | len:u32 | payload[len] | crc32:u32
+
+The CRC covers ``type + len + payload``, so a torn tail (partial write
+at the moment of death) or a flipped byte fails the check and recovery
+truncates the segment THERE — a damaged journal degrades to a shorter
+one, never to a crash. Payloads are compact JSON: debuggable with
+``head``, versionable without a schema registry.
+
+Record types:
+
+  ADMIT       ``{k, d, p|f, o}`` — idempotency key, payload digest,
+              spooled request bytes (base64) or path, opt overrides.
+              Written BEFORE the queue accepts, fsynced (group commit)
+              before submit returns: an admitted request is durable.
+  SETTLE      ``{k, out}`` — the tombstone: the request's future
+              resolved (ok/error/handback). Flushed to the OS (survives
+              SIGKILL) but not fsynced — replaying a settled entry is
+              harmless (idempotency cache × purity × first-wins settle),
+              losing an unsettled one is not, so only admits pay fsync.
+  MARK        ``{ks: [...]}`` — the in-flight marker: the launching
+              tick's member keys, written once per admission life at
+              dispatch. A key whose mark never settles was in flight
+              when the process died — that is what makes a crash
+              mid-flush *attributable* on replay (recovery's blame
+              count, the quarantine ladder's input).
+  QUARANTINE  ``{k, d}`` — the poison verdict: this entry crashed the
+              process ``quarantine_after`` times and is never replayed
+              again; payloads with digest ``d`` are rejected at
+              admission with `PoisonRequestError` (HTTP 422).
+
+Segments rotate at `segment_bytes`; a rotated segment whose every admit
+key has settled is unlinked (retired-entry GC), so a long-lived replica
+holds O(live entries) journal bytes, not O(history). Each Journal owns
+its directory exclusively (the fleet gives every replica slot its own
+subdirectory, stable across respawns).
+
+fsync batching is group commit: concurrent admits append under the
+lock, and whoever fsyncs covers every frame written before it — later
+admits observe the synced offset and skip their own fsync.
+
+The disabled path is allocation-free per the PR 4 convention: the hot
+paths call `mark_if_active`/`settle_if_active` with the service's
+journal handle, and with journaling off that is one None check —
+pinned by tracemalloc in tests/test_durable.py.
+
+Fault sites `journal.write` and `journal.fsync` (resilience/faults.py)
+fire inside append and sync respectively, so chaos plans can pin what a
+failed write means: an admit that cannot be made durable is REJECTED
+(typed, retryable) and never half-trusted.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import os
+import struct
+import threading
+import uuid
+import binascii
+from pathlib import Path
+
+from kindel_tpu.resilience import faults
+from kindel_tpu.resilience.policy import record_degrade
+
+MAGIC = b"KJ"
+#: record types
+REC_ADMIT = 1
+REC_SETTLE = 2
+REC_MARK = 3
+REC_QUARANTINE = 4
+
+_HDR = struct.Struct("<BI")
+_CRC = struct.Struct("<I")
+#: frame overhead: magic + type/len header + crc trailer
+FRAME_OVERHEAD = len(MAGIC) + _HDR.size + _CRC.size
+
+#: rotate the live segment past this many bytes
+SEGMENT_BYTES_DEFAULT = 8 << 20
+
+SEGMENT_PREFIX = "seg-"
+SEGMENT_SUFFIX = ".kj"
+
+
+class PoisonRequestError(RuntimeError):
+    """The payload's digest is quarantined: an identical request crashed
+    this replica `quarantine_after` times and was taken out of replay.
+    A REQUEST-level verdict (HTTP 422, no retry-after): the router
+    surfaces it to the caller instead of failing over — the request
+    would kill every replica it lands on."""
+
+    def __init__(self, message: str, digest: str = ""):
+        super().__init__(message)
+        self.digest = digest
+
+
+class JournalWriteError(RuntimeError):
+    """An admit could not be made durable (write or fsync failed). The
+    admission is rejected — a request the journal cannot protect is
+    never half-admitted."""
+
+
+def encode_frame(rtype: int, doc: dict) -> bytes:
+    """One CRC-framed record (see module docstring for the layout)."""
+    payload = json.dumps(doc, separators=(",", ":")).encode()
+    hdr = _HDR.pack(rtype, len(payload))
+    crc = binascii.crc32(payload, binascii.crc32(hdr))
+    return MAGIC + hdr + payload + _CRC.pack(crc & 0xFFFFFFFF)
+
+
+def payload_digest(payload) -> str:
+    """Stable identity of one request payload: sha256 of the bytes (or
+    of a path marker for path payloads) — what quarantine keys on, and
+    the prefix of generated idempotency keys."""
+    if isinstance(payload, (bytes, bytearray)):
+        return hashlib.sha256(bytes(payload)).hexdigest()[:32]
+    return hashlib.sha256(b"path:" + str(payload).encode()).hexdigest()[:32]
+
+
+def new_key(digest: str) -> str:
+    """Idempotency key for a journaled direct submission — the same
+    ``digest16-nonce16`` shape the fleet RPC client stamps on the wire,
+    so one key vocabulary covers both admission doors."""
+    return digest[:16] + "-" + uuid.uuid4().hex[:16]
+
+
+def segment_index(path) -> int:
+    name = Path(path).name
+    return int(name[len(SEGMENT_PREFIX):-len(SEGMENT_SUFFIX)])
+
+
+def segment_files(dirpath) -> list:
+    """Journal segments under `dirpath` in append order."""
+    d = Path(dirpath)
+    if not d.is_dir():
+        return []
+    segs = [
+        p for p in d.iterdir()
+        if p.name.startswith(SEGMENT_PREFIX)
+        and p.name.endswith(SEGMENT_SUFFIX)
+    ]
+    return sorted(segs, key=segment_index)
+
+
+_JOURNAL_METRICS = None
+_metrics_lock = threading.Lock()
+
+
+def journal_metrics():
+    """Process-global `kindel_journal_*` family (cached — the admit
+    path must not pay a registry lock per request), plus the poison
+    counters the quarantine ladder feeds."""
+    global _JOURNAL_METRICS
+    if _JOURNAL_METRICS is None:
+        with _metrics_lock:
+            if _JOURNAL_METRICS is None:
+                from types import SimpleNamespace
+
+                from kindel_tpu.obs.metrics import default_registry
+
+                reg = default_registry()
+                _JOURNAL_METRICS = SimpleNamespace(
+                    appends=reg.counter(
+                        "kindel_journal_appends_total",
+                        "records appended to the admission journal "
+                        "(admits, tombstones, marks, quarantines)",
+                    ),
+                    fsyncs=reg.counter(
+                        "kindel_journal_fsyncs_total",
+                        "journal fsync calls (group commit: one fsync "
+                        "covers every admit appended before it)",
+                    ),
+                    live=reg.gauge(
+                        "kindel_journal_live_entries",
+                        "admitted journal entries without a settle "
+                        "tombstone (what a respawn would replay)",
+                    ),
+                    replayed=reg.counter(
+                        "kindel_journal_replayed_total",
+                        "journal entries re-submitted through the "
+                        "normal admission path at recovery",
+                    ),
+                    truncated=reg.counter(
+                        "kindel_journal_truncated_frames_total",
+                        "torn or CRC-failed journal frames dropped by "
+                        "the recovery scan (clean truncation, never a "
+                        "crash)",
+                    ),
+                    segments_retired=reg.counter(
+                        "kindel_journal_segments_retired_total",
+                        "rotated journal segments unlinked because "
+                        "every admit they held had settled",
+                    ),
+                    errors=reg.counter(
+                        "kindel_journal_errors_total",
+                        "journal append/fsync failures (an admit that "
+                        "cannot be made durable is rejected; settle/"
+                        "mark failures degrade and are recorded here)",
+                    ),
+                    quarantined=reg.counter(
+                        "kindel_quarantined_requests_total",
+                        "journal entries quarantined after crashing "
+                        "the replica --quarantine-after times (failed "
+                        "typed with PoisonRequestError, never replayed "
+                        "again)",
+                    ),
+                    poison_rejects=reg.counter(
+                        "kindel_poison_rejects_total",
+                        "submissions rejected at admission because "
+                        "their payload digest is quarantined (HTTP "
+                        "422, no retry-after)",
+                    ),
+                )
+    return _JOURNAL_METRICS
+
+
+def mark_if_active(journal, entries) -> None:
+    """Dispatch-site hook: stamp the in-flight marker for one launching
+    tick's member requests. One None check when journaling is off —
+    allocation-free per the PR 4 convention (tracemalloc-pinned)."""
+    if journal is None:
+        return
+    journal.record_mark(
+        req.key for req, _units in entries if req.key is not None
+    )
+
+
+def settle_if_active(journal, key, outcome: str) -> None:
+    """Settle-site hook: tombstone one entry. None check when off."""
+    if journal is None or key is None:
+        return
+    journal.record_settle(key, outcome)
+
+
+class Journal:
+    """One replica's admission journal: scan-on-open, append-only live
+    segment, group-commit fsync, rotation + retired-entry GC."""
+
+    def __init__(self, dirpath, *,
+                 segment_bytes: int = SEGMENT_BYTES_DEFAULT):
+        from kindel_tpu.durable import recovery
+
+        self.dir = Path(dirpath)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.segment_bytes = int(segment_bytes)
+        self._m = journal_metrics()
+        #: the synchronous history scan: quarantined digests must gate
+        #: admission from the FIRST request, so this cannot be deferred
+        #: to the (asynchronous) replay
+        self.scan = recovery.scan(self.dir)
+        self._m.truncated.inc(self.scan.truncated)
+        self.quarantined: set[str] = set(self.scan.quarantined)
+        self._lock = threading.RLock()
+        #: key -> digest of admitted-but-unsettled entries (this life +
+        #: history); the replay set is derived from the scan, this dict
+        #: is the GC/gauge view
+        self._live: dict[str, str] = {
+            rec.key: rec.digest for rec in self.scan.live()
+        }
+        #: keys marked in-flight in their CURRENT admission life (one
+        #: MARK per life — a dispatch retry must not double-blame)
+        self._marked: set[str] = set()
+        #: rotated segment -> the admit keys it holds (GC input);
+        #: history segments join with the keys the scan attributed
+        self._segments: dict[Path, set] = {
+            p: set(keys) for p, keys in self.scan.segment_keys.items()
+        }
+        # retire fully-settled history segments before opening a new one
+        self._gc_locked()
+        self._seg_index = self.scan.next_index
+        self._seg_path = self.dir / (
+            f"{SEGMENT_PREFIX}{self._seg_index:08d}{SEGMENT_SUFFIX}"
+        )
+        self._seg_keys: set = set()
+        self._fh = open(self._seg_path, "ab")
+        self._seg_written = 0
+        self._written = 0
+        self._synced = 0
+        self._closed = False
+        self._m.live.set(len(self._live))
+
+    # ------------------------------------------------------------ appends
+
+    def _append_locked(self, rtype: int, doc: dict) -> int:
+        """Append one frame to the live segment (caller holds the lock).
+        Returns the journal's total written offset after the frame."""
+        frame = encode_frame(rtype, doc)
+        if (
+            self._seg_written
+            and self._seg_written + len(frame) > self.segment_bytes
+        ):
+            self._rotate_locked()
+        faults.hook("journal.write")
+        self._fh.write(frame)
+        # flush to the OS on every append: page-cache bytes survive a
+        # SIGKILL (process death), which is the failure unit replay
+        # serves; only admits additionally pay fsync (machine death)
+        self._fh.flush()
+        self._seg_written += len(frame)
+        self._written += len(frame)
+        self._m.appends.inc()
+        return self._written
+
+    def _fsync_to(self, offset: int) -> None:
+        """Group commit: make every frame at/before `offset` durable.
+        A concurrent admit's fsync may already have covered it."""
+        if self._synced >= offset:
+            return
+        with self._lock:
+            if self._synced >= offset:
+                return
+            faults.hook("journal.fsync")
+            os.fsync(self._fh.fileno())
+            self._m.fsyncs.inc()
+            self._synced = self._written
+
+    def _rotate_locked(self) -> None:
+        """Seal the live segment and open the next; retire any rotated
+        segment whose every admit has settled."""
+        try:
+            os.fsync(self._fh.fileno())
+        finally:
+            self._fh.close()
+        self._segments[self._seg_path] = self._seg_keys
+        self._seg_index += 1
+        self._seg_path = self.dir / (
+            f"{SEGMENT_PREFIX}{self._seg_index:08d}{SEGMENT_SUFFIX}"
+        )
+        self._seg_keys = set()
+        self._fh = open(self._seg_path, "ab")
+        self._seg_written = 0
+        self._synced = self._written  # old segment fsynced in full
+        self._gc_locked()
+
+    def _gc_locked(self) -> None:
+        for path in list(self._segments):
+            keys = self._segments[path]
+            if any(k in self._live for k in keys):
+                continue
+            try:
+                path.unlink(missing_ok=True)
+            except OSError as e:
+                record_degrade("journal.gc", "unlink_failed", 1)
+                self._m.errors.inc()
+                _ = e
+                continue
+            del self._segments[path]
+            self._m.segments_retired.inc()
+
+    # ------------------------------------------------------------- records
+
+    def record_admit(self, key: str, payload, opts: dict | None = None,
+                     digest: str | None = None) -> None:
+        """WAL the admission BEFORE the queue accepts: key, digest,
+        spooled bytes (or path), opt overrides. Durable (group-commit
+        fsync) before return — a failure here must reject the admit
+        (`JournalWriteError`), never half-trust it."""
+        if digest is None:
+            digest = payload_digest(payload)
+        doc: dict = {"k": key, "d": digest}
+        if isinstance(payload, (bytes, bytearray)):
+            doc["p"] = base64.b64encode(bytes(payload)).decode()
+        else:
+            doc["f"] = str(payload)
+        if opts:
+            doc["o"] = opts
+        try:
+            with self._lock:
+                offset = self._append_locked(REC_ADMIT, doc)
+                self._live[key] = digest
+                self._marked.discard(key)
+                self._seg_keys.add(key)
+            self._fsync_to(offset)
+        except Exception as e:
+            self._m.errors.inc()
+            raise JournalWriteError(
+                f"admission journal write failed: {e!r}"
+            ) from e
+        self._m.live.set(len(self._live))
+
+    def record_settle(self, key: str, outcome: str) -> None:
+        """Tombstone one entry (idempotent: a second settle of the same
+        key — a watchdog racing a late flush — records nothing). Never
+        raises: the future already resolved; a tombstone the journal
+        could not write only costs one harmless replay next life."""
+        try:
+            with self._lock:
+                if key not in self._live:
+                    return
+                self._append_locked(REC_SETTLE, {"k": key, "out": outcome})
+                del self._live[key]
+                self._marked.discard(key)
+        except Exception as e:  # noqa: BLE001 — settle path must not raise
+            self._m.errors.inc()
+            record_degrade("journal.settle", f"write_failed:{type(e).__name__}", 1)
+            return
+        self._m.live.set(len(self._live))
+
+    def record_mark(self, keys) -> None:
+        """In-flight marker for one launching tick: the member keys not
+        yet marked in their current admission life. Never raises (a
+        mark the journal could not write only under-attributes blame)."""
+        try:
+            with self._lock:
+                fresh = [
+                    k for k in keys
+                    if k in self._live and k not in self._marked
+                ]
+                if not fresh:
+                    return
+                self._append_locked(REC_MARK, {"ks": fresh})
+                self._marked.update(fresh)
+        except Exception as e:  # noqa: BLE001 — dispatch path must not raise
+            self._m.errors.inc()
+            record_degrade("journal.mark", f"write_failed:{type(e).__name__}", 1)
+
+    def record_quarantine(self, key: str, digest: str) -> None:
+        """The poison verdict: entry `key` is out of replay forever and
+        payloads with `digest` are rejected at admission. Durable — a
+        quarantine that did not survive the next crash would let the
+        poison crash-loop resume."""
+        try:
+            with self._lock:
+                offset = self._append_locked(
+                    REC_QUARANTINE, {"k": key, "d": digest}
+                )
+                self.quarantined.add(digest)
+                # counter moves BEFORE the live gauge drops: a poller
+                # that sees the journal drained must already see the
+                # quarantine counted
+                self._m.quarantined.inc()
+                self._live.pop(key, None)
+                self._marked.discard(key)
+            self._fsync_to(offset)
+        except Exception as e:
+            self._m.errors.inc()
+            raise JournalWriteError(
+                f"quarantine journal write failed: {e!r}"
+            ) from e
+        self._m.live.set(len(self._live))
+
+    # -------------------------------------------------------------- views
+
+    @property
+    def live_count(self) -> int:
+        with self._lock:
+            return len(self._live)
+
+    def live_keys(self) -> set:
+        with self._lock:
+            return set(self._live)
+
+    def is_quarantined(self, digest: str) -> bool:
+        return digest in self.quarantined
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "dir": str(self.dir),
+                "live": len(self._live),
+                "quarantined": len(self.quarantined),
+                "segment": self._seg_index,
+            }
+
+    def gc(self) -> None:
+        """Opportunistic retired-entry GC (also runs at rotation)."""
+        with self._lock:
+            self._gc_locked()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            try:
+                self._fh.flush()
+                os.fsync(self._fh.fileno())
+            except OSError:
+                self._m.errors.inc()
+                record_degrade("journal.close", "fsync_failed", 1)
+            self._fh.close()

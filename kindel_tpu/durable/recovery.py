@@ -1,0 +1,308 @@
+"""Replay-on-respawn: the journal scan + recovery state machine
+(DESIGN.md §24).
+
+A respawned replica process starts warm (shared AOT/tune stores) but
+EMPTY — every request the dead process had admitted is gone unless
+something re-submits it. This module is that something:
+
+  1. `scan` walks the journal segments in append order, tolerating any
+     damage: a torn tail or CRC-failed frame truncates the segment
+     cleanly at that point (counted, never a crash). It reduces the
+     record stream to the live entry set (admits without tombstones),
+     the per-key blame count (in-flight MARKs that never settled — one
+     per crashed admission life), and the quarantined digest set.
+  2. `replay` re-submits every live entry through the NORMAL admission
+     path under its ORIGINAL idempotency key. Entries blamed for
+     `quarantine_after` crashes are quarantined instead — typed
+     `PoisonRequestError` from then on — and entries blamed at least
+     once replay as *suspects*: the serve worker dispatches them
+     isolated (a flush of one), so a poison request cannot take
+     co-batched survivors down again (the §13 ladder's bisection,
+     applied preemptively).
+  3. `gc_segments` retires fully-settled rotated segments.
+
+At-most-once is compositional, not magical: the fleet idempotency
+cache coalesces a racing wire resubmission with the local replay of
+the same key (replay pre-claims its keys), consensus purity makes any
+duplicate that does slip through byte-identical, and first-wins settle
+on the router's outer future keeps the client's answer single. The
+journal tombstone then closes each entry's life exactly once.
+"""
+
+from __future__ import annotations
+
+import base64
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from kindel_tpu.durable.journal import (
+    _CRC,
+    _HDR,
+    MAGIC,
+    REC_ADMIT,
+    REC_MARK,
+    REC_QUARANTINE,
+    REC_SETTLE,
+    journal_metrics,
+    segment_files,
+    segment_index,
+)
+from kindel_tpu.resilience.policy import record_degrade
+
+import json
+import binascii
+
+
+@dataclass
+class AdmitRecord:
+    """One live (unsettled) journal entry, ready to re-submit."""
+
+    key: str
+    digest: str
+    payload_b64: str | None = None
+    path: str | None = None
+    opts: dict = field(default_factory=dict)
+
+    def payload(self):
+        """The spooled request payload: bytes for byte payloads, the
+        original path string for path payloads (replay re-reads it; a
+        vanished file fails the entry typed, through the normal decode
+        error surface)."""
+        if self.payload_b64 is not None:
+            return base64.b64decode(self.payload_b64)
+        return self.path
+
+
+@dataclass
+class ScanResult:
+    """What one journal directory says happened before this life."""
+
+    #: key -> AdmitRecord for admits without a settle tombstone,
+    #: insertion-ordered (replay preserves admission order)
+    entries: dict = field(default_factory=dict)
+    #: keys whose life ended in a tombstone (settle or quarantine)
+    settled: set = field(default_factory=set)
+    #: key -> crashed-life count (MARKs never followed by a settle)
+    blame: dict = field(default_factory=dict)
+    #: payload digests under quarantine
+    quarantined: set = field(default_factory=set)
+    #: torn/CRC-failed frames dropped by the scan
+    truncated: int = 0
+    #: segment path -> admit keys it holds (GC input)
+    segment_keys: dict = field(default_factory=dict)
+    #: index the next live segment should use
+    next_index: int = 0
+
+    def live(self) -> list:
+        return list(self.entries.values())
+
+
+def iter_frames(path):
+    """Yield ``(rtype, doc)`` frames from one segment, stopping cleanly
+    at the first torn or corrupt frame. Returns (via StopIteration
+    machinery) after yielding the valid prefix; the caller counts the
+    truncation by comparing file size against consumed bytes — but for
+    simplicity this generator yields a final ``(None, None)`` sentinel
+    when it stopped early."""
+    with open(path, "rb") as fh:
+        data = fh.read()
+    off = 0
+    n = len(data)
+    while off < n:
+        end = off + len(MAGIC) + _HDR.size
+        if data[off:off + len(MAGIC)] != MAGIC or end > n:
+            yield None, None
+            return
+        rtype, plen = _HDR.unpack(data[off + len(MAGIC):end])
+        frame_end = end + plen + _CRC.size
+        if frame_end > n:
+            yield None, None
+            return
+        payload = data[end:end + plen]
+        (crc,) = _CRC.unpack(data[end + plen:frame_end])
+        want = binascii.crc32(payload, binascii.crc32(data[off + len(MAGIC):end]))
+        if crc != want & 0xFFFFFFFF:
+            yield None, None
+            return
+        try:
+            doc = json.loads(payload)
+        except ValueError:
+            yield None, None
+            return
+        yield rtype, doc
+        off = frame_end
+
+
+def scan(dirpath) -> ScanResult:
+    """Reduce a journal directory to its recovery state. Damage-
+    tolerant by construction: any unreadable segment or frame truncates
+    that segment's contribution and the scan continues — recovery must
+    never crash on the journal a crash left behind."""
+    result = ScanResult()
+    #: keys marked in their current (scanning) admission life
+    marked: set = set()
+    segs = segment_files(dirpath)
+    if segs:
+        result.next_index = segment_index(segs[-1]) + 1
+    for seg in segs:
+        keys_here = result.segment_keys.setdefault(Path(seg), set())
+        try:
+            frames = list(iter_frames(seg))
+        except OSError:
+            # unreadable segment: its contribution truncates wholesale
+            result.truncated += 1
+            continue
+        for rtype, doc in frames:
+            if rtype is None:
+                result.truncated += 1
+                break
+            if rtype == REC_ADMIT:
+                key = doc.get("k")
+                if not key:
+                    continue
+                keys_here.add(key)
+                result.entries[key] = AdmitRecord(
+                    key=key,
+                    digest=doc.get("d", ""),
+                    payload_b64=doc.get("p"),
+                    path=doc.get("f"),
+                    opts=doc.get("o") or {},
+                )
+                result.settled.discard(key)
+                marked.discard(key)
+            elif rtype == REC_SETTLE:
+                key = doc.get("k")
+                if not key:
+                    continue
+                if result.entries.pop(key, None) is not None:
+                    result.settled.add(key)
+                if key in marked:
+                    # this life's mark settled: not a crash
+                    marked.discard(key)
+                    result.blame[key] = max(
+                        0, result.blame.get(key, 0) - 1
+                    )
+            elif rtype == REC_MARK:
+                for key in doc.get("ks") or ():
+                    if key in result.entries and key not in marked:
+                        marked.add(key)
+                        result.blame[key] = result.blame.get(key, 0) + 1
+            elif rtype == REC_QUARANTINE:
+                key = doc.get("k")
+                digest = doc.get("d")
+                if digest:
+                    result.quarantined.add(digest)
+                if key and result.entries.pop(key, None) is not None:
+                    result.settled.add(key)
+    return result
+
+
+def gc_segments(dirpath, live_keys, segment_keys=None,
+                keep=frozenset()) -> int:
+    """Unlink rotated segments whose every admit key has settled.
+    `segment_keys` defaults to a fresh scan's attribution; `keep`
+    protects the live segment. Returns the number retired."""
+    if segment_keys is None:
+        segment_keys = scan(dirpath).segment_keys
+    m = journal_metrics()
+    removed = 0
+    keep = {Path(p) for p in keep}
+    for seg, keys in segment_keys.items():
+        seg = Path(seg)
+        if seg in keep:
+            continue
+        if any(k in live_keys for k in keys):
+            continue
+        try:
+            seg.unlink(missing_ok=True)
+        except OSError:
+            record_degrade("journal.gc", "unlink_failed", 1)
+            continue
+        removed += 1
+        m.segments_retired.inc()
+    return removed
+
+
+def _settle_claim(claim_fut, inner) -> None:
+    """Done-callback bridging a local replay onto a pre-claimed
+    idempotency-cache future: a racing wire resubmission of the same
+    key coalesces onto the replay's response instead of applying the
+    request a second time. The response tuple is built by the same
+    status mapping the HTTP handler uses, so the waiter cannot tell
+    replay from a fresh apply."""
+    from kindel_tpu.serve.service import consensus_post_response
+
+    resp = consensus_post_response(lambda _body: inner.result(), b"")
+    try:
+        claim_fut.set_result(resp)
+    except Exception:  # noqa: BLE001 — claim already settled by a racer
+        record_degrade("journal.replay", "claim_settle_race", 1)
+
+
+#: longest one serialized suspect replay may hold up the next (the
+#: replay thread, not the service, waits) — past it the next suspect
+#: proceeds and the straggler keeps its own settle path
+SUSPECT_REPLAY_TIMEOUT_S = 120.0
+
+
+def replay(service, result: ScanResult, journal, *,
+           quarantine_after: int = 3, claim_cache=None) -> dict:
+    """Re-submit every live scanned entry through `service`'s normal
+    admission path under its original key; quarantine entries blamed
+    for `quarantine_after` crashes. `claim_cache` (the fleet RPC
+    adapter's IdempotencyCache, when present) is pre-claimed per key so
+    wire resubmissions coalesce with the local replay. Returns a small
+    report dict ({"replayed": n, "quarantined": n, "skipped": n}).
+
+    Suspects (blame ≥ 1) replay SERIALLY — each one's future settles
+    before the next suspect launches. Blame must stay attributable: if
+    two suspects were in flight when the poison among them crashed the
+    process again, BOTH would be blamed again, and an innocent
+    co-batched survivor could ride the poison's ladder into quarantine.
+    One-at-a-time, only the entry actually dispatching at the moment of
+    death collects the blame.
+
+    An entry whose resubmission fails (journal write fault, service
+    already draining) is left LIVE — the next respawn retries it; an
+    entry must never be silently dropped here."""
+    m = journal_metrics()
+    report = {"replayed": 0, "quarantined": 0, "skipped": 0}
+    for rec in result.live():
+        blame = result.blame.get(rec.key, 0)
+        if blame >= quarantine_after or rec.digest in journal.quarantined:
+            journal.record_quarantine(rec.key, rec.digest)
+            report["quarantined"] += 1
+            continue
+        claim_fut = None
+        if claim_cache is not None:
+            first, fut = claim_cache.claim(rec.key)
+            if not first:
+                # a wire resubmission beat us to the key: ITS apply is
+                # journaling under the same key — nothing to replay
+                report["skipped"] += 1
+                continue
+            claim_fut = fut
+        try:
+            inner = service._submit_replay(
+                rec.key, rec.payload(), rec.opts, suspect=blame > 0
+            )
+        except Exception as e:  # noqa: BLE001 — entry stays live for the next life
+            record_degrade("journal.replay", "resubmit_failed", 1)
+            if claim_fut is not None:
+                claim_fut.set_exception(e)
+            continue
+        m.replayed.inc()
+        report["replayed"] += 1
+        if claim_fut is not None:
+            inner.add_done_callback(
+                lambda f, cf=claim_fut: _settle_claim(cf, f)
+            )
+        if blame > 0:
+            # serialize: this suspect settles (its tombstone written by
+            # the done-callback) before the next one may launch
+            try:
+                inner.result(timeout=SUSPECT_REPLAY_TIMEOUT_S)
+            except Exception:  # noqa: BLE001 — outcome already recorded via the
+                # settle callback; the wait exists only for sequencing
+                record_degrade("journal.replay", "suspect_failed", 1)
+    return report

@@ -53,7 +53,11 @@ from kindel_tpu.fleet.rpc import (  # noqa: F401
     RpcServiceClient,
     RpcTransportError,
 )
-from kindel_tpu.fleet.service import FleetService  # noqa: F401
+from kindel_tpu.fleet.service import (  # noqa: F401
+    FleetService,
+    parse_replica_addrs,
+    static_fleet,
+)
 from kindel_tpu.fleet.supervisor import (  # noqa: F401
     FleetAutoscaler,
     FleetSupervisor,
@@ -69,8 +73,10 @@ __all__ = [
     "RpcServerAdapter",
     "RpcServiceClient",
     "RpcTransportError",
+    "parse_replica_addrs",
     "rendezvous_score",
     "routing_key",
+    "static_fleet",
 ]
 
 

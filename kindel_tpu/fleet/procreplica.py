@@ -76,6 +76,7 @@ class ReplicaProcess:
         deadline = time.monotonic() + self.spawn_timeout_s
         while time.monotonic() < deadline:
             if self.proc.poll() is not None:
+                self._remove_addr_file()
                 raise ReplicaSpawnError(
                     f"replica process exited rc={self.proc.returncode} "
                     "before handshaking its address"
@@ -88,10 +89,21 @@ class ReplicaProcess:
             except (OSError, ValueError, KeyError):
                 time.sleep(0.05)
         self.kill()
+        self._remove_addr_file()
         raise ReplicaSpawnError(
             f"replica process did not handshake within "
             f"{self.spawn_timeout_s}s ({self.addr_file})"
         )
+
+    def _remove_addr_file(self) -> None:
+        """A spawn that never (fully) handshook must not leave its
+        addr-file behind — a crash-looping slot would otherwise
+        accumulate one stale file per failed generation, and a later
+        start could read a half-written address."""
+        try:
+            os.unlink(self.addr_file)
+        except OSError:
+            pass  # never written, or already swept
 
     @property
     def pid(self) -> int | None:
@@ -153,15 +165,49 @@ class ProcessReplicaFactory:
         self.spawn_timeout_s = spawn_timeout_s
         self.metrics = metrics
         self._generation = 0
+        service = dict(service_config or {})
+        if service.get("journal_dir"):
+            # per-SLOT journal directory, stable across generations: a
+            # respawned process must find (and replay) exactly what its
+            # predecessor journaled, and never a sibling slot's entries
+            service["journal_dir"] = os.path.join(
+                str(service["journal_dir"]), replica_id
+            )
         self._config = {
             "replica_id": replica_id,
             "host": host,
             "port": 0,
-            "service": dict(service_config or {}),
+            "service": service,
         }
+
+    def sweep_stale_files(self, keep_generation: int) -> None:
+        """Remove older generations' addr/config debris for this slot —
+        a crash-looping slot re-enters here every respawn, so startup
+        is the natural sweep point (satellite: stale addr-files used to
+        accumulate one per failed spawn)."""
+        prefix = f"{self.replica_id}.g"
+        try:
+            names = os.listdir(self.workdir)
+        except OSError:
+            return
+        for name in names:
+            if not name.startswith(prefix):
+                continue
+            stem = name[len(prefix):].split(".", 1)[0]
+            try:
+                gen = int(stem)
+            except ValueError:
+                continue
+            if gen >= keep_generation:
+                continue
+            try:
+                os.unlink(os.path.join(self.workdir, name))
+            except OSError:
+                pass  # already swept by a racer
 
     def _spawner(self):
         gen = self._generation
+        self.sweep_stale_files(gen)
         addr_file = os.path.join(
             self.workdir, f"{self.replica_id}.g{gen}.addr"
         )
@@ -175,12 +221,19 @@ class ProcessReplicaFactory:
         os.replace(tmp, config_path)
 
         def spawn():
-            if gen > 0:
-                fleet_metrics().respawns.inc()
-            return ReplicaProcess(
+            t0 = time.perf_counter()
+            proc = ReplicaProcess(
                 _spawn_argv(config_path), addr_file,
                 spawn_timeout_s=self.spawn_timeout_s,
             ).start()
+            # spawn→ready wall per generation: recovery cost is a
+            # tracked number (serve_load's rpc report renders p50/p99)
+            fleet_metrics().respawn_seconds.observe(
+                time.perf_counter() - t0
+            )
+            if gen > 0:
+                fleet_metrics().respawns.inc()
+            return proc
 
         return spawn
 
@@ -318,6 +371,13 @@ def main(argv=None) -> int:
     with open(args.config) as fh:
         cfg = json.load(fh)
 
+    # a replica process honors KINDEL_TPU_FAULTS exactly like the CLI:
+    # chaos plans (crash kinds scoped with match= to one poison key)
+    # inject in the child, where the dispatch actually runs
+    from kindel_tpu.resilience import faults as rfaults
+
+    rfaults.activate_from_env()
+
     # the serve stack (and through it jax) loads only here, in the
     # child — the parent-side fleet tier stays device-free
     from kindel_tpu.fleet.rpc import RpcServerAdapter
@@ -341,6 +401,10 @@ def main(argv=None) -> int:
     )
     adapter = RpcServerAdapter(service, stop_event=stop_event)
     service._extra_post_routes.update(adapter.post_routes())
+    # journal replay pre-claims its keys in the adapter's idempotency
+    # cache: a router-side resubmission of an orphaned key coalesces
+    # onto the local replay instead of applying twice (DESIGN.md §24)
+    service.recovery_claim = adapter.cache
     service.start()
     host, port = service.http_address
 
@@ -361,20 +425,29 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGTERM, _on_signal)
     signal.signal(signal.SIGINT, _on_signal)
     parent = os.getppid()
-    while not stop_event.wait(1.0):
-        # orphan watchdog: if the spawning fleet died without reaping
-        # us (SIGKILLed test runner, crashed supervisor), exit instead
-        # of serving nobody forever
-        if os.getppid() != parent:
-            print(
-                "kindel-fleet replica: parent gone, exiting",
-                file=sys.stderr,
-            )
-            break
-    if service.live:
-        service.drain()
-    else:
-        service.stop(drain=False)
+    try:
+        while not stop_event.wait(1.0):
+            # orphan watchdog: if the spawning fleet died without reaping
+            # us (SIGKILLed test runner, crashed supervisor), exit instead
+            # of serving nobody forever
+            if os.getppid() != parent:
+                print(
+                    "kindel-fleet replica: parent gone, exiting",
+                    file=sys.stderr,
+                )
+                break
+        if service.live:
+            service.drain()
+        else:
+            service.stop(drain=False)
+    finally:
+        # clean exits (drain, orphan-watchdog) sweep their own
+        # handshake file; only a SIGKILL leaves one, and the factory's
+        # startup sweep collects those
+        try:
+            os.unlink(addr_file)
+        except OSError:
+            pass  # parent already swept it
     return 0
 
 

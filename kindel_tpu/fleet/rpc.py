@@ -57,6 +57,7 @@ from collections import OrderedDict
 from concurrent.futures import Future, ThreadPoolExecutor
 from dataclasses import dataclass, field
 
+from kindel_tpu.durable.journal import PoisonRequestError
 from kindel_tpu.io.fasta import parse_fasta
 from kindel_tpu.obs import trace
 from kindel_tpu.obs.metrics import default_registry
@@ -605,6 +606,11 @@ class RpcServiceClient:
             return AdmissionError(text, retry_after)
         if status == 504:
             return DeadlineExceeded(text)
+        if status == 422:
+            # quarantined payload (DESIGN.md §24): request-level, not
+            # retryable, not a failover trigger — it would crash every
+            # replica it lands on; the caller must see it
+            return PoisonRequestError(text)
         if status == 400:
             return ValueError(text)
         return RpcTransportError(f"HTTP {status}: {text[:200]}")
@@ -741,8 +747,13 @@ class RpcServerAdapter:
                     )
 
                 def request_fn(payload):
+                    # the wire idempotency key IS the journal key: the
+                    # durable admission journal (DESIGN.md §24) records
+                    # the entry under the same identity the dedupe
+                    # cache and any resubmission carry
                     return self.service.request(
-                        payload, deadline_s=deadline_s, **overrides
+                        payload, deadline_s=deadline_s,
+                        idempotency_key=key, **overrides
                     )
 
                 return consensus_post_response(request_fn, body)

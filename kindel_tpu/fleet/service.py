@@ -46,6 +46,66 @@ from kindel_tpu.obs.metrics import (
 from kindel_tpu.resilience.policy import ProbePolicy
 
 
+def parse_replica_addrs(spec) -> list:
+    """``host:port,host:port,...`` → [(host, port), ...] — the
+    `--replica-addrs` grammar. Accepts a pre-split sequence too."""
+    if isinstance(spec, str):
+        parts = [p.strip() for p in spec.split(",") if p.strip()]
+    else:
+        parts = [str(p).strip() for p in spec if str(p).strip()]
+    addrs = []
+    for part in parts:
+        host, sep, port = part.rpartition(":")
+        if not sep or not host:
+            raise ValueError(
+                f"bad replica address {part!r} (want host:port)"
+            )
+        addrs.append((host, int(port)))
+    if not addrs:
+        raise ValueError("no replica addresses given")
+    return addrs
+
+
+def static_fleet(addrs, *, rpc_timeout_ms=None, **fleet_kwargs):
+    """A FleetService over a STATIC roster of pre-spawned remote
+    replicas (`kindel serve --replica-addrs host:port,...`): each slot
+    is an RpcServiceClient attached to its address — spawn and respawn
+    are disabled by construction (a restart re-dials the same address;
+    the process on the other machine is somebody else's to run), while
+    probe/evict/drain/failover run the unchanged Replica machinery.
+    This is the ROADMAP multi-host leg: a second machine runs
+    `python -m kindel_tpu.fleet.procreplica` (or plain `kindel serve`
+    with the RPC adapter routes) and joins the fleet today.
+
+    Autoscaling is refused — the roster is the capacity."""
+    addrs = parse_replica_addrs(addrs)
+    if fleet_kwargs.get("min_replicas") or fleet_kwargs.get("max_replicas"):
+        raise ValueError(
+            "a static roster cannot autoscale: the fleet can neither "
+            "spawn a new remote machine nor retire one it did not spawn"
+        )
+    by_index = {f"r{i}": addr for i, addr in enumerate(addrs)}
+
+    def attach_factory(rid, registry):
+        from kindel_tpu.fleet.rpc import RpcServiceClient
+
+        addr = by_index.get(rid)
+        if addr is None:
+            raise ValueError(
+                f"replica {rid} is not in the static roster "
+                f"({sorted(by_index)})"
+            )
+        return RpcServiceClient(
+            addr[0], addr[1], metrics=registry,
+            rpc_timeout_ms=rpc_timeout_ms, label=rid,
+        )
+
+    return FleetService(
+        replicas=len(addrs), service_factory=attach_factory,
+        **fleet_kwargs,
+    )
+
+
 class FleetService:
     """N supervised replicas + router + drain, one submit() surface."""
 
@@ -130,11 +190,19 @@ class FleetService:
             return lambda: service_factory(rid, registry)
 
         def factory():
+            import os
+
             from kindel_tpu.serve import ConsensusService
 
-            return ConsensusService(
-                metrics=registry, **self._service_kwargs
-            )
+            kwargs = dict(self._service_kwargs)
+            if kwargs.get("journal_dir"):
+                # one admission journal per replica SLOT (stable across
+                # restarts): sibling replicas must never interleave
+                # frames in one segment file (kindel_tpu.durable)
+                kwargs["journal_dir"] = os.path.join(
+                    str(kwargs["journal_dir"]), rid
+                )
+            return ConsensusService(metrics=registry, **kwargs)
 
         return factory
 

@@ -535,6 +535,10 @@ def fleet_metrics():
                      loss (the cross-host sibling of restarts: counted
                      when a process-backed replica's restart spawns a
                      fresh OS process)
+      respawn_seconds  spawn→ready wall time of one replica process
+                     (first spawns and respawns alike) — recovery cost
+                     as a tracked number; serve_load's rpc report
+                     renders the p50/p99
     """
     global _FLEET_METRICS
     if _FLEET_METRICS is None:
@@ -604,6 +608,12 @@ def fleet_metrics():
                         "replica OS processes respawned after "
                         "host/process loss (cross-host sibling of the "
                         "warm-restart counter)",
+                    ),
+                    respawn_seconds=reg.histogram(
+                        "kindel_fleet_respawn_seconds",
+                        "spawn-to-ready wall time of one replica "
+                        "process (first spawns and respawns alike) — "
+                        "what a recovery-from-host-loss costs",
                     ),
                 )
     return _FLEET_METRICS

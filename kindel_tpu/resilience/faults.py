@@ -31,12 +31,20 @@ hits, with what probability), and the hot paths call `hook(site)` /
                     plan can attack submissions without the supervisor's
                     high-rate probe traffic consuming the spec's
                     hit budget (and vice versa)
+  journal.write     one admission-journal frame append (durable/journal
+                    — fires before the bytes reach the file, so a
+                    failed admit WAL write rejects the admission typed
+                    and leaves no half-trusted frame)
+  journal.fsync     one journal group-commit fsync (durable/journal)
 
 Fault kinds: `error` (synthetic transient RPC error), `oom` (synthetic
 XLA RESOURCE_EXHAUSTED — the retry/degrade policies classify it exactly
 like the real one), `stall` (latency injection), `truncate` (drop the
 tail of an I/O chunk), `kill` (raise through a worker loop so the
-thread dies and the supervisor's auto-restart is exercised).
+thread dies and the supervisor's auto-restart is exercised), `crash`
+(hard process exit via os._exit — the in-band SIGKILL the durable
+journal's replay/quarantine machinery is tested against; only ever
+inject into a replica CHILD process).
 
 Network kinds (the wire-level siblings of the device/IO family, fired
 at the fleet RPC transport): `refused` (connection refused before the
@@ -65,7 +73,10 @@ Each entry is `site:kind[:times][:key=value...]` with keys `times`
 (fire at most N times, default 1), `after` (skip the first N hits of
 the site), `p` (fire probability per eligible hit — drawn from the
 plan's seeded RNG, so the same seed replays the same fault sequence),
-`delay` (stall seconds). Fired counts are recorded on the plan
+`delay` (stall seconds), `match` (fire only when the hook's note — the
+serve flush hooks pass the member idempotency keys — contains this
+substring: targets one poison request). Fired counts are recorded on
+the plan
 (`plan.fired`) so chaos tests can assert metrics against exactly what
 was injected.
 """
@@ -79,21 +90,32 @@ import threading
 import time
 
 #: the fault kinds a spec may name (see module docstring); the second
-#: tuple is the wire-level family fired at the fleet RPC transport
+#: tuple is the wire-level family fired at the fleet RPC transport.
+#: `crash` HARD-EXITS the process (os._exit — no cleanup, no atexit,
+#: no buffered-file flush): the in-band SIGKILL that the durable
+#: journal's replay/quarantine machinery (DESIGN.md §24) exists to
+#: survive. Only ever inject it into a CHILD process (a replica spawned
+#: by fleet/procreplica) — in a test runner it kills the runner.
 KINDS = (
-    "error", "oom", "stall", "truncate", "kill",
+    "error", "oom", "stall", "truncate", "kill", "crash",
     "refused", "timeout", "slow", "drop_response", "garbage", "reset",
 )
 
 #: the hook points threaded through the hot paths (documentation +
 #: parse-time typo guard; custom sites are allowed via FaultSpec(...,
-#: known_site=False) for tests of the harness itself)
+#: known_site=False) for tests of the harness itself). journal.write /
+#: journal.fsync sit inside the durable admission journal's append and
+#: group-commit sync (kindel_tpu.durable.journal): a fault there pins
+#: what a failed WAL write means — the admit is rejected typed, never
+#: half-trusted
 SITES = (
     "device.dispatch",
     "device.compile",
     "io.read_chunk",
     "serve.flush",
     "serve.worker",
+    "journal.write",
+    "journal.fsync",
     "rpc.connect",
     "rpc.call",
     "rpc.probe",
@@ -126,12 +148,17 @@ class InjectedWorkerKill(InjectedFault):
 class FaultSpec:
     """One injectable fault: fire `kind` at `site`, at most `times`
     times, skipping the first `after` hits, each eligible hit firing
-    with probability `p` (from the plan's seeded RNG)."""
+    with probability `p` (from the plan's seeded RNG). `match` scopes
+    the spec to hits whose note (the hook's request-identity string —
+    the serve flush hooks pass the member idempotency keys) contains
+    the substring: how a chaos plan targets ONE poison request instead
+    of every flush."""
 
-    __slots__ = ("site", "kind", "times", "after", "p", "delay_s")
+    __slots__ = ("site", "kind", "times", "after", "p", "delay_s", "match")
 
     def __init__(self, site: str, kind: str, times: int = 1, after: int = 0,
                  p: float = 1.0, delay_s: float = 0.05,
+                 match: str | None = None,
                  known_site: bool = True):
         if kind not in KINDS:
             raise ValueError(f"unknown fault kind {kind!r} (one of {KINDS})")
@@ -150,11 +177,13 @@ class FaultSpec:
         self.after = after
         self.p = p
         self.delay_s = delay_s
+        self.match = match
 
     def __repr__(self) -> str:
         return (
             f"FaultSpec({self.site}:{self.kind} times={self.times} "
-            f"after={self.after} p={self.p} delay={self.delay_s})"
+            f"after={self.after} p={self.p} delay={self.delay_s}"
+            + (f" match={self.match!r}" if self.match else "") + ")"
         )
 
 
@@ -208,6 +237,8 @@ class FaultPlan:
                     kwargs["p"] = float(v)
                 elif k == "delay":
                     kwargs["delay_s"] = float(v)
+                elif k == "match":
+                    kwargs["match"] = v
                 else:
                     raise ValueError(
                         f"unknown fault spec option {k!r} in {part!r}"
@@ -223,16 +254,23 @@ class FaultPlan:
         with self._lock:
             return sum(self.fired.values())
 
-    def _match(self, site: str) -> list[FaultSpec]:
+    def _match(self, site: str, note: str | None = None) -> list[FaultSpec]:
         """Advance the site's hit counter and return the specs that fire
         on this hit (stalls ordered before raising kinds, so a
-        stall+error combo stalls first, then raises)."""
+        stall+error combo stalls first, then raises). `note` is the
+        hook's request-identity string; a spec carrying `match` fires
+        only when its substring appears there (and does not consume its
+        `times` budget otherwise)."""
         with self._lock:
             hit = self._hits.get(site, 0) + 1
             self._hits[site] = hit
             due = []
             for i, s in enumerate(self.specs):
                 if s.site != site:
+                    continue
+                if s.match is not None and (
+                    note is None or s.match not in note
+                ):
                     continue
                 if hit <= s.after:
                     continue
@@ -248,6 +286,12 @@ class FaultPlan:
         return due
 
     def _raise_for(self, site: str, spec: FaultSpec) -> None:
+        if spec.kind == "crash":
+            # the in-band SIGKILL: no unwinding, no atexit, no flushed
+            # buffers — what the durable journal's replay-on-respawn
+            # exists to survive. Only meaningful in a replica CHILD
+            # process (fleet/procreplica activates plans from the env).
+            os._exit(86)
         if spec.kind == "kill":
             raise InjectedWorkerKill(
                 site, "kill", f"injected worker kill at {site}"
@@ -290,9 +334,9 @@ class FaultPlan:
             f"UNAVAILABLE: injected transient {spec.kind} fault at {site}",
         )
 
-    def fire(self, site: str) -> None:
+    def fire(self, site: str, note: str | None = None) -> None:
         """Apply every due spec at this hook point (called by hook())."""
-        for spec in self._match(site):
+        for spec in self._match(site, note):
             if spec.kind in ("stall", "slow"):
                 self._sleep(spec.delay_s)
             else:
@@ -346,13 +390,16 @@ def activate_from_env() -> FaultPlan | None:
     return activate(FaultPlan.parse(spec))
 
 
-def hook(site: str) -> None:
+def hook(site: str, note: str | None = None) -> None:
     """Named fault hook: one global load + None check when no plan is
     active (allocation-free, branch-once — the hot paths call this
-    unconditionally, same bar as the obs no-op span)."""
+    unconditionally, same bar as the obs no-op span). `note` carries a
+    request-identity string for `match=`-scoped specs; hot paths that
+    would pay an allocation to build it guard on `active_plan()` and
+    pass it only when a plan is live."""
     plan = _ACTIVE
     if plan is not None:
-        plan.fire(site)
+        plan.fire(site, note)
 
 
 def hook_bytes(site: str, data: bytes) -> bytes:

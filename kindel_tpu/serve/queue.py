@@ -86,6 +86,15 @@ class ServeRequest:
     deadline: float | None = None
     span: object = None
     wait_span: object = None
+    #: durable-journal identity (kindel_tpu.durable, DESIGN.md §24):
+    #: the idempotency key the admission journal WAL'd this request
+    #: under (None with journaling off — no allocation on that path)
+    key: str | None = None
+    #: quarantine suspect: this entry was in flight when a previous
+    #: process life crashed (blamed at least once on replay). The
+    #: worker dispatches suspects ISOLATED — a flush of one — so a
+    #: poison request cannot take co-batched survivors down again
+    suspect: bool = False
 
 
 class RequestQueue:
@@ -148,11 +157,17 @@ class RequestQueue:
                 + self._ALPHA * max(seconds, 1e-4)
             )
 
-    def submit(self, req: ServeRequest) -> None:
+    def submit(self, req: ServeRequest, force: bool = False) -> None:
         """Admit or reject. Raises AdmissionError past the watermark or
         when the request's deadline is already infeasible. Opens the
         request's root trace span plus its admission / queue-wait
-        children (all shared no-op spans when tracing is disabled)."""
+        children (all shared no-op spans when tracing is disabled).
+
+        `force` skips the watermark and deadline-feasibility checks
+        (closed/draining still reject): the journal replay path — these
+        requests were already admitted once, in a previous process
+        life, and re-admission must not be sheddable or the entry would
+        leak until some later respawn finds headroom."""
         now = self._clock()
         if req.span is None:
             req.span = trace.start_span("serve.request")
@@ -180,7 +195,7 @@ class RequestQueue:
                 depth = len(self._q)
                 if traced:
                     adm.set_attribute(depth=depth)
-                if depth >= self.high_watermark:
+                if not force and depth >= self.high_watermark:
                     if self._rejects is not None:
                         self._rejects.inc()
                     retry = self.estimated_wait_s(
@@ -190,7 +205,7 @@ class RequestQueue:
                         f"queue depth {depth} at/over watermark "
                         f"{self.high_watermark}", jittered_retry_after(retry),
                     )
-                if req.deadline is not None:
+                if not force and req.deadline is not None:
                     budget = req.deadline - now
                     est = self.estimated_wait_s(depth + 1)
                     if budget <= 0 or est > budget:

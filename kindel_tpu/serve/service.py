@@ -17,11 +17,19 @@ import json
 import sys
 import threading
 import time
-from concurrent.futures import Future
+from concurrent.futures import CancelledError, Future
 from dataclasses import replace
 
 from kindel_tpu.batch import BatchOptions, SampleResult
 
+from kindel_tpu.durable.journal import (
+    Journal,
+    JournalWriteError,
+    PoisonRequestError,
+    journal_metrics,
+    new_key as journal_new_key,
+    payload_digest as journal_payload_digest,
+)
 from kindel_tpu.obs import runtime as obs_runtime
 from kindel_tpu.serve.batcher import MicroBatcher
 from kindel_tpu.serve.metrics import (
@@ -67,6 +75,12 @@ def consensus_post_response(request_fn, body: bytes):
         )
     except DeadlineExceeded as e:
         return 504, "text/plain", f"{e}\n".encode(), {}
+    except PoisonRequestError as e:
+        # quarantined payload (DESIGN.md §24): a REQUEST-level verdict
+        # with no retry-after — retrying it anywhere would crash a
+        # replica; 422 = semantically unprocessable, unlike 400's
+        # undecodable
+        return 422, "text/plain", f"{e}\n".encode(), {}
     except ValueError as e:  # decode rejection — the client's fault
         return 400, "text/plain", f"{e}\n".encode(), {}
     except Exception as e:  # noqa: BLE001 — server-side failure
@@ -84,6 +98,25 @@ def readyz_response(readyz_fn):
     doc = readyz_fn()
     status = 200 if doc.get("ready") else 503
     return status, "application/json", json.dumps(doc).encode(), {}
+
+
+def _journal_settle_callback(journal, key: str):
+    """Done-callback tombstoning one journal entry: however the future
+    resolves — result, error, cancellation — the entry's life ends with
+    exactly one settle record (record_settle is idempotent, so a
+    watchdog racing a late flush tombstones once)."""
+
+    def _cb(fut):
+        try:
+            exc = fut.exception()
+        except CancelledError:
+            journal.record_settle(key, "cancelled")
+            return
+        journal.record_settle(
+            key, "ok" if exc is None else f"error:{type(exc).__name__}"
+        )
+
+    return _cb
 
 
 def _aot_provenance() -> dict:
@@ -112,6 +145,8 @@ class ConsensusService:
         http_host: str = "127.0.0.1",
         http_port: int | None = None,
         max_body_mb: int | None = None,
+        journal_dir: str | None = None,
+        quarantine_after: int | None = None,
         extra_post_routes: dict | None = None,
         metrics: MetricsRegistry | None = None,
         warmup: bool = False,
@@ -228,6 +263,38 @@ class ConsensusService:
         obs_runtime.ingest_counters().mode.set(
             mode=self.ingest_mode, source=im_src
         )
+        # durable admission journal (DESIGN.md §24): a write-ahead log
+        # under the queue — admit records before the queue accepts,
+        # tombstones at settle, replay at the next start. Resolved like
+        # every knob (explicit --journal-dir > KINDEL_TPU_JOURNAL_DIR >
+        # off); the off path is one None check on every hot-path site
+        # (allocation-free, PR 4 convention)
+        jd_explicit = (
+            journal_dir if journal_dir is not None
+            else getattr(tuning, "journal_dir", None)
+        )
+        self.journal_dir, jd_src = tune.resolve_journal_dir(jd_explicit)
+        self._m_tune_source.set(knob="journal_dir", source=jd_src)
+        qa_explicit = (
+            quarantine_after if quarantine_after is not None
+            else getattr(tuning, "quarantine_after", None)
+        )
+        self.quarantine_after, qa_src = tune.resolve_quarantine_after(
+            qa_explicit
+        )
+        self._m_tune_source.set(knob="quarantine_after", source=qa_src)
+        #: the journal scans its directory synchronously here (the
+        #: quarantined-digest gate must hold from the first request);
+        #: the REPLAY of live entries runs on a background thread at
+        #: start()
+        self._journal = (
+            Journal(self.journal_dir) if self.journal_dir else None
+        )
+        #: fleet RPC adapter's IdempotencyCache, set by the owner
+        #: BEFORE start(): replay pre-claims its keys there so a wire
+        #: resubmission coalesces with the local replay (at-most-once)
+        self.recovery_claim = None
+        self._recovery_thread: threading.Thread | None = None
         # per-replica device mesh (DESIGN.md §23): one flush fans
         # across every local device; resolved like every knob (explicit
         # > KINDEL_TPU_MESH > host-keyed store > all-local-devices) and
@@ -280,6 +347,7 @@ class ConsensusService:
             breaker=self.breaker, retry=retry, watchdog_s=watchdog_s,
             numpy_fallback=numpy_fallback, lane_coalesce=lane_coalesce,
             ingest_mode=self.ingest_mode, mesh_plan=self.mesh_plan,
+            journal=self._journal,
         )
         self._http: ServeHTTPServer | None = None
         self._http_host = http_host
@@ -301,6 +369,16 @@ class ConsensusService:
         # /metrics exposition attributes cold-start cost (best-effort)
         obs_runtime.install()
         self.worker.start()
+        if self._journal is not None and self._recovery_thread is None:
+            # replay-on-respawn (DESIGN.md §24): live entries from the
+            # previous process life re-enter through the normal
+            # admission path under their original keys, off the start
+            # path (a big orphan set must not delay readiness)
+            self._recovery_thread = threading.Thread(
+                target=self._recover_journal,
+                name="kindel-serve-recovery", daemon=True,
+            )
+            self._recovery_thread.start()
         if self._do_warmup and self._warm_thread is None:
             self._warm_state = "warming"
             self._warm_thread = threading.Thread(
@@ -332,6 +410,35 @@ class ConsensusService:
             self._http.stop()
             self._http = None
         self.worker.stop(drain=drain)
+        if self._journal is not None:
+            self._journal.gc()
+            self._journal.close()
+
+    def _recover_journal(self) -> None:
+        """Background replay of the journal's live entries. A recovery
+        failure never takes the service down — unreplayed entries stay
+        live in the journal for the NEXT life to retry."""
+        from kindel_tpu.durable import recovery
+
+        try:
+            report = recovery.replay(
+                self, self._journal.scan, self._journal,
+                quarantine_after=self.quarantine_after,
+                claim_cache=self.recovery_claim,
+            )
+            if any(report.values()):
+                print(
+                    f"kindel-serve journal recovery: {report}",
+                    file=sys.stderr,
+                )
+        except Exception as e:  # noqa: BLE001 — recovery is best-effort per life
+            from kindel_tpu.resilience.policy import record_degrade
+
+            record_degrade("journal.replay", "recovery_failed", 1)
+            print(
+                f"kindel-serve journal recovery failed: {e!r}",
+                file=sys.stderr,
+            )
 
     def drain(self, handback: bool = False) -> list[ServeRequest]:
         """Graceful shutdown: stop admitting (new submits reject with a
@@ -346,6 +453,14 @@ class ConsensusService:
         handed = self.queue.handback() if handback else []
         if not handback:
             self.queue.close_admission()
+        jr = self._journal
+        if jr is not None:
+            # a handed-back request's future settles on ANOTHER replica
+            # — this journal's entry would leak without its own
+            # tombstone (the hand-back IS this replica's settle)
+            for req in handed:
+                if req.key is not None:
+                    jr.record_settle(req.key, "handback")
         self.stop(drain=True)
         return handed
 
@@ -494,6 +609,11 @@ class ConsensusService:
             # live residency per pool (pages in use, resident segments,
             # parked admissions) — the paged tier's capacity signal
             doc["paged"] = self.batcher.residency_snapshot()
+        if self._journal is not None:
+            # durability posture (DESIGN.md §24): live = entries a
+            # respawn would replay, quarantined = poison digests barred
+            # from admission
+            doc["journal"] = self._journal.snapshot()
         if self._warm_error is not None:
             doc["warmup_error"] = self._warm_error
         return doc
@@ -522,9 +642,15 @@ class ConsensusService:
     # ------------------------------------------------------------- requests
 
     def submit(self, payload, deadline_s: float | None = None,
+               idempotency_key: str | None = None,
                **opt_overrides) -> Future:
         """Admit one request (path or SAM/BAM bytes). Returns a Future of
-        SampleResult. Raises AdmissionError when load-shedding."""
+        SampleResult. Raises AdmissionError when load-shedding,
+        PoisonRequestError (422 on the wire) when the payload's digest
+        is quarantined. `idempotency_key` (the fleet RPC adapter passes
+        the wire header's) keys the durable journal entry; with
+        journaling on and no key supplied, one is generated — the
+        journal and the wire share one key vocabulary."""
         if not self.breaker.allow_admission():
             self._m_shed.inc()
             # jittered so a cohort of synchronized shed clients does not
@@ -538,20 +664,90 @@ class ConsensusService:
             replace(self.default_opts, **opt_overrides)
             if opt_overrides else self.default_opts
         )
+        jr = self._journal
+        if jr is None:
+            req = ServeRequest(
+                payload=payload, opts=opts,
+                deadline=(
+                    time.monotonic() + deadline_s
+                    if deadline_s is not None else None
+                ),
+            )
+            self.queue.submit(req)
+            return req.future
+        digest = journal_payload_digest(payload)
+        if jr.is_quarantined(digest):
+            journal_metrics().poison_rejects.inc()
+            raise PoisonRequestError(
+                f"payload {digest[:16]} is quarantined: an identical "
+                f"request crashed this replica {self.quarantine_after} "
+                "times (DESIGN.md §24) — do not retry",
+                digest=digest,
+            )
         req = ServeRequest(
             payload=payload, opts=opts,
             deadline=(
                 time.monotonic() + deadline_s
                 if deadline_s is not None else None
             ),
+            key=idempotency_key or journal_new_key(digest),
         )
-        self.queue.submit(req)
+        self._journal_admit(jr, req, opt_overrides, digest)
+        return req.future
+
+    def _journal_admit(self, jr, req: ServeRequest, opt_overrides: dict,
+                       digest: str, force: bool = False) -> None:
+        """WAL-then-accept: the admit record is durable BEFORE the
+        queue takes the request; a queue rejection tombstones the entry
+        it just wrote (nothing to replay — the caller got the error)."""
+        try:
+            jr.record_admit(
+                req.key, req.payload, opt_overrides, digest=digest
+            )
+        except JournalWriteError as e:
+            # an admit the journal cannot protect is rejected, typed
+            # and retryable — durability is the contract, not best
+            # effort
+            raise AdmissionError(
+                f"admission journal unavailable: {e}",
+                jittered_retry_after(0.5),
+            ) from e
+        req.future.add_done_callback(_journal_settle_callback(jr, req.key))
+        try:
+            self.queue.submit(req, force=force)
+        except AdmissionError:
+            jr.record_settle(req.key, "rejected")
+            raise
+
+    def _submit_replay(self, key: str, payload, opts: dict,
+                       suspect: bool = False) -> Future:
+        """Recovery-path admission (kindel_tpu.durable.recovery): the
+        entry was already admitted in a previous process life, so
+        re-admission is forced past the watermark; `suspect` entries
+        (blamed for a crash) dispatch isolated. No deadline — the
+        original one is a dead process's monotonic timestamp."""
+        jr = self._journal
+        req = ServeRequest(
+            payload=payload,
+            opts=(
+                replace(self.default_opts, **opts) if opts
+                else self.default_opts
+            ),
+            key=key,
+            suspect=suspect,
+        )
+        self._journal_admit(
+            jr, req, opts, journal_payload_digest(payload), force=True
+        )
         return req.future
 
     def request(self, payload, timeout: float | None = None,
+                idempotency_key: str | None = None,
                 **opt_overrides) -> SampleResult:
         """Synchronous submit: blocks until served (or raises)."""
-        return self.submit(payload, **opt_overrides).result(timeout=timeout)
+        return self.submit(
+            payload, idempotency_key=idempotency_key, **opt_overrides
+        ).result(timeout=timeout)
 
     # ---------------------------------------------------------- HTTP ingest
 

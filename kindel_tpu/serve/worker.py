@@ -40,9 +40,11 @@ from kindel_tpu.batch import (
     SampleResult,
     _assemble_outputs,
     _fold_results,
+    cohort_pad_shapes,
     launch_cohort_kernel,
     pack_cohort,
 )
+from kindel_tpu.durable.journal import mark_if_active
 from kindel_tpu.obs import runtime as obs_runtime
 from kindel_tpu.obs import trace
 from kindel_tpu.pileup_jax import _bucket
@@ -142,6 +144,17 @@ def _coalesce_counters() -> tuple:
 def _payload_label(payload) -> str:
     return "<bytes>" if isinstance(payload, (bytes, bytearray)) else str(
         payload
+    )
+
+
+def _flush_note(entries) -> str:
+    """Request-identity string for `match=`-scoped fault specs: the
+    member idempotency keys (payload labels for unjournaled requests).
+    Built ONLY when a fault plan is active — the disabled hot path
+    stays allocation-free."""
+    return "|".join(
+        req.key if req.key is not None else _payload_label(req.payload)
+        for req, _units in entries
     )
 
 
@@ -290,10 +303,15 @@ class ServeWorker:
                  numpy_fallback: bool = True, supervise: bool = True,
                  supervise_interval_s: float = 0.1,
                  lane_coalesce: int = 1, ingest_mode: str = "host",
-                 mesh_plan=None):
+                 mesh_plan=None, journal=None):
         self.queue = queue
         self.batcher = batcher
         self._clock = clock
+        #: durable admission journal (kindel_tpu.durable, DESIGN.md
+        #: §24), or None. The worker's only journal duty is the
+        #: in-flight MARK at each dispatch site — one None check when
+        #: off (allocation-free, PR 4 convention)
+        self.journal = journal
         #: per-replica device mesh plan (kindel_tpu.parallel.meshexec,
         #: DESIGN.md §23): one flush fans across every local device.
         #: None = single-device dispatch, the exact pre-mesh behavior
@@ -652,9 +670,36 @@ class ServeWorker:
             # bam_to_consensus on a read-less file
             self._complete(req, SampleResult())
             return
+        if req.suspect:
+            # quarantine suspect (DESIGN.md §24): this entry was in
+            # flight when a previous process life crashed. Dispatch it
+            # ISOLATED — a flush of one, bypassing every batcher — so
+            # if it crashes again it takes no co-batched survivors
+            # with it (the §13 bisection, applied preemptively).
+            self._solo_dispatch(req, units)
+            return
         self.batcher.add(req, units)
         if self._m_pending_rows is not None:
             self._m_pending_rows.set(self.batcher.pending_rows)
+
+    def _solo_dispatch(self, req: ServeRequest, units) -> None:
+        """One-request dispatch for quarantine suspects, on the decode
+        thread (a suspect may crash the process — it must never share a
+        launching tick). The classic shape-derived path: byte-identical
+        to any batched mode by vmap-row independence."""
+        shapes = cohort_pad_shapes(units, req.opts)
+        flush = Flush(req.opts, shapes, [(req, units)], self._clock())
+        self._flush_seq += 1
+        try:
+            self._dispatch_entries(
+                flush.entries, flush, self._flush_seq, flush.shapes,
+                depth=0,
+            )
+        except BaseException as e:  # noqa: BLE001 — decode-pool isolation boundary
+            self._fail(
+                req, RuntimeError(f"suspect dispatch aborted: {e!r}")
+            )
+            raise
 
     # ------------------------------------------------------------- dispatch
 
@@ -813,7 +858,13 @@ class ServeWorker:
         residency is active, classic snapshot+re-upload otherwise."""
         from kindel_tpu.paged.retire import extract_flush
 
-        rfaults.hook("serve.flush")
+        # in-flight marker BEFORE the fault hook: a crash fired at this
+        # site must already be attributable to the tick's member keys
+        mark_if_active(self.journal, flush.entries)
+        if rfaults.active_plan() is None:
+            rfaults.hook("serve.flush")
+        else:
+            rfaults.hook("serve.flush", _flush_note(flush.entries))
         cls = flush.page_class
         with trace.span("paged.launch") as sp:
             out, table, row_of = self.batcher.dispatch_tick(flush)
@@ -968,7 +1019,13 @@ class ServeWorker:
         the segment kernel (kindel_tpu.ragged) — byte-identical output,
         one compiled executable per page class instead of one per lane
         shape."""
-        rfaults.hook("serve.flush")
+        # in-flight marker BEFORE the fault hook: a crash fired at this
+        # site must already be attributable to the batch's member keys
+        mark_if_active(self.journal, entries)
+        if rfaults.active_plan() is None:
+            rfaults.hook("serve.flush")
+        else:
+            rfaults.hook("serve.flush", _flush_note(entries))
         units = []
         paths = []
         for idx, (req, req_units) in enumerate(entries):

@@ -125,6 +125,12 @@ RPC_TIMEOUT_MS_DEFAULT = 30000
 #: weaponizable memory hole); the env pin is KINDEL_TPU_MAX_BODY_MB
 MAX_BODY_MB_DEFAULT = 1024
 
+#: crashes one journal entry may be blamed for before it is
+#: quarantined instead of replayed (kindel_tpu.durable, DESIGN.md §24);
+#: the env pin is KINDEL_TPU_QUARANTINE_AFTER. A robustness bound, not
+#: measured.
+QUARANTINE_AFTER_DEFAULT = 3
+
 #: default page-class geometry spec (name:ROWSxLENGTH, ascending —
 #: kindel_tpu.ragged.pack.parse_classes is the grammar); the env pin is
 #: KINDEL_TPU_RAGGED_CLASSES, `kindel tune --ragged-budget-s` persists a
@@ -175,6 +181,8 @@ class TuningConfig:
     ragged_classes: str | None = None
     rpc_timeout_ms: float | None = None
     max_body_mb: int | None = None
+    journal_dir: str | None = None
+    quarantine_after: int | None = None
     sources: tuple = ()
 
 
@@ -815,6 +823,39 @@ def resolve_max_body_mb(explicit: int | None = None) -> tuple[int, str]:
     if pin is not None and pin > 0:
         return pin, "env"
     return MAX_BODY_MB_DEFAULT, "default"
+
+
+def resolve_journal_dir(explicit: str | None = None) -> tuple[str | None, str]:
+    """The durable admission-journal activation knob (kindel_tpu.durable,
+    DESIGN.md §24): explicit arg (`--journal-dir`) >
+    KINDEL_TPU_JOURNAL_DIR > off (None). A directory path switches the
+    write-ahead admission journal ON for the replica; `off`/empty
+    anywhere disables. Not measured — durability is a policy, not a
+    latency optimum."""
+    if explicit is not None:
+        text = str(explicit).strip()
+        if text and text.lower() != "off":
+            return text, "explicit"
+        return None, "explicit"
+    env = os.environ.get("KINDEL_TPU_JOURNAL_DIR", "").strip()
+    if env and env.lower() != "off":
+        return env, "env"
+    return None, "default"
+
+
+def resolve_quarantine_after(explicit: int | None = None) -> tuple[int, str]:
+    """The poison-quarantine ladder depth (kindel_tpu.durable): a journal
+    entry blamed for this many crashes is quarantined instead of
+    replayed. explicit arg (`--quarantine-after`) >
+    KINDEL_TPU_QUARANTINE_AFTER > default (3); malformed/non-positive
+    pins fall through — an unparseable knob must never take a replica
+    down at boot."""
+    if explicit is not None and int(explicit) > 0:
+        return int(explicit), "explicit"
+    pin, _present = _env_int("KINDEL_TPU_QUARANTINE_AFTER")
+    if pin is not None and pin > 0:
+        return pin, "env"
+    return QUARANTINE_AFTER_DEFAULT, "default"
 
 
 def resolve_batch_mode(explicit: str | None = None) -> tuple[str, str]:

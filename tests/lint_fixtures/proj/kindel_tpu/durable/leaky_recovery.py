@@ -1,0 +1,15 @@
+"""Known-bad: a pre-claimed recovery future leaks on a replay failure
+path (future-settlement, durable scope — PR 15): the handler logs the
+resubmission failure but forgets the idempotency-cache claim, so every
+wire resubmission of that key waits forever."""
+
+from concurrent.futures import Future
+
+
+def replay_leaky(resubmit, log):
+    claim = Future()
+    try:
+        claim.set_result(resubmit())
+    except Exception:
+        log("replay resubmit failed")  # claim stranded forever
+    return None

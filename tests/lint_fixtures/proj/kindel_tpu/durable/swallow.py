@@ -1,0 +1,11 @@
+"""Known-bad: silent swallow in durable/ — a journal append error that
+nobody records or re-raises silently converts "durable admission" into
+"best effort", exactly the lie the write-ahead journal exists to make
+impossible (the admit must be rejected typed instead)."""
+
+
+def append_or_shrug(journal, frame):
+    try:
+        journal.append(frame)
+    except Exception:
+        return False

@@ -322,6 +322,24 @@ def test_fixture_scope_extension_hits_emit(fixture_results):
     assert len(dl) == 3, dl
 
 
+def test_fixture_scope_extension_hits_durable(fixture_results):
+    """The durable scope extension (PR 15 satellite): the admission
+    journal + recovery tier is covered by the silent-swallow lint (a
+    swallowed journal error silently converts "durable" into "best
+    effort") and the future-settlement contract (a leaked recovery
+    claim strands every wire resubmission of that key) — one known-bad
+    fixture per rule scope."""
+    by_id = {r.spec.id: r for r in fixture_results}
+    assert any(
+        "durable/swallow" in f.path
+        for f in by_id["silent-swallow"].findings
+    )
+    assert any(
+        "durable/leaky_recovery" in f.path
+        for f in by_id["future-settlement"].findings
+    )
+
+
 def test_purity_fixture_needs_the_closure(fixture_results):
     """The chained fixture's jit body is clean — only the call-graph
     walk sees the env read two calls deep, which is exactly what the

@@ -1,0 +1,880 @@
+"""Durable admission journal + replay-on-respawn + poison quarantine
+(kindel_tpu.durable): DESIGN.md §24's claims, asserted.
+
+  * frame codec + scan: admits/settles/marks/quarantines round-trip;
+    blame counts exactly the lives that died with an entry in flight;
+  * torn-write matrix: the journal blob cut at EVERY frame boundary
+    (plus mid-frame cuts and corrupted frames) scans without crashing,
+    never resurrects a settled key, never drops an unsettled one whose
+    admit survived intact;
+  * fsync/write faults (`journal.write`/`journal.fsync` sites): an
+    admit the journal cannot make durable is rejected typed, and the
+    journal keeps working once the fault clears;
+  * rotation + retired-entry GC bound the on-disk footprint to live
+    entries;
+  * replay-on-respawn: a service restarted over the dead life's
+    journal re-serves exactly the unsettled entries — settled keys are
+    not re-applied, the journal drains to zero live entries;
+  * quarantine ladder: an entry blamed for K crashes is quarantined
+    (never replayed), identical payloads 422 at admission, suspects
+    (blame ≥ 1) dispatch isolated from healthy traffic;
+  * disabled path allocation-free (tracemalloc, PR 4 convention);
+  * satellites: stale addr-file sweep, respawn-latency report fields,
+    the static `--replica-addrs` roster;
+  * the flagship: a 3-process fleet under wire faults, one replica
+    SIGKILLed twice mid-load plus one injected poison request — every
+    non-poison request settles exactly once with FASTA sha256 equal to
+    the single-replica reference, the poison key is quarantined after
+    exactly K blamed crashes, and every slot's journal drains to zero
+    live entries.
+"""
+
+import hashlib
+import json
+import os
+import threading
+import time
+import tracemalloc
+from pathlib import Path
+
+import pytest
+
+from kindel_tpu.durable import journal as dj
+from kindel_tpu.durable import recovery as dr
+from kindel_tpu.durable.journal import (
+    Journal,
+    JournalWriteError,
+    PoisonRequestError,
+    encode_frame,
+)
+from kindel_tpu.obs import trace
+from kindel_tpu.obs.metrics import default_registry
+from kindel_tpu.resilience import faults as rfaults
+from kindel_tpu.resilience import policy as rpolicy
+from kindel_tpu.resilience.faults import FaultPlan
+from kindel_tpu.serve.queue import AdmissionError
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    """Process-global fault plans / policies / tracers must not leak
+    (same hygiene as test_resilience.py)."""
+    rfaults.deactivate()
+    prev = rpolicy.set_default_policy(None)
+    yield
+    rfaults.deactivate()
+    rpolicy.set_default_policy(prev)
+    trace.disable_tracing()
+
+
+def _snap() -> dict:
+    return default_registry().snapshot()
+
+
+def _delta(before: dict, after: dict, name: str) -> int:
+    return int(after.get(name, 0)) - int(before.get(name, 0))
+
+
+def _sam_payload(seed: int = 0) -> bytes:
+    import tempfile
+
+    from benchmarks.serve_load import _synth_sam
+
+    with tempfile.TemporaryDirectory() as d:
+        return _synth_sam(Path(d) / "x.sam", seed=seed).read_bytes()
+
+
+# ------------------------------------------------------- codec + scan
+
+
+def test_frame_roundtrip_scan_and_blame(tmp_path):
+    d = tmp_path / "j"
+    j = Journal(d)
+    j.record_admit("k1", b"payload-one", {"min_depth": 2})
+    j.record_admit("k2", str(tmp_path / "some.bam"))
+    j.record_mark(["k1", "k2"])
+    j.record_mark(["k1"])  # second mark of one life: not double-blamed
+    j.record_settle("k1", "ok")
+    j.record_settle("k1", "ok")  # idempotent: no second tombstone
+    j.record_admit("k3", b"payload-three")
+    j.record_quarantine("kq", "deadbeef" * 4)
+    assert j.live_count == 2  # k2, k3
+    j.close()
+
+    r = dr.scan(d)
+    assert sorted(r.entries) == ["k2", "k3"]
+    assert "k1" in r.settled
+    # k1 marked then settled: no blame; k2 marked, never settled: 1
+    assert r.blame.get("k1", 0) == 0
+    assert r.blame["k2"] == 1
+    assert ("deadbeef" * 4) in r.quarantined
+    assert r.truncated == 0
+    # payload round-trip: bytes come back as bytes, paths as paths
+    assert r.entries["k3"].payload() == b"payload-three"
+    assert r.entries["k2"].payload() == str(tmp_path / "some.bam")
+    assert r.entries["k2"].opts == {}
+
+
+def _model_scan(frames):
+    """Reference model of the scan semantics over complete frames."""
+    live, settled, marked, blame = {}, set(), set(), {}
+    for rtype, doc in frames:
+        if rtype == dj.REC_ADMIT:
+            live[doc["k"]] = doc
+            settled.discard(doc["k"])
+            marked.discard(doc["k"])
+        elif rtype == dj.REC_SETTLE:
+            if doc["k"] in live:
+                del live[doc["k"]]
+                settled.add(doc["k"])
+            if doc["k"] in marked:
+                marked.discard(doc["k"])
+                blame[doc["k"]] = max(0, blame.get(doc["k"], 0) - 1)
+        elif rtype == dj.REC_MARK:
+            for k in doc["ks"]:
+                if k in live and k not in marked:
+                    marked.add(k)
+                    blame[k] = blame.get(k, 0) + 1
+        elif rtype == dj.REC_QUARANTINE:
+            if doc["k"] in live:
+                del live[doc["k"]]
+                settled.add(doc["k"])
+    return live, settled, blame
+
+
+def test_torn_write_matrix_every_frame_boundary(tmp_path):
+    """The satellite matrix: cut the journal at every frame boundary
+    and at mid-frame offsets; recovery never crashes, never replays a
+    settled key, never drops an unsettled one whose admit survived."""
+    frames = [
+        (dj.REC_ADMIT, {"k": "k1", "d": "d1", "p": "QUJD"}),
+        (dj.REC_ADMIT, {"k": "k2", "d": "d2", "p": "REVG"}),
+        (dj.REC_MARK, {"ks": ["k1", "k2"]}),
+        (dj.REC_SETTLE, {"k": "k1", "out": "ok"}),
+        (dj.REC_ADMIT, {"k": "k3", "d": "d3", "p": "R0hJ"}),
+        (dj.REC_SETTLE, {"k": "k2", "out": "error:X"}),
+        (dj.REC_QUARANTINE, {"k": "k4", "d": "d4"}),
+    ]
+    blobs = [encode_frame(rt, doc) for rt, doc in frames]
+    blob = b"".join(blobs)
+    ends = []
+    off = 0
+    for b in blobs:
+        off += len(b)
+        ends.append(off)
+    seg = tmp_path / "j" / "seg-00000000.kj"
+    seg.parent.mkdir(parents=True)
+
+    cuts = set(ends)
+    for e in ends:  # mid-frame cuts: torn tails of every frame
+        cuts.update({e - 1, e - 5, e - len(blobs[0]) // 2})
+    cuts.update({0, 1, 3, len(blob)})
+    for cut in sorted(c for c in cuts if 0 <= c <= len(blob)):
+        seg.write_bytes(blob[:cut])
+        r = dr.scan(seg.parent)  # must never raise
+        complete = [
+            frames[i] for i, e in enumerate(ends) if e <= cut
+        ]
+        live, settled, _blame = _model_scan(complete)
+        assert set(r.entries) == set(live), f"cut={cut}"
+        # a settled key is never live again
+        assert not (set(r.entries) & settled), f"cut={cut}"
+        # torn tail counted iff bytes remain past the last whole frame
+        whole = sum(1 for e in ends if e <= cut)
+        torn = cut > (ends[whole - 1] if whole else 0)
+        assert r.truncated == (1 if torn else 0), f"cut={cut}"
+
+
+def test_corrupt_frame_truncates_segment_there(tmp_path):
+    frames = [
+        (dj.REC_ADMIT, {"k": f"k{i}", "d": f"d{i}", "p": "QUJD"})
+        for i in range(5)
+    ]
+    blobs = [encode_frame(rt, doc) for rt, doc in frames]
+    seg = tmp_path / "j" / "seg-00000000.kj"
+    seg.parent.mkdir(parents=True)
+    for i in range(5):
+        corrupted = b"".join(blobs)
+        # flip one payload byte of frame i: CRC fails, scan stops there
+        pos = sum(len(b) for b in blobs[:i]) + dj.FRAME_OVERHEAD - 2
+        corrupted = (
+            corrupted[:pos]
+            + bytes([corrupted[pos] ^ 0xFF])
+            + corrupted[pos + 1:]
+        )
+        seg.write_bytes(corrupted)
+        r = dr.scan(seg.parent)
+        assert sorted(r.entries) == [f"k{j}" for j in range(i)]
+        assert r.truncated == 1
+
+
+def test_scan_continues_past_damaged_middle_segment(tmp_path):
+    d = tmp_path / "j"
+    d.mkdir()
+    (d / "seg-00000000.kj").write_bytes(
+        encode_frame(dj.REC_ADMIT, {"k": "a", "d": "da", "p": "QQ=="})
+    )
+    (d / "seg-00000001.kj").write_bytes(b"\x00garbage\xff" * 3)
+    (d / "seg-00000002.kj").write_bytes(
+        encode_frame(dj.REC_ADMIT, {"k": "b", "d": "db", "p": "Qg=="})
+    )
+    r = dr.scan(d)
+    assert sorted(r.entries) == ["a", "b"]
+    assert r.truncated == 1
+    assert r.next_index == 3
+
+
+# ------------------------------------------------- write/fsync faults
+
+
+def test_journal_write_fault_rejects_admit_then_recovers(tmp_path):
+    j = Journal(tmp_path / "j")
+    rfaults.activate(FaultPlan.parse("journal.write:error"))
+    with pytest.raises(JournalWriteError):
+        j.record_admit("k1", b"x", {})
+    # fault exhausted: the journal keeps working, state consistent
+    j.record_admit("k2", b"y", {})
+    assert j.live_keys() == {"k2"}
+    j.close()
+    r = dr.scan(tmp_path / "j")
+    assert sorted(r.entries) == ["k2"]
+
+
+def test_journal_fsync_fault_rejects_admit(tmp_path):
+    j = Journal(tmp_path / "j")
+    rfaults.activate(FaultPlan.parse("journal.fsync:error"))
+    with pytest.raises(JournalWriteError):
+        j.record_admit("k1", b"x", {})
+    rfaults.deactivate()
+    # the frame reached the OS before the failed fsync: recovery may
+    # see it live (at-least-once existence), and the CALLER saw a
+    # rejection — replaying a rejected-but-durable admit is the
+    # harmless direction (purity), dropping a confirmed one is not
+    j.record_admit("k2", b"y", {})
+    j.close()
+    assert "k2" in dr.scan(tmp_path / "j").entries
+
+
+def test_settle_and_mark_write_failures_degrade_not_raise(tmp_path):
+    j = Journal(tmp_path / "j")
+    j.record_admit("k1", b"x", {})
+    rfaults.activate(FaultPlan.parse("journal.write:error:times=2"))
+    j.record_settle("k1", "ok")  # swallowed + recorded, never raises
+    j.record_mark(["k1"])
+    rfaults.deactivate()
+    j.close()
+
+
+# --------------------------------------------------- rotation and GC
+
+
+def test_rotation_and_retired_entry_gc(tmp_path):
+    d = tmp_path / "j"
+    j = Journal(d, segment_bytes=256)  # tiny: rotate every few frames
+    for i in range(40):
+        key = f"k{i}"
+        j.record_admit(key, b"x" * 16, {})
+        j.record_settle(key, "ok")
+    j.record_admit("live-one", b"y", {})
+    j.gc()
+    segs = dj.segment_files(d)
+    # fully-settled rotated segments were unlinked; what remains holds
+    # the live entry and the (possibly empty) live segment
+    assert len(segs) <= 3, [s.name for s in segs]
+    r = dr.scan(d)
+    assert sorted(r.entries) == ["live-one"]
+    j.close()
+
+
+# ------------------------------------------- service-level integration
+
+
+def _service(journal_dir, **kw):
+    from kindel_tpu.serve import ConsensusService
+
+    kw.setdefault("warmup", False)
+    kw.setdefault("http_port", None)
+    return ConsensusService(journal_dir=str(journal_dir), **kw)
+
+
+def _wait(pred, timeout=60.0, every=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(every)
+    return False
+
+
+def test_replay_on_restart_serves_only_unsettled(tmp_path):
+    payload = _sam_payload()
+    jd = tmp_path / "journal"
+
+    svc = _service(jd).start()
+    served = svc.request(payload, timeout=120)
+    reference = [s.sequence for s in served.consensuses]
+    assert svc._journal.live_count == 0
+    svc.stop()
+
+    # life 2: admit two orphans (worker pinned dead — nothing serves),
+    # then die abruptly
+    svc2 = _service(jd)
+    svc2.worker._killed = True
+    svc2.start()
+    f1 = svc2.submit(payload)
+    f2 = svc2.submit(payload, min_depth=2)
+    assert svc2._journal.live_count == 2
+    svc2.kill()
+    assert not f1.done() and not f2.done()  # abandoned, like a SIGKILL
+
+    # life 3: replay serves exactly the two orphans
+    before = _snap()
+    svc3 = _service(jd).start()
+    assert _wait(lambda: svc3._journal.live_count == 0, 120)
+    after = _snap()
+    assert _delta(before, after, "kindel_journal_replayed_total") == 2
+    # the replayed result is the same consensus the direct path produced
+    r = dr.scan(jd)
+    assert not r.entries
+    svc3.stop()
+    # the settled key from life 1 was never replayed: total replays
+    # stayed at 2 and a fresh scan shows nothing live
+    final = dr.scan(jd)
+    assert not final.entries
+    _ = reference
+
+
+def test_replay_preserves_opt_overrides(tmp_path):
+    payload = _sam_payload()
+    jd = tmp_path / "journal"
+    svc = _service(jd)
+    svc.worker._killed = True
+    svc.start()
+    svc.submit(payload, min_depth=3, trim_ends=True)
+    svc.kill()
+
+    seen = {}
+    svc2 = _service(jd)
+    orig = svc2._submit_replay
+
+    def spy(key, pl, opts, suspect=False):
+        seen["opts"] = dict(opts)
+        seen["suspect"] = suspect
+        return orig(key, pl, opts, suspect=suspect)
+
+    svc2._submit_replay = spy
+    svc2.start()
+    assert _wait(lambda: svc2._journal.live_count == 0, 120)
+    svc2.stop()
+    assert seen["opts"] == {"min_depth": 3, "trim_ends": True}
+    assert seen["suspect"] is False  # never marked: not a suspect
+
+
+def test_quarantine_after_k_blamed_crashes(tmp_path):
+    payload = _sam_payload(seed=3)
+    jd = tmp_path / "journal"
+    key = dj.payload_digest(payload)[:16] + "-poisonpoisonpoi"
+    # three process lives, each of which died with the entry mid-flush
+    for _life in range(3):
+        j = Journal(jd)
+        j.record_admit(key, payload, {})
+        j.record_mark([key])
+        j._fh.flush()
+        j._fh.close()  # abrupt: no close() bookkeeping, like os._exit
+    assert dr.scan(jd).blame[key] == 3
+
+    before = _snap()
+    svc = _service(jd, quarantine_after=3).start()
+    assert _wait(lambda: svc._journal.live_count == 0, 60)
+    after = _snap()
+    assert _delta(
+        before, after, "kindel_quarantined_requests_total"
+    ) == 1
+    assert _delta(before, after, "kindel_journal_replayed_total") == 0
+    # identical payloads are barred at the door, typed
+    with pytest.raises(PoisonRequestError):
+        svc.submit(payload)
+    # ... and the verdict survives a restart (quarantine is durable)
+    svc.stop()
+    svc2 = _service(jd, quarantine_after=3).start()
+    with pytest.raises(PoisonRequestError):
+        svc2.submit(payload)
+    # a DIFFERENT payload is unaffected
+    ok = svc2.request(_sam_payload(seed=4), timeout=120)
+    assert ok.consensuses
+    svc2.stop()
+
+
+def test_suspect_replays_isolated_from_batcher(tmp_path):
+    payload = _sam_payload(seed=5)
+    jd = tmp_path / "journal"
+    j = Journal(jd)
+    j.record_admit("susp-key-000000000000000000", payload, {})
+    j.record_mark(["susp-key-000000000000000000"])  # blamed once
+    j._fh.flush()
+    j._fh.close()
+
+    svc = _service(jd, quarantine_after=3)
+    batched = []
+    orig_add = svc.worker.batcher.add
+    svc.worker.batcher.add = lambda req, units: (
+        batched.append(req.key), orig_add(req, units)
+    )
+    svc.start()
+    assert _wait(lambda: svc._journal.live_count == 0, 120)
+    svc.stop()
+    # the suspect was served (journal drained, tombstone ok) but NEVER
+    # entered a shared batcher lane
+    assert "susp-key-000000000000000000" not in batched
+    assert not dr.scan(jd).entries
+
+
+def test_poison_http_mapping_is_422_without_retry_after():
+    from kindel_tpu.fleet.rpc import RpcServiceClient
+    from kindel_tpu.serve.service import consensus_post_response
+
+    def poisoned(_body):
+        raise PoisonRequestError("payload abc is quarantined")
+
+    status, ctype, body, headers = consensus_post_response(
+        poisoned, b"x"
+    )
+    assert status == 422
+    assert b"quarantined" in body
+    assert "Retry-After" not in headers
+    # ... and the RPC client maps it back to the same type, which the
+    # router treats as a REQUEST failure (no failover: it would crash
+    # the next replica too)
+    exc = RpcServiceClient._status_error(422, {}, body)
+    assert isinstance(exc, PoisonRequestError)
+    from kindel_tpu.fleet.router import REPLICA_FAILURES
+
+    assert not isinstance(exc, REPLICA_FAILURES)
+    from kindel_tpu.fleet.rpc import wire_transient
+
+    assert not wire_transient(exc)
+
+
+def test_handback_tombstones_drain_the_journal(tmp_path):
+    payload = _sam_payload()
+    jd = tmp_path / "journal"
+    svc = _service(jd)
+    svc.worker._killed = True  # nothing pops the queue
+    svc.start()
+    svc.submit(payload)
+    svc.submit(payload)
+    assert svc._journal.live_count == 2
+    handed = svc.drain(handback=True)
+    assert len(handed) == 2
+    # the hand-back IS this replica's settle: nothing left to replay
+    assert not dr.scan(jd).entries
+
+
+def test_queue_rejection_tombstones_the_admit(tmp_path):
+    payload = _sam_payload()
+    jd = tmp_path / "journal"
+    svc = _service(jd, max_depth=1, high_watermark=1)
+    svc.worker._killed = True
+    svc.start()
+    svc.submit(payload)
+    with pytest.raises(AdmissionError):
+        svc.submit(payload)  # watermark: rejected AFTER the WAL write
+    # the rejected admit was tombstoned — only the accepted one is live
+    assert svc._journal.live_count == 1
+    svc.kill()
+
+
+def test_journal_admit_fault_maps_to_admission_error(tmp_path):
+    payload = _sam_payload()
+    svc = _service(tmp_path / "journal")
+    svc.worker._killed = True
+    svc.start()
+    rfaults.activate(FaultPlan.parse("journal.write:error"))
+    with pytest.raises(AdmissionError) as exc:
+        svc.submit(payload)
+    assert exc.value.retry_after_s > 0
+    rfaults.deactivate()
+    svc.kill()
+
+
+# -------------------------------------------- disabled-path allocation
+
+
+def test_disabled_journal_hooks_are_allocation_free():
+    """The acceptance pin: with journaling off, the dispatch-site and
+    settle-site hooks are one None check (PR 4 convention)."""
+
+    class _Req:
+        __slots__ = ("key", "payload")
+
+        def __init__(self):
+            self.key = None
+            self.payload = b"x"
+
+    entries = [(_Req(), []) for _ in range(4)]
+
+    def burst(n):
+        for _ in range(n):
+            dj.mark_if_active(None, entries)
+            dj.settle_if_active(None, "k", "ok")
+
+    burst(64)  # warm any lazy interning
+    tracemalloc.start()
+    try:
+        before = tracemalloc.take_snapshot()
+        burst(4096)
+        after = tracemalloc.take_snapshot()
+    finally:
+        tracemalloc.stop()
+    journal_py = str(Path(dj.__file__))
+    leaked = sum(
+        stat.size_diff
+        for stat in after.compare_to(before, "filename")
+        if stat.traceback[0].filename == journal_py and stat.size_diff > 0
+    )
+    assert leaked < 512, (
+        f"disabled journal hooks allocated {leaked} bytes over 4096 calls"
+    )
+
+
+# ------------------------------------------------------ knob plumbing
+
+
+def test_journal_knob_resolution_precedence(monkeypatch):
+    from kindel_tpu import tune
+
+    monkeypatch.delenv("KINDEL_TPU_JOURNAL_DIR", raising=False)
+    monkeypatch.delenv("KINDEL_TPU_QUARANTINE_AFTER", raising=False)
+    assert tune.resolve_journal_dir() == (None, "default")
+    assert tune.resolve_quarantine_after() == (3, "default")
+    monkeypatch.setenv("KINDEL_TPU_JOURNAL_DIR", "/var/j")
+    monkeypatch.setenv("KINDEL_TPU_QUARANTINE_AFTER", "7")
+    assert tune.resolve_journal_dir() == ("/var/j", "env")
+    assert tune.resolve_quarantine_after() == (7, "env")
+    # explicit beats env; "off" is an explicit disable
+    assert tune.resolve_journal_dir("/x") == ("/x", "explicit")
+    assert tune.resolve_journal_dir("off") == (None, "explicit")
+    assert tune.resolve_quarantine_after(2) == (2, "explicit")
+    # malformed env pins fall through, never crash a boot
+    monkeypatch.setenv("KINDEL_TPU_QUARANTINE_AFTER", "banana")
+    assert tune.resolve_quarantine_after() == (3, "default")
+    monkeypatch.setenv("KINDEL_TPU_QUARANTINE_AFTER", "-1")
+    assert tune.resolve_quarantine_after() == (3, "default")
+
+
+def test_fault_spec_match_scopes_to_note():
+    plan = FaultPlan.parse("serve.flush:crash:times=5:match=poisonkey")
+    # without a matching note the spec neither fires nor burns budget
+    plan.fire("serve.flush")
+    plan.fire("serve.flush", "other|keys")
+    assert plan.fired == {}
+    assert plan.specs[0].match == "poisonkey"
+    # crash would os._exit: assert reachability via the ledger of a
+    # NON-crash kind with the same match plumbing
+    plan2 = FaultPlan.parse("serve.flush:error:match=abc")
+    with pytest.raises(rfaults.InjectedFault):
+        plan2.fire("serve.flush", "xx|abc|yy")
+    assert plan2.fired == {("serve.flush", "error"): 1}
+
+
+# ---------------------------------------------------------- satellites
+
+
+def test_spawn_failure_sweeps_addr_file(tmp_path):
+    import sys
+
+    from kindel_tpu.fleet.procreplica import (
+        ReplicaProcess,
+        ReplicaSpawnError,
+    )
+
+    addr = tmp_path / "r0.g0.addr"
+    addr.write_text("{}")  # half-written handshake from a dying child
+    proc = ReplicaProcess(
+        [sys.executable, "-c", "import sys; sys.exit(3)"], str(addr),
+        spawn_timeout_s=30.0,
+    )
+    with pytest.raises(ReplicaSpawnError):
+        proc.start()
+    assert not addr.exists()
+
+
+def test_factory_sweeps_stale_generations(tmp_path):
+    from kindel_tpu.fleet.procreplica import ProcessReplicaFactory
+
+    for gen in range(3):
+        (tmp_path / f"r7.g{gen}.addr").write_text("{}")
+        (tmp_path / f"r7.g{gen}.json").write_text("{}")
+    (tmp_path / "r8.g0.addr").write_text("{}")  # another slot: kept
+    factory = ProcessReplicaFactory("r7", str(tmp_path))
+    factory.sweep_stale_files(keep_generation=2)
+    left = sorted(p.name for p in tmp_path.iterdir())
+    assert left == ["r7.g2.addr", "r7.g2.json", "r8.g0.addr"]
+
+
+def test_factory_routes_journal_dir_per_slot(tmp_path):
+    from kindel_tpu.fleet.procreplica import ProcessReplicaFactory
+
+    factory = ProcessReplicaFactory(
+        "r3", str(tmp_path),
+        service_config={"journal_dir": str(tmp_path / "jrn")},
+    )
+    assert factory._config["service"]["journal_dir"] == str(
+        tmp_path / "jrn" / "r3"
+    )
+
+
+def test_respawn_latency_fields_in_rpc_report():
+    from benchmarks.serve_load import rpc_report
+    from kindel_tpu.obs.metrics import fleet_metrics
+
+    # the histogram exists on the fleet family (observed by the
+    # process factory's spawn timer)
+    assert fleet_metrics().respawn_seconds is not None
+    after = {
+        "kindel_rpc_call_seconds": {"p50": 0.01, "p99": 0.02},
+        "kindel_fleet_respawn_seconds": {"p50": 1.5, "p99": 3.0},
+    }
+    report = rpc_report({}, after)
+    assert report["respawn_p50_ms"] == 1500.0
+    assert report["respawn_p99_ms"] == 3000.0
+
+
+def test_parse_replica_addrs_and_static_fleet_guards():
+    from kindel_tpu.fleet import parse_replica_addrs, static_fleet
+
+    assert parse_replica_addrs("a:1, b:2,") == [("a", 1), ("b", 2)]
+    assert parse_replica_addrs(["10.0.0.1:8801"]) == [("10.0.0.1", 8801)]
+    with pytest.raises(ValueError):
+        parse_replica_addrs("no-port")
+    with pytest.raises(ValueError):
+        parse_replica_addrs("")
+    with pytest.raises(ValueError):
+        static_fleet("a:1,b:2", min_replicas=1, max_replicas=3)
+
+
+def test_static_fleet_serves_and_fails_over():
+    """The multi-host groundwork satellite: a FleetService over two
+    PRE-SPAWNED remote replicas (stub services behind real HTTP + the
+    real RPC adapter — the wire without the device). Killing one
+    backend fails requests over to the survivor; a slot restart
+    re-dials the SAME address (spawn/respawn disabled)."""
+    from types import SimpleNamespace
+
+    from kindel_tpu.fleet.rpc import RpcServerAdapter
+    from kindel_tpu.fleet.service import static_fleet
+    from kindel_tpu.io.fasta import Sequence
+    from kindel_tpu.serve.metrics import MetricsRegistry, ServeHTTPServer
+
+    class _Stub:
+        def __init__(self, name):
+            self.name = name
+            self.applied = 0
+
+        def request(self, payload, deadline_s=None,
+                    idempotency_key=None, **opts):
+            self.applied += 1
+            return SimpleNamespace(
+                consensuses=[Sequence("ref_cns", "ACGTACGT")]
+            )
+
+        def healthz(self):
+            return {"status": "ok", "queue_depth": 0, "watermark": 64,
+                    "est_wait_s": 0.0}
+
+        def drain(self, handback=False):
+            return []
+
+    stubs = [_Stub("a"), _Stub("b")]
+    servers = [
+        ServeHTTPServer(
+            MetricsRegistry(), health_fn=s.healthz,
+            post_routes=RpcServerAdapter(s).post_routes(),
+        ).start()
+        for s in stubs
+    ]
+    try:
+        addrs = ",".join(f"{srv.host}:{srv.port}" for srv in servers)
+        fleet = static_fleet(
+            addrs, supervise=False, probe_interval_s=10.0,
+        ).start()
+        try:
+            res = fleet.request(b"payload-one", timeout=30)
+            assert [s.sequence for s in res.consensuses] == ["ACGTACGT"]
+            assert sum(s.applied for s in stubs) == 1
+            # roster slots re-dial their OWN address on restart —
+            # never spawn
+            rep0 = fleet.replica("r0")
+            host_before = rep0.service._host, rep0.service._port
+            rep0.restart()
+            assert (rep0.service._host, rep0.service._port) == host_before
+            # kill one backend server: the router fails the ticket
+            # over to the survivor (RpcTransportError is a
+            # replica-level failure)
+            servers[0].stop()
+            for _ in range(4):
+                res = fleet.request(b"payload-two", timeout=30)
+                assert res.consensuses[0].sequence == "ACGTACGT"
+        finally:
+            fleet.stop(drain=False)
+    finally:
+        for srv in servers:
+            try:
+                srv.stop()
+            except Exception:  # noqa: BLE001 — already stopped above
+                pass
+
+
+# ---------------------------------------------------------- the flagship
+
+
+def test_flagship_double_sigkill_plus_poison_quarantine(
+    tmp_path, monkeypatch
+):
+    """The flagship chaos run (DESIGN.md §24): 3 replica processes with
+    per-slot journals under wire faults; one replica is SIGKILLed twice
+    mid-load (its respawns finish their own orphans via journal
+    replay), and one poison request — scoped by a match= crash fault to
+    its payload digest — crash-loops its replica until the quarantine
+    ladder takes it out after exactly K blamed crashes. Every
+    non-poison request settles exactly once with FASTA sha256 equal to
+    the single-replica in-process reference, and every slot's journal
+    drains to zero live entries."""
+    from benchmarks.serve_load import _synth_sam, run_load
+
+    K = 2
+    # single-replica in-process reference: the byte-identity anchor
+    reference = run_load(clients=2, requests_per_client=3)
+    assert reference["errors"] == 0
+    assert reference["fasta_distinct"] == 1
+
+    poison = _synth_sam(tmp_path / "poison.sam", seed=99).read_bytes()
+    digest16 = hashlib.sha256(poison).hexdigest()[:16]
+    jdir = tmp_path / "journal"
+
+    # children inherit the env: the crash fires ONLY on flushes whose
+    # member keys carry the poison digest (procreplica children
+    # activate KINDEL_TPU_FAULTS at boot)
+    monkeypatch.setenv(
+        "KINDEL_TPU_FAULTS",
+        f"serve.flush:crash:times=20:match={digest16}",
+    )
+    # parent-side wire faults on the submission path (the idempotency
+    # machinery's test vehicle), activated in-process
+    plan = rfaults.activate(FaultPlan.parse(
+        "seed=11,rpc.call:drop_response:times=2:after=1,"
+        "rpc.call:slow:times=2:delay=0.02"
+    ))
+    before = _snap()
+    chaos_state: dict = {}
+
+    def chaos(svc):
+        victim = svc.replica("r0")
+
+        def converged(min_generation=0):
+            return (
+                victim.generation >= min_generation
+                and {r.state for r in svc.roster()} == {"ok"}
+            )
+
+        def wait_converged(what, min_generation=0):
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                if converged(min_generation):
+                    return
+                time.sleep(0.05)
+            raise AssertionError(
+                f"fleet never converged after {what}: "
+                f"{[(r.replica_id, r.state, r.generation) for r in svc.roster()]}"
+            )
+
+        # mid-load SIGKILL #1 and #2 of the same slot: convergence is
+        # the slot's RESPAWN (generation bump), not just probe calm —
+        # right after a SIGKILL every state still reads "ok"
+        time.sleep(0.15)
+        gen0 = victim.generation
+        svc.kill_replica("r0")
+        wait_converged("first SIGKILL", min_generation=gen0 + 1)
+        svc.kill_replica("r0")
+        wait_converged("second SIGKILL", min_generation=gen0 + 2)
+        chaos_state["victim_generations"] = victim.generation - gen0
+
+        # the poison request, submitted straight at r2's wire: its
+        # flush crashes the child; the respawn replays it (suspect →
+        # isolated), crashes again, and the THIRD life quarantines it
+        poison_rep = svc.replica("r2")
+        r2_gen0 = poison_rep.generation
+        fut = poison_rep.service.submit(poison)
+        try:
+            fut.result(timeout=60)
+            chaos_state["poison_outcome"] = "served"
+        except Exception as e:  # noqa: BLE001 — the expected path
+            chaos_state["poison_outcome"] = type(e).__name__
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            if dr.scan(jdir / "r2").quarantined:
+                break
+            time.sleep(0.25)
+        # capture the blame ledger NOW: once everything settles, the
+        # retired-entry GC is entitled to unlink the history segments
+        post = dr.scan(jdir / "r2")
+        chaos_state["quarantined"] = sorted(post.quarantined)
+        chaos_state["poison_blame"] = {
+            k: v for k, v in post.blame.items()
+            if k.startswith(digest16)
+        }
+        wait_converged("poison quarantine")
+        chaos_state["r2_generations"] = (
+            svc.replica("r2").generation - r2_gen0
+        )
+
+    report = run_load(
+        clients=3, requests_per_client=3, procs=3,
+        probe_interval_s=0.02, chaos=chaos,
+        service_config={
+            "journal_dir": str(jdir), "quarantine_after": K,
+        },
+    )
+    after = _snap()
+
+    # exactly once: every non-poison request resolved, none errored,
+    # byte-identical to the single-replica in-process reference
+    assert "chaos_errors" not in report, report.get("chaos_errors")
+    assert report["errors"] == 0
+    assert report["completed"] == report["requests"] == 9
+    assert report["fasta_distinct"] == 1
+    assert report["fasta_sha256"] == reference["fasta_sha256"]
+
+    # the poison request failed typed at the caller (its replica died
+    # under it / rejected it post-quarantine) — never served
+    assert chaos_state["poison_outcome"] != "served"
+
+    # quarantined after exactly K blamed crashes, on the replica it
+    # crashed: the journal names the digest and the blame count
+    poison_digest = dj.payload_digest(poison)
+    assert chaos_state["quarantined"] == [poison_digest]
+    assert chaos_state["poison_blame"], "poison key never blamed"
+    assert all(
+        v == K for v in chaos_state["poison_blame"].values()
+    ), chaos_state["poison_blame"]
+
+    # both SIGKILLs were detected and the slot respawned twice; the
+    # poison crash-looped r2 through two more generations (the exact
+    # respawn COUNTER can race the final fleet stop, so generations —
+    # which the quarantine itself proves — are the hard pin)
+    assert chaos_state["victim_generations"] == 2
+    assert chaos_state["r2_generations"] >= 2
+    assert _delta(before, after, "kindel_fleet_evictions_total") >= 3
+    assert _delta(before, after, "kindel_fleet_respawns_total") >= 3
+    # respawn latency is now a tracked number
+    assert report["rpc"]["respawn_p99_ms"] > 0
+    # the parent-side wire plan fired as written
+    assert plan.fired[("rpc.call", "drop_response")] == 2
+
+    # zero journal entries leaked: after drain, every slot's journal
+    # scans to zero live entries
+    for slot in ("r0", "r1", "r2"):
+        leftover = dr.scan(jdir / slot)
+        assert not leftover.entries, (
+            slot, list(leftover.entries)
+        )
