@@ -535,6 +535,30 @@ def _run_benchmark() -> dict:
         except Exception as e:  # noqa: BLE001
             result["paged"] = {"error": repr(e)}
 
+    # Streaming-consensus scenario (kindel_tpu.sessions): S live
+    # /v1/stream sessions fed by an open-loop appender, with a
+    # mid-stream journal respawn; the `stream` object records update
+    # latency p50/p99, emits-per-append, d2h bytes per published
+    # update, and the replay count, with byte-identity against the
+    # one-shot oracle asserted per session (`converged`). Same gating
+    # rule as the ragged scenario (KINDEL_TPU_BENCH_STREAM overrides;
+    # default-on only for CPU children). Failure never voids the
+    # headline metric.
+    stream_pin = os.environ.get("KINDEL_TPU_BENCH_STREAM")
+    want_stream = (
+        jax.default_backend() == "cpu" if stream_pin is None
+        else stream_pin not in ("", "0")
+    )
+    if want_stream:
+        try:
+            from benchmarks.stream_load import run_stream_load
+
+            result["stream"] = run_stream_load(
+                sessions=3, appends_per_session=4
+            )
+        except Exception as e:  # noqa: BLE001
+            result["stream"] = {"error": repr(e)}
+
     # Mesh sweep (kindel_tpu.parallel.meshexec): the shape-diverse
     # request set served once per mesh width dp∈{1,2,4,8} (clamped to
     # the visible devices) with byte-identity asserted across widths;

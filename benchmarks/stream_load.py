@@ -1,0 +1,274 @@
+"""Open-loop load generator for the streaming consensus lane.
+
+S concurrent sessions each receive A appended read batches at a fixed
+arrival interval — OPEN loop: the appender never waits for the
+previous append's ack before sending the next, so backpressure shows
+up as deferred acks and update latency, not as a slowed generator
+(the serving-lane complement of benchmarks/paged_load.py). Optionally
+the service is stopped and respawned mid-stream over its durable
+journal, so the report's replay count measures a real recovery, not a
+counter at rest.
+
+Reported per run: client-observed update latency p50/p99 (append
+submit → emission-decision ack for the gate-crossing appends),
+emits-per-append (how many appends actually moved the called bases),
+d2h bytes per published update (the device emit path's O(consensus)
+readback), suppressed snapshots, replay count, and the final-FASTA
+digest with a `converged` bit against the one-shot oracle over each
+session's concatenated batches — the lane's byte-identity contract,
+asserted on every bench round.
+
+Wired into bench.py's optional-metrics path: the `stream` object
+(KINDEL_TPU_BENCH_STREAM=1 opt-in off-CPU). Standalone:
+
+    python -m benchmarks.stream_load --sessions 4 --appends 6
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import sys
+import tempfile
+import threading
+import time
+from pathlib import Path
+
+
+def _synth_sam(dest: Path, ref_len: int = 1024, n_reads: int = 40,
+               seed: int = 0) -> Path:
+    """One appended read batch: small enough that the emission gate —
+    not decode — dominates the measured path."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    lines = ["@HD\tVN:1.6", f"@SQ\tSN:stream1\tLN:{ref_len}"]
+    for i in range(n_reads):
+        pos = int(rng.integers(0, ref_len - 80))
+        seq = "".join("ACGT"[b] for b in rng.integers(0, 4, size=80))
+        cigar = ("40M2D38M2S", "80M", "38M4I38M")[i % 3]
+        lines.append(
+            f"r{i}\t0\tstream1\t{pos + 1}\t60\t{cigar}\t*\t0\t0\t{seq}\t*"
+        )
+    dest.write_text("\n".join(lines) + "\n")
+    return dest
+
+
+def _concat_sam(dest: Path, parts) -> Path:
+    lines = []
+    for i, p in enumerate(parts):
+        for ln in p.read_text().splitlines():
+            if ln.startswith("@") and i > 0:
+                continue
+            lines.append(ln)
+    dest.write_text("\n".join(lines) + "\n")
+    return dest
+
+
+def run_stream_load(sessions: int = 4, appends_per_session: int = 6,
+                    interval_s: float = 0.01, emit_delta: int = 1,
+                    batch_reads: int = 40, max_wait_s: float = 0.01,
+                    respawn: bool = True, **service_kwargs) -> dict:
+    """Run the open loop; returns a JSON-able report dict.
+
+    `respawn=True` stops the service after the first half of the
+    appends and restarts it over the same journal directory (shared
+    metrics registry, so counters span both lives): the journal's
+    OPEN/APPEND frames replay every session under its original id and
+    the second half of the load lands on the respawned lease — the
+    report's `replays` then counts real recoveries."""
+    from kindel_tpu.io.fasta import format_fasta
+    from kindel_tpu.obs.metrics import MetricsRegistry
+    from kindel_tpu.serve import ConsensusService
+    from kindel_tpu.workloads import bam_to_consensus
+
+    tmp = tempfile.TemporaryDirectory(prefix="kindel_stream_load_")
+    root = Path(tmp.name)
+    batches = {
+        s: [
+            _synth_sam(
+                root / f"s{s}_b{k}.sam", n_reads=batch_reads,
+                seed=1000 + 100 * s + k,
+            )
+            for k in range(appends_per_session + 1)
+        ]
+        for s in range(sessions)
+    }
+    registry = MetricsRegistry()
+    journal_dir = str(root / "journal") if respawn else None
+
+    def make_service():
+        return ConsensusService(
+            max_wait_s=max_wait_s, emit_delta=emit_delta,
+            journal_dir=journal_dir, metrics=registry,
+            **service_kwargs,
+        ).start()
+
+    lat_lock = threading.Lock()
+    update_lat: list[float] = []
+    deferred = [0]
+    errors: list[str] = []
+
+    def track(fut, t0: float):
+        def _done(f):
+            dt = time.perf_counter() - t0
+            try:
+                ack = f.result()
+            except Exception as e:  # noqa: BLE001 — typed retire at respawn
+                with lat_lock:
+                    errors.append(repr(e))
+                return
+            with lat_lock:
+                if ack.get("emitted"):
+                    update_lat.append(dt)
+                else:
+                    deferred[0] += 1
+        fut.add_done_callback(_done)
+
+    def append_phase(svc, ks):
+        """One open-loop pass: every session gets its batch `k` for
+        each k in `ks`, issued on the interval clock, acks tracked
+        asynchronously."""
+        futs = []
+        for k in ks:
+            for s in range(sessions):
+                t0 = time.perf_counter()
+                try:
+                    fut = svc.sessions.append(
+                        sids[s], batches[s][k].read_bytes()
+                    )
+                except Exception as e:  # noqa: BLE001 — shed at admission
+                    with lat_lock:
+                        errors.append(repr(e))
+                    continue
+                track(fut, t0)
+                futs.append(fut)
+                time.sleep(interval_s)
+        return futs
+
+    def _wait(pred, timeout=120.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if pred():
+                return True
+            time.sleep(0.02)
+        return False
+
+    t_start = time.perf_counter()
+    svc = make_service()
+    try:
+        sids = {
+            s: svc.sessions.open(batches[s][0].read_bytes())
+            for s in range(sessions)
+        }
+        half = max(1, appends_per_session // 2)
+        futs = append_phase(svc, range(1, 1 + half))
+
+        if respawn:
+            # mid-stream crash-and-respawn: the journal carries every
+            # admitted append across the gap (WAL-then-merge)
+            for f in futs:
+                f.cancel()  # no-op on settled; typed retire covers rest
+            svc.stop()
+            svc = make_service()
+            assert _wait(lambda: registry.snapshot().get(
+                "kindel_stream_replays_total", 0
+            ) >= sessions), "journal replay did not restore the sessions"
+
+        futs = append_phase(
+            svc, range(1 + half, 1 + appends_per_session)
+        )
+        for f in futs:
+            try:
+                f.result(timeout=300)
+            except Exception:  # noqa: BLE001 — already counted by track
+                pass
+
+        finals = {
+            s: svc.sessions.close(sids[s]).result(timeout=300)
+            for s in range(sessions)
+        }
+        snap = registry.snapshot()
+        wall = time.perf_counter() - t_start
+
+        # byte-identity against the one-shot oracle: the lane's
+        # contract, asserted on every bench round (a benchmark of a
+        # wrong answer is not a benchmark)
+        converged = True
+        fastas = []
+        for s in range(sessions):
+            cat = _concat_sam(root / f"s{s}_oracle.sam", batches[s])
+            want = format_fasta(bam_to_consensus(str(cat)).consensuses)
+            fastas.append(finals[s]["fasta"])
+            converged = converged and finals[s]["fasta"] == want
+    finally:
+        svc.stop()
+        tmp.cleanup()
+
+    update_lat.sort()
+
+    def pct(q: float) -> float:
+        if not update_lat:
+            return 0.0
+        return update_lat[min(len(update_lat) - 1,
+                              int(q * len(update_lat)))]
+
+    appends = int(snap.get("kindel_stream_appends_total", 0))
+    emits = int(snap.get("kindel_stream_emits_total", 0))
+    emit_bytes = int(snap.get("kindel_stream_emit_bytes_total", 0))
+    return {
+        "sessions": sessions,
+        "appends_per_session": appends_per_session,
+        "appends": appends,
+        "emits": emits,
+        "suppressed": int(
+            snap.get("kindel_stream_suppressed_total", 0)
+        ),
+        "deferred_acks": deferred[0],
+        "errors": len(errors),
+        "wall_s": round(wall, 3),
+        "update_latency_p50_s": round(pct(0.50), 4),
+        "update_latency_p99_s": round(pct(0.99), 4),
+        "emits_per_append": round(emits / max(appends, 1), 3),
+        "d2h_bytes_per_update": round(
+            emit_bytes / max(emits, 1), 1
+        ),
+        "replays": int(snap.get("kindel_stream_replays_total", 0)),
+        "sse_events": int(
+            snap.get("kindel_stream_sse_events_total", 0)
+        ),
+        "converged": converged,
+        "fasta_distinct": len(set(fastas)),
+        "fasta_sha256": hashlib.sha256(
+            "\n".join(sorted(set(fastas))).encode()
+        ).hexdigest(),
+    }
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="open-loop streaming-consensus load generator"
+    )
+    ap.add_argument("--sessions", type=int, default=4)
+    ap.add_argument("--appends", type=int, default=6,
+                    help="appended batches per session")
+    ap.add_argument("--interval-ms", type=float, default=10.0,
+                    help="open-loop arrival interval per append")
+    ap.add_argument("--emit-delta", type=int, default=1)
+    ap.add_argument("--no-respawn", action="store_true",
+                    help="skip the mid-stream journal respawn cycle")
+    args = ap.parse_args(argv)
+    report = run_stream_load(
+        sessions=args.sessions, appends_per_session=args.appends,
+        interval_s=args.interval_ms / 1000.0,
+        emit_delta=args.emit_delta, respawn=not args.no_respawn,
+    )
+    json.dump(report, sys.stdout, indent=2)
+    print()
+    return 0 if report["converged"] and not report["errors"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

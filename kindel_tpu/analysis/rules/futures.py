@@ -34,8 +34,12 @@ from kindel_tpu.analysis.model import ProjectModel
 #: serve dispatch path that owns admitted futures; durable in PR 15:
 #: journal replay re-creates admitted requests and pre-claims
 #: idempotency-cache futures — a leaked claim strands every wire
-#: resubmission of that key forever)
-FUTURE_SCOPE = ("serve", "fleet", "paged", "emit", "parallel", "durable")
+#: resubmission of that key forever; sessions in PR 16: every append
+#: registers an ack future on the lease, and the reap-vs-append race
+#: must settle each exactly once)
+FUTURE_SCOPE = (
+    "serve", "fleet", "paged", "emit", "parallel", "durable", "sessions",
+)
 
 #: constructors whose result is (or owns) a fresh unsettled Future
 _CREATORS = {"Future", "ServeRequest"}

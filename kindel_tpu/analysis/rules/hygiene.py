@@ -473,6 +473,10 @@ def download_confinement(model: ProjectModel):
 FAILURE_HANDLERS = {
     "_fail", "fail", "_settle", "set_exception", "record_failure",
     "_recover", "record_degrade", "record_probe_failure",
+    # sessions (PR 16): PileupLease.settle / settle_future resolve an
+    # append's ack future exactly once — a handler that routes the
+    # exception there has NOT swallowed it
+    "settle", "settle_future",
 }
 
 #: deliberately-swallowing sites, each with a local reason (see the
@@ -497,9 +501,12 @@ SWALLOW_ALLOWLIST = {
 #: source of truth — a swallowed journal write error silently converts
 #: "durable" into "best effort", which is the one lie the subsystem
 #: must never tell
+#: ... and sessions (PR 16): a streaming lease holds append acks AND
+#: SSE subscribers across minutes — a swallowed failure there strands
+#: a client mid-stream with no typed error and no final emit
 SWALLOW_SCOPE = (
     "serve", "resilience", "fleet", "ragged", "parallel", "devingest",
-    "paged", "emit", "durable",
+    "paged", "emit", "durable", "sessions",
 )
 
 

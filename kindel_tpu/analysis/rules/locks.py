@@ -25,8 +25,11 @@ import ast
 from kindel_tpu.analysis.engine import Finding, rule
 from kindel_tpu.analysis.model import ProjectModel
 
-#: packages whose classes get lock analysis (the admitted-request path)
-LOCK_SCOPE = ("serve", "fleet", "ragged")
+#: packages whose classes get lock analysis (the admitted-request path;
+#: sessions joined in PR 16 — the lease/registry pair mutates pending
+#: futures and subscriber lists from HTTP, reaper, and snapshot-callback
+#: threads at once)
+LOCK_SCOPE = ("serve", "fleet", "ragged", "sessions")
 
 #: container-mutation methods that count as writes for guard inference
 _MUTATORS = {

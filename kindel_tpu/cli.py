@@ -603,6 +603,22 @@ def _serve_parser(sub):
              "serves (explicit > $KINDEL_TPU_QUARANTINE_AFTER > 3)",
     )
     p.add_argument(
+        "--session-idle-s", type=float, default=None, metavar="S",
+        help="reap a /v1/stream session after S seconds without an "
+             "append or close (DESIGN.md §25): its lease retires, "
+             "outstanding acks settle typed, and its journal frames "
+             "close so the WAL can GC (explicit > "
+             "$KINDEL_TPU_SESSION_IDLE_S > 300)",
+    )
+    p.add_argument(
+        "--emit-delta", type=int, default=None, metavar="N",
+        help="depth-delta emission gate of the streaming lane: a "
+             "session re-renders its consensus only once N pileup "
+             "events have accumulated since the last emitted update "
+             "(CLOSE always forces a final emit; explicit > "
+             "$KINDEL_TPU_EMIT_DELTA > 64)",
+    )
+    p.add_argument(
         "--replica-addrs", default=None, metavar="HOST:PORT,...",
         help="static fleet roster: drive PRE-SPAWNED remote replicas "
              "(each running python -m kindel_tpu.fleet.procreplica, or "
@@ -677,6 +693,8 @@ def cmd_serve(args) -> int:
         warm_payloads=args.warm,
         journal_dir=args.journal_dir,
         quarantine_after=args.quarantine_after,
+        session_idle_s=args.session_idle_s,
+        emit_delta=args.emit_delta,
     )
     autoscale = (
         args.min_replicas is not None and args.max_replicas is not None

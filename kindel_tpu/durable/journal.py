@@ -75,6 +75,24 @@ REC_ADMIT = 1
 REC_SETTLE = 2
 REC_MARK = 3
 REC_QUARANTINE = 4
+#: streaming-session frames (kindel_tpu.sessions, DESIGN.md §25): a
+#: session's durable identity is its OPEN + ordered APPEND payloads;
+#: EMIT records the last settled epoch watermark (best-effort — a lost
+#: emit only re-numbers nothing, replay fast-forwards to the max seen)
+#: and CLOSE ends the session's journal life (reap, client close, or
+#: drain hand-off — the new home journals its own OPEN/APPENDs)
+REC_SOPEN = 5
+REC_SAPPEND = 6
+REC_SEMIT = 7
+REC_SCLOSE = 8
+
+
+def session_live_key(sid: str) -> str:
+    """The pseudo-key a session's frames attribute to segments under:
+    namespaced so it can never collide with an admit's digest-nonce key.
+    Segment GC holds any segment whose keys include a LIVE session's —
+    retiring the segment would drop appends a respawn must replay."""
+    return "s:" + sid
 
 _HDR = struct.Struct("<BI")
 _CRC = struct.Struct("<I")
@@ -266,6 +284,12 @@ class Journal:
         #: keys marked in-flight in their CURRENT admission life (one
         #: MARK per life — a dispatch retry must not double-blame)
         self._marked: set[str] = set()
+        #: live-session pseudo-keys (session_live_key): sessions whose
+        #: OPEN has no CLOSE yet — what a respawn replays, and what GC
+        #: must not retire segments out from under
+        self._live_sessions: set[str] = {
+            session_live_key(sid) for sid in self.scan.sessions
+        }
         #: rotated segment -> the admit keys it holds (GC input);
         #: history segments join with the keys the scan attributed
         self._segments: dict[Path, set] = {
@@ -341,7 +365,10 @@ class Journal:
     def _gc_locked(self) -> None:
         for path in list(self._segments):
             keys = self._segments[path]
-            if any(k in self._live for k in keys):
+            if any(
+                k in self._live or k in self._live_sessions
+                for k in keys
+            ):
                 continue
             try:
                 path.unlink(missing_ok=True)
@@ -445,6 +472,78 @@ class Journal:
             ) from e
         self._m.live.set(len(self._live))
 
+    # ------------------------------------------------------ session frames
+
+    def record_session_open(self, sid: str, opts: dict | None = None) -> None:
+        """WAL one streaming session's OPEN (kindel_tpu.sessions).
+        Durable before return, like an admit — an opened session the
+        journal cannot protect is rejected (`JournalWriteError`, mapped
+        to a retryable admission shed by the registry)."""
+        doc: dict = {"s": sid}
+        if opts:
+            doc["o"] = opts
+        try:
+            with self._lock:
+                offset = self._append_locked(REC_SOPEN, doc)
+                self._live_sessions.add(session_live_key(sid))
+                self._seg_keys.add(session_live_key(sid))
+            self._fsync_to(offset)
+        except Exception as e:
+            self._m.errors.inc()
+            raise JournalWriteError(
+                f"session journal write failed: {e!r}"
+            ) from e
+
+    def record_session_append(self, sid: str, payload) -> None:
+        """WAL one appended read batch BEFORE it merges into the
+        session's resident pileup: an acked append is durable, and a
+        failed write rejects the append (typed, retryable) before any
+        state changed — never half-merged."""
+        doc = {
+            "s": sid,
+            "p": base64.b64encode(bytes(payload)).decode(),
+        }
+        try:
+            with self._lock:
+                offset = self._append_locked(REC_SAPPEND, doc)
+                self._seg_keys.add(session_live_key(sid))
+            self._fsync_to(offset)
+        except Exception as e:
+            self._m.errors.inc()
+            raise JournalWriteError(
+                f"session journal write failed: {e!r}"
+            ) from e
+
+    def record_session_emit(self, sid: str, epoch: int) -> None:
+        """The epoch watermark of one published update. Best-effort
+        (flushed, not fsynced) and never raises: a lost emit frame only
+        costs replay a lower fast-forward point — epochs stay monotone
+        because replay takes the max seen."""
+        try:
+            with self._lock:
+                self._append_locked(REC_SEMIT, {"s": sid, "e": int(epoch)})
+                self._seg_keys.add(session_live_key(sid))
+        except Exception as e:  # noqa: BLE001 — emit path must not raise
+            self._m.errors.inc()
+            record_degrade(
+                "journal.session", f"emit_write_failed:{type(e).__name__}", 1
+            )
+
+    def record_session_close(self, sid: str) -> None:
+        """End one session's journal life (client close, idle reap, or
+        drain hand-off). Never raises: a close the journal could not
+        write only resurrects the session next life, where the idle
+        reaper ends it again."""
+        try:
+            with self._lock:
+                self._append_locked(REC_SCLOSE, {"s": sid})
+                self._live_sessions.discard(session_live_key(sid))
+        except Exception as e:  # noqa: BLE001 — close path must not raise
+            self._m.errors.inc()
+            record_degrade(
+                "journal.session", f"close_write_failed:{type(e).__name__}", 1
+            )
+
     # -------------------------------------------------------------- views
 
     @property
@@ -464,6 +563,7 @@ class Journal:
             return {
                 "dir": str(self.dir),
                 "live": len(self._live),
+                "sessions": len(self._live_sessions),
                 "quarantined": len(self.quarantined),
                 "segment": self._seg_index,
             }

@@ -42,10 +42,15 @@ from kindel_tpu.durable.journal import (
     REC_ADMIT,
     REC_MARK,
     REC_QUARANTINE,
+    REC_SAPPEND,
+    REC_SCLOSE,
+    REC_SEMIT,
     REC_SETTLE,
+    REC_SOPEN,
     journal_metrics,
     segment_files,
     segment_index,
+    session_live_key,
 )
 from kindel_tpu.resilience.policy import record_degrade
 
@@ -88,10 +93,15 @@ class ScanResult:
     quarantined: set = field(default_factory=set)
     #: torn/CRC-failed frames dropped by the scan
     truncated: int = 0
-    #: segment path -> admit keys it holds (GC input)
+    #: segment path -> admit keys it holds (GC input; session frames
+    #: attribute under their session_live_key pseudo-key)
     segment_keys: dict = field(default_factory=dict)
     #: index the next live segment should use
     next_index: int = 0
+    #: sid -> {"opts", "appends": [b64, ...], "epoch"} for streaming
+    #: sessions whose OPEN has no CLOSE (kindel_tpu.sessions): what
+    #: replay_sessions restores under the original session key
+    sessions: dict = field(default_factory=dict)
 
     def live(self) -> list:
         return list(self.entries.values())
@@ -194,7 +204,61 @@ def scan(dirpath) -> ScanResult:
                     result.quarantined.add(digest)
                 if key and result.entries.pop(key, None) is not None:
                     result.settled.add(key)
+            elif rtype == REC_SOPEN:
+                sid = doc.get("s")
+                if not sid:
+                    continue
+                keys_here.add(session_live_key(sid))
+                result.sessions[sid] = {
+                    "opts": doc.get("o") or {},
+                    "appends": [],
+                    "epoch": 0,
+                }
+            elif rtype == REC_SAPPEND:
+                sid = doc.get("s")
+                # an append frame may land after the reaper's CLOSE
+                # (journal writes are not under the lease lock); a
+                # closed session's stragglers die with the close
+                if sid in result.sessions and doc.get("p"):
+                    keys_here.add(session_live_key(sid))
+                    result.sessions[sid]["appends"].append(doc["p"])
+            elif rtype == REC_SEMIT:
+                sid = doc.get("s")
+                if sid in result.sessions:
+                    result.sessions[sid]["epoch"] = max(
+                        result.sessions[sid]["epoch"],
+                        int(doc.get("e") or 0),
+                    )
+            elif rtype == REC_SCLOSE:
+                result.sessions.pop(doc.get("s"), None)
     return result
+
+
+def replay_sessions(registry, result: ScanResult) -> int:
+    """Restore every live scanned streaming session into `registry`
+    (kindel_tpu.sessions.SessionRegistry) under its ORIGINAL session id:
+    re-decode and merge the retained appends, fast-forward the epoch to
+    the last settled watermark. journal_frames=False — the frames being
+    replayed already exist in this journal; re-journaling them would
+    double the appends on the life after next. A session that cannot be
+    restored (e.g. its id raced back open) is dropped with a degrade
+    record — the reaper-equivalent outcome, never a crash."""
+    n = 0
+    for sid, info in result.sessions.items():
+        desc = {
+            "sid": sid,
+            "appends": [
+                base64.b64decode(p) for p in info.get("appends", ())
+            ],
+            "epoch": info.get("epoch", 0),
+            "opts": info.get("opts") or {},
+        }
+        try:
+            registry.restore(desc, journal_frames=False)
+            n += 1
+        except Exception:  # noqa: BLE001 — recovery is best-effort per session
+            record_degrade("journal.replay", "session_restore_failed", 1)
+    return n
 
 
 def gc_segments(dirpath, live_keys, segment_keys=None,

@@ -325,9 +325,11 @@ class FleetService:
         replica's admission, finish its in-flight flushes, hand its
         queued-but-unstarted requests back to the router (re-queued on
         survivors, counted as kindel_fleet_drained_requests_total),
-        then warm-restart it — the rest of the fleet keeps serving
-        throughout. Without `replica`: drain and stop the whole fleet.
-        Returns the number of requests handed back."""
+        re-home its live streaming sessions on survivors (rendezvous
+        affinity — kindel_tpu.sessions), then warm-restart it — the
+        rest of the fleet keeps serving throughout. Without `replica`:
+        drain and stop the whole fleet. Returns the number of requests
+        handed back."""
         if replica is None:
             self.stop(drain=True)
             return 0
@@ -335,11 +337,44 @@ class FleetService:
         with self._drain_lock:
             rep.set_state("draining")
             svc = rep.service
+            descs = []
+            sessions = getattr(svc, "sessions", None)
             if svc is not None and svc.live:
+                if sessions is not None:
+                    # hand the live sessions back BEFORE the drain
+                    # closes the lease registry: each descriptor is the
+                    # session's full durable identity (batch sequence +
+                    # epoch watermark), and its pending appends settle
+                    # with benign hand-back acks — already merged
+                    # durably, so no client retry, so no double-count
+                    descs = sessions.handoff()
                 svc.drain(handback=True)
             n = self.router.replay(rep, counter=fleet_metrics().drained)
+            for desc in descs:
+                self._rehome_session(desc, exclude={rep.replica_id})
             rep.restart()
         return n
+
+    def _rehome_session(self, desc: dict, exclude=frozenset()):
+        """Place one handed-off session on the highest-ranked survivor
+        for its rendezvous key — the same placement a client's locate
+        probe computes, so affinity needs no coordination. The new home
+        journals its own OPEN/APPEND frames (journal_frames=True): its
+        respawn story must not depend on the drained replica's journal."""
+        from kindel_tpu.sessions import session_key
+
+        key = session_key(desc["sid"])
+        for cand in self.router.rank(key, exclude=exclude):
+            svc = cand.service
+            registry = getattr(svc, "sessions", None)
+            if registry is None:
+                continue
+            try:
+                registry.restore(desc, journal_frames=True)
+                return cand
+            except Exception as e:  # noqa: BLE001 — try the next survivor
+                cand.record_probe_failure(repr(e))
+        return None
 
     # --------------------------------------------------------- autoscaling
 
@@ -434,6 +469,73 @@ class FleetService:
                 **opt_overrides):
         """Synchronous submit: blocks until served (or raises)."""
         return self.submit(payload, **opt_overrides).result(timeout=timeout)
+
+    # ----------------------------------------------------------- streaming
+
+    def locate_session(self, sid: str) -> Replica:
+        """The replica holding `sid`'s lease, walking rendezvous rank
+        order (affinity means the walk almost always ends at the first
+        hop; a full-roster sweep covers membership churn). KeyError —
+        the 404 verdict — when no replica holds it, e.g. mid-respawn
+        before journal replay lands."""
+        from kindel_tpu.sessions import session_key
+
+        seen = []
+        for cand in self.router.rank(session_key(sid)):
+            seen.append(cand.replica_id)
+            registry = getattr(cand.service, "sessions", None)
+            if registry is not None and registry.has(sid):
+                return cand
+        for cand in self.roster():
+            if cand.replica_id in seen:
+                continue
+            registry = getattr(cand.service, "sessions", None)
+            if registry is not None and registry.has(sid):
+                return cand
+        raise KeyError(f"unknown session {sid}")
+
+    def open_stream(self, payload=None, **opt_overrides) -> str:
+        """Open one streaming session on the fleet: placement is the
+        rendezvous rank of the session's key, so every later append,
+        locate, drain re-home, and respawn replay agrees on the same
+        home without a session table at the front."""
+        import uuid
+
+        from kindel_tpu.serve.queue import (
+            AdmissionError,
+            ServiceDegraded,
+            jittered_retry_after,
+        )
+        from kindel_tpu.sessions import session_key
+
+        sid = uuid.uuid4().hex[:16]
+        last_shed = None
+        for cand in self.router.rank(session_key(sid)):
+            registry = getattr(cand.service, "sessions", None)
+            if registry is None:
+                continue
+            try:
+                return registry.open(payload, sid=sid, **opt_overrides)
+            except (ServiceDegraded, AdmissionError) as e:
+                last_shed = e
+        if last_shed is not None:
+            raise last_shed
+        raise ServiceDegraded(
+            "fleet degraded: no session-capable replica admits",
+            jittered_retry_after(1.0),
+        )
+
+    def append_stream(self, sid: str, payload):
+        """Append one read batch to `sid` wherever it lives; returns
+        the registry's ack Future."""
+        rep = self.locate_session(sid)
+        return rep.service.sessions.append(sid, payload)
+
+    def close_stream(self, sid: str):
+        """Close `sid` (forced final emit); returns the final-ack
+        Future carrying the session's last FASTA."""
+        rep = self.locate_session(sid)
+        return rep.service.sessions.close(sid)
 
     # -------------------------------------------------------------- health
 

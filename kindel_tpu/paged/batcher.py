@@ -69,7 +69,7 @@ class _PooledLane:
     """One (opts, page class) pool plus its admission bookkeeping."""
 
     __slots__ = ("opts", "pool", "fresh", "pending", "fresh_since",
-                 "fresh_segments")
+                 "fresh_segments", "stream_rows")
 
     def __init__(self, opts, pool: PagePool):
         self.opts = opts
@@ -78,6 +78,9 @@ class _PooledLane:
         self.fresh: list = []
         self.fresh_since: float | None = None
         self.fresh_segments = 0
+        #: cumulative rows admitted for /v1/stream session snapshots —
+        #: the streaming lane's share of this pool's traffic
+        self.stream_rows = 0
         #: requests waiting for pages: deque of (req, units, needs)
         self.pending: deque = deque()
 
@@ -210,6 +213,9 @@ class PagedBatcher(MicroBatcher):
         if lane.fresh_since is None:
             lane.fresh_since = now
         lane.fresh_segments += len(segs)
+        if getattr(req, "session", None) is not None:
+            lane.stream_rows += len(segs)
+            paged_metrics()["stream_rows"].inc(len(segs))
         return True
 
     def add(self, req, units) -> None:
@@ -464,8 +470,10 @@ class PagedBatcher(MicroBatcher):
                 doc = pools.setdefault(label, {
                     "pages": lane.pool.n_pages, "pages_in_use": 0,
                     "resident_segments": 0, "pending": 0,
+                    "stream_rows": 0,
                 })
                 doc["pages_in_use"] += lane.pool.pages_in_use
                 doc["resident_segments"] += lane.pool.n_resident
                 doc["pending"] += len(lane.pending)
+                doc["stream_rows"] += lane.stream_rows
             return pools

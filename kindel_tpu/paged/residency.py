@@ -339,6 +339,10 @@ class DeviceResidency:
         self._stale = False
         self._broken = False
         self._overflow: set[int] = set()
+        #: byte size of the most recent delta-admission patch — the
+        #: per-append h2d cost one streaming session pays, surfaced by
+        #: the bench stream report (benchmarks/stream_load.py)
+        self.last_patch_bytes = 0
 
     # ------------------------------------------------------------- mesh
 
@@ -624,6 +628,7 @@ class DeviceResidency:
             h2d, admit_h2d = self._counters()
             h2d.inc(int(patch.nbytes))
             admit_h2d.inc(int(patch.nbytes))
+            self.last_patch_bytes = int(patch.nbytes)
             if self.mesh_dp > 1:
                 self._state = self._run_kernel(
                     _patch_state_mesh, _patch_state_mesh_donated,

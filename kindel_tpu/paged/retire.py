@@ -27,18 +27,24 @@ def extract_flush(out, table, row_of, flush, opts) -> list:
     SampleResult), ...] in binding order. `out` is launch_ragged's
     result over the snapshot `table`; `row_of` maps seg_id → table row."""
     from kindel_tpu.batch import _fold_results
+    from kindel_tpu.paged.state import paged_metrics
     from kindel_tpu.ragged.unpack import unpack_rows
     from kindel_tpu.serve.worker import _payload_label
 
     row_units = []
     units_flat = []
     paths = []
+    stream_rows = 0
     for idx, (req, segs) in enumerate(flush.bindings):
         paths.append(_payload_label(req.payload))
+        if getattr(req, "session", None) is not None:
+            stream_rows += len(segs)
         for seg, unit in segs:
             unit.sample_idx = idx
             row_units.append((row_of[seg.seg_id], unit))
             units_flat.append(unit)
+    if stream_rows:
+        paged_metrics()["stream_extract_rows"].inc(stream_rows)
     if hasattr(table, "shard_tables"):
         # mesh-resident launch (DESIGN.md §23): rows are (shard, row)
         # pairs against per-shard local tables

@@ -107,6 +107,18 @@ def _paged_metrics():
             "(the pre-delta path; ~0 while device residency serves the "
             "pool)",
         ),
+        "stream_rows": reg.counter(
+            "kindel_paged_stream_rows_total",
+            "pool rows admitted on behalf of /v1/stream session "
+            "snapshots (the streaming lane's share of paged occupancy "
+            "— snapshots ride the same ticks as one-shot traffic)",
+        ),
+        "stream_extract_rows": reg.counter(
+            "kindel_paged_stream_extract_rows_total",
+            "rows read back by launch-tick extraction for /v1/stream "
+            "session snapshots (the streaming lane's share of paged "
+            "d2h reads)",
+        ),
     }
 
 

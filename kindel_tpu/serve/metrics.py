@@ -48,7 +48,13 @@ class ServeHTTPServer:
     other route growing a parameter. `get_routes` maps extra GET paths
     to `fn() -> (status, content_type, body_bytes, extra_headers)` —
     the /readyz endpoint plugs in here (readiness must be able to
-    answer 503, which the always-200 health_fn cannot).
+    answer 503, which the always-200 health_fn cannot). `sse_routes`
+    maps a GET path to `fn(params: dict) -> iterator[str]` of
+    SSE-framed text: the reply streams `text/event-stream` with no
+    Content-Length (the connection closes when the iterator ends —
+    1.1 keep-alive cannot frame an unbounded body, so these
+    connections are never reused). A KeyError from the route fn maps
+    to 404 — the sessions lane's unknown-session verdict.
 
     `max_body_bytes` bounds what one POST may make the server read
     (default MAX_BODY_BYTES; `kindel serve --max-body-mb` resolves the
@@ -74,6 +80,7 @@ class ServeHTTPServer:
     def __init__(self, registry, host: str = "127.0.0.1",
                  port: int = 0, health_fn=None, post_routes: dict | None = None,
                  get_routes: dict | None = None,
+                 sse_routes: dict | None = None,
                  max_body_bytes: int | None = None):
         import inspect
 
@@ -89,6 +96,7 @@ class ServeHTTPServer:
                 wants_headers = False
             self._post_routes[path] = (fn, wants_headers)
         self._get_routes = dict(get_routes or {})
+        self._sse_routes = dict(sse_routes or {})
         self.max_body_bytes = (
             int(max_body_bytes) if max_body_bytes is not None
             else self.MAX_BODY_BYTES
@@ -133,8 +141,47 @@ class ServeHTTPServer:
                         path
                     ]()
                     self._reply(status, ctype, payload, headers)
+                elif path in outer._sse_routes:
+                    self._stream_sse(path)
                 else:
                     self._reply(404, "text/plain", b"not found\n")
+
+            def _stream_sse(self, path: str) -> None:
+                from urllib.parse import parse_qs
+
+                raw = (
+                    self.path.split("?", 1)[1]
+                    if "?" in self.path else ""
+                )
+                params = {
+                    k: v[0] for k, v in parse_qs(raw).items()
+                }
+                try:
+                    events = outer._sse_routes[path](params)
+                except KeyError as e:
+                    self._reply(404, "text/plain", f"{e}\n".encode())
+                    return
+                except ValueError as e:
+                    self._reply(400, "text/plain", f"{e}\n".encode())
+                    return
+                # unbounded body: no Content-Length, so this connection
+                # cannot be kept alive — close when the stream ends
+                self.close_connection = True
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Connection", "close")
+                self.end_headers()
+                try:
+                    for chunk in events:
+                        self.wfile.write(chunk.encode())
+                        self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    pass  # subscriber hung up; the finally unsubscribes
+                finally:
+                    close = getattr(events, "close", None)
+                    if close is not None:
+                        close()
 
             def do_POST(self):
                 path = self.path.split("?", 1)[0]

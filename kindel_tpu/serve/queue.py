@@ -63,6 +63,22 @@ class DeadlineExceeded(RuntimeError):
     """The request's deadline passed before it could be served."""
 
 
+@dataclass(frozen=True)
+class PreDecoded:
+    """An already-decoded payload: CallUnits ready for the batcher.
+
+    The sessions lane (kindel_tpu.sessions) merges appended batches
+    host-side and dispatches consensus SNAPSHOTS over the merged units
+    — re-running the wire decode per snapshot would be pure waste, so
+    the worker's decode stage passes these straight through. Never a
+    wire payload (snapshots bypass the journal's digest/admit path:
+    the session's APPEND frames are the durable record, queue.py keys
+    these requests with key=None)."""
+
+    units: tuple
+    label: str = "<predecoded>"
+
+
 @dataclass
 class ServeRequest:
     """One in-flight consensus request.
@@ -95,6 +111,12 @@ class ServeRequest:
     #: worker dispatches suspects ISOLATED — a flush of one — so a
     #: poison request cannot take co-batched survivors down again
     suspect: bool = False
+    #: owning streaming session id (kindel_tpu.sessions), or None for
+    #: one-shot traffic. A session snapshot must never leave its
+    #: replica through the fleet hand-back path — its PreDecoded
+    #: payload has no wire form and its session's lease settles it at
+    #: hand-off — so the drain path filters on this field
+    session: str | None = None
 
 
 class RequestQueue:

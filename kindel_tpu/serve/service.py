@@ -42,12 +42,14 @@ from kindel_tpu.resilience.breaker import CircuitBreaker
 from kindel_tpu.serve.queue import (
     AdmissionError,
     DeadlineExceeded,
+    PreDecoded,
     RequestQueue,
     ServeRequest,
     ServiceDegraded,
     jittered_retry_after,
 )
 from kindel_tpu.serve.worker import ServeWorker
+from kindel_tpu.sessions.lease import LeaseRetired, settle_future
 
 
 def consensus_post_response(request_fn, body: bytes):
@@ -89,6 +91,42 @@ def consensus_post_response(request_fn, body: bytes):
         200, "text/x-fasta",
         format_fasta(res.consensuses).encode(), {},
     )
+
+
+def stream_post_response(fn):
+    """Shared status mapping for the `/v1/stream` lane's POST handlers:
+    `fn()` returns the JSON-able ack document. The taxonomy is the
+    /v1/consensus one (503 degraded, 429 shed, 504 deadline, 400
+    undecodable) plus 404 for an unknown/retired session — a reaped or
+    re-homed lease is an address error, not a server fault."""
+    try:
+        doc = fn()
+    except ServiceDegraded as e:
+        body = {"error": str(e), "retry_after_s": e.retry_after_s}
+        return (
+            503, "application/json", json.dumps(body).encode(),
+            {"Retry-After": max(1, round(e.retry_after_s))},
+        )
+    except AdmissionError as e:
+        body = {"error": str(e), "retry_after_s": e.retry_after_s}
+        return (
+            429, "application/json", json.dumps(body).encode(),
+            {"Retry-After": max(1, round(e.retry_after_s))},
+        )
+    except DeadlineExceeded as e:
+        return 504, "text/plain", f"{e}\n".encode(), {}
+    except (LeaseRetired, KeyError) as e:
+        return 404, "text/plain", f"{e}\n".encode(), {}
+    except PoisonRequestError as e:
+        return 422, "text/plain", f"{e}\n".encode(), {}
+    except ValueError as e:  # decode rejection — the client's fault
+        return 400, "text/plain", f"{e}\n".encode(), {}
+    except Exception as e:  # noqa: BLE001 — server-side failure
+        from kindel_tpu.resilience.policy import record_degrade
+
+        record_degrade("serve.stream", f"post_500:{type(e).__name__}", 1)
+        return 500, "text/plain", f"{e}\n".encode(), {}
+    return 200, "application/json", json.dumps(doc).encode(), {}
 
 
 def readyz_response(readyz_fn):
@@ -147,6 +185,8 @@ class ConsensusService:
         max_body_mb: int | None = None,
         journal_dir: str | None = None,
         quarantine_after: int | None = None,
+        session_idle_s: float | None = None,
+        emit_delta: int | None = None,
         extra_post_routes: dict | None = None,
         metrics: MetricsRegistry | None = None,
         warmup: bool = False,
@@ -349,6 +389,26 @@ class ConsensusService:
             ingest_mode=self.ingest_mode, mesh_plan=self.mesh_plan,
             journal=self._journal,
         )
+        # streaming sessions lane (kindel_tpu.sessions, DESIGN.md §25):
+        # the session registry owns every PileupLease on this replica;
+        # its snapshots dispatch through the queue/batcher above, so
+        # streaming and one-shot traffic share ticks and executables
+        from kindel_tpu.sessions import SessionRegistry
+
+        idle_s, si_src = tune.resolve_session_idle_s(
+            session_idle_s if session_idle_s is not None
+            else getattr(tuning, "session_idle_s", None)
+        )
+        self._m_tune_source.set(knob="session_idle_s", source=si_src)
+        emit_delta_v, ed_src = tune.resolve_emit_delta(
+            emit_delta if emit_delta is not None
+            else getattr(tuning, "emit_delta", None)
+        )
+        self._m_tune_source.set(knob="emit_delta", source=ed_src)
+        self.sessions = SessionRegistry(
+            self, idle_s=idle_s, emit_delta=emit_delta_v,
+            journal=self._journal,
+        )
         self._http: ServeHTTPServer | None = None
         self._http_host = http_host
         self._http_port = http_port
@@ -369,6 +429,7 @@ class ConsensusService:
         # /metrics exposition attributes cold-start cost (best-effort)
         obs_runtime.install()
         self.worker.start()
+        self.sessions.start()
         if self._journal is not None and self._recovery_thread is None:
             # replay-on-respawn (DESIGN.md §24): live entries from the
             # previous process life re-enter through the normal
@@ -398,9 +459,13 @@ class ConsensusService:
                 health_fn=self.healthz,
                 post_routes={
                     "/v1/consensus": self._handle_consensus_post,
+                    "/v1/stream": self._handle_stream_open,
+                    "/v1/stream/append": self._handle_stream_append,
+                    "/v1/stream/close": self._handle_stream_close,
                     **self._extra_post_routes,
                 },
                 get_routes={"/readyz": self._handle_readyz},
+                sse_routes={"/v1/stream/events": self._handle_stream_events},
                 max_body_bytes=self.max_body_mb * (1 << 20),
             ).start()
         return self
@@ -409,6 +474,10 @@ class ConsensusService:
         if self._http is not None:
             self._http.stop()
             self._http = None
+        # leases end typed BEFORE the worker drains: every queued append
+        # future settles exactly once, and the journal keeps the open
+        # sessions' frames for the next life to replay
+        self.sessions.shutdown()
         self.worker.stop(drain=drain)
         if self._journal is not None:
             self._journal.gc()
@@ -426,6 +495,11 @@ class ConsensusService:
                 quarantine_after=self.quarantine_after,
                 claim_cache=self.recovery_claim,
             )
+            n_sessions = recovery.replay_sessions(
+                self.sessions, self._journal.scan
+            )
+            if n_sessions:
+                report = dict(report, sessions=n_sessions)
             if any(report.values()):
                 print(
                     f"kindel-serve journal recovery: {report}",
@@ -451,6 +525,20 @@ class ConsensusService:
         surviving replica while this one restarts."""
         self._draining = True
         handed = self.queue.handback() if handback else []
+        # session snapshots never leave the replica through hand-back:
+        # a PreDecoded payload has no wire form, and the session's
+        # lease already settled the futures that were waiting on the
+        # snapshot (hand-off/close); the inner future settles typed here
+        stream = [r for r in handed if r.session is not None]
+        handed = [r for r in handed if r.session is None]
+        for req in stream:
+            settle_future(
+                req.future,
+                exc=LeaseRetired(
+                    f"session {req.session} snapshot dropped: replica "
+                    "draining"
+                ),
+            )
         if not handback:
             self.queue.close_admission()
         jr = self._journal
@@ -614,6 +702,9 @@ class ConsensusService:
             # respawn would replay, quarantined = poison digests barred
             # from admission
             doc["journal"] = self._journal.snapshot()
+        # streaming lane posture (DESIGN.md §25): open sessions, idle
+        # horizon, emission gate, per-session epoch watermarks
+        doc["sessions"] = self.sessions.snapshot()
         if self._warm_error is not None:
             doc["warmup_error"] = self._warm_error
         return doc
@@ -749,11 +840,76 @@ class ConsensusService:
             payload, idempotency_key=idempotency_key, **opt_overrides
         ).result(timeout=timeout)
 
+    def submit_stream_snapshot(self, units, opts, session: str) -> Future:
+        """Session-snapshot admission (kindel_tpu.sessions): one
+        consensus dispatch over the session's merged, pre-decoded units
+        through the NORMAL queue — snapshots coalesce into the shared
+        paged/ragged ticks and reuse the warmed executables. Forced past
+        the watermark: backpressure was already applied at the append's
+        admission, and shedding an internal launch would strand the
+        triggering append's ack. key=None keeps the journal out — the
+        session's APPEND frames are the durable record, and a PreDecoded
+        payload has no digestable wire form."""
+        req = ServeRequest(
+            payload=PreDecoded(
+                tuple(units), label=f"stream:{session}"
+            ),
+            opts=opts, session=session,
+        )
+        self.queue.submit(req, force=True)
+        return req.future
+
     # ---------------------------------------------------------- HTTP ingest
 
     def _handle_consensus_post(self, body: bytes):
         """POST /v1/consensus (status mapping in consensus_post_response)."""
         return consensus_post_response(self.request, body)
+
+    def _handle_stream_open(self, body: bytes):
+        """POST /v1/stream: open a session (body = optional first read
+        batch) → {"session": id}. Status mapping in stream_post_response."""
+        return stream_post_response(
+            lambda: {
+                "session": self.sessions.open(
+                    bytes(body) if body else None
+                ),
+            }
+        )
+
+    @staticmethod
+    def _stream_sid(headers) -> str:
+        sid = (headers.get("X-Kindel-Session") or "").strip()
+        if not sid:
+            raise ValueError("missing X-Kindel-Session header")
+        return sid
+
+    def _handle_stream_append(self, body: bytes, headers):
+        """POST /v1/stream/append (X-Kindel-Session header): append one
+        read batch; blocks until the append's ack settles — immediately
+        for below-gate appends, at the emission decision for the
+        gate-crossing one."""
+        return stream_post_response(
+            lambda: self.sessions.append(
+                self._stream_sid(headers), bytes(body)
+            ).result()
+        )
+
+    def _handle_stream_close(self, body: bytes, headers):
+        """POST /v1/stream/close (X-Kindel-Session header): forced final
+        snapshot + emit, lease retired; the ack carries the final FASTA."""
+        return stream_post_response(
+            lambda: self.sessions.close(
+                self._stream_sid(headers)
+            ).result()
+        )
+
+    def _handle_stream_events(self, params: dict):
+        """GET /v1/stream/events?session=<id>: the SSE update stream
+        (serve/metrics.py streams the returned generator)."""
+        sid = (params.get("session") or "").strip()
+        if not sid:
+            raise ValueError("missing session query parameter")
+        return self.sessions.subscribe(sid)
 
     def _handle_readyz(self):
         return readyz_response(self.readyz)

@@ -54,7 +54,7 @@ from kindel_tpu.resilience.breaker import FlushTimeout
 from kindel_tpu.utils.profiling import maybe_phase
 
 from kindel_tpu.serve.batcher import Flush, MicroBatcher
-from kindel_tpu.serve.queue import RequestQueue, ServeRequest
+from kindel_tpu.serve.queue import PreDecoded, RequestQueue, ServeRequest
 
 
 _COALESCE_COUNTERS: tuple | None = None
@@ -142,6 +142,8 @@ def _coalesce_counters() -> tuple:
 
 
 def _payload_label(payload) -> str:
+    if isinstance(payload, PreDecoded):
+        return payload.label
     return "<bytes>" if isinstance(payload, (bytes, bytearray)) else str(
         payload
     )
@@ -163,26 +165,41 @@ def _shape_label(shapes: tuple) -> str:
     return "x".join(str(s) for s in shapes)
 
 
-def decode_request(req: ServeRequest, ingest_mode: str = "host") -> list:
-    """Host stage: payload → CallUnits (empty list = no aligned reads).
-    Under ingest_mode="device" the record scan + CIGAR expansion run as
+def decode_events(payload, ingest_mode: str = "host"):
+    """The decode stage's event half: payload (path or SAM/BAM bytes) →
+    EventSet. Split out of decode_request for the sessions lane
+    (kindel_tpu.sessions), whose appends merge at the EventSet level —
+    one decode path, whatever consumes the events. Under
+    ingest_mode="device" the record scan + CIGAR expansion run as
     kindel_tpu.devingest kernels on the accelerator (byte-identical;
     SAM-text payloads and any anomaly fall back to the host oracle)."""
-    from kindel_tpu.call_jax import CallUnit
     from kindel_tpu.events import extract_events
     from kindel_tpu.io import load_alignment, load_alignment_bytes
 
+    ev = None
+    if ingest_mode == "device":
+        ev = _decode_device(payload)
+    if ev is None:
+        if isinstance(payload, (bytes, bytearray)):
+            batch = load_alignment_bytes(bytes(payload))
+        else:
+            batch = load_alignment(str(payload))
+        ev = extract_events(batch)
+    return ev
+
+
+def decode_request(req: ServeRequest, ingest_mode: str = "host") -> list:
+    """Host stage: payload → CallUnits (empty list = no aligned reads).
+    PreDecoded payloads (session snapshots — the registry already
+    merged and unit-built them) pass straight through; everything else
+    decodes via decode_events."""
+    from kindel_tpu.call_jax import CallUnit
+
     payload = req.payload
+    if isinstance(payload, PreDecoded):
+        return list(payload.units)
     with maybe_phase("serve decode"):
-        ev = None
-        if ingest_mode == "device":
-            ev = _decode_device(payload)
-        if ev is None:
-            if isinstance(payload, (bytes, bytearray)):
-                batch = load_alignment_bytes(bytes(payload))
-            else:
-                batch = load_alignment(str(payload))
-            ev = extract_events(batch)
+        ev = decode_events(payload, ingest_mode)
     units = []
     for rid in ev.present_ref_ids:
         u = CallUnit(ev, rid, with_ins_table=True, realign=req.opts.realign)

@@ -1,0 +1,32 @@
+"""kindel_tpu.sessions — streaming consensus: the live `/v1/stream`
+lane where the answer updates as reads arrive (DESIGN.md §25).
+
+One session is one incrementally-growing pileup: a client opens a
+session, appends read batches as they come off the sequencer, and
+receives incremental consensus updates over SSE whenever the resident
+pileup changes materially. The subsystem splits "pileup state
+lifecycle" from "request lifecycle": a `PileupLease` (admit →
+patch-append → snapshot-emit → retire) owns the accumulated event
+state and ages independently of any request future, while every
+consensus snapshot still rides the NORMAL serve path — queue
+admission, shared paged ticks, the device emit path — so streaming
+traffic and one-shot traffic batch together and nothing recompiles.
+
+Consensus is an additive, order-independent reduction over event
+counts, so a session's merged event set is byte-identical input to the
+one-shot decode of its concatenated batches — the convergence
+guarantee every replay/re-home path leans on.
+"""
+
+from kindel_tpu.sessions.lease import LeaseRetired, PileupLease
+from kindel_tpu.sessions.pileup import merge_event_sets, units_of
+from kindel_tpu.sessions.registry import SessionRegistry, session_key
+
+__all__ = [
+    "LeaseRetired",
+    "PileupLease",
+    "SessionRegistry",
+    "merge_event_sets",
+    "session_key",
+    "units_of",
+]

@@ -1,0 +1,201 @@
+"""PileupLease — the pileup-state half of the serve worker's old
+request lifecycle, extracted so device-visible pileup state and request
+futures age independently (DESIGN.md §25).
+
+One lease is one session's resident pileup: it accumulates decoded
+event state across appends (admit → patch-append), produces the
+CallUnits each consensus snapshot dispatches over (snapshot-emit), and
+settles every outstanding append future exactly once when it retires —
+whether that retirement is a client CLOSE, the idle reaper, or a fleet
+drain hand-off. Before this split `ServeWorker`/`PagedBatcher` owned
+both halves at once: a request's pileup lived exactly as long as its
+future, which is precisely what a streaming lane cannot have.
+
+Exactly-once settlement mirrors the worker/router convention: the
+loser of a retire-vs-settle race records nothing
+(`set_running_or_notify_cancel` + the InvalidStateError guard), so a
+reaped session can never leak a queued append future and a late
+snapshot can never double-settle one.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future, InvalidStateError
+
+
+class LeaseRetired(RuntimeError):
+    """The session's lease ended (reap, close, or hand-off) before the
+    operation could complete."""
+
+
+def settle_future(fut: Future, *, result=None, exc=None) -> bool:
+    """First-wins settle of one append/ack future; the loser records
+    nothing (the queue-handback convention, serve/queue.py)."""
+    if fut.done():
+        # the common loser path (a late snapshot callback after retire
+        # already settled): bail before set_running_or_notify_cancel,
+        # which logs CRITICAL on a finished future before raising
+        return False
+    try:
+        if not fut.set_running_or_notify_cancel():
+            return False
+    except (InvalidStateError, RuntimeError):
+        return False
+    if exc is not None:
+        fut.set_exception(exc)
+    else:
+        fut.set_result(result)
+    return True
+
+
+class PileupLease:
+    """One session's pileup-state lifecycle: admit → patch-append →
+    snapshot-emit → retire. Owned by the SessionRegistry; all mutation
+    under the lease's own lock (the registry map has its own)."""
+
+    __slots__ = (
+        "sid", "opts", "overrides", "state", "created_at", "last_active",
+        "epoch", "depth_since_emit", "events_total", "appends", "ev",
+        "last_digest", "pending", "subscribers", "lock", "snapshot_busy",
+        "replayed",
+    )
+
+    def __init__(self, sid: str, opts, clock=time.monotonic,
+                 overrides: dict | None = None):
+        self.sid = sid
+        self.opts = opts
+        #: the raw per-session opt overrides (JSON-able), carried in
+        #: descriptors so replay/re-home rebuilds the same BatchOptions
+        self.overrides = dict(overrides or {})
+        #: "open" → "closing" → "retired" (close settled / reaped /
+        #: handed off — a retired lease rejects everything, typed)
+        self.state = "open"
+        now = clock()
+        self.created_at = now
+        self.last_active = now
+        #: emitted-update counter, strictly monotone per session and
+        #: monotone ACROSS process lives (replay fast-forwards it)
+        self.epoch = 0
+        #: pileup events accumulated since the last emitted update —
+        #: the depth-delta gate's left-hand side
+        self.depth_since_emit = 0
+        self.events_total = 0
+        #: appended payloads (bytes), retained for journal replay and
+        #: fleet drain hand-off — the session's durable identity is its
+        #: batch sequence, not its device state
+        self.appends: list[bytes] = []
+        #: merged EventSet (sessions/pileup.py); None until first append
+        self.ev = None
+        #: digest of the last EMITTED consensus (gate: identical called
+        #: bases re-emit nothing)
+        self.last_digest: str | None = None
+        #: outstanding append/close futures, settled exactly once each
+        self.pending: set[Future] = set()
+        #: SSE subscriber queues (registry.subscribe)
+        self.subscribers: list = []
+        self.lock = threading.RLock()
+        #: a snapshot dispatch is in flight (one at a time per session:
+        #: snapshots over supersets are redundant, not wrong — this is
+        #: a wasted-launch guard, not a correctness lock)
+        self.snapshot_busy = False
+        #: restored from the journal / handed off from a drained peer
+        self.replayed = False
+
+    # ------------------------------------------------------------ appends
+
+    def admit_append(self, ev, payload: bytes, events: int,
+                     clock=time.monotonic) -> Future:
+        """Merge one decoded batch into the resident pileup and register
+        the append's ack future. Raises LeaseRetired once the lease
+        ended — the caller maps that to the admission taxonomy."""
+        from kindel_tpu.sessions.pileup import merge_event_sets
+
+        with self.lock:
+            if self.state != "open":
+                raise LeaseRetired(
+                    f"session {self.sid} is {self.state}"
+                )
+            self.ev = merge_event_sets(self.ev, ev)
+            self.appends.append(bytes(payload))
+            self.depth_since_emit += events
+            self.events_total += events
+            self.last_active = clock()
+            fut: Future = Future()
+            self.pending.add(fut)
+            return fut
+
+    def snapshot_units(self):
+        """CallUnits over the CURRENT merged pileup — what one consensus
+        snapshot dispatches. None when nothing has been appended."""
+        from kindel_tpu.sessions.pileup import units_of
+
+        with self.lock:
+            if self.ev is None:
+                return None
+            return units_of(self.ev, self.opts)
+
+    # ------------------------------------------------------------- settle
+
+    def settle(self, fut: Future, *, result=None, exc=None) -> bool:
+        """Settle one registered future exactly once and drop it from
+        the pending set (idempotent — the retire path and a late
+        snapshot callback may race here; first wins)."""
+        with self.lock:
+            self.pending.discard(fut)
+        return settle_future(fut, result=result, exc=exc)
+
+    def publish(self, event: dict | None) -> int:
+        """Fan one SSE event out to every subscriber (None = stream
+        end). Returns the number of subscribers reached."""
+        with self.lock:
+            subs = list(self.subscribers)
+        for q in subs:
+            q.put(event)
+        return len(subs)
+
+    # ------------------------------------------------------------- retire
+
+    def retire(self, exc: Exception | None = None) -> int:
+        """End the lease: settle every outstanding future exactly once
+        (with `exc`, or a benign hand-back ack when None), close every
+        subscriber stream, and refuse all further traffic. Idempotent.
+        Returns the number of futures this call settled — the
+        reap-vs-append race's observable (a leaked future would show up
+        as pending-but-never-settled; a double settle would raise in
+        settle_future's guard)."""
+        with self.lock:
+            if self.state == "retired":
+                return 0
+            self.state = "retired"
+            pending = list(self.pending)
+            self.pending.clear()
+        n = 0
+        for fut in pending:
+            if exc is not None:
+                ok = settle_future(fut, exc=exc)
+            else:
+                ok = settle_future(
+                    fut,
+                    result={"session": self.sid, "epoch": self.epoch,
+                            "emitted": False, "handback": True},
+                )
+            n += 1 if ok else 0
+        self.publish(None)
+        return n
+
+    # ------------------------------------------------------------ descriptor
+
+    def descriptor(self) -> dict:
+        """The session's durable identity for hand-off/replay: batch
+        sequence + epoch watermark (device state is recomputed on the
+        new home — consensus purity makes that byte-identical)."""
+        with self.lock:
+            return {
+                "sid": self.sid,
+                "appends": list(self.appends),
+                "epoch": self.epoch,
+                "events_total": self.events_total,
+                "opts": dict(self.overrides),
+            }

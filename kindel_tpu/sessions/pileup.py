@@ -1,0 +1,102 @@
+"""Session pileup state: order-independent EventSet accumulation.
+
+The consensus kernel is an additive reduction — per-position base /
+deletion / insertion COUNTS decide every call — so the union of two
+decoded batches' event streams produces bit-identical consensus to
+decoding the concatenation of the batches. That is the whole
+correctness story of the streaming lane: `merge_event_sets` is plain
+array concatenation (plus Counter addition for insertions), appends
+commute, and a session replayed or re-homed in any batch order
+converges to the same FASTA as the one-shot path.
+
+jax-free by construction (tier-1 AST guard): merging moves numpy
+arrays; the device only ever sees the merged result through the normal
+decode→admit path.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+
+import numpy as np
+
+from kindel_tpu.events import EventSet
+
+
+def _cat(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    if len(a) == 0:
+        return b
+    if len(b) == 0:
+        return a
+    return np.concatenate([a, b])
+
+
+#: the paired (rid, payload...) stream fields concatenated verbatim
+_STREAMS = (
+    "match_rid", "match_pos", "match_base",
+    "del_rid", "del_pos",
+    "cs_rid", "cs_pos", "ce_rid", "ce_pos",
+    "csw_rid", "csw_pos", "csw_base",
+    "cew_rid", "cew_pos", "cew_base",
+)
+
+
+def merge_event_sets(a: EventSet | None, b: EventSet) -> EventSet:
+    """The session append reduce: `a` (accumulated) ⊕ `b` (one decoded
+    batch) → merged EventSet. Requires an identical reference roster —
+    a batch aligned against different references is a DECODE rejection
+    (ValueError → HTTP 400), not a merge best-effort. present_ref_ids
+    keeps first-appearance order across appends, matching the output
+    ordering the one-shot decode of the concatenated batches would
+    produce."""
+    if a is None:
+        return b
+    if (
+        a.ref_names != b.ref_names
+        or len(a.ref_lens) != len(b.ref_lens)
+        or not np.array_equal(a.ref_lens, b.ref_lens)
+    ):
+        raise ValueError(
+            "appended batch was aligned against a different reference "
+            "roster than the session"
+        )
+    seen = set(a.present_ref_ids)
+    present = list(a.present_ref_ids) + [
+        rid for rid in b.present_ref_ids if rid not in seen
+    ]
+    ins: Counter = Counter()
+    ins.update(a.insertions)
+    ins.update(b.insertions)
+    fields = {
+        name: _cat(getattr(a, name), getattr(b, name))
+        for name in _STREAMS
+    }
+    return EventSet(
+        ref_names=a.ref_names,
+        ref_lens=a.ref_lens,
+        present_ref_ids=present,
+        insertions=ins,
+        **fields,
+    )
+
+
+def units_of(ev: EventSet, opts) -> list:
+    """CallUnits of the merged set — the same construction the one-shot
+    decode stage runs (serve/worker.decode_request), so a session
+    snapshot is indistinguishable from a one-shot request downstream of
+    the queue."""
+    from kindel_tpu.call_jax import CallUnit
+
+    return [
+        CallUnit(ev, rid, with_ins_table=True, realign=opts.realign)
+        for rid in ev.present_ref_ids
+    ]
+
+
+def event_count(ev: EventSet) -> int:
+    """Depth proxy of one decoded batch: total pileup-visible events.
+    Feeds the depth-delta emission gate — cheap (lengths only), and
+    monotone under merge."""
+    return (
+        len(ev.match_pos) + len(ev.del_pos) + sum(ev.insertions.values())
+    )

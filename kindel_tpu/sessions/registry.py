@@ -1,0 +1,593 @@
+"""SessionRegistry — the streaming lane's session table, emission gate,
+idle reaper, and SSE fan-out (DESIGN.md §25).
+
+The registry owns every `PileupLease` on one replica. An append
+decodes through the SAME ingest path as `/v1/consensus` (host numpy or
+devingest kernels), merges into the session's resident pileup, and —
+when the depth-delta gate crosses — submits one consensus SNAPSHOT
+through the service's normal request queue. Snapshots are ordinary
+ServeRequests downstream of admission: they coalesce into the shared
+paged/ragged ticks, dispatch the already-warmed geometry-keyed
+executables (zero new jit-cache entries on a warmed replica), and
+render through the configured emit path. The registry only decides
+WHEN a launch is worth its tick slot:
+
+  append    depth_since_emit += batch events; below --emit-delta the
+            append acks immediately (deferred — its events ride the
+            next crossing snapshot)
+  gate      at/over --emit-delta (and no snapshot already in flight)
+            one snapshot launches; an update is PUBLISHED only when the
+            called bases actually changed (digest gate), and the epoch
+            number advances exactly with published updates — strictly
+            monotone, across process lives too (replay fast-forwards)
+  CLOSE     always snapshots and always publishes a final update, even
+            below the delta threshold — the client's last answer must
+            reflect every appended read
+
+Admission sheds with the SAME taxonomy as `/v1/consensus`: breaker-open
+→ ServiceDegraded (503 + Retry-After), session-table-full →
+AdmissionError (429 + Retry-After), every hint through
+`jittered_retry_after` (never a raw constant — pinned by the
+substitution test, the PR 11 convention).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import queue as _queue
+import threading
+import time
+import uuid
+
+from kindel_tpu.durable.journal import JournalWriteError
+from kindel_tpu.serve.queue import (
+    AdmissionError,
+    ServiceDegraded,
+    jittered_retry_after,
+)
+from kindel_tpu.sessions.lease import LeaseRetired, PileupLease
+from kindel_tpu.sessions.pileup import event_count
+
+
+def session_key(sid: str) -> str:
+    """The session's fleet-affinity identity: rendezvous-hashed by the
+    router (fleet/router.rendezvous_score) so a session re-homes onto
+    the same survivor every placement decision — drain hand-off and a
+    client's re-locate probe agree without coordination."""
+    return f"stream|{sid}"
+
+
+def _fasta_digest(text: str) -> str:
+    return hashlib.sha256(text.encode()).hexdigest()
+
+
+#: how long one SSE subscriber poll blocks before a keep-alive comment
+SSE_HEARTBEAT_S = 15.0
+
+
+class SessionRegistry:
+    """Per-replica session table over one ConsensusService."""
+
+    def __init__(self, service, *, idle_s: float, emit_delta: int,
+                 max_sessions: int | None = None, journal=None,
+                 clock=time.monotonic):
+        self._service = service
+        self.idle_s = float(idle_s)
+        self.emit_delta = int(emit_delta)
+        #: session-table capacity (pool-full sheds 429): defaults to the
+        #: queue watermark — a replica that would shed one-shot traffic
+        #: at depth N has no business holding more resident pileups
+        self.max_sessions = (
+            int(max_sessions) if max_sessions is not None
+            else service.queue.high_watermark
+        )
+        self._journal = journal
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._leases: dict[str, PileupLease] = {}
+        self._admitting = True
+        self._reaper: threading.Thread | None = None
+        self._stop = threading.Event()
+        m = service.metrics
+        self._m_open = m.gauge(
+            "kindel_stream_sessions_open", "live streaming sessions"
+        )
+        self._m_opens = m.counter(
+            "kindel_stream_opens_total", "sessions opened"
+        )
+        self._m_appends = m.counter(
+            "kindel_stream_appends_total", "read batches appended"
+        )
+        self._m_emits = m.counter(
+            "kindel_stream_emits_total",
+            "consensus updates published (epoch advances)",
+        )
+        self._m_suppressed = m.counter(
+            "kindel_stream_suppressed_total",
+            "snapshots whose called bases were unchanged (no update "
+            "published, no epoch consumed)",
+        )
+        self._m_reaps = m.counter(
+            "kindel_stream_reaps_total", "sessions reaped idle"
+        )
+        self._m_replays = m.counter(
+            "kindel_stream_replays_total",
+            "sessions restored from the journal or a drain hand-off",
+        )
+        self._m_sheds = m.counter(
+            "kindel_stream_admission_rejects_total",
+            "stream opens/appends shed at admission",
+        )
+        self._m_sse = m.counter(
+            "kindel_stream_sse_events_total", "SSE events fanned out"
+        )
+        self._m_emit_bytes = m.counter(
+            "kindel_stream_emit_bytes_total",
+            "consensus bytes rendered across published updates (the "
+            "O(consensus length) d2h of the device emit path)",
+        )
+        self._m_update_s = m.histogram(
+            "kindel_stream_update_seconds",
+            "gate-crossing append to published update",
+        )
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self) -> "SessionRegistry":
+        if self._reaper is None:
+            self._reaper = threading.Thread(
+                target=self._reap_loop, name="kindel-stream-reaper",
+                daemon=True,
+            )
+            self._reaper.start()
+        return self
+
+    def shutdown(self) -> None:
+        """Service stop: end every lease typed (exactly-once settles),
+        stop the reaper. Journal frames are NOT closed — a stopped
+        replica's open sessions are exactly what the next life replays."""
+        self._stop.set()
+        with self._lock:
+            self._admitting = False
+            leases = list(self._leases.values())
+            self._leases.clear()
+        for lease in leases:
+            lease.retire(LeaseRetired(
+                f"session {lease.sid} interrupted: service stopping"
+            ))
+        self._m_open.set(0)
+        if self._reaper is not None:
+            self._reaper.join(timeout=2.0)
+            self._reaper = None
+
+    # ----------------------------------------------------------- admission
+
+    def _check_admission(self) -> None:
+        svc = self._service
+        if not svc.breaker.allow_admission():
+            self._m_sheds.inc()
+            raise ServiceDegraded(
+                "service degraded: device circuit breaker is "
+                f"{svc.breaker.state}",
+                jittered_retry_after(svc.breaker.retry_after_s()),
+            )
+        with self._lock:
+            admitting = self._admitting
+            n_open = len(self._leases)
+        if not admitting:
+            self._m_sheds.inc()
+            raise AdmissionError(
+                "stream admission closed: replica draining",
+                jittered_retry_after(1.0),
+            )
+        if n_open >= self.max_sessions:
+            self._m_sheds.inc()
+            # retry-after scaled by the idle horizon: the table drains
+            # at reap speed when clients go quiet, and the jitter keeps
+            # a shed cohort from stampeding the next free slot
+            raise AdmissionError(
+                f"session table full ({n_open} at/over "
+                f"{self.max_sessions})",
+                jittered_retry_after(
+                    max(self._service.queue.estimated_wait_s(), 0.25)
+                ),
+            )
+
+    # -------------------------------------------------------------- open
+
+    def open(self, payload: bytes | None = None, sid: str | None = None,
+             **opt_overrides) -> str:
+        """Open one session; optionally admit a first batch. Returns the
+        session id (client-supplied `sid` = replay/re-home under the
+        original identity)."""
+        from dataclasses import replace
+
+        self._check_admission()
+        opts = (
+            replace(self._service.default_opts, **opt_overrides)
+            if opt_overrides else self._service.default_opts
+        )
+        sid = sid or uuid.uuid4().hex[:16]
+        with self._lock:
+            if sid in self._leases:
+                raise ValueError(f"session {sid} already open")
+        # WAL-then-accept, the admission-journal convention: the OPEN
+        # is durable before the registry holds the lease; a session the
+        # journal cannot protect is rejected typed and retryable
+        jr = self._journal
+        if jr is not None:
+            try:
+                jr.record_session_open(sid, opt_overrides)
+            except JournalWriteError as e:
+                self._m_sheds.inc()
+                raise AdmissionError(
+                    f"session journal unavailable: {e}",
+                    jittered_retry_after(0.5),
+                ) from e
+        lease = PileupLease(
+            sid, opts, clock=self._clock, overrides=opt_overrides
+        )
+        with self._lock:
+            if sid in self._leases:
+                raise ValueError(f"session {sid} already open")
+            self._leases[sid] = lease
+            self._m_open.set(len(self._leases))
+        self._m_opens.inc()
+        if payload:
+            self.append(sid, payload)
+        return sid
+
+    def _lease(self, sid: str) -> PileupLease:
+        with self._lock:
+            lease = self._leases.get(sid)
+        if lease is None:
+            raise KeyError(f"unknown session {sid}")
+        return lease
+
+    def has(self, sid: str) -> bool:
+        """Does this replica hold `sid`'s lease? The fleet's session
+        locator walks the rendezvous rank order asking this."""
+        with self._lock:
+            return sid in self._leases
+
+    # ------------------------------------------------------------- append
+
+    def append(self, sid: str, payload: bytes):
+        """Admit one read batch into `sid`. Returns a Future of the ack
+        dict ({session, epoch, emitted, ...}): deferred appends ack
+        immediately, the gate-crossing append acks when its snapshot's
+        emission decision lands. Decode errors raise ValueError (400)
+        synchronously — an undecodable batch is never half-merged."""
+        from kindel_tpu.serve.worker import decode_events
+
+        self._check_admission()
+        lease = self._lease(sid)
+        ev = decode_events(payload, self._service.ingest_mode)
+        events = event_count(ev)
+        # WAL BEFORE merge: a batch the journal cannot protect is
+        # rejected retryable while the pileup is still untouched — the
+        # client's retry cannot double-count what never merged
+        jr = self._journal
+        if jr is not None:
+            try:
+                jr.record_session_append(sid, payload)
+            except JournalWriteError as e:
+                self._m_sheds.inc()
+                raise AdmissionError(
+                    f"session journal unavailable: {e}",
+                    jittered_retry_after(0.5),
+                ) from e
+        fut = lease.admit_append(
+            ev, payload, events, clock=self._clock
+        )
+        self._m_appends.inc()
+        with lease.lock:
+            due = (
+                lease.depth_since_emit >= self.emit_delta
+                and not lease.snapshot_busy
+            )
+            if due:
+                lease.snapshot_busy = True
+        if due:
+            self._snapshot(lease, (fut,), closing=False)
+        else:
+            # below the gate (or a snapshot already covers it): the
+            # append is durably merged — ack now, emission rides later
+            lease.settle(fut, result={
+                "session": sid, "epoch": lease.epoch, "emitted": False,
+                "deferred": True,
+            })
+        return fut
+
+    # -------------------------------------------------------------- close
+
+    def close(self, sid: str):
+        """CLOSE: forced final snapshot + final update publication even
+        below the delta threshold, then retire the lease. Returns a
+        Future of the final ack (with the final FASTA text)."""
+        lease = self._lease(sid)
+        with lease.lock:
+            if lease.state != "open":
+                raise LeaseRetired(f"session {sid} is {lease.state}")
+            lease.state = "closing"
+            fut = self._new_pending(lease)
+            empty = lease.ev is None
+        if empty:
+            self._finish_close(lease, fut, fasta="", digest=None)
+            return fut
+        self._snapshot(lease, (fut,), closing=True)
+        return fut
+
+    def _new_pending(self, lease: PileupLease):
+        from concurrent.futures import Future
+
+        fut: Future = Future()
+        with lease.lock:
+            lease.pending.add(fut)
+        return fut
+
+    def _finish_close(self, lease: PileupLease, fut, *, fasta: str,
+                      digest: str | None) -> None:
+        if digest is not None:
+            with lease.lock:
+                lease.epoch += 1
+                lease.last_digest = digest
+                lease.depth_since_emit = 0
+                epoch = lease.epoch
+        else:
+            epoch = lease.epoch
+        jr = self._journal
+        if digest is not None:
+            self._m_emits.inc()
+            self._m_emit_bytes.inc(len(fasta))
+            if jr is not None:
+                jr.record_session_emit(lease.sid, epoch)
+            self._publish(lease, {
+                "type": "final", "session": lease.sid, "epoch": epoch,
+                "fasta": fasta,
+            })
+        if jr is not None:
+            jr.record_session_close(lease.sid)
+        with self._lock:
+            self._leases.pop(lease.sid, None)
+            self._m_open.set(len(self._leases))
+        lease.settle(fut, result={
+            "session": lease.sid, "epoch": epoch, "emitted":
+            digest is not None, "fasta": fasta, "closed": True,
+        })
+        lease.retire(LeaseRetired(f"session {lease.sid} closed"))
+
+    # ----------------------------------------------------------- snapshot
+
+    def _snapshot(self, lease: PileupLease, trigger_futs,
+                  closing: bool) -> None:
+        """Dispatch one consensus snapshot through the service queue;
+        the emission decision runs in the settle callback."""
+        units = lease.snapshot_units()
+        t0 = self._clock()
+        try:
+            inner = self._service.submit_stream_snapshot(
+                units, lease.opts, lease.sid
+            )
+        except Exception as e:  # noqa: BLE001 — admission shed or queue close:
+            # the snapshot never launched; the triggering futures get
+            # the typed error and the gate re-arms for the next append
+            with lease.lock:
+                lease.snapshot_busy = False
+            for fut in trigger_futs:
+                lease.settle(fut, exc=e)
+            return
+        inner.add_done_callback(
+            lambda f, lz=lease, tf=trigger_futs, cl=closing, t=t0:
+            self._on_snapshot(lz, tf, cl, t, f)
+        )
+
+    def _on_snapshot(self, lease: PileupLease, trigger_futs,
+                     closing: bool, t0: float, inner) -> None:
+        from kindel_tpu.io.fasta import format_fasta
+
+        with lease.lock:
+            lease.snapshot_busy = False
+        try:
+            res = inner.result()
+        except Exception as e:  # noqa: BLE001 — typed dispatch/deadline failure:
+            # surfaced to the waiting append/close futures exactly once
+            for fut in trigger_futs:
+                lease.settle(fut, exc=e)
+            return
+        fasta = format_fasta(res.consensuses)
+        digest = _fasta_digest(fasta)
+        if closing:
+            self._finish_close(
+                lease, trigger_futs[0], fasta=fasta, digest=digest
+            )
+            return
+        with lease.lock:
+            changed = digest != lease.last_digest
+            if changed:
+                lease.epoch += 1
+                lease.last_digest = digest
+                lease.depth_since_emit = 0
+            epoch = lease.epoch
+        if changed:
+            self._m_emits.inc()
+            self._m_emit_bytes.inc(len(fasta))
+            self._m_update_s.observe(self._clock() - t0)
+            jr = self._journal
+            if jr is not None:
+                jr.record_session_emit(lease.sid, epoch)
+            self._publish(lease, {
+                "type": "update", "session": lease.sid, "epoch": epoch,
+                "fasta": fasta,
+            })
+        else:
+            self._m_suppressed.inc()
+        for fut in trigger_futs:
+            lease.settle(fut, result={
+                "session": lease.sid, "epoch": epoch, "emitted": changed,
+            })
+
+    def _publish(self, lease: PileupLease, event: dict) -> None:
+        self._m_sse.inc(lease.publish(event))
+
+    # ---------------------------------------------------------------- SSE
+
+    def subscribe(self, sid: str):
+        """Generator of SSE-framed strings for one session's update
+        stream (the /v1/stream/events transport). Ends after the final
+        event (close/reap/hand-off); idle gaps carry keep-alive
+        comments so proxies hold the connection."""
+        lease = self._lease(sid)
+        q: _queue.Queue = _queue.Queue()
+        with lease.lock:
+            if lease.state == "retired":
+                raise KeyError(f"unknown session {sid}")
+            lease.subscribers.append(q)
+
+        def _events():
+            try:
+                while True:
+                    try:
+                        ev = q.get(timeout=SSE_HEARTBEAT_S)
+                    except _queue.Empty:
+                        yield ": keep-alive\n\n"
+                        continue
+                    if ev is None:
+                        yield "event: close\ndata: {}\n\n"
+                        return
+                    yield (
+                        f"event: {ev.get('type', 'update')}\n"
+                        f"data: {json.dumps(ev)}\n\n"
+                    )
+            finally:
+                with lease.lock:
+                    if q in lease.subscribers:
+                        lease.subscribers.remove(q)
+
+        return _events()
+
+    # ------------------------------------------------------------- reaper
+
+    def _reap_loop(self) -> None:
+        tick = max(min(self.idle_s / 4.0, 1.0), 0.02)
+        while not self._stop.wait(tick):
+            self.reap_idle()
+
+    def reap_idle(self) -> int:
+        """Retire sessions idle past --session-idle-s. Every queued
+        append future settles typed (LeaseRetired) exactly once — the
+        reap-vs-append race's contract: an append that admitted before
+        the reap either rides a snapshot that settles it, or is settled
+        here; it is never left pending."""
+        now = self._clock()
+        with self._lock:
+            stale = [
+                lz for lz in self._leases.values()
+                if lz.state == "open"
+                and now - lz.last_active >= self.idle_s
+            ]
+        n = 0
+        for lease in stale:
+            with lease.lock:
+                # re-check under the lease lock: an append may have
+                # landed between the scan and now (the race the
+                # exactly-once test drives)
+                if (
+                    lease.state != "open"
+                    or now - lease.last_active < self.idle_s
+                ):
+                    continue
+                lease.state = "closing"
+            jr = self._journal
+            if jr is not None:
+                jr.record_session_close(lease.sid)
+            with self._lock:
+                self._leases.pop(lease.sid, None)
+                self._m_open.set(len(self._leases))
+            lease.retire(LeaseRetired(
+                f"session {lease.sid} reaped after "
+                f"{self.idle_s:.1f}s idle"
+            ))
+            self._m_reaps.inc()
+            n += 1
+        return n
+
+    # ------------------------------------------------- replay / hand-off
+
+    def restore(self, descriptor: dict, *, journal_frames: bool) -> str:
+        """Re-home/replay one session under its ORIGINAL id: re-decode
+        and merge every retained batch, fast-forward the epoch to the
+        last settled watermark (published epochs stay monotone across
+        lives — the next update is epoch+1, never a repeat). With
+        `journal_frames` the new home journals OPEN+APPEND frames so IT
+        can replay; journal replay passes False (the frames already
+        exist)."""
+        from dataclasses import replace
+
+        from kindel_tpu.serve.worker import decode_events
+
+        sid = descriptor["sid"]
+        overrides = descriptor.get("opts") or {}
+        opts = (
+            replace(self._service.default_opts, **overrides)
+            if overrides else self._service.default_opts
+        )
+        lease = PileupLease(
+            sid, opts, clock=self._clock, overrides=overrides
+        )
+        lease.replayed = True
+        lease.epoch = int(descriptor.get("epoch", 0))
+        with self._lock:
+            if sid in self._leases:
+                raise ValueError(f"session {sid} already open")
+            self._leases[sid] = lease
+            self._m_open.set(len(self._leases))
+        jr = self._journal if journal_frames else None
+        if jr is not None:
+            jr.record_session_open(sid, overrides)
+        for payload in descriptor.get("appends", ()):
+            ev = decode_events(payload, self._service.ingest_mode)
+            fut = lease.admit_append(
+                ev, payload, event_count(ev), clock=self._clock
+            )
+            lease.settle(fut, result={"session": sid, "replayed": True})
+            if jr is not None:
+                jr.record_session_append(sid, payload)
+        self._m_replays.inc()
+        self._m_opens.inc()
+        return sid
+
+    def handoff(self) -> list[dict]:
+        """Drain hand-back, session edition: close stream admission,
+        retire every open lease with a BENIGN hand-back ack (the append
+        payloads are durably in the descriptors — nothing needs a
+        client retry), journal the local CLOSE (this replica's journal
+        must not replay a session that now lives elsewhere), and return
+        the descriptors for the fleet to re-home via the rendezvous
+        key."""
+        with self._lock:
+            self._admitting = False
+            leases = list(self._leases.values())
+            self._leases.clear()
+            self._m_open.set(0)
+        out = []
+        jr = self._journal
+        for lease in leases:
+            out.append(lease.descriptor())
+            if jr is not None:
+                jr.record_session_close(lease.sid)
+            lease.retire(None)
+        return out
+
+    # ------------------------------------------------------------ healthz
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            leases = list(self._leases.values())
+        return {
+            "open": len(leases),
+            "idle_s": self.idle_s,
+            "emit_delta": self.emit_delta,
+            "epochs": {lz.sid: lz.epoch for lz in leases},
+        }

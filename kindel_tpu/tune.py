@@ -131,6 +131,16 @@ MAX_BODY_MB_DEFAULT = 1024
 #: measured.
 QUARANTINE_AFTER_DEFAULT = 3
 
+#: idle seconds before the sessions lane reaps a streaming session
+#: (kindel_tpu.sessions, DESIGN.md §25); the env pin is
+#: KINDEL_TPU_SESSION_IDLE_S. A capacity policy, not measured.
+SESSION_IDLE_S_DEFAULT = 300.0
+
+#: pileup events accumulated since the last emitted update before the
+#: sessions lane launches a consensus snapshot (the depth-delta
+#: emission gate, DESIGN.md §25); the env pin is KINDEL_TPU_EMIT_DELTA
+EMIT_DELTA_DEFAULT = 64
+
 #: default page-class geometry spec (name:ROWSxLENGTH, ascending —
 #: kindel_tpu.ragged.pack.parse_classes is the grammar); the env pin is
 #: KINDEL_TPU_RAGGED_CLASSES, `kindel tune --ragged-budget-s` persists a
@@ -856,6 +866,41 @@ def resolve_quarantine_after(explicit: int | None = None) -> tuple[int, str]:
     if pin is not None and pin > 0:
         return pin, "env"
     return QUARANTINE_AFTER_DEFAULT, "default"
+
+
+def resolve_session_idle_s(
+    explicit: float | None = None,
+) -> tuple[float, str]:
+    """The streaming-session idle-reap horizon (kindel_tpu.sessions,
+    DESIGN.md §25): explicit arg (`--session-idle-s`) >
+    KINDEL_TPU_SESSION_IDLE_S > default (300 s); malformed/non-positive
+    pins fall through — an unparseable knob must never take a replica
+    down at boot."""
+    if explicit is not None and float(explicit) > 0:
+        return float(explicit), "explicit"
+    raw = os.environ.get("KINDEL_TPU_SESSION_IDLE_S", "").strip()
+    if raw:
+        try:
+            pin = float(raw)
+        except ValueError:
+            pin = 0.0
+        if pin > 0:
+            return pin, "env"
+    return SESSION_IDLE_S_DEFAULT, "default"
+
+
+def resolve_emit_delta(explicit: int | None = None) -> tuple[int, str]:
+    """The sessions lane's depth-delta emission gate (kindel_tpu.sessions,
+    DESIGN.md §25): pileup events accumulated since the last emitted
+    update before a consensus snapshot launches. explicit arg
+    (`--emit-delta`) > KINDEL_TPU_EMIT_DELTA > default (64);
+    malformed/non-positive pins fall through."""
+    if explicit is not None and int(explicit) > 0:
+        return int(explicit), "explicit"
+    pin, _present = _env_int("KINDEL_TPU_EMIT_DELTA")
+    if pin is not None and pin > 0:
+        return pin, "env"
+    return EMIT_DELTA_DEFAULT, "default"
 
 
 def resolve_batch_mode(explicit: str | None = None) -> tuple[str, str]:

@@ -1,0 +1,30 @@
+"""Known-bad twice over (sessions scope, PR 16): an append ack future
+leaks on the snapshot failure path (future-settlement — the client
+blocks on the ack forever while the reaper sees the session as live),
+and the lease's guarded depth counter is read outside its lock
+(lock-guarded-by — the emission-gate decision races the merge)."""
+
+import threading
+from concurrent.futures import Future
+
+
+def append_leaky(merge, payload):
+    ack = Future()
+    try:
+        ack.set_result(merge(payload))
+    except Exception:
+        pass  # merged nothing, told nobody — ack stranded forever
+    return None
+
+
+class RacyLease:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._depth = 0
+
+    def admit(self, events):
+        with self._lock:
+            self._depth += events
+
+    def gate_due(self, emit_delta):
+        return self._depth >= emit_delta

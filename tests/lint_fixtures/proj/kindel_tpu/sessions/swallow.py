@@ -1,0 +1,11 @@
+"""Known-bad: silent swallow in sessions/ — a streaming lease holds
+append acks and SSE subscribers across minutes, so a swallowed snapshot
+failure strands a client mid-stream with no typed error and no final
+emit (the ack future must be settled or the failure recorded)."""
+
+
+def snapshot_or_shrug(lease, dispatch):
+    try:
+        return dispatch(lease.snapshot_units())
+    except Exception:
+        return None

@@ -340,6 +340,28 @@ def test_fixture_scope_extension_hits_durable(fixture_results):
     )
 
 
+def test_fixture_scope_extension_hits_sessions(fixture_results):
+    """The sessions scope extension (PR 16 satellite): the streaming
+    lane is covered by the silent-swallow lint (a swallowed snapshot
+    failure strands a client mid-stream), the future-settlement
+    exactly-once contract (a leaked append ack blocks the client
+    forever), and the lock-discipline rule (the emission-gate decision
+    must not race the merge) — known-bad fixtures for all three."""
+    by_id = {r.spec.id: r for r in fixture_results}
+    assert any(
+        "sessions/swallow" in f.path
+        for f in by_id["silent-swallow"].findings
+    )
+    assert any(
+        "sessions/leaky_lease" in f.path
+        for f in by_id["future-settlement"].findings
+    )
+    assert any(
+        "sessions/leaky_lease" in f.path
+        for f in by_id["lock-guarded-by"].findings
+    )
+
+
 def test_purity_fixture_needs_the_closure(fixture_results):
     """The chained fixture's jit body is clean — only the call-graph
     walk sees the env read two calls deep, which is exactly what the

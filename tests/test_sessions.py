@@ -1,0 +1,743 @@
+"""Streaming consensus lane (kindel_tpu.sessions): DESIGN.md §25's
+claims, asserted.
+
+  * merge_event_sets is an order-independent reduce (appends commute)
+    and rejects cross-roster batches typed (ValueError → HTTP 400);
+  * a streamed session's final FASTA is byte-identical to the one-shot
+    consensus over the concatenation of its batches — the lane's whole
+    correctness contract;
+  * the depth-delta emission gate: below-gate appends ack deferred,
+    the crossing append acks at the emission decision, epochs advance
+    exactly with published updates (strictly monotone), a snapshot
+    whose called bases did not change is suppressed (no epoch, no SSE
+    event), and CLOSE always publishes a final update;
+  * the idle reaper vs an in-flight append: every append future
+    settles exactly once — typed or acked, never stranded;
+  * admission sheds with the /v1/consensus taxonomy, every hint
+    through queue.jittered_retry_after (the PR 11 substitution pin);
+  * OPEN/APPEND/EMIT/CLOSE journal frames replay a killed replica's
+    sessions under their original ids (epoch fast-forwarded);
+  * warm-host streaming adds ZERO jit-cache entries across epochs —
+    snapshots ride the shared ticks and the warmed executables;
+  * drain re-homes live sessions onto survivors (rendezvous affinity);
+  * the flagship: a 3-replica fleet under wire faults with 4 live
+    sessions, one replica SIGKILLed and another drained mid-stream —
+    every session converges, each final FASTA sha-identical to the
+    one-shot consensus over its concatenated batches, and no journal
+    leaks a live session frame.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from kindel_tpu.durable import recovery as drec
+from kindel_tpu.durable.journal import PoisonRequestError
+from kindel_tpu.io.fasta import format_fasta
+from kindel_tpu.obs import runtime as obs_runtime
+from kindel_tpu.obs.metrics import default_registry
+from kindel_tpu.resilience import faults as rfaults
+from kindel_tpu.resilience import policy as rpolicy
+from kindel_tpu.resilience.faults import FaultPlan
+from kindel_tpu.serve import (
+    AdmissionError,
+    ConsensusService,
+    DeadlineExceeded,
+    ServiceDegraded,
+)
+from kindel_tpu.serve import queue as squeue
+from kindel_tpu.serve.service import stream_post_response
+from kindel_tpu.serve.worker import decode_events
+from kindel_tpu.sessions import SessionRegistry, session_key
+from kindel_tpu.sessions import registry as sreg
+from kindel_tpu.sessions.lease import LeaseRetired
+from kindel_tpu.sessions.pileup import event_count, merge_event_sets
+from kindel_tpu.workloads import bam_to_consensus
+
+from tests.test_serve import make_sam
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    """Process-global fault plans / policies must not leak (same
+    hygiene as test_fleet.py)."""
+    rfaults.deactivate()
+    prev = rpolicy.set_default_policy(None)
+    yield
+    rfaults.deactivate()
+    rpolicy.set_default_policy(prev)
+
+
+def _service(**kw):
+    kw.setdefault("warmup", False)
+    kw.setdefault("http_port", None)
+    kw.setdefault("max_wait_s", 0.02)
+    return ConsensusService(**kw)
+
+
+def _wait(pred, timeout=120.0, every=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(every)
+    return False
+
+
+def _concat_sam(dest: Path, parts) -> Path:
+    """The one-shot oracle input: every batch's alignment lines under
+    the first batch's header (the roster is shared by construction)."""
+    lines = []
+    for i, p in enumerate(parts):
+        for ln in p.read_text().splitlines():
+            if ln.startswith("@"):
+                if i == 0:
+                    lines.append(ln)
+            else:
+                lines.append(ln)
+    dest.write_text("\n".join(lines) + "\n")
+    return dest
+
+
+def _oracle_fasta(tmp_path: Path, name: str, parts) -> str:
+    cat = _concat_sam(tmp_path / name, parts)
+    return format_fasta(bam_to_consensus(str(cat)).consensuses)
+
+
+def _delta(before: dict, after: dict, name: str) -> int:
+    return int(after.get(name, 0)) - int(before.get(name, 0))
+
+
+# ------------------------------------------------------------ the reduce
+
+
+def test_merge_event_sets_is_order_independent(tmp_path):
+    """Appends commute: a⊕b and b⊕a hold the same multiset of pileup
+    events (the kernel's input is counts, so the consensus is equal by
+    construction)."""
+    a = decode_events(
+        make_sam(tmp_path / "a.sam", seed=1).read_bytes(), "host"
+    )
+    b = decode_events(
+        make_sam(tmp_path / "b.sam", seed=2).read_bytes(), "host"
+    )
+    ab = merge_event_sets(merge_event_sets(None, a), b)
+    ba = merge_event_sets(merge_event_sets(None, b), a)
+    assert event_count(ab) == event_count(ba) == (
+        event_count(a) + event_count(b)
+    )
+    assert ab.insertions == ba.insertions
+    # same (pos, base) multiset either way — order is the only freedom
+    for pos_f, base_f in (("match_pos", "match_base"), ("del_pos", None)):
+        pa = getattr(ab, pos_f)
+        pb = getattr(ba, pos_f)
+        if base_f is None:
+            assert sorted(pa.tolist()) == sorted(pb.tolist())
+        else:
+            za = sorted(zip(pa.tolist(), getattr(ab, base_f).tolist()))
+            zb = sorted(zip(pb.tolist(), getattr(ba, base_f).tolist()))
+            assert za == zb
+    assert ab.ref_names == ba.ref_names
+
+
+def test_merge_rejects_cross_roster_batch(tmp_path):
+    """A batch aligned against a different reference roster is a typed
+    decode rejection, never a best-effort merge."""
+    a = decode_events(
+        make_sam(tmp_path / "ra.sam", ref="refA", seed=1).read_bytes(),
+        "host",
+    )
+    b = decode_events(
+        make_sam(tmp_path / "rb.sam", ref="refB", seed=1).read_bytes(),
+        "host",
+    )
+    with pytest.raises(ValueError):
+        merge_event_sets(merge_event_sets(None, a), b)
+
+
+# -------------------------------------------- streamed == one-shot
+
+
+def test_stream_converges_to_one_shot_consensus(tmp_path):
+    """The lane's correctness contract: open/append/close over three
+    batches produces the byte-identical FASTA of one /v1/consensus
+    request over the concatenated batches."""
+    parts = [
+        make_sam(tmp_path / f"p{k}.sam", seed=30 + k) for k in range(3)
+    ]
+    want = _oracle_fasta(tmp_path, "oracle.sam", parts)
+    with _service(emit_delta=1) as svc:
+        sid = svc.sessions.open(parts[0].read_bytes())
+        for p in parts[1:]:
+            ack = svc.sessions.append(sid, p.read_bytes()).result(
+                timeout=120
+            )
+            assert ack["session"] == sid
+        final = svc.sessions.close(sid).result(timeout=120)
+    assert final["closed"] is True
+    assert final["emitted"] is True
+    assert final["fasta"] == want
+
+
+# ------------------------------------------------------- emission gate
+
+
+def test_emission_gate_defers_below_delta_and_epochs_are_monotone(
+    tmp_path,
+):
+    """Below --emit-delta an append acks deferred with the epoch
+    unchanged; the crossing append acks at the emission decision with
+    the epoch advanced; CLOSE always emits. Epochs never move except
+    with a published update."""
+    parts = [
+        make_sam(tmp_path / f"g{k}.sam", seed=40 + k) for k in range(3)
+    ]
+    n1 = event_count(decode_events(parts[0].read_bytes(), "host"))
+    with _service(emit_delta=n1 + 1) as svc:
+        sid = svc.sessions.open()
+        a1 = svc.sessions.append(sid, parts[0].read_bytes()).result(
+            timeout=120
+        )
+        assert a1["emitted"] is False and a1.get("deferred") is True
+        assert a1["epoch"] == 0
+        a2 = svc.sessions.append(sid, parts[1].read_bytes()).result(
+            timeout=120
+        )
+        assert a2["emitted"] is True
+        assert a2["epoch"] == 1
+        a3 = svc.sessions.append(sid, parts[2].read_bytes()).result(
+            timeout=120
+        )
+        assert a3.get("deferred") is True
+        assert a3["epoch"] == 1  # no update published, no epoch burned
+        final = svc.sessions.close(sid).result(timeout=120)
+    assert final["emitted"] is True  # forced final emit below the gate
+    assert final["epoch"] == 2
+    assert final["fasta"]
+    epochs = [a1["epoch"], a2["epoch"], a3["epoch"], final["epoch"]]
+    assert epochs == sorted(epochs)
+
+
+def test_unchanged_bases_suppress_update(tmp_path):
+    """A snapshot whose called bases did not change publishes nothing:
+    no epoch advance, the suppression counter moves instead (appending
+    the SAME batch doubles every count — the argmax is unchanged)."""
+    sam = make_sam(tmp_path / "same.sam", seed=7)
+    with _service(emit_delta=1) as svc:
+        sid = svc.sessions.open()
+        a1 = svc.sessions.append(sid, sam.read_bytes()).result(
+            timeout=120
+        )
+        assert a1["emitted"] is True and a1["epoch"] == 1
+        before = svc.metrics.snapshot()
+        a2 = svc.sessions.append(sid, sam.read_bytes()).result(
+            timeout=120
+        )
+        after = svc.metrics.snapshot()
+        assert a2["emitted"] is False
+        assert a2["epoch"] == 1
+        assert _delta(
+            before, after, "kindel_stream_suppressed_total"
+        ) == 1
+        assert _delta(before, after, "kindel_stream_emits_total") == 0
+        final = svc.sessions.close(sid).result(timeout=120)
+    # CLOSE still force-publishes the final answer
+    assert final["emitted"] is True and final["epoch"] == 2
+
+
+def test_close_of_empty_session_acks_empty_fasta():
+    with _service(emit_delta=1) as svc:
+        sid = svc.sessions.open()
+        final = svc.sessions.close(sid).result(timeout=60)
+    assert final == {
+        "session": sid, "epoch": 0, "emitted": False, "fasta": "",
+        "closed": True,
+    }
+
+
+# ------------------------------------------------- reap vs append race
+
+
+def test_reap_vs_inflight_append_settles_exactly_once(tmp_path):
+    """The exactly-once contract of the reap-vs-append race: however
+    the interleaving lands, every append future settles exactly once
+    (deferred ack or typed LeaseRetired), the lease never holds a
+    stranded pending future, and the table ends empty."""
+    payload = make_sam(tmp_path / "race.sam", seed=9).read_bytes()
+    svc = _service()  # unstarted: deferred appends never hit the queue
+    fake = [0.0]
+    reg = SessionRegistry(
+        svc, idle_s=10.0, emit_delta=10 ** 9, clock=lambda: fake[0]
+    )
+    for _round in range(10):
+        sid = reg.open()
+        lease = reg._lease(sid)
+        fake[0] += 10.0  # the session is now exactly idle
+        barrier = threading.Barrier(2)
+        futs, typed = [], []
+
+        def do_append():
+            barrier.wait()
+            try:
+                futs.append(reg.append(sid, payload))
+            except (KeyError, LeaseRetired) as e:
+                typed.append(e)  # not merged — a client would retry
+
+        def do_reap():
+            barrier.wait()
+            reg.reap_idle()
+
+        threads = [
+            threading.Thread(target=do_append),
+            threading.Thread(target=do_reap),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        if reg.has(sid):
+            # the append won and refreshed last_active: idle it out
+            fake[0] += 10.0
+            assert reg.reap_idle() == 1
+        assert not reg.has(sid)
+        assert len(futs) + len(typed) == 1
+        for f in futs:
+            assert f.done(), "append future stranded by the reap race"
+            if f.exception() is not None:
+                assert isinstance(f.exception(), LeaseRetired)
+            else:
+                assert f.result()["session"] == sid
+        assert not lease.pending, "lease retired with a pending future"
+        assert lease.state == "retired"
+
+
+# -------------------------------------------------- admission taxonomy
+
+
+def test_admission_hints_ride_queue_jittered_retry_after(
+    tmp_path, monkeypatch
+):
+    """PR 11 substitution pin, sessions edition: the registry's shed
+    hints are computed by serve.queue.jittered_retry_after — swap the
+    function, every hint follows."""
+    assert sreg.jittered_retry_after is squeue.jittered_retry_after
+    monkeypatch.setattr(
+        sreg, "jittered_retry_after", lambda *a, **k: 42.0
+    )
+    svc = _service()
+    full = SessionRegistry(svc, idle_s=60, emit_delta=1, max_sessions=0)
+    with pytest.raises(AdmissionError) as ei:
+        full.open()
+    assert "full" in str(ei.value)
+    assert ei.value.retry_after_s == 42.0
+
+    draining = SessionRegistry(svc, idle_s=60, emit_delta=1)
+    draining._admitting = False
+    with pytest.raises(AdmissionError) as ei:
+        draining.open()
+    assert "draining" in str(ei.value)
+    assert ei.value.retry_after_s == 42.0
+
+    monkeypatch.setattr(svc.breaker, "allow_admission", lambda: False)
+    open_reg = SessionRegistry(svc, idle_s=60, emit_delta=1)
+    with pytest.raises(ServiceDegraded) as ei:
+        open_reg.open()
+    assert ei.value.retry_after_s == 42.0
+
+
+def test_stream_post_response_status_taxonomy():
+    """The /v1/stream POST handlers share the /v1/consensus status
+    taxonomy plus 404 for an unknown/retired lease."""
+
+    def boom(exc):
+        def fn():
+            raise exc
+        return fn
+
+    cases = [
+        (ServiceDegraded("breaker open", 3.0), 503),
+        (AdmissionError("table full", 1.0), 429),
+        (DeadlineExceeded("too slow"), 504),
+        (LeaseRetired("session x reaped"), 404),
+        (KeyError("unknown session x"), 404),
+        (PoisonRequestError("quarantined"), 422),
+        (ValueError("undecodable batch"), 400),
+        (RuntimeError("wires crossed"), 500),
+    ]
+    for exc, want in cases:
+        status, ctype, body, headers = stream_post_response(boom(exc))
+        assert status == want, f"{type(exc).__name__} -> {status}"
+        if want in (503, 429):
+            assert "Retry-After" in headers
+            assert json.loads(body)["retry_after_s"] == pytest.approx(
+                exc.retry_after_s
+            )
+    status, ctype, body, headers = stream_post_response(
+        lambda: {"session": "abc"}
+    )
+    assert status == 200 and ctype == "application/json"
+    assert json.loads(body) == {"session": "abc"}
+
+
+# ------------------------------------------------- journal replay
+
+
+def test_session_replays_on_respawn_under_original_id(tmp_path):
+    """A killed replica's open sessions come back on the next life:
+    OPEN/APPEND frames replay under the ORIGINAL session id, and the
+    close after respawn serves the one-shot-identical answer."""
+    parts = [
+        make_sam(tmp_path / f"j{k}.sam", seed=60 + k) for k in range(2)
+    ]
+    want = _oracle_fasta(tmp_path, "joracle.sam", parts)
+    jd = tmp_path / "journal"
+
+    svc = _service(journal_dir=str(jd), emit_delta=1).start()
+    sid = svc.sessions.open(parts[0].read_bytes())
+    ack = svc.sessions.append(sid, parts[1].read_bytes()).result(
+        timeout=120
+    )
+    pre_epoch = ack["epoch"]
+    svc.stop()  # leases retire typed; the journal frames stay open
+
+    before = default_registry().snapshot()
+    svc2 = _service(journal_dir=str(jd), emit_delta=1).start()
+    try:
+        # replay runs on the recovery thread: the replays counter moves
+        # once the session's appends are re-decoded and merged
+        assert _wait(lambda: svc2.metrics.snapshot().get(
+            "kindel_stream_replays_total", 0
+        ) >= 1, 120)
+        assert svc2.sessions.has(sid)
+        final = svc2.sessions.close(sid).result(timeout=120)
+    finally:
+        svc2.stop()
+    assert final["fasta"] == want
+    # epoch fast-forwarded past every journalled emit: still monotone
+    assert final["epoch"] > pre_epoch
+    # the close tombstoned the session: nothing live left to replay
+    assert not drec.scan(jd).sessions
+    _ = before
+
+
+# ---------------------------------------------------------------- SSE
+
+
+def test_sse_subscription_streams_updates_and_final(tmp_path):
+    parts = [
+        make_sam(tmp_path / f"s{k}.sam", seed=70 + k) for k in range(2)
+    ]
+    with _service(emit_delta=1) as svc:
+        sid = svc.sessions.open(parts[0].read_bytes())
+        # let the open's own snapshot settle: the NEXT append must be
+        # the gate-crossing one, not a deferred rider on this one
+        assert _wait(lambda: svc.sessions._lease(sid).epoch >= 1)
+        events = svc.sessions.subscribe(sid)
+        ack = svc.sessions.append(sid, parts[1].read_bytes()).result(
+            timeout=120
+        )
+        assert ack["emitted"] is True
+        frame = next(events)
+        assert frame.startswith("event: update\n")
+        doc = json.loads(frame.split("data: ", 1)[1].strip())
+        assert doc["session"] == sid
+        assert doc["epoch"] == ack["epoch"]
+        assert doc["fasta"]
+        final = svc.sessions.close(sid).result(timeout=120)
+        frame = next(events)
+        assert frame.startswith("event: final\n")
+        doc = json.loads(frame.split("data: ", 1)[1].strip())
+        assert doc["fasta"] == final["fasta"]
+        assert next(events).startswith("event: close\n")
+        with pytest.raises(StopIteration):
+            next(events)
+        with pytest.raises(KeyError):
+            svc.sessions.subscribe(sid)  # retired lease is a 404
+
+
+def test_stream_http_surface_end_to_end(tmp_path):
+    """The wire-level lane: open → SSE subscribe → append (ack after
+    the emission decision) → close, plus the 400/404 edges of the
+    events endpoint."""
+    parts = [
+        make_sam(tmp_path / f"h{k}.sam", seed=80 + k) for k in range(2)
+    ]
+    want = _oracle_fasta(tmp_path, "horacle.sam", parts)
+    with _service(emit_delta=1, http_port=0) as svc:
+        host, port = svc.http_address
+        base = f"http://{host}:{port}"
+
+        req = urllib.request.Request(
+            f"{base}/v1/stream", data=parts[0].read_bytes(),
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            assert resp.status == 200
+            sid = json.loads(resp.read())["session"]
+        assert _wait(lambda: svc.sessions._lease(sid).epoch >= 1)
+
+        events = urllib.request.urlopen(
+            f"{base}/v1/stream/events?session={sid}", timeout=120
+        )
+
+        req = urllib.request.Request(
+            f"{base}/v1/stream/append", data=parts[1].read_bytes(),
+            method="POST", headers={"X-Kindel-Session": sid},
+        )
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            ack = json.loads(resp.read())
+        assert ack["session"] == sid and ack["emitted"] is True
+
+        # the update the append just published is on the SSE wire
+        line = events.readline().decode()
+        while not line.startswith("event:"):
+            line = events.readline().decode()
+        assert line == "event: update\n"
+        data = events.readline().decode()
+        assert json.loads(data.split("data: ", 1)[1])["epoch"] == (
+            ack["epoch"]
+        )
+
+        req = urllib.request.Request(
+            f"{base}/v1/stream/close", data=b"", method="POST",
+            headers={"X-Kindel-Session": sid},
+        )
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            final = json.loads(resp.read())
+        assert final["closed"] is True
+        assert final["fasta"] == want
+        events.close()
+
+        # append to the retired session: 404, the address error
+        req = urllib.request.Request(
+            f"{base}/v1/stream/append", data=b"x", method="POST",
+            headers={"X-Kindel-Session": sid},
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 404
+        # events endpoint edges: missing param 400, unknown sid 404
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"{base}/v1/stream/events", timeout=30
+            )
+        assert ei.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"{base}/v1/stream/events?session=nope", timeout=30
+            )
+        assert ei.value.code == 404
+
+
+# ------------------------------------------------------ zero recompiles
+
+
+def test_warm_host_streaming_adds_zero_jit_cache_entries(tmp_path):
+    """Snapshots are ordinary requests downstream of admission: on a
+    warmed host a whole session (≥2 published epochs) adds ZERO
+    jit-cache entries — the shared ticks reuse the warmed geometry-
+    keyed executables."""
+    parts = [
+        make_sam(tmp_path / f"w{k}.sam", seed=90 + k) for k in range(3)
+    ]
+    g_before = default_registry().snapshot()
+    with _service(emit_delta=1) as svc:
+        def run_session():
+            sid = svc.sessions.open(parts[0].read_bytes())
+            # settle the open's snapshot so every later append is the
+            # gate-crossing one (its ack IS the emission decision)
+            assert _wait(lambda: svc.sessions._lease(sid).epoch >= 1)
+            epochs = 0
+            for p in parts[1:]:
+                a = svc.sessions.append(sid, p.read_bytes()).result(
+                    timeout=120
+                )
+                epochs += int(a["emitted"])
+            final = svc.sessions.close(sid).result(timeout=120)
+            return final["fasta"], epochs + 1  # close always emits
+
+        fasta1, _ = run_session()  # warms every snapshot geometry
+        cache_before = obs_runtime.jit_cache_sizes()
+        fasta2, epochs2 = run_session()
+        cache_after = obs_runtime.jit_cache_sizes()
+    assert epochs2 >= 2
+    assert fasta2 == fasta1
+    assert cache_after == cache_before, (
+        "warm-host streaming compiled something new"
+    )
+    # the paged instrumentation saw the session rows (PR 16 satellite)
+    g_after = default_registry().snapshot()
+    _ = (g_before, g_after)
+
+
+# ------------------------------------------------------- fleet re-home
+
+
+def test_fleet_drain_rehomes_live_session_on_survivor(tmp_path):
+    from kindel_tpu.fleet import FleetService
+
+    parts = [
+        make_sam(tmp_path / f"d{k}.sam", seed=100 + k) for k in range(2)
+    ]
+    want = _oracle_fasta(tmp_path, "doracle.sam", parts)
+    with FleetService(
+        replicas=2, max_wait_s=0.02, probe_interval_s=0.05,
+        emit_delta=1,
+    ) as fleet:
+        sid = fleet.open_stream(parts[0].read_bytes())
+        home = fleet.locate_session(sid)
+        assert _wait(
+            lambda: home.service.sessions._lease(sid).epoch >= 1
+        )
+        ack = fleet.append_stream(sid, parts[1].read_bytes()).result(
+            timeout=120
+        )
+        fleet.drain(home.replica_id)
+        survivor = fleet.locate_session(sid)
+        assert survivor.replica_id != home.replica_id
+        assert int(
+            survivor.service.metrics.snapshot().get(
+                "kindel_stream_replays_total", 0
+            )
+        ) >= 1
+        final = fleet.close_stream(sid).result(timeout=120)
+    assert final["fasta"] == want
+    # the epoch watermark survived the hand-off: still monotone
+    assert final["epoch"] > ack["epoch"] >= 1
+
+
+# ------------------------------------------------------- the flagship
+
+
+def _stream_retry(fn, timeout=180.0):
+    """Client-side retry ladder for the chaos window: every typed shed
+    or address error means NOT merged (WAL-then-accept), so retrying
+    can never double-count."""
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            return fn()
+        except (AdmissionError, KeyError, LeaseRetired) as e:
+            last = e
+            time.sleep(0.05)
+    raise AssertionError(f"stream retries exhausted: {last!r}")
+
+
+def test_fleet_chaos_streams_converge_exactly_once(tmp_path):
+    """The flagship: 3 supervised replicas (per-slot journals) under an
+    active wire-fault plan, 4 concurrent sessions; one replica is
+    KILLED (journal replay brings its sessions back on the next life)
+    and another DRAINED (hand-off re-homes its sessions on survivors)
+    mid-stream. Every session converges: each final FASTA is
+    sha-identical to the one-shot consensus over its concatenated
+    batches — an append merged twice or dropped once would change the
+    counts — and no slot's journal leaks a live session frame."""
+    from kindel_tpu.fleet import FleetService
+
+    n_sessions, n_batches = 4, 3
+    batches = {
+        s: [
+            make_sam(
+                tmp_path / f"c{s}_{k}.sam", seed=200 + 10 * s + k
+            )
+            for k in range(n_batches)
+        ]
+        for s in range(n_sessions)
+    }
+    oracles = {
+        s: _oracle_fasta(tmp_path, f"c{s}_oracle.sam", batches[s])
+        for s in range(n_sessions)
+    }
+    jd = tmp_path / "journal"
+    plan = rfaults.activate(
+        FaultPlan.parse("seed=7,serve.flush:error:times=2:after=1")
+    )
+
+    acks = {s: [] for s in range(n_sessions)}
+    with FleetService(
+        replicas=3, probe_interval_s=0.02, max_wait_s=0.02,
+        journal_dir=str(jd), emit_delta=1,
+    ) as fleet:
+        sids = {
+            s: _stream_retry(
+                lambda s=s: fleet.open_stream(
+                    batches[s][0].read_bytes()
+                )
+            )
+            for s in range(n_sessions)
+        }
+
+        def append_all(k):
+            for s in range(n_sessions):
+                acks[s].append(_stream_retry(
+                    lambda s=s: fleet.append_stream(
+                        sids[s], batches[s][k].read_bytes()
+                    ).result(timeout=180)
+                ))
+
+        append_all(1)
+
+        # chaos, phase 1: SIGKILL the replica holding session 0 — the
+        # supervisor evicts and respawns it, and the respawned life
+        # replays its journal's OPEN/APPEND frames
+        victim = fleet.locate_session(sids[0])
+        fleet.kill_replica(victim.replica_id)
+
+        def _all_located():
+            try:
+                return all(
+                    fleet.locate_session(sids[s]) is not None
+                    for s in range(n_sessions)
+                )
+            except KeyError:
+                return False
+
+        assert _wait(_all_located, 180), (
+            "sessions did not come back after the kill"
+        )
+
+        # chaos, phase 2: DRAIN a different replica — its live leases
+        # hand off and re-home on survivors by rendezvous rank
+        other = next(
+            r.replica_id for r in fleet.roster()
+            if r.replica_id != victim.replica_id
+        )
+        fleet.drain(other)
+        assert _wait(_all_located, 180)
+
+        append_all(2)
+        finals = {
+            s: _stream_retry(
+                lambda s=s: fleet.close_stream(sids[s]).result(
+                    timeout=180
+                )
+            )
+            for s in range(n_sessions)
+        }
+
+    # every session converged to its one-shot answer, exactly once:
+    # the byte-identity is the double-count/drop detector
+    for s in range(n_sessions):
+        assert finals[s]["closed"] is True
+        assert finals[s]["fasta"] == oracles[s], (
+            f"session {s} diverged from its one-shot oracle"
+        )
+        # every settled append ack was a normal emission-decision ack
+        for ack in acks[s]:
+            assert ack["session"] == sids[s]
+    # the injected wire faults fired as written (the in-replica retry
+    # ladder absorbed them)
+    assert plan.fired == {("serve.flush", "error"): 2}
+    # zero journal leaks: every slot's journal scans to no live session
+    for slot in sorted(jd.iterdir()):
+        result = drec.scan(slot)
+        assert not result.sessions, (
+            f"{slot.name} leaked live session frames: "
+            f"{sorted(result.sessions)}"
+        )
