@@ -492,6 +492,19 @@ def _run_benchmark() -> dict:
     except Exception as e:  # noqa: BLE001
         result["lint"] = {"error": repr(e)}
 
+    # Perf-regression posture (kindel_tpu.obs.perfgate): where does this
+    # round's headline number stand against the committed bench history
+    # for the same (backend, series)? The verdict rides along in the
+    # result line so a regressed round is self-describing — the gate
+    # itself (`kindel perf --gate`) stays a separate CI stage. Failure
+    # never voids the headline metric.
+    try:
+        from kindel_tpu.obs import perfgate
+
+        result["perfgate"] = perfgate.provenance(REPO, result)
+    except Exception as e:  # noqa: BLE001
+        result["perfgate"] = {"error": repr(e)}
+
     # Shape-diverse serve scenario (kindel_tpu.ragged): the ROADMAP's
     # multi-sample regime — mixed contig/read lengths, some multi-ref
     # payloads — run through BOTH batch modes; the `ragged` object

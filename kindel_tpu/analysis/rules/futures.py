@@ -36,9 +36,12 @@ from kindel_tpu.analysis.model import ProjectModel
 #: idempotency-cache futures — a leaked claim strands every wire
 #: resubmission of that key forever; sessions in PR 16: every append
 #: registers an ack future on the lease, and the reap-vs-append race
-#: must settle each exactly once)
+#: must settle each exactly once; obs in PR 18: the SLO engine's
+#: attach() registers done-callbacks on admitted futures — an obs-layer
+#: helper that creates a future of its own inherits the same contract)
 FUTURE_SCOPE = (
     "serve", "fleet", "paged", "emit", "parallel", "durable", "sessions",
+    "obs",
 )
 
 #: constructors whose result is (or owns) a fresh unsettled Future

@@ -486,6 +486,19 @@ SWALLOW_ALLOWLIST = {
     ("serve/service.py", "consensus_post_response"),
     ("serve/service.py", "_aot_provenance"),
     ("fleet/service.py", "_replica_healthz"),
+    # obs (PR 18): the runtime-introspection probes poll best-effort
+    # backend internals (jit cache sizes, device memory stats) whose
+    # APIs vary across jax versions — a probe failure must degrade to
+    # "no sample", never to a serving failure
+    ("obs/runtime.py", "install"),
+    ("obs/runtime.py", "jit_cache_sizes"),
+    ("obs/runtime.py", "device_memory_stats"),
+    ("obs/runtime.py", "update_device_gauges"),
+    ("obs/runtime.py", "runtime_snapshot"),
+    # obs/perfgate (PR 18): provenance() decorates a bench result line
+    # with the gate verdict — a history-read failure must surface as
+    # {"error": ...} in the provenance object, never void the headline
+    ("obs/perfgate.py", "provenance"),
 }
 
 #: packages whose broad except handlers must handle the failure —
@@ -504,9 +517,13 @@ SWALLOW_ALLOWLIST = {
 #: ... and sessions (PR 16): a streaming lease holds append acks AND
 #: SSE subscribers across minutes — a swallowed failure there strands
 #: a client mid-stream with no typed error and no final emit
+#: ... and obs (PR 18): the observability plane is how every other
+#: failure becomes visible — a swallowed error in trace collection or
+#: SLO accounting silently blinds the operator exactly when the data
+#: mattered, so its handlers must record_failure or stay typed
 SWALLOW_SCOPE = (
     "serve", "resilience", "fleet", "ragged", "parallel", "devingest",
-    "paged", "emit", "durable", "sessions",
+    "paged", "emit", "durable", "sessions", "obs",
 )
 
 

@@ -619,6 +619,26 @@ def _serve_parser(sub):
              "$KINDEL_TPU_EMIT_DELTA > 64)",
     )
     p.add_argument(
+        "--slo", default=None, metavar="SPEC",
+        help="declarative SLOs over the request settle path "
+             "(kindel_tpu.obs.slo, DESIGN.md §26): objectives separated "
+             "by ';', each 'route=/v1/consensus p99_ms=500 "
+             "err_budget=0.1%%' with optional window_s=/fast_window_s=/"
+             "fast_burn= overrides; burn-rate gauges export as "
+             "kindel_slo_* and a fast-burning route flips /readyz to "
+             "503 (explicit > $KINDEL_TPU_SLO > off)",
+    )
+    p.add_argument(
+        "--trace-collect", default=None, metavar="PATH",
+        help="stitch every process's spans into ONE merged Perfetto/"
+             "Chrome trace at PATH on drain/stop "
+             "(kindel_tpu.obs.fleetview): replicas spool spans and "
+             "serve GET /v1/trace; the fleet front joins them by trace "
+             "id across process boundaries; ring capacity per process "
+             "via $KINDEL_TPU_TRACE_BUFFER (explicit > "
+             "$KINDEL_TPU_TRACE_COLLECT > off)",
+    )
+    p.add_argument(
         "--replica-addrs", default=None, metavar="HOST:PORT,...",
         help="static fleet roster: drive PRE-SPAWNED remote replicas "
              "(each running python -m kindel_tpu.fleet.procreplica, or "
@@ -719,6 +739,8 @@ def cmd_serve(args) -> int:
             ),
             fleet_watermark=args.fleet_watermark,
             max_body_mb=args.max_body_mb,
+            slo=args.slo,
+            trace_collect=args.trace_collect,
         )
         posture = (
             f"static roster of {len(service.replicas)} remote "
@@ -737,6 +759,11 @@ def cmd_serve(args) -> int:
             min_replicas=args.min_replicas,
             max_replicas=args.max_replicas,
             max_body_mb=args.max_body_mb,
+            # fleet-front observability plane (DESIGN.md §26): the SLO
+            # engine and the stitched-trace collector live on the
+            # front, never in replica children
+            slo=args.slo,
+            trace_collect=args.trace_collect,
         )
         scale_note = (
             f", autoscaling {args.min_replicas}-{args.max_replicas}"
@@ -781,7 +808,8 @@ def cmd_serve(args) -> int:
 
         service = ConsensusService(
             http_host=args.host, http_port=args.port,
-            max_body_mb=args.max_body_mb, **service_kwargs
+            max_body_mb=args.max_body_mb, slo=args.slo,
+            trace_collect=args.trace_collect, **service_kwargs
         )
         posture = "single replica"
     service.start()
@@ -1404,6 +1432,122 @@ def cmd_lint(args) -> int:
     return 1 if failed else 0
 
 
+def _perf_parser(sub):
+    p = sub.add_parser(
+        "perf",
+        help="the committed BENCH_*/MULTICHIP_* trajectory as a typed "
+             "series store and a CI gate (kindel_tpu.obs.perfgate): "
+             "list the history, or --gate to replay it (and optionally "
+             "a fresh bench line) against noise-tolerant per-(backend, "
+             "series) regression floors",
+    )
+    p.add_argument(
+        "--gate", action="store_true",
+        help="exit nonzero on regression: every committed sample is "
+             "re-gated against its own predecessors in round order, "
+             "plus the fresh --line if given",
+    )
+    p.add_argument(
+        "--line", default=None, metavar="PATH",
+        help="a fresh bench.py JSON result line to gate against the "
+             "history ('-' reads stdin)",
+    )
+    p.add_argument(
+        "--history", default=None, metavar="DIR",
+        help="directory holding the BENCH_*/MULTICHIP_* JSON files "
+             "(default: the repo root)",
+    )
+    p.add_argument(
+        "--tolerance", type=float, default=None, metavar="F",
+        help="allowed fractional drop below the best prior in a series "
+             "before the gate fires (default 0.35 — CPU-fallback "
+             "numbers swing with host load)",
+    )
+    p.add_argument(
+        "--format", choices=["text", "json"], default="text",
+        help="output format",
+    )
+
+
+def cmd_perf(args) -> int:
+    """Inspect/gate the committed perf trajectory; exit 0 when clean,
+    1 on regression (--gate), 2 on usage errors."""
+    import json as _json
+    from pathlib import Path
+
+    from kindel_tpu.obs import perfgate
+
+    root = Path(
+        args.history if args.history
+        else Path(__file__).resolve().parent.parent
+    )
+    tolerance = (
+        args.tolerance if args.tolerance is not None
+        else perfgate.DEFAULT_TOLERANCE
+    )
+    store = perfgate.load_history(root)
+    fresh_doc = None
+    if args.line:
+        try:
+            raw = (
+                sys.stdin.read() if args.line == "-"
+                else open(args.line).read()
+            )
+            fresh_doc = _json.loads(raw)
+        except (OSError, ValueError) as e:
+            print(f"unreadable --line: {e}", file=sys.stderr)
+            return 2
+        if isinstance(fresh_doc, dict) and isinstance(
+            fresh_doc.get("parsed"), dict
+        ):
+            fresh_doc = fresh_doc["parsed"]  # driver-wrapper shape
+    if not args.gate:
+        doc = {
+            "series": {
+                f"{backend}/{series}": [
+                    {"round": s.round, "value": s.value, "unit": s.unit,
+                     "source": s.source}
+                    for s in samples
+                ]
+                for (backend, series), samples in store.series().items()
+            },
+            "skipped": [
+                {"source": src, "reason": why}
+                for src, why in store.skipped
+            ],
+        }
+        if args.format == "json":
+            print(_json.dumps(doc, indent=1))
+        else:
+            for key, rows in sorted(doc["series"].items()):
+                values = " -> ".join(f"{r['value']:g}" for r in rows)
+                print(f"{key}: {values} {rows[-1]['unit']}".rstrip())
+            for row in doc["skipped"]:
+                print(f"skipped {row['source']}: {row['reason']}")
+        return 0
+    result = perfgate.gate_history(store, tolerance=tolerance)
+    if fresh_doc is not None:
+        result.checks.extend(
+            perfgate.gate_fresh(
+                store, fresh_doc, tolerance=tolerance
+            ).checks
+        )
+    if args.format == "json":
+        print(_json.dumps(result.to_doc(), indent=1))
+    else:
+        for c in result.checks:
+            mark = "ok " if c.ok else "REGRESSION"
+            print(f"{mark} {c.backend}/{c.series}: {c.detail}")
+        verdict = "clean" if result.ok else (
+            f"{len(result.regressions)} regression(s)"
+        )
+        print(
+            f"perf gate: {verdict} over {len(result.checks)} check(s), "
+            f"{len(store.skipped)} record(s) skipped"
+        )
+    return 0 if result.ok else 1
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="kindel-tpu",
@@ -1544,6 +1688,7 @@ def build_parser() -> argparse.ArgumentParser:
     _serve_parser(sub)
     _tune_parser(sub)
     _lint_parser(sub)
+    _perf_parser(sub)
 
     sub.add_parser("version", help="show version")
     return parser
@@ -1579,6 +1724,7 @@ def main(argv=None) -> int:
         "serve": cmd_serve,
         "tune": cmd_tune,
         "lint": cmd_lint,
+        "perf": cmd_perf,
     }[args.command]
     trace_path = getattr(args, "trace", None)
     if trace_path is None:

@@ -157,7 +157,9 @@ class ProcessReplicaFactory:
                  host: str = "127.0.0.1",
                  rpc_timeout_ms: float | None = None,
                  spawn_timeout_s: float = SPAWN_TIMEOUT_S,
-                 metrics=None):
+                 metrics=None,
+                 trace_dir: str | None = None,
+                 trace_buffer: int | None = None):
         self.replica_id = replica_id
         self.workdir = str(workdir)
         self.host = host
@@ -179,6 +181,14 @@ class ProcessReplicaFactory:
             "port": 0,
             "service": service,
         }
+        if trace_dir:
+            # trace collection on: the child spools every span to a
+            # generation-unique file in this dir (named with ITS pid —
+            # a respawn never overwrites its predecessor's spans) and
+            # serves /v1/trace for live drains (kindel_tpu.obs.fleetview)
+            self._config["trace_dir"] = str(trace_dir)
+            if trace_buffer:
+                self._config["trace_buffer"] = int(trace_buffer)
 
     def sweep_stale_files(self, keep_generation: int) -> None:
         """Remove older generations' addr/config debris for this slot —
@@ -285,6 +295,12 @@ class ProcessFleetService(FleetService):
             service_factory=self._proc_factory,
             **fleet_kwargs,
         )
+        self._trace_dir = None
+        if self._trace_collect:
+            # per-process span spools land here; collect_traces() reads
+            # them for dead replicas and drains live ones over the wire
+            self._trace_dir = os.path.join(self.workdir, "traces")
+            os.makedirs(self._trace_dir, exist_ok=True)
 
     def _proc_factory(self, rid: str, registry):
         maker = self._makers.get(rid)
@@ -296,6 +312,8 @@ class ProcessFleetService(FleetService):
                 rpc_timeout_ms=self._rpc_timeout_ms,
                 spawn_timeout_s=self._spawn_timeout_s,
                 metrics=registry,
+                trace_dir=self._trace_dir,
+                trace_buffer=self._trace_buffer,
             )
         return maker()
 
@@ -325,6 +343,23 @@ class ProcessFleetService(FleetService):
             raise ReplicaSpawnError(
                 f"no replica process came up: {errors!r}"
             )
+
+    def _collect_into(self, collector) -> None:
+        """Fleet-wide trace sweep: the front tap, every live replica's
+        /v1/trace drain, then the on-disk spools (the ONLY record a
+        SIGKILLed replica leaves; the collector's (trace_id, span_id)
+        dedupe makes the wire/spool overlap harmless)."""
+        super()._collect_into(collector)
+        for rep in self.roster():
+            svc = rep.service
+            if svc is None or not svc.live:
+                continue
+            try:
+                collector.add_ndjson(rep.replica_id, svc.trace_drain())
+            except Exception as e:  # noqa: BLE001 — one dead wire must not sink the sweep
+                collector.record_failure(rep.replica_id, e)
+        if self._trace_dir:
+            collector.collect_spool_dir(self._trace_dir)
 
     def rpc_stats(self) -> dict:
         """Summed wire posture across live replica processes (each
@@ -386,6 +421,21 @@ def main(argv=None) -> int:
     stop_event = threading.Event()
     service_kwargs = dict(cfg.get("service") or {})
     service_kwargs.setdefault("warmup", False)
+    if cfg.get("trace_dir"):
+        # stitched-trace collection is on: spool every span to a file
+        # named with THIS pid (a respawned slot never overwrites its
+        # predecessor's spans) and let the service expose /v1/trace;
+        # the drain/SIGTERM path flushes the tap before exit
+        service_kwargs["trace_spool"] = os.path.join(
+            cfg["trace_dir"],
+            f"{cfg.get('replica_id', 'r?')}.{os.getpid()}.trace.jsonl",
+        )
+        if cfg.get("trace_buffer"):
+            service_kwargs["trace_buffer"] = int(cfg["trace_buffer"])
+        # merging is the FRONT's job: an inherited
+        # KINDEL_TPU_TRACE_COLLECT must not make every child clobber
+        # the fleet's merged file with its own single-process view
+        os.environ.pop("KINDEL_TPU_TRACE_COLLECT", None)
     if isinstance(service_kwargs.get("tuning"), dict):
         # the config crossed the process boundary as JSON; rebuild the
         # frozen TuningConfig the serve stack expects

@@ -60,7 +60,7 @@ from dataclasses import dataclass, field
 from kindel_tpu.durable.journal import PoisonRequestError
 from kindel_tpu.io.fasta import parse_fasta
 from kindel_tpu.obs import trace
-from kindel_tpu.obs.metrics import default_registry
+from kindel_tpu.obs.metrics import WIRE_LATENCY_BUCKETS, default_registry
 from kindel_tpu.resilience import faults
 from kindel_tpu.resilience.policy import RetryPolicy, is_transient
 from kindel_tpu.serve.queue import (
@@ -133,6 +133,7 @@ def rpc_metrics():
                         "kindel_rpc_call_seconds",
                         "wall time of one fleet RPC exchange "
                         "(send → response read), successful or not",
+                        buckets=WIRE_LATENCY_BUCKETS,
                     ),
                     dedup_hits=reg.counter(
                         "kindel_rpc_dedup_hits_total",
@@ -460,6 +461,23 @@ class RpcServiceClient:
 
     def readyz(self) -> dict:
         return self._call_json("GET", "/readyz")
+
+    def trace_drain(self, timeout_s: float | None = None) -> bytes:
+        """Drain the replica's span buffer (`GET /v1/trace`): raw
+        ndjson bytes — one JSON span record per line, parsed
+        journal-style by the fleet-front TraceCollector (the payload is
+        NOT a JSON document, so this bypasses `_call_json`)."""
+        status, _headers, data = self._transport.call(
+            "GET", "/v1/trace", body=None, headers={},
+            timeout_s=timeout_s if timeout_s is not None else self.timeout_s,
+            fault_site="rpc.probe",
+        )
+        if status != 200:
+            raise RpcTransportError(
+                f"GET /v1/trace -> HTTP {status}: "
+                f"{data[:200].decode(errors='replace')}"
+            )
+        return data
 
     # -------------------------------------------------------- serving
 

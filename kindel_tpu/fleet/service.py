@@ -31,6 +31,7 @@ supervises; only the services it assembles touch the device.
 
 from __future__ import annotations
 
+import sys
 import threading
 import time
 
@@ -38,6 +39,7 @@ from kindel_tpu.fleet.replica import Replica
 from kindel_tpu.fleet.router import FleetRouter
 from kindel_tpu.fleet.supervisor import FleetSupervisor
 from kindel_tpu.obs.metrics import (
+    LabeledRegistry,
     MetricsRegistry,
     MultiRegistry,
     default_registry,
@@ -121,6 +123,9 @@ class FleetService:
                  max_replicas: int | None = None,
                  autoscale_interval_s: float = 0.25,
                  max_body_mb: int | None = None,
+                 slo: str | None = None,
+                 trace_collect: str | None = None,
+                 trace_buffer: int | None = None,
                  **service_kwargs):
         """`service_kwargs` are ConsensusService knobs applied to every
         replica (max_batch_rows, max_wait_s, warmup, consensus opts,
@@ -146,6 +151,9 @@ class FleetService:
         #: snapshot the list instead of taking it
         self._membership_lock = threading.RLock()
         self._registries = [MetricsRegistry() for _ in range(replicas)]
+        #: slot names parallel to _registries (the replica="<slot>"
+        #: label on the fleet /metrics union)
+        self._registry_slots = [f"r{i}" for i in range(replicas)]
         self.replicas: list[Replica] = []
         for i in range(replicas):
             rid = f"r{i}"
@@ -178,6 +186,23 @@ class FleetService:
         from kindel_tpu import tune
 
         self.max_body_mb, _mb_src = tune.resolve_max_body_mb(max_body_mb)
+        # fleet-front SLO engine (kindel_tpu.obs.slo, DESIGN.md §26):
+        # observes every submit()'s settlement AFTER failover/hedging/
+        # replay — the client-visible outcome, not a replica's view
+        slo_spec, _slo_src = tune.resolve_slo(slo)
+        self.slo_engine = None
+        if slo_spec:
+            from kindel_tpu.obs.slo import SloEngine, parse_slo
+
+            self.slo_engine = SloEngine(parse_slo(slo_spec))
+        # stitched-trace collection (kindel_tpu.obs.fleetview): the
+        # merged Perfetto file is written here on stop()/collect
+        tc_path, _tc_src = tune.resolve_trace_collect(trace_collect)
+        self._trace_collect = tc_path
+        self._trace_buffer, _tb_src = tune.resolve_trace_buffer(
+            trace_buffer
+        )
+        self._trace_tap = None
         self._http = None
         self._http_host = http_host
         self._http_port = http_port
@@ -211,6 +236,14 @@ class FleetService:
     def start(self) -> "FleetService":
         self._started_at = time.monotonic()
         fleet_metrics()  # the kindel_fleet_* series exist from boot
+        if self._trace_collect and self._trace_tap is None:
+            # the front's own spans (router placement, rpc.call hops)
+            # join the stitched trace through this tap
+            from kindel_tpu.obs import fleetview
+
+            self._trace_tap = fleetview.install_replica_tracing(
+                capacity=self._trace_buffer
+            )
         self._start_replicas()
         if self.supervisor is not None:
             self.supervisor.start()
@@ -224,10 +257,14 @@ class FleetService:
                 readyz_response,
             )
 
+            # front-process (global) series render first and unlabeled;
+            # replica registries render behind them with a
+            # replica="<slot>" label so same-named families from N
+            # replicas never merge silently
             self._http = ServeHTTPServer(
                 MultiRegistry(
-                    *self.registries(), default_registry(),
-                    refresh=obs_runtime.update_device_gauges,
+                    default_registry(), *self.labeled_registries(),
+                    refresh=self._refresh_metrics,
                 ),
                 host=self._http_host, port=self._http_port,
                 health_fn=self.healthz,
@@ -258,6 +295,17 @@ class FleetService:
     def registries(self) -> list:
         with self._membership_lock:
             return list(self._registries)
+
+    def labeled_registries(self) -> list:
+        """The replica registries as render views tagged
+        `replica="<slot>"` — what the fleet /metrics union scrapes, so
+        same-named series from N replicas stay distinguishable instead
+        of silently collapsing into whichever replica rendered first."""
+        with self._membership_lock:
+            pairs = list(zip(self._registry_slots, self._registries))
+        return [
+            LabeledRegistry(reg, "replica", slot) for slot, reg in pairs
+        ]
 
     def __enter__(self) -> "FleetService":
         return self.start()
@@ -319,6 +367,55 @@ class FleetService:
         if self._http is not None:
             self._http.stop()
             self._http = None
+        if self._trace_collect:
+            try:
+                self.collect_traces()
+            except OSError as e:
+                from kindel_tpu.resilience.policy import record_degrade
+
+                record_degrade("fleetview.collect", "write_failed", 1)
+                print(
+                    f"kindel-fleet trace collection failed: {e!r}",
+                    file=sys.stderr,
+                )
+        if self._trace_tap is not None:
+            from kindel_tpu.obs import trace as obs_trace
+
+            self._trace_tap.close()
+            active = obs_trace.active_tracer()
+            if active is not None and active.exporter is self._trace_tap:
+                obs_trace.disable_tracing()
+            self._trace_tap = None
+
+    def _refresh_metrics(self) -> None:
+        """Per-scrape refresh: device gauges plus SLO burn gauges."""
+        from kindel_tpu.obs import runtime as obs_runtime
+
+        obs_runtime.update_device_gauges()
+        if self.slo_engine is not None:
+            self.slo_engine.refresh()
+
+    def collect_traces(self, path: str | None = None) -> str | None:
+        """Stitch the fleet's span streams into ONE Perfetto file at
+        `path` (default: the `trace_collect` knob). The in-process
+        fleet shares the front tracer, so the front tap carries every
+        span; ProcessFleetService extends this with per-replica wire
+        drains and crash spools."""
+        out = path or self._trace_collect
+        if not out:
+            return None
+        from kindel_tpu.obs import fleetview
+
+        collector = fleetview.TraceCollector(out)
+        self._collect_into(collector)
+        return collector.write()
+
+    def _collect_into(self, collector) -> None:
+        """Feed every reachable span stream into the collector."""
+        if self._trace_tap is not None:
+            collector.add_ndjson(
+                collector.FRONT, self._trace_tap.drain_payload()
+            )
 
     def drain(self, replica=None) -> int:
         """Zero-downtime drain. With `replica` (id or index): stop that
@@ -390,6 +487,7 @@ class FleetService:
             self._next_index += 1
             registry = MetricsRegistry()
             self._registries.append(registry)
+            self._registry_slots.append(rid)
             factory = self._make_factory(rid, registry,
                                          self._service_factory)
             rep = Replica(rid, factory,
@@ -461,9 +559,14 @@ class FleetService:
         """Admit one request into the fleet; Future of SampleResult.
         Raises AdmissionError/ServiceDegraded when shedding (fleet
         watermark, or no replica admits)."""
-        return self.router.submit(
+        fut = self.router.submit(
             payload, deadline_s=deadline_s, **opt_overrides
         )
+        if self.slo_engine is not None:
+            # observed at the fleet front: the settlement the CLIENT
+            # sees, after failover/hedging/replay have done their work
+            self.slo_engine.attach("/v1/consensus", fut)
+        return fut
 
     def request(self, payload, timeout: float | None = None,
                 **opt_overrides):
@@ -577,13 +680,24 @@ class FleetService:
     def readyz(self) -> dict:
         roster = self.roster()
         ready = (not self._stopped) and any(r.admitting for r in roster)
-        return {
+        doc = {
             "ready": ready,
             "status": "ok" if ready else (
                 "stopped" if self._stopped else "no_admitting_replica"
             ),
             "replicas": {r.replica_id: r.state for r in roster},
         }
+        if self.slo_engine is not None:
+            # fast-burn degrades fleet readiness: the balancer stops
+            # routing here until the burn window drains (DESIGN.md §26)
+            slo_doc = self.slo_engine.evaluate()
+            if ready and any(
+                r["fast_burn_active"] for r in slo_doc.values()
+            ):
+                doc["ready"] = False
+                doc["status"] = "slo_degraded"
+            doc["slo"] = slo_doc
+        return doc
 
     # ------------------------------------------------------------- metrics
 

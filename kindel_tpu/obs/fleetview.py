@@ -1,0 +1,413 @@
+"""kindel_tpu.obs.fleetview — stitched cross-process fleet traces.
+
+The span tracer (obs/trace.py) and its RPC propagation stop at the
+process boundary: a request served by a 3-process fleet leaves N
+disjoint span files.  This module stitches them back into ONE
+Perfetto/Chrome trace where a request's tree spans front router → RPC
+hop → replica queue/batcher/worker → device dispatch:
+
+  * **SpanTap** — a trace exporter each replica installs: a bounded
+    in-memory ring (drop-oldest, counted) drained over the wire via
+    ``GET /v1/trace`` (ndjson), plus an optional write-through spool
+    file flushed per span (JsonlExporter-style) so a SIGKILLed replica
+    still leaves everything up to its last completed span on disk.
+  * **Journal-style reads** — ``parse_ndjson``/``read_spool`` truncate
+    at the first torn or corrupt line (the PR 15 durability rule: a
+    torn tail is data loss already paid for; propagating it would turn
+    one bad line into a corrupt merged file).
+  * **TraceCollector** — the fleet front's merge point: deduplicates
+    records by ``(trace_id, span_id)`` (a span drained over HTTP and
+    later re-read from the spool counts once), assigns each source a
+    stable pseudo-pid with a ``process_name`` metadata event, and
+    writes a single ``traceEvents`` document.  Spans from different
+    processes join by the trace id that already crossed the wire in
+    ``X-Kindel-Trace``.
+
+Collection must never take serving down: every wire/file failure lands
+in ``TraceCollector.record_failure`` (counted, remembered, swallowed).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from collections import deque
+
+from kindel_tpu.obs import trace
+from kindel_tpu.obs.metrics import default_registry
+
+#: the drain route every traced replica (and the front) exposes
+TRACE_ROUTE = "/v1/trace"
+
+#: drain payload content type: one JSON span record per ``\n`` line
+TRACE_CONTENT_TYPE = "application/x-ndjson"
+
+#: default SpanTap ring capacity (spans) — KINDEL_TPU_TRACE_BUFFER
+DEFAULT_BUFFER = 4096
+
+_FLEETVIEW_METRICS = None
+_fv_lock = threading.Lock()
+
+
+def fleetview_metrics():
+    """The process-global ``kindel_fleetview_*`` family (cached, same
+    pattern as ``rpc_metrics``/``fleet_metrics``)."""
+    global _FLEETVIEW_METRICS
+    if _FLEETVIEW_METRICS is None:
+        with _fv_lock:
+            if _FLEETVIEW_METRICS is None:
+                from types import SimpleNamespace
+
+                reg = default_registry()
+                _FLEETVIEW_METRICS = SimpleNamespace(
+                    collected=reg.counter(
+                        "kindel_fleetview_spans_collected_total",
+                        "span records merged into the stitched fleet "
+                        "trace by source (front or replica slot)",
+                    ),
+                    dropped=reg.counter(
+                        "kindel_fleetview_spans_dropped_total",
+                        "span records dropped from a full SpanTap ring "
+                        "before any drain could ship them",
+                    ),
+                    truncated=reg.counter(
+                        "kindel_fleetview_truncated_tails_total",
+                        "torn/corrupt trailing lines truncated from "
+                        "replica span streams during collection "
+                        "(journal-style: cut at the last complete span)",
+                    ),
+                    collections=reg.counter(
+                        "kindel_fleetview_collections_total",
+                        "fleet-wide trace collection sweeps (drains of "
+                        "every reachable replica plus spool reads)",
+                    ),
+                    collect_errors=reg.counter(
+                        "kindel_fleetview_collect_errors_total",
+                        "per-source trace collection failures "
+                        "(unreachable replica, unreadable spool) — "
+                        "the merged trace is still written without them",
+                    ),
+                )
+    return _FLEETVIEW_METRICS
+
+
+class SpanTap:
+    """Trace exporter with a drainable ring and a crash-tolerant spool.
+
+    ``export`` is called by the tracer for every finished span: the
+    record is appended to a bounded ring (oldest dropped, counted) and,
+    when a spool path is configured, written+flushed as one JSON line —
+    so a SIGKILL tears at most the line in flight.  ``drain()`` empties
+    the ring; the /v1/trace route serves it over the wire.
+    """
+
+    def __init__(self, spool_path=None, capacity: int = DEFAULT_BUFFER):
+        self.spool_path = str(spool_path) if spool_path else None
+        self._ring: deque = deque(maxlen=max(1, int(capacity)))
+        self._lock = threading.Lock()
+        self._spool = None
+        self._dropped = 0
+        self._closed = False
+        if self.spool_path:
+            self._spool = open(self.spool_path, "w")
+
+    def export(self, record: dict) -> None:
+        line = json.dumps(record, default=str)
+        with self._lock:
+            if self._closed:
+                return
+            if len(self._ring) == self._ring.maxlen:
+                self._dropped += 1
+                fleetview_metrics().dropped.inc()
+            self._ring.append(line)
+            if self._spool is not None:
+                self._spool.write(line + "\n")
+                self._spool.flush()
+
+    @property
+    def dropped(self) -> int:
+        return self._dropped
+
+    def drain_lines(self) -> list[str]:
+        """Return-and-clear the ring (each element one JSON record)."""
+        with self._lock:
+            lines = list(self._ring)
+            self._ring.clear()
+        return lines
+
+    def drain_payload(self) -> bytes:
+        """The ring as an ndjson wire payload (and clear it)."""
+        lines = self.drain_lines()
+        if not lines:
+            return b""
+        return ("\n".join(lines) + "\n").encode()
+
+    def close(self) -> None:
+        """Final flush (SIGTERM/drain path): close the spool so every
+        exported span is durably on disk before the process exits."""
+        with self._lock:
+            self._closed = True
+            if self._spool is not None:
+                try:
+                    self._spool.flush()
+                    self._spool.close()
+                finally:
+                    self._spool = None
+
+
+def trace_drain_response(tap: SpanTap):
+    """``GET /v1/trace`` handler body: drain the tap as ndjson."""
+    return 200, TRACE_CONTENT_TYPE, tap.drain_payload(), {}
+
+
+def parse_ndjson(data: bytes) -> tuple[list[dict], int]:
+    """Parse an ndjson span stream journal-style.
+
+    Returns ``(records, truncated)``: parsing stops at the first line
+    that is torn (no trailing newline) or fails to parse as a JSON
+    object with the span-record keys — everything before the tear is
+    kept, everything after discarded, and ``truncated`` counts the
+    cut lines.  Never raises on payload content.
+    """
+    records: list[dict] = []
+    if not data:
+        return records, 0
+    text = data.decode("utf-8", errors="replace")
+    lines = text.split("\n")
+    # a well-formed stream ends with "\n" → last element is ""; any
+    # trailing non-empty element is a torn line (write cut mid-record)
+    complete, tail = lines[:-1], lines[-1]
+    truncated = 1 if tail.strip() else 0
+    for i, line in enumerate(complete):
+        if not line.strip():
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            rec = None
+        if (
+            not isinstance(rec, dict)
+            or "trace_id" not in rec
+            or "span_id" not in rec
+            or "name" not in rec
+        ):
+            # corrupt line: journal rule — cut here, count the rest
+            truncated += sum(
+                1 for rest in complete[i:] if rest.strip()
+            )
+            break
+        records.append(rec)
+    return records, truncated
+
+
+def read_spool(path) -> tuple[list[dict], int]:
+    """Read a replica spool file journal-style (see parse_ndjson).
+    A missing file is simply an empty stream."""
+    try:
+        with open(path, "rb") as fh:
+            data = fh.read()
+    except OSError:
+        return [], 0
+    return parse_ndjson(data)
+
+
+class TraceCollector:
+    """Merge span streams from many processes into one Perfetto file.
+
+    Sources are named (``front``, ``r0`` …); each gets a stable
+    pseudo-pid plus a ``process_name`` metadata event so Perfetto
+    renders the fleet as named process lanes.  Records are deduplicated
+    by ``(trace_id, span_id)`` — a span seen both over the wire and in
+    a spool counts once (first sighting wins).
+    """
+
+    FRONT = "front"
+
+    def __init__(self, path=None):
+        self.path = str(path) if path else None
+        self._lock = threading.Lock()
+        self._spans: dict[tuple, tuple] = {}  # (trace,span) -> (src, rec)
+        self._pids: dict[str, int] = {}
+        self._truncated: dict[str, int] = {}
+        self._errors: list[tuple[str, str]] = []
+        self._m = fleetview_metrics()
+
+    def _pid(self, source: str) -> int:
+        pid = self._pids.get(source)
+        if pid is None:
+            pid = self._pids[source] = len(self._pids) + 1
+        return pid
+
+    def record_failure(self, source: str, exc: BaseException) -> None:
+        """One source failed to yield its stream (unreachable replica,
+        unreadable spool).  Count it, remember it, keep collecting —
+        a merged trace minus one source beats no trace."""
+        self._m.collect_errors.inc()
+        with self._lock:
+            self._errors.append((source, repr(exc)))
+
+    @property
+    def errors(self) -> list[tuple[str, str]]:
+        with self._lock:
+            return list(self._errors)
+
+    def add_records(self, source: str, records) -> int:
+        """Merge parsed span records from one source; returns how many
+        were new (not already seen under their (trace_id, span_id))."""
+        added = 0
+        with self._lock:
+            self._pid(source)
+            for rec in records:
+                key = (rec.get("trace_id"), rec.get("span_id"))
+                if key in self._spans:
+                    continue
+                self._spans[key] = (source, rec)
+                added += 1
+        if added:
+            self._m.collected.labels(source=source).inc(added)
+        return added
+
+    def add_ndjson(self, source: str, data: bytes) -> int:
+        """Merge a wire/spool ndjson stream (journal-truncated)."""
+        records, truncated = parse_ndjson(data)
+        if truncated:
+            self._m.truncated.inc(truncated)
+            with self._lock:
+                self._truncated[source] = (
+                    self._truncated.get(source, 0) + truncated
+                )
+        return self.add_records(source, records)
+
+    def add_spool(self, source: str, path) -> int:
+        """Merge a replica's on-disk spool (crashed-replica path)."""
+        try:
+            with open(path, "rb") as fh:
+                data = fh.read()
+        except OSError as e:
+            self.record_failure(source, e)
+            return 0
+        return self.add_ndjson(source, data)
+
+    def collect_spool_dir(self, trace_dir) -> int:
+        """Merge every ``<rid>.<pid>.trace.jsonl`` spool in a directory
+        (each process writes its own generation-unique spool, so a
+        respawned slot never overwrites its predecessor's spans)."""
+        added = 0
+        try:
+            names = sorted(os.listdir(str(trace_dir)))
+        except OSError as e:
+            self.record_failure("spool-dir", e)
+            return 0
+        for name in names:
+            if not name.endswith(".trace.jsonl"):
+                continue
+            source = name.split(".", 1)[0]
+            added += self.add_spool(
+                source, os.path.join(str(trace_dir), name)
+            )
+        return added
+
+    def span_count(self) -> int:
+        with self._lock:
+            return len(self._spans)
+
+    def sources(self) -> list[str]:
+        with self._lock:
+            return sorted(self._pids)
+
+    def merge(self) -> dict:
+        """Build the single Perfetto/Chrome ``traceEvents`` document:
+        pseudo-pid per source, per-source thread lanes, span args
+        carrying trace/span/parent ids so cross-process trees stay
+        joinable in the UI and in tests."""
+        events: list[dict] = []
+        with self._lock:
+            pids = dict(self._pids)
+            spans = list(self._spans.values())
+            truncated = dict(self._truncated)
+        tids: dict[tuple, int] = {}
+        for source, pid in sorted(pids.items(), key=lambda kv: kv[1]):
+            events.append(
+                {
+                    "name": "process_name",
+                    "ph": "M",
+                    "pid": pid,
+                    "args": {"name": f"kindel:{source}"},
+                }
+            )
+        for source, rec in spans:
+            pid = pids[source]
+            tkey = (source, rec.get("thread", "main"))
+            tid = tids.get(tkey)
+            if tid is None:
+                tid = tids[tkey] = (
+                    len([1 for k in tids if k[0] == source]) + 1
+                )
+            args = dict(rec.get("attrs") or {})
+            args["trace_id"] = rec["trace_id"]
+            args["span_id"] = rec["span_id"]
+            if rec.get("parent_id"):
+                args["parent_id"] = rec["parent_id"]
+            args["source"] = source
+            events.append(
+                {
+                    "name": rec["name"],
+                    "ph": "X",
+                    "ts": round(float(rec.get("start_s", 0.0)) * 1e6, 3),
+                    "dur": round(
+                        float(rec.get("duration_s", 0.0)) * 1e6, 3
+                    ),
+                    "pid": pid,
+                    "tid": tid,
+                    "args": args,
+                }
+            )
+            for ev in rec.get("events") or []:
+                events.append(
+                    {
+                        "name": ev.get("name", "event"),
+                        "ph": "i",
+                        "ts": round(float(ev.get("t_s", 0.0)) * 1e6, 3),
+                        "pid": pid,
+                        "tid": tid,
+                        "s": "t",
+                        "args": dict(ev.get("attrs") or {}),
+                    }
+                )
+        return {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "producer": "kindel-tpu obs.fleetview",
+                "sources": sorted(pids),
+                "truncated_tails": truncated,
+                "collect_errors": len(self._errors),
+            },
+        }
+
+    def write(self, path=None) -> str:
+        """Write the merged document atomically (tmp + rename) so a
+        crash mid-write never leaves a half-merged file at the final
+        path."""
+        out = str(path or self.path)
+        if not out:
+            raise ValueError("TraceCollector.write: no output path")
+        self._m.collections.inc()
+        doc = self.merge()
+        tmp = out + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(doc, fh)
+        os.replace(tmp, out)
+        return out
+
+
+def install_replica_tracing(
+    spool_path=None, capacity: int = DEFAULT_BUFFER
+) -> SpanTap:
+    """Install a SpanTap as the process tracer exporter (replica boot
+    path).  Returns the tap; the caller wires ``/v1/trace`` to it and
+    closes it on drain/SIGTERM."""
+    tap = SpanTap(spool_path=spool_path, capacity=capacity)
+    trace.enable_tracing(exporter=tap)
+    return tap

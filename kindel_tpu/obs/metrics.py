@@ -37,6 +37,16 @@ from collections import deque
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
 
+#: log-spaced latency buckets for wire-era histograms (RPC exchanges,
+#: stream update latency): the serve-era default bucket ladder tops out
+#: too coarsely for paths whose p99 lands seconds deep on the CPU bench
+#: — this ladder keeps the 1-2.5-5 per-decade pattern from 1 ms through
+#: 10 s so a slow p99 resolves into a real bucket instead of +Inf
+WIRE_LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0,
+)
+
 
 def _fmt(v: float) -> str:
     return str(int(v)) if float(v).is_integer() else repr(float(v))
@@ -454,6 +464,71 @@ class MetricsRegistry:
         out: dict = {}
         for m in metrics:
             m.snapshot_into(out)
+        return out
+
+
+class LabeledRegistry:
+    """Render-time view of one registry with a constant label injected
+    into every sample (the fleet `/metrics` union tags each replica's
+    series `replica="<slot>"` while front-process series stay bare).
+
+    Inside a MultiRegistry, a plain registry claims its metric names in
+    `seen` and later same-named families are skipped entirely — correct
+    for the serve-plus-global pair, silently wrong for N replicas whose
+    same-named histograms would all collapse into whichever rendered
+    first.  A labeled view dedupes only the HELP/TYPE comments by name;
+    its samples always render, distinguished by the injected label."""
+
+    def __init__(self, registry: MetricsRegistry, label: str, value):
+        if not _LABEL_RE.match(label):
+            raise ValueError(f"invalid label name {label!r}")
+        self._registry = registry
+        self._label = label
+        self._value = escape_label_value(value)
+
+    def _inject(self, line: str) -> str:
+        """Add the constant label to one rendered sample line."""
+        brace = line.find("{")
+        if brace >= 0:
+            end = line.rfind("}")
+            inner = line[brace + 1:end]
+            pair = f'{self._label}="{self._value}"'
+            inner = pair + ("," + inner if inner else "")
+            return f"{line[:brace]}{{{inner}}}{line[end + 1:]}"
+        sp = line.find(" ")
+        return (
+            f'{line[:sp]}{{{self._label}="{self._value}"}}{line[sp:]}'
+        )
+
+    def _render_into(self, out: list[str], seen: set) -> None:
+        with self._registry._lock:
+            metrics = list(self._registry._metrics.values())
+        for m in metrics:
+            emit_comments = m.name not in seen
+            seen.add(m.name)
+            for line in m.render():
+                if line.startswith("#"):
+                    if emit_comments:
+                        out.append(line)
+                else:
+                    out.append(self._inject(line))
+
+    def render(self) -> str:
+        out: list[str] = []
+        self._render_into(out, set())
+        return "\n".join(out) + "\n"
+
+    def snapshot(self) -> dict:
+        """Underlying snapshot with the label injected into every key
+        so replica snapshots merge without clobbering each other."""
+        out: dict = {}
+        pair = f'{self._label}="{self._value}"'
+        for key, v in self._registry.snapshot().items():
+            brace = key.find("{")
+            if brace >= 0:
+                out[f"{key[:brace]}{{{pair},{key[brace + 1:]}"] = v
+            else:
+                out[f"{key}{{{pair}}}"] = v
         return out
 
 

@@ -1,0 +1,313 @@
+"""kindel_tpu.obs.perfgate — the BENCH history as a CI gate.
+
+The repo carries its own performance trajectory as committed JSON:
+``BENCH_r01..r05.json`` (driver wrappers around a ``bench.py`` line),
+``BENCH_tpu_live.json`` (one bare line from real hardware), and
+``MULTICHIP_r01..r06.json`` (mesh rounds — failures, then the PR 14
+sweep).  Until now that trajectory was loose files; this module types
+it into a series store and turns it into a gate:
+
+  * **Ingestion** — each file becomes :class:`PerfSample` rows keyed by
+    ``(backend, series)``.  Records that carry no number (rc != 0
+    wrappers, ``parsed: null``, mesh timeout rounds) are *skipped with
+    a reason*, never silently dropped — ``kindel perf`` prints them.
+  * **Noise-tolerant thresholds** — CPU-fallback numbers swing with
+    host load (the committed history spans 13.3 → 27.9 Mbases/s on the
+    same code path), so the gate compares a fresh value against the
+    best prior in its series and fails only below
+    ``best * (1 - tolerance)`` (default tolerance 0.35).  Higher is
+    better for every ingested series (throughput, occupancy).
+  * **History replay** (``kindel perf --gate``) — every committed
+    sample is re-gated against its own predecessors in round order, so
+    the committed trajectory itself proves the gate's polarity: the
+    real r01→r06 history passes, a deliberately-regressed fixture line
+    (tools/perfgate_regressed_fixture.json) fails.
+
+Backends are normalised (``cpu-fallback``/``cpu`` collapse to ``cpu``)
+so a fresh CPU line gates against the CPU history, never the TPU line.
+Stdlib-only on purpose: bench.py's parent process imports this without
+pulling jax.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+from dataclasses import dataclass, field
+
+#: regression threshold: fail when fresh < best_prior * (1 - tolerance)
+DEFAULT_TOLERANCE = 0.35
+
+_ROUND_RE = re.compile(r"_r(\d+)\.json$")
+
+
+@dataclass(frozen=True)
+class PerfSample:
+    """One typed point on the committed performance trajectory."""
+
+    series: str        # e.g. consensus_throughput_bacterial
+    backend: str       # normalised: cpu | tpu | ...
+    value: float
+    unit: str
+    source: str        # file name the sample came from
+    round: int         # ordering key within the series (r01 -> 1)
+
+    @property
+    def key(self) -> tuple:
+        return (self.backend, self.series)
+
+
+@dataclass
+class HistoryStore:
+    """Every ingested sample plus every skip, with its reason."""
+
+    samples: list = field(default_factory=list)
+    skipped: list = field(default_factory=list)  # (source, reason)
+
+    def series(self) -> dict:
+        """``(backend, series) -> [PerfSample]`` sorted by round."""
+        out: dict = {}
+        for s in self.samples:
+            out.setdefault(s.key, []).append(s)
+        for key in out:
+            out[key].sort(key=lambda s: (s.round, s.source))
+        return out
+
+
+def normalize_backend(backend) -> str:
+    b = str(backend or "unknown").split()[0].strip().lower()
+    if b.startswith("cpu"):
+        return "cpu"  # cpu-fallback and forced-cpu gate against cpu
+    return b
+
+
+def _round_of(source: str, default: int = 0) -> int:
+    m = _ROUND_RE.search(source)
+    return int(m.group(1)) if m else default
+
+
+def _headline_sample(doc: dict, source: str, round_no: int):
+    """A bare bench.py result line -> PerfSample (None if numberless)."""
+    value = doc.get("value")
+    metric = doc.get("metric")
+    if not isinstance(value, (int, float)) or not metric:
+        return None
+    return PerfSample(
+        series=str(metric),
+        backend=normalize_backend(doc.get("backend")),
+        value=float(value),
+        unit=str(doc.get("unit", "")),
+        source=source,
+        round=round_no,
+    )
+
+
+def ingest_doc(store: HistoryStore, doc, source: str) -> None:
+    """Type one committed JSON document into the store.  Recognises the
+    three shapes in the repo root (driver wrapper, bare bench line,
+    mesh sweep) and records a skip reason for anything numberless."""
+    name = os.path.basename(source)
+    round_no = _round_of(name)
+    if not isinstance(doc, dict):
+        store.skipped.append((name, "not a JSON object"))
+        return
+    if "parsed" in doc or ("rc" in doc and "cmd" in doc):
+        # driver wrapper around a bench.py run
+        if doc.get("rc") not in (0, None):
+            store.skipped.append((name, f"bench rc={doc.get('rc')}"))
+            return
+        parsed = doc.get("parsed")
+        if not isinstance(parsed, dict):
+            store.skipped.append((name, "no parsed bench line"))
+            return
+        sample = _headline_sample(parsed, name, round_no)
+        if sample is None:
+            store.skipped.append((name, "parsed line carries no value"))
+            return
+        store.samples.append(sample)
+        return
+    if "ragged" in doc or "paged" in doc:
+        # MULTICHIP mesh sweep: occupancy per lane width as SLI series
+        backend = normalize_backend(doc.get("backend"))
+        added = False
+        for section in ("ragged", "paged"):
+            widths = (doc.get(section) or {}).get("widths") or {}
+            for width, row in sorted(widths.items()):
+                occ = (row or {}).get("occupancy")
+                if not isinstance(occ, (int, float)):
+                    continue
+                store.samples.append(
+                    PerfSample(
+                        series=f"mesh_{section}_occupancy_w{width}",
+                        backend=backend,
+                        value=float(occ),
+                        unit="fraction",
+                        source=name,
+                        round=round_no,
+                    )
+                )
+                added = True
+        if not added:
+            store.skipped.append((name, "mesh sweep without occupancy"))
+        return
+    if "n_devices" in doc and "ok" in doc:
+        store.skipped.append(
+            (name, f"multichip failure record (rc={doc.get('rc')})")
+        )
+        return
+    sample = _headline_sample(doc, name, round_no)
+    if sample is None:
+        store.skipped.append((name, "unrecognised shape"))
+        return
+    store.samples.append(sample)
+
+
+def load_history(root) -> HistoryStore:
+    """Ingest every BENCH_*/MULTICHIP_* JSON under ``root``."""
+    store = HistoryStore()
+    patterns = ("BENCH_r*.json", "BENCH_tpu_live.json",
+                "MULTICHIP_r*.json")
+    paths: list[str] = []
+    for pat in patterns:
+        paths.extend(glob.glob(os.path.join(str(root), pat)))
+    for path in sorted(paths):
+        try:
+            with open(path) as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError) as e:
+            store.skipped.append((os.path.basename(path), f"unreadable: {e}"))
+            continue
+        ingest_doc(store, doc, path)
+    return store
+
+
+@dataclass(frozen=True)
+class Check:
+    """One gate comparison (fresh-vs-history or replayed history)."""
+
+    series: str
+    backend: str
+    value: float
+    best_prior: float | None
+    floor: float | None
+    ok: bool
+    detail: str
+
+
+@dataclass
+class GateResult:
+    checks: list = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return all(c.ok for c in self.checks)
+
+    @property
+    def regressions(self) -> list:
+        return [c for c in self.checks if not c.ok]
+
+    def to_doc(self) -> dict:
+        return {
+            "ok": self.ok,
+            "checks": [
+                {
+                    "series": c.series,
+                    "backend": c.backend,
+                    "value": c.value,
+                    "best_prior": c.best_prior,
+                    "floor": c.floor,
+                    "ok": c.ok,
+                    "detail": c.detail,
+                }
+                for c in self.checks
+            ],
+        }
+
+
+def _check_sample(sample: PerfSample, priors,
+                  tolerance: float) -> Check:
+    values = [p.value for p in priors]
+    if not values:
+        return Check(
+            series=sample.series, backend=sample.backend,
+            value=sample.value, best_prior=None, floor=None, ok=True,
+            detail="no prior history — recorded, not gated",
+        )
+    best = max(values)
+    floor = best * (1.0 - tolerance)
+    ok = sample.value >= floor
+    detail = (
+        f"{sample.value:g} vs best prior {best:g} "
+        f"(floor {floor:g}, tolerance {tolerance:.0%})"
+    )
+    return Check(
+        series=sample.series, backend=sample.backend,
+        value=sample.value, best_prior=best, floor=floor, ok=ok,
+        detail=detail,
+    )
+
+
+def gate_fresh(store: HistoryStore, fresh_doc: dict,
+               tolerance: float = DEFAULT_TOLERANCE,
+               source: str = "fresh") -> GateResult:
+    """Gate one fresh bench.py line against the committed history."""
+    result = GateResult()
+    sample = _headline_sample(dict(fresh_doc or {}), source, 10**9)
+    if sample is None:
+        result.checks.append(
+            Check(
+                series=str((fresh_doc or {}).get("metric", "?")),
+                backend=normalize_backend(
+                    (fresh_doc or {}).get("backend")
+                ),
+                value=float("nan"), best_prior=None, floor=None,
+                ok=False, detail="fresh line carries no numeric value",
+            )
+        )
+        return result
+    priors = store.series().get(sample.key, [])
+    result.checks.append(_check_sample(sample, priors, tolerance))
+    return result
+
+
+def gate_history(store: HistoryStore,
+                 tolerance: float = DEFAULT_TOLERANCE) -> GateResult:
+    """Replay the committed trajectory in round order: each sample is
+    gated against its own predecessors.  The real history must pass;
+    a regressed line spliced into it must fail."""
+    result = GateResult()
+    for _key, samples in sorted(store.series().items()):
+        for i, sample in enumerate(samples):
+            result.checks.append(
+                _check_sample(sample, samples[:i], tolerance)
+            )
+    return result
+
+
+def provenance(root, fresh_doc: dict,
+               tolerance: float = DEFAULT_TOLERANCE) -> dict:
+    """Compact verdict embedded in the bench.py result line: how this
+    run compares to the committed history (never raises — bench output
+    must survive a broken history dir)."""
+    try:
+        store = load_history(root)
+        gated = gate_fresh(store, fresh_doc, tolerance=tolerance)
+        check = gated.checks[0]
+        if check.best_prior is None:
+            verdict = "no_history"
+        else:
+            verdict = "pass" if check.ok else "regression"
+        return {
+            "verdict": verdict,
+            "series": check.series,
+            "backend": check.backend,
+            "best_prior": check.best_prior,
+            "floor": check.floor,
+            "tolerance": tolerance,
+            "history_samples": len(store.samples),
+            "history_skipped": len(store.skipped),
+        }
+    except Exception as e:  # pragma: no cover - defensive
+        return {"verdict": "error", "error": repr(e)}

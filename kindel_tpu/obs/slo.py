@@ -1,0 +1,335 @@
+"""kindel_tpu.obs.slo — declarative SLOs with multi-window burn-rate alerts.
+
+The resilience stack (hedging, failover, replay, durable admission)
+exists to protect service-level objectives, but until now nothing in
+the process *watched* them: operators got raw histograms and had to do
+the burn math in their heads.  This module closes the loop:
+
+  * **Declarative objectives** — `--slo 'route=/v1/consensus p99_ms=500
+    err_budget=0.1%'` (explicit > ``KINDEL_TPU_SLO`` > off, resolved
+    like every knob via tune.py).  Several objectives separated by
+    ``;``.  A request counts against the budget when it errors OR when
+    it exceeds the route's latency target — the standard "slow is the
+    new down" accounting: latency violations spend error budget.
+  * **Ring-buffer observations** — per-route bounded deques of
+    ``(t, latency_s, ok)`` fed from the existing request settle path
+    (serve worker completion / fleet front futures).  No new
+    synchronisation on the hot path beyond one deque append under a
+    lock.
+  * **Multi-window burn rate** — the classic fast/slow pair: the burn
+    rate is ``bad_fraction / err_budget`` over a window; an alert needs
+    BOTH the fast window (is it burning *now*?) and the slow window
+    (is it more than a blip?) over threshold.  On fast-burn the engine
+    flips ``degraded()`` true — serve/fleet ``/readyz`` turns 503 — and
+    drops a detached ``slo.fast_burn`` span so a burn incident carries
+    its own annotation inside the active trace window.  Recovery is
+    automatic when the fast window drains below threshold.
+
+Gauges exported per route (process-global registry):
+
+  kindel_slo_burn_rate          bad_fraction/err_budget, fast window
+  kindel_slo_budget_remaining   1 - slow-window burn (negative = blown)
+  kindel_slo_fast_burn_active   1 while the multi-window alert is firing
+  kindel_slo_fast_burn_total    alert activations (counter)
+  kindel_slo_observations_total settled requests by route/outcome
+
+The engine is deliberately self-contained: parse errors in a spec fall
+through to "off" (an unparseable knob must never take a replica down at
+boot — tune.py's standing rule), and evaluation is O(window) on a
+bounded deque, cheap enough to run inline from ``/readyz``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent import futures
+from dataclasses import dataclass
+
+from kindel_tpu.obs import trace
+from kindel_tpu.obs.metrics import default_registry
+
+#: default budget/burn windows (seconds).  Production SLOs use long
+#: windows (hours); the defaults here are short enough that a serving
+#: process sees signal within a bench run while still giving the
+#: fast/slow pair distinct roles.  Both are per-spec overridable
+#: (``window_s=`` / ``fast_window_s=``) so tests can compress time.
+DEFAULT_WINDOW_S = 300.0
+DEFAULT_FAST_WINDOW_S = 60.0
+
+#: default multi-window alert threshold: the fast window must burn at
+#: this multiple of the budget rate (and the slow window at >= 1x)
+#: before the engine degrades readiness.  14.4 is the canonical
+#: "2% of a 30-day budget in one hour" page threshold scaled to our
+#: fast window; per-spec overridable (``fast_burn=``).
+DEFAULT_FAST_BURN = 14.4
+
+#: per-route observation ring size — bounds memory under sustained load
+#: (old observations age out by window anyway; the cap is a backstop)
+DEFAULT_RING = 4096
+
+
+@dataclass(frozen=True)
+class SloSpec:
+    """One declarative objective for one route."""
+
+    route: str
+    p99_ms: float | None = None      # latency target; None = errors only
+    err_budget: float = 0.001        # allowed bad fraction (0.1% default)
+    window_s: float = DEFAULT_WINDOW_S
+    fast_window_s: float = DEFAULT_FAST_WINDOW_S
+    fast_burn: float = DEFAULT_FAST_BURN
+
+
+class SloParseError(ValueError):
+    """A spec string that does not follow the grammar."""
+
+
+def _parse_fraction(tok: str) -> float:
+    """``0.1%`` -> 0.001; ``0.001`` -> 0.001."""
+    tok = tok.strip()
+    if tok.endswith("%"):
+        v = float(tok[:-1]) / 100.0
+    else:
+        v = float(tok)
+    if not (0.0 < v <= 1.0):
+        raise SloParseError(f"err_budget out of (0, 1]: {tok!r}")
+    return v
+
+
+def parse_slo(spec: str) -> list[SloSpec]:
+    """Parse an ``--slo`` string into specs.
+
+    Grammar: objectives separated by ``;``; each objective is
+    whitespace-separated ``key=value`` tokens.  ``route=`` is required;
+    ``p99_ms=``, ``err_budget=`` (percent or fraction), ``window_s=``,
+    ``fast_window_s=`` and ``fast_burn=`` are optional.  Raises
+    :class:`SloParseError` on malformed input — callers resolving the
+    knob from the environment catch it and fall through to off.
+    """
+    specs: list[SloSpec] = []
+    for entry in str(spec).split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        fields: dict = {}
+        for tok in entry.split():
+            if "=" not in tok:
+                raise SloParseError(f"token without '=': {tok!r}")
+            key, _, val = tok.partition("=")
+            key = key.strip()
+            try:
+                if key == "route":
+                    fields["route"] = val.strip()
+                elif key == "p99_ms":
+                    fields["p99_ms"] = float(val)
+                elif key == "err_budget":
+                    fields["err_budget"] = _parse_fraction(val)
+                elif key == "window_s":
+                    fields["window_s"] = float(val)
+                elif key == "fast_window_s":
+                    fields["fast_window_s"] = float(val)
+                elif key == "fast_burn":
+                    fields["fast_burn"] = float(val)
+                else:
+                    raise SloParseError(f"unknown SLO key {key!r}")
+            except SloParseError:
+                raise
+            except (TypeError, ValueError) as e:
+                raise SloParseError(f"bad value for {key!r}: {val!r}") from e
+        if "route" not in fields or not fields["route"]:
+            raise SloParseError(f"objective without route=: {entry!r}")
+        for fkey in ("p99_ms", "window_s", "fast_window_s", "fast_burn"):
+            if fkey in fields and fields[fkey] <= 0:
+                raise SloParseError(f"{fkey} must be positive: {entry!r}")
+        specs.append(SloSpec(**fields))
+    return specs
+
+
+_SLO_METRICS = None
+_slo_lock = threading.Lock()
+
+
+def slo_metrics():
+    """The process-global ``kindel_slo_*`` family (cached, same pattern
+    as ``rpc_metrics``/``fleet_metrics``)."""
+    global _SLO_METRICS
+    if _SLO_METRICS is None:
+        with _slo_lock:
+            if _SLO_METRICS is None:
+                from types import SimpleNamespace
+
+                reg = default_registry()
+                _SLO_METRICS = SimpleNamespace(
+                    burn_rate=reg.gauge(
+                        "kindel_slo_burn_rate",
+                        "SLO burn rate over the fast window by route "
+                        "(bad_fraction / err_budget; > 1 means the "
+                        "budget is being spent faster than allowed)",
+                    ),
+                    budget_remaining=reg.gauge(
+                        "kindel_slo_budget_remaining",
+                        "fraction of the route's error budget left over "
+                        "the slow window (1 = untouched, 0 = exactly "
+                        "spent, negative = blown)",
+                    ),
+                    fast_burn_active=reg.gauge(
+                        "kindel_slo_fast_burn_active",
+                        "1 while the multi-window fast-burn alert is "
+                        "firing for the route (readiness is degraded)",
+                    ),
+                    fast_burn_total=reg.counter(
+                        "kindel_slo_fast_burn_total",
+                        "fast-burn alert activations by route "
+                        "(transitions into the burning state)",
+                    ),
+                    observations=reg.counter(
+                        "kindel_slo_observations_total",
+                        "settled requests observed by the SLO engine "
+                        "by route and outcome (good/bad)",
+                    ),
+                )
+    return _SLO_METRICS
+
+
+class _RouteState:
+    __slots__ = ("spec", "ring", "burning")
+
+    def __init__(self, spec: SloSpec, ring: int):
+        self.spec = spec
+        self.ring: deque = deque(maxlen=ring)  # (t, latency_s, ok)
+        self.burning = False
+
+
+class SloEngine:
+    """Evaluate declarative SLOs over ring-buffered observations.
+
+    Thread-safe; ``observe()`` is the hot-path entry (one append under
+    a lock), ``evaluate()``/``degraded()`` are the read side, called
+    from ``/readyz`` and the metrics refresh hook.
+    """
+
+    def __init__(self, specs, ring: int = DEFAULT_RING, clock=None):
+        self._routes = {s.route: _RouteState(s, ring) for s in specs}
+        self._clock = clock or time.monotonic
+        self._lock = threading.Lock()
+        self._m = slo_metrics()
+
+    @property
+    def specs(self) -> list[SloSpec]:
+        return [st.spec for st in self._routes.values()]
+
+    def observe(self, route: str, latency_s: float, ok: bool) -> None:
+        """Record one settled request.  Routes without an objective are
+        ignored — the engine only buffers what it will evaluate."""
+        st = self._routes.get(route)
+        if st is None:
+            return
+        bad = (not ok) or (
+            st.spec.p99_ms is not None
+            and latency_s * 1000.0 > st.spec.p99_ms
+        )
+        with self._lock:
+            st.ring.append((self._clock(), latency_s, not bad))
+        self._m.observations.labels(
+            route=route, outcome="bad" if bad else "good"
+        ).inc()
+
+    def attach(self, route: str, fut, start_s: float | None = None) -> None:
+        """Feed a Future's settlement into the engine: latency measured
+        from ``start_s`` (engine clock) to the done callback; any
+        exception (or cancellation) counts as bad."""
+        if route not in self._routes:
+            return
+        t0 = self._clock() if start_s is None else start_s
+
+        def _settled(f) -> None:
+            try:
+                ok = f.exception() is None
+            except futures.CancelledError:
+                ok = False  # a cancelled request spent budget too
+            self.observe(route, self._clock() - t0, ok)
+
+        fut.add_done_callback(_settled)
+
+    def _burn(self, st: _RouteState, now: float, horizon_s: float) -> tuple:
+        """(burn_rate, good, bad) over the window ending now."""
+        cutoff = now - horizon_s
+        good = bad = 0
+        for t, _lat, ok in st.ring:
+            if t < cutoff:
+                continue
+            if ok:
+                good += 1
+            else:
+                bad += 1
+        total = good + bad
+        if total == 0:
+            return 0.0, 0, 0
+        return (bad / total) / st.spec.err_budget, good, bad
+
+    def evaluate(self) -> dict:
+        """Recompute burn rates for every route, update the gauges, and
+        manage fast-burn state transitions.  Returns a per-route doc
+        (also embedded in readyz responses)."""
+        now = self._clock()
+        out: dict = {}
+        with self._lock:
+            states = list(self._routes.values())
+        for st in states:
+            spec = st.spec
+            with self._lock:
+                # trim aged-out observations so the ring stays small
+                cutoff = now - max(spec.window_s, spec.fast_window_s)
+                while st.ring and st.ring[0][0] < cutoff:
+                    st.ring.popleft()
+                fast_burn, fgood, fbad = self._burn(
+                    st, now, spec.fast_window_s
+                )
+                slow_burn, sgood, sbad = self._burn(st, now, spec.window_s)
+            firing = fast_burn >= spec.fast_burn and slow_burn >= 1.0
+            if firing and not st.burning:
+                st.burning = True
+                self._m.fast_burn_total.labels(route=spec.route).inc()
+                # annotate the active trace window: a burn incident
+                # carries its own marker span with the numbers attached
+                sp = trace.start_span("slo.fast_burn")
+                sp.set_attribute(
+                    route=spec.route,
+                    burn_rate=round(fast_burn, 3),
+                    fast_window_s=spec.fast_window_s,
+                    err_budget=spec.err_budget,
+                )
+                sp.finish()
+            elif not firing and st.burning:
+                st.burning = False
+            budget_remaining = 1.0 - slow_burn
+            route_labels = {"route": spec.route}
+            self._m.burn_rate.labels(**route_labels).set(fast_burn)
+            self._m.budget_remaining.labels(**route_labels).set(
+                budget_remaining
+            )
+            self._m.fast_burn_active.labels(**route_labels).set(
+                1.0 if st.burning else 0.0
+            )
+            out[spec.route] = {
+                "burn_rate": round(fast_burn, 4),
+                "slow_burn_rate": round(slow_burn, 4),
+                "budget_remaining": round(budget_remaining, 4),
+                "fast_burn_active": st.burning,
+                "window": {"good": sgood, "bad": sbad},
+                "fast_window": {"good": fgood, "bad": fbad},
+            }
+        return out
+
+    def refresh(self) -> None:
+        """Metrics-refresh hook (MultiRegistry ``refresh=``): recompute
+        gauges before a scrape renders them."""
+        self.evaluate()
+
+    def degraded(self) -> bool:
+        """True while any route's fast-burn alert is firing.  Evaluates
+        inline — readyz always sees current-window truth."""
+        doc = self.evaluate()
+        return any(r["fast_burn_active"] for r in doc.values())

@@ -187,7 +187,12 @@ class ConsensusService:
         quarantine_after: int | None = None,
         session_idle_s: float | None = None,
         emit_delta: int | None = None,
+        slo: str | None = None,
+        trace_spool: str | None = None,
+        trace_collect: str | None = None,
+        trace_buffer: int | None = None,
         extra_post_routes: dict | None = None,
+        extra_get_routes: dict | None = None,
         metrics: MetricsRegistry | None = None,
         warmup: bool = False,
         warm_payloads=(),
@@ -409,6 +414,28 @@ class ConsensusService:
             self, idle_s=idle_s, emit_delta=emit_delta_v,
             journal=self._journal,
         )
+        # SLO engine (kindel_tpu.obs.slo, DESIGN.md §26): declarative
+        # objectives over the request settle path; off unless a spec
+        # resolves (explicit > KINDEL_TPU_SLO > off)
+        slo_spec, slo_src = tune.resolve_slo(slo)
+        self._m_tune_source.set(knob="slo", source=slo_src)
+        self.slo_engine = None
+        if slo_spec:
+            from kindel_tpu.obs.slo import SloEngine, parse_slo
+
+            self.slo_engine = SloEngine(parse_slo(slo_spec))
+        # stitched-trace plumbing (kindel_tpu.obs.fleetview): a SpanTap
+        # is installed at start() when either knob resolves — replicas
+        # spool + serve /v1/trace, a single-process service can also
+        # write its own merged file at stop()
+        tc_path, tc_src = tune.resolve_trace_collect(trace_collect)
+        self._m_tune_source.set(knob="trace_collect", source=tc_src)
+        tb, tb_src = tune.resolve_trace_buffer(trace_buffer)
+        self._m_tune_source.set(knob="trace_buffer", source=tb_src)
+        self._trace_collect = tc_path
+        self._trace_spool = trace_spool
+        self._trace_buffer = tb
+        self._trace_tap = None
         self._http: ServeHTTPServer | None = None
         self._http_host = http_host
         self._http_port = http_port
@@ -416,6 +443,9 @@ class ConsensusService:
         #: start() — the fleet RPC adapter (fleet/rpc.py) replaces
         #: /v1/consensus with its idempotency-aware variant this way
         self._extra_post_routes = dict(extra_post_routes or {})
+        #: caller-supplied GET routes merged over the defaults the same
+        #: way (/v1/trace lands here when tracing collection is on)
+        self._extra_get_routes = dict(extra_get_routes or {})
         self._started_at: float | None = None
         #: drain posture: /readyz answers 503 while True (admission is
         #: closed on the queue; in-flight work keeps finishing)
@@ -428,6 +458,19 @@ class ConsensusService:
         # fold JAX compile wall-time into the default registry so the
         # /metrics exposition attributes cold-start cost (best-effort)
         obs_runtime.install()
+        if (
+            self._trace_spool or self._trace_collect
+        ) and self._trace_tap is None:
+            from kindel_tpu.obs import fleetview
+
+            self._trace_tap = fleetview.install_replica_tracing(
+                spool_path=self._trace_spool,
+                capacity=self._trace_buffer,
+            )
+            self._extra_get_routes.setdefault(
+                fleetview.TRACE_ROUTE,
+                lambda: fleetview.trace_drain_response(self._trace_tap),
+            )
         self.worker.start()
         self.sessions.start()
         if self._journal is not None and self._recovery_thread is None:
@@ -453,7 +496,7 @@ class ConsensusService:
             self._http = ServeHTTPServer(
                 MultiRegistry(
                     self.metrics, default_registry(),
-                    refresh=obs_runtime.update_device_gauges,
+                    refresh=self._refresh_metrics,
                 ),
                 host=self._http_host, port=self._http_port,
                 health_fn=self.healthz,
@@ -464,11 +507,22 @@ class ConsensusService:
                     "/v1/stream/close": self._handle_stream_close,
                     **self._extra_post_routes,
                 },
-                get_routes={"/readyz": self._handle_readyz},
+                get_routes={
+                    "/readyz": self._handle_readyz,
+                    **self._extra_get_routes,
+                },
                 sse_routes={"/v1/stream/events": self._handle_stream_events},
                 max_body_bytes=self.max_body_mb * (1 << 20),
             ).start()
         return self
+
+    def _refresh_metrics(self) -> None:
+        """Per-scrape refresh hook: point-in-time device gauges plus
+        the SLO burn gauges (both cheap; both must be current in the
+        exposition a scrape renders)."""
+        obs_runtime.update_device_gauges()
+        if self.slo_engine is not None:
+            self.slo_engine.refresh()
 
     def stop(self, drain: bool = True) -> None:
         if self._http is not None:
@@ -479,9 +533,37 @@ class ConsensusService:
         # sessions' frames for the next life to replay
         self.sessions.shutdown()
         self.worker.stop(drain=drain)
+        self._flush_trace_tap()
         if self._journal is not None:
             self._journal.gc()
             self._journal.close()
+
+    def _flush_trace_tap(self) -> None:
+        """Final trace flush (stop/drain/SIGTERM path): write the
+        single-process merged file when `trace_collect` asked for one,
+        then close the tap so every span is durably spooled before the
+        process exits."""
+        tap = self._trace_tap
+        if tap is None:
+            return
+        self._trace_tap = None
+        from kindel_tpu.obs import fleetview
+
+        if self._trace_collect:
+            collector = fleetview.TraceCollector(self._trace_collect)
+            collector.add_ndjson(
+                fleetview.TraceCollector.FRONT, tap.drain_payload()
+            )
+            try:
+                collector.write()
+            except OSError as e:
+                collector.record_failure("write", e)
+        tap.close()
+        from kindel_tpu.obs import trace as obs_trace
+
+        active = obs_trace.active_tracer()
+        if active is not None and active.exporter is tap:
+            obs_trace.disable_tracing()
 
     def _recover_journal(self) -> None:
         """Background replay of the journal's live entries. A recovery
@@ -724,11 +806,22 @@ class ConsensusService:
             ready, status = False, "dead"
         else:
             ready, status = True, "ok"
-        return {
+        doc = {
             "ready": ready,
             "status": status,
             "queue_depth": self.queue.depth,
         }
+        if self.slo_engine is not None:
+            # a fast-burning SLO degrades readiness: the balancer stops
+            # routing NEW traffic here until the burn window drains
+            slo_doc = self.slo_engine.evaluate()
+            if ready and any(
+                r["fast_burn_active"] for r in slo_doc.values()
+            ):
+                doc["ready"] = False
+                doc["status"] = "slo_degraded"
+            doc["slo"] = slo_doc
+        return doc
 
     # ------------------------------------------------------------- requests
 
@@ -765,6 +858,8 @@ class ConsensusService:
                 ),
             )
             self.queue.submit(req)
+            if self.slo_engine is not None:
+                self.slo_engine.attach("/v1/consensus", req.future)
             return req.future
         digest = journal_payload_digest(payload)
         if jr.is_quarantined(digest):
@@ -784,6 +879,8 @@ class ConsensusService:
             key=idempotency_key or journal_new_key(digest),
         )
         self._journal_admit(jr, req, opt_overrides, digest)
+        if self.slo_engine is not None:
+            self.slo_engine.attach("/v1/consensus", req.future)
         return req.future
 
     def _journal_admit(self, jr, req: ServeRequest, opt_overrides: dict,
@@ -857,6 +954,8 @@ class ConsensusService:
             opts=opts, session=session,
         )
         self.queue.submit(req, force=True)
+        if self.slo_engine is not None:
+            self.slo_engine.attach("/v1/stream", req.future)
         return req.future
 
     # ---------------------------------------------------------- HTTP ingest

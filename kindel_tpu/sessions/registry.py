@@ -41,6 +41,7 @@ import time
 import uuid
 
 from kindel_tpu.durable.journal import JournalWriteError
+from kindel_tpu.obs.metrics import WIRE_LATENCY_BUCKETS
 from kindel_tpu.serve.queue import (
     AdmissionError,
     ServiceDegraded,
@@ -130,6 +131,7 @@ class SessionRegistry:
         self._m_update_s = m.histogram(
             "kindel_stream_update_seconds",
             "gate-crossing append to published update",
+            buckets=WIRE_LATENCY_BUCKETS,
         )
 
     # ----------------------------------------------------------- lifecycle

@@ -141,6 +141,11 @@ SESSION_IDLE_S_DEFAULT = 300.0
 #: emission gate, DESIGN.md §25); the env pin is KINDEL_TPU_EMIT_DELTA
 EMIT_DELTA_DEFAULT = 64
 
+#: SpanTap ring capacity (spans buffered per process for /v1/trace
+#: collection, kindel_tpu.obs.fleetview, DESIGN.md §26); the env pin is
+#: KINDEL_TPU_TRACE_BUFFER. A memory bound, not measured.
+TRACE_BUFFER_DEFAULT = 4096
+
 #: default page-class geometry spec (name:ROWSxLENGTH, ascending —
 #: kindel_tpu.ragged.pack.parse_classes is the grammar); the env pin is
 #: KINDEL_TPU_RAGGED_CLASSES, `kindel tune --ragged-budget-s` persists a
@@ -901,6 +906,52 @@ def resolve_emit_delta(explicit: int | None = None) -> tuple[int, str]:
     if pin is not None and pin > 0:
         return pin, "env"
     return EMIT_DELTA_DEFAULT, "default"
+
+
+def resolve_slo(explicit: str | None = None) -> tuple[str | None, str]:
+    """The declarative SLO spec (kindel_tpu.obs.slo, DESIGN.md §26):
+    explicit arg (`--slo`) > KINDEL_TPU_SLO > off (None). The returned
+    value is the raw spec string — the engine parses it; a malformed
+    pin falls through to off (an unparseable knob must never take a
+    replica down at boot), a malformed explicit arg raises so the
+    operator sees the grammar error at the CLI."""
+    from kindel_tpu.obs.slo import SloParseError, parse_slo
+
+    if explicit is not None and str(explicit).strip():
+        parse_slo(explicit)  # raises SloParseError on a bad explicit
+        return str(explicit), "explicit"
+    raw = os.environ.get("KINDEL_TPU_SLO", "").strip()
+    if raw:
+        try:
+            if parse_slo(raw):
+                return raw, "env"
+        except SloParseError:
+            pass
+    return None, "default"
+
+
+def resolve_trace_collect(explicit: str | None = None) -> tuple[str | None, str]:
+    """The stitched fleet trace output path (kindel_tpu.obs.fleetview,
+    DESIGN.md §26): explicit arg (`--trace-collect`) >
+    KINDEL_TPU_TRACE_COLLECT > off (None)."""
+    if explicit is not None and str(explicit).strip():
+        return str(explicit), "explicit"
+    raw = os.environ.get("KINDEL_TPU_TRACE_COLLECT", "").strip()
+    if raw:
+        return raw, "env"
+    return None, "default"
+
+
+def resolve_trace_buffer(explicit: int | None = None) -> tuple[int, str]:
+    """The per-process SpanTap ring capacity (kindel_tpu.obs.fleetview,
+    DESIGN.md §26): explicit arg > KINDEL_TPU_TRACE_BUFFER > default
+    (4096 spans); malformed/non-positive pins fall through."""
+    if explicit is not None and int(explicit) > 0:
+        return int(explicit), "explicit"
+    pin, _present = _env_int("KINDEL_TPU_TRACE_BUFFER")
+    if pin is not None and pin > 0:
+        return pin, "env"
+    return TRACE_BUFFER_DEFAULT, "default"
 
 
 def resolve_batch_mode(explicit: str | None = None) -> tuple[str, str]:

@@ -1,0 +1,14 @@
+"""Known-bad (obs scope, PR 18): a collection ack future leaks on the
+drain failure path — the caller awaiting the merged-trace handle blocks
+forever while the collector believes the flush completed."""
+
+from concurrent.futures import Future
+
+
+def collect_leaky(drain, merge):
+    ack = Future()
+    try:
+        ack.set_result(merge(drain()))
+    except Exception:
+        pass  # drained nothing, told nobody — ack stranded forever
+    return None

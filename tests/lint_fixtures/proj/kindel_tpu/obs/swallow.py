@@ -1,0 +1,11 @@
+"""Known-bad: silent swallow in obs/ — the observability plane is how
+every other failure becomes visible, so a trace-collection handler that
+eats an exception without recording it blinds the operator exactly when
+the data mattered (the collector must record_failure or re-raise)."""
+
+
+def collect_or_shrug(collector, drain):
+    try:
+        return collector.add_ndjson("r0", drain())
+    except Exception:
+        return None

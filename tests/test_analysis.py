@@ -362,6 +362,24 @@ def test_fixture_scope_extension_hits_sessions(fixture_results):
     )
 
 
+def test_fixture_scope_extension_hits_obs(fixture_results):
+    """The obs scope extension (PR 18 satellite): the observability
+    plane is covered by the silent-swallow lint (a swallowed
+    trace-collection error blinds the operator exactly when the data
+    mattered) and the future-settlement contract (a leaked collection
+    ack blocks the caller forever) — one known-bad fixture per rule
+    scope."""
+    by_id = {r.spec.id: r for r in fixture_results}
+    assert any(
+        "obs/swallow" in f.path
+        for f in by_id["silent-swallow"].findings
+    )
+    assert any(
+        "obs/leaky_collect" in f.path
+        for f in by_id["future-settlement"].findings
+    )
+
+
 def test_purity_fixture_needs_the_closure(fixture_results):
     """The chained fixture's jit body is clean — only the call-graph
     walk sees the env read two calls deep, which is exactly what the

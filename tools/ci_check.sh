@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# CI gate for the kindel_tpu repo: static hygiene + perf regression.
+#
+#   tools/ci_check.sh            # lint --strict, then perf --gate
+#   tools/ci_check.sh --self-test  # additionally prove the perf gate
+#                                  # FIRES on the committed regressed
+#                                  # fixture (exits nonzero if it
+#                                  # silently passes a known-bad line)
+#
+# Both stages run on CPU (JAX_PLATFORMS=cpu) so the gate is identical
+# on dev boxes and accelerator-less CI runners.
+set -euo pipefail
+
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+cd "$REPO"
+
+export JAX_PLATFORMS=cpu
+
+echo "== kindel lint --strict =="
+python -m kindel_tpu.cli lint --strict
+
+echo "== kindel perf --gate =="
+python -m kindel_tpu.cli perf --gate
+
+if [[ "${1:-}" == "--self-test" ]]; then
+    echo "== perf gate self-test (regressed fixture must FAIL) =="
+    if python -m kindel_tpu.cli perf --gate \
+        --line tools/perfgate_regressed_fixture.json; then
+        echo "self-test FAILED: gate passed a known-regressed line" >&2
+        exit 1
+    fi
+    echo "self-test ok: gate fired on the regressed fixture"
+fi
+
+echo "ci_check: all stages green"
