@@ -592,6 +592,27 @@ def _run_benchmark() -> dict:
         except Exception as e:  # noqa: BLE001
             result["mesh"] = {"error": repr(e)}
 
+    # Pod sweep (kindel_tpu.parallel.meshexec, DESIGN.md §27): the pod
+    # cohort through all three tiers at dp × procs — degraded
+    # single-process pod plans plus an actual localhost 2-process JAX
+    # group — identity asserted against the dp=1 oracle; the `pod`
+    # object reports per-config wall and the cross-process allgather
+    # byte tax (MULTICHIP_r07 records one run). Same gating rule as
+    # the mesh sweep (KINDEL_TPU_BENCH_POD overrides; default-on only
+    # for CPU children). Failure never voids the headline metric.
+    pod_pin = os.environ.get("KINDEL_TPU_BENCH_POD")
+    want_pod = (
+        jax.default_backend() == "cpu" if pod_pin is None
+        else pod_pin not in ("", "0")
+    )
+    if want_pod:
+        try:
+            from benchmarks.pod_sweep import run_pod_sweep
+
+            result["pod"] = run_pod_sweep()
+        except Exception as e:  # noqa: BLE001
+            result["pod"] = {"error": repr(e)}
+
     # Optional serving metrics (KINDEL_TPU_BENCH_SERVE=1): a small
     # closed-loop load run against the in-process service, so rounds can
     # track online throughput / p99 latency / batch occupancy alongside

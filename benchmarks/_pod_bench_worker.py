@@ -1,0 +1,76 @@
+"""Worker for the pod sweep (benchmarks/pod_sweep.py): one process of a
+pod-mesh bench configuration. With ``nprocs=2`` it joins a localhost
+2-process JAX group (4 virtual CPU devices each) exactly like the test
+harness (tests/_dist_pod_worker.py) — the plan builder brings the group
+up from the `--mesh pod:<dp>` knob surface alone; with ``nprocs=1`` it
+runs the degraded single-process plan (the oracle when the spec is
+``1``). Drives all three dispatch tiers through the shared podfixture
+drivers and prints ONE JSON line: wall, digests, and the pod allgather
+byte tax.
+
+Usage:
+  python benchmarks/_pod_bench_worker.py <proc_id> <port> <spec> \
+      <tmpdir> <nprocs> [realign]
+
+(underscore prefix: not collected by pytest)."""
+
+import json
+import os
+import sys
+import time
+
+proc_id = int(sys.argv[1])
+port = int(sys.argv[2])
+spec = sys.argv[3]
+tmpdir = sys.argv[4]
+nprocs = int(sys.argv[5])
+realign = len(sys.argv) > 6 and sys.argv[6] == "realign"
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=" + (
+    "4" if nprocs == 2 else "8"
+)
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+if nprocs == 2:
+    os.environ["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+    os.environ["JAX_NUM_PROCESSES"] = "2"
+    os.environ["JAX_PROCESS_ID"] = str(proc_id)
+os.environ["KINDEL_TPU_MESH"] = spec
+os.environ["KINDEL_TPU_TUNE_CACHE"] = os.path.join(
+    tmpdir, f"proc{proc_id}", "tune.json"
+)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+_here = os.path.dirname(os.path.abspath(__file__))
+_repo = os.path.dirname(_here)
+sys.path.insert(0, _repo)
+sys.path.insert(0, os.path.join(_repo, "tests"))
+
+from tests import podfixture  # noqa: E402
+from kindel_tpu.obs.metrics import default_registry  # noqa: E402
+from kindel_tpu.parallel import meshexec  # noqa: E402
+
+plan = meshexec.plan()
+assert plan.procs == nprocs, f"wanted {nprocs} processes, got {plan}"
+
+t0 = time.perf_counter()
+digests = podfixture.all_digests(
+    os.path.join(tmpdir, f"proc{proc_id}", "sams"), plan,
+    realign=realign,
+)
+wall = time.perf_counter() - t0
+snap = default_registry().snapshot()
+print(json.dumps({
+    "proc": proc_id,
+    "spec": spec,
+    "procs": plan.procs,
+    "dp": plan.dp,
+    "realign": realign,
+    "wall_s": round(wall, 3),
+    "allgather_bytes": int(
+        snap.get("kindel_pod_allgather_bytes_total", 0)
+    ),
+    "digests": digests,
+}), flush=True)

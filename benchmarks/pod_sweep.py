@@ -1,0 +1,148 @@
+"""Pod sweep: the pod-mesh matrix benched — dp × procs, identity asserted.
+
+The pod data plane (kindel_tpu.parallel.meshexec, DESIGN.md §27) spans
+one mesh across every process of a JAX distributed group. This scenario
+runs the fixed pod cohort (tests/podfixture.py — the same drivers the
+byte-identity tests pin) through all three dispatch tiers at each
+configuration:
+
+  * the dp=1 single-device oracle,
+  * degraded single-process pod plans (``pod:2``, ``pod:4``),
+  * an actual localhost 2-process group at dp ∈ {2, 4} (4 virtual CPU
+    devices per process, coordinator + gloo brought up by the plan
+    builder from the `--mesh pod:<dp>` knob surface alone),
+
+and reports per-config wall, the cross-process allgather byte tax
+(`kindel_pod_allgather_bytes_total` — the pod tier's only DCN
+transfer), and whether every configuration's FASTA digests matched the
+oracle (a sweep that silently changed the answer would be worse than
+no sweep). Every configuration runs in a fresh process, so each wall
+includes its own compile — the comparison is config-vs-config, not
+warm-vs-cold. `bench.py` attaches the report as its `pod` object
+(`KINDEL_TPU_BENCH_POD` overrides the CPU-only default);
+`MULTICHIP_r07.json` records one run. The perf gate reads the
+2-process dp=2 tier walls as the `(cpu, pod_dp2)` series.
+
+Standalone:
+
+    python -m benchmarks.pod_sweep
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+#: (spec, procs) sweep points; the first is the byte-identity oracle
+SWEEP = (
+    ("1", 1),
+    ("pod:2", 1),
+    ("pod:4", 1),
+    ("pod:2", 2),
+    ("pod:4", 2),
+)
+
+
+def _run_single(spec: str, tmpdir: str, realign: bool) -> dict:
+    """One single-process configuration in a fresh interpreter (its own
+    jit cache — walls comparable across configs)."""
+    worker = Path(__file__).parent / "_pod_bench_worker.py"
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    argv = [sys.executable, str(worker), "0", "0", spec, tmpdir, "1"]
+    if realign:
+        argv.append("realign")
+    out = subprocess.run(
+        argv, env=env, capture_output=True, text=True, check=True,
+        cwd=str(REPO),
+    )
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def _run_pair(spec: str, tmpdir: str, realign: bool) -> list[dict]:
+    """One 2-process configuration through the shared harness in
+    tests/distfixture.py (port reservation + bind-race retry +
+    cleanup)."""
+    sys.path.insert(0, str(REPO / "tests"))
+    import distfixture
+
+    worker = Path(__file__).parent / "_pod_bench_worker.py"
+    extra = [spec, tmpdir, "2"]
+    if realign:
+        extra.append("realign")
+    outs = distfixture.run_two_process(
+        worker, extra_argv=tuple(extra), timeout=1800,
+    )
+    return [
+        json.loads(out.strip().splitlines()[-1])
+        for _rc, out, _err in outs
+    ]
+
+
+def run_pod_sweep(realign: bool = False, sweep=SWEEP) -> dict:
+    """Run every sweep point; returns {"identical": ..., "configs":
+    [...]} with the oracle first."""
+    tmp = tempfile.TemporaryDirectory(prefix="kindel_pod_sweep_")
+    try:
+        configs: list[dict] = []
+        oracle: dict | None = None
+        identical = True
+        for spec, procs in sweep:
+            sub = os.path.join(
+                tmp.name, f"{spec.replace(':', '_')}_p{procs}"
+            )
+            if procs == 1:
+                recs = [_run_single(spec, sub, realign)]
+            else:
+                recs = _run_pair(spec, sub, realign)
+            entry = {
+                "spec": spec,
+                "procs": procs,
+                "dp": recs[0]["dp"],
+                "wall_s": max(r["wall_s"] for r in recs),
+                "allgather_bytes": sum(
+                    r["allgather_bytes"] for r in recs
+                ),
+                "digests": recs[0]["digests"],
+            }
+            if any(r["digests"] != recs[0]["digests"] for r in recs):
+                identical = False
+                entry["disagreement"] = "workers diverged"
+            if oracle is None:
+                oracle = entry
+            elif entry["digests"] != oracle["digests"]:
+                identical = False
+                entry["disagreement"] = "diverged from oracle"
+            configs.append(entry)
+        for entry in configs:
+            entry.pop("digests", None)
+        return {
+            "realign": realign,
+            "identical": identical,
+            "configs": configs,
+        }
+    finally:
+        tmp.cleanup()
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--realign", action="store_true")
+    args = ap.parse_args(argv)
+    report = run_pod_sweep(realign=args.realign)
+    json.dump(report, sys.stdout, indent=1)
+    print()
+    return 0 if report["identical"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -50,6 +50,7 @@ DOC_ONLY_KNOBS = {
     "KINDEL_TPU_BENCH_RAGGED": "bench.py ragged-scenario opt-in",
     "KINDEL_TPU_BENCH_PAGED": "bench.py paged-scenario opt-in",
     "KINDEL_TPU_BENCH_MESH": "bench.py mesh-sweep opt-in",
+    "KINDEL_TPU_BENCH_POD": "bench.py pod-sweep opt-in",
     "KINDEL_TPU_BENCH_STREAM": "bench.py streaming-scenario opt-in",
 }
 

@@ -352,6 +352,11 @@ def ragged_pack_vectorized(model: ProjectModel):
 DOWNLOAD_SITES = {
     # AOT export parity check blocks on both executables by design
     ("aot.py", "export_executable"),
+    # compat.py reference-shape conversions run on host-resident numpy
+    # Pileup fields — np.asarray there never touches a device buffer
+    # (the module imports jax only for the version shims)
+    ("compat.py", "pileup_to_alignment"),
+    ("compat.py", "pileup_from_reference_arrays"),
     # cohort wire download + realign CDR window fetches (d2h counted)
     ("batch.py", "_assemble_outputs"),
     ("batch.py", "_fetch"),
@@ -392,6 +397,18 @@ DOWNLOAD_SITES = {
     ("parallel/meshexec.py", "mesh_for"),
     ("parallel/meshexec.py", "place_stacked"),
     ("parallel/meshexec.py", "stack_shards"),
+    # pod tier (DESIGN.md §27): put_sharded/replicated normalize HOST
+    # numpy inputs ahead of placement (never a device read);
+    # fetch_global is THE pod output download — the cross-process
+    # allgather, bytes counted on kindel_pod_allgather_bytes_total
+    ("parallel/meshexec.py", "put_sharded"),
+    ("parallel/meshexec.py", "replicated"),
+    ("parallel/meshexec.py", "fetch_global"),
+    # pod-replicated paged admit/clear operands: np.asarray on
+    # host-built offset/patch planes before replication (h2d counted
+    # by the admit counter as always)
+    ("paged/residency.py", "admit"),
+    ("paged/residency.py", "clear"),
     # explicit *_host fetch helpers (named as downloads)
     ("pileup_jax.py", "fetch_counts_host"),
     ("stats_jax.py", "entropy_rows_host"),
@@ -467,6 +484,59 @@ def download_confinement(model: ProjectModel):
                 "with a review",
             ))
     return findings, declared
+
+
+@rule("jax-compat-confinement", min_sites=3)
+def jax_compat_confinement(model: ProjectModel):
+    """The version-sensitive jax multi-host surface — ``jax.shard_map``
+    attribute access, any ``jax.distributed`` attribute access, and
+    imports of ``shard_map``/``jax.distributed`` — may only appear in
+    compat.py, the one version-spanning chokepoint. ``shard_map``
+    graduated out of ``jax.experimental`` and ``jax.distributed`` grew
+    ``is_initialized`` across releases: a raw spelling anywhere else is
+    exactly how the seed's 9 shard_map tests broke on a jax pin. Call
+    sites spell ``compat.shard_map`` / ``compat.distributed_*`` so a
+    jax upgrade touches one file."""
+    findings, compat_sites = [], 0
+    for rel, mod in model.modules.items():
+        is_compat = rel == f"{model.package}/compat.py"
+        for node in ast.walk(mod.tree):
+            hit = None
+            if isinstance(node, ast.Attribute) and isinstance(
+                node.value, ast.Name
+            ) and node.value.id == "jax":
+                if node.attr == "shard_map":
+                    hit = "jax.shard_map"
+                elif node.attr == "distributed":
+                    hit = "jax.distributed"
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name in (
+                        "jax.distributed", "jax.experimental.shard_map"
+                    ):
+                        hit = f"import {a.name}"
+            elif isinstance(node, ast.ImportFrom):
+                m = node.module or ""
+                if m == "jax.experimental.shard_map" or (
+                    m in ("jax", "jax.experimental")
+                    and any(
+                        a.name in ("shard_map", "distributed")
+                        for a in node.names
+                    )
+                ):
+                    hit = f"from {m} import"
+            if hit is None:
+                continue
+            if is_compat:
+                compat_sites += 1
+            else:
+                findings.append(Finding(
+                    "jax-compat-confinement", "error", rel, node.lineno,
+                    f"{hit} outside compat.py — spell it compat.shard_map"
+                    " / compat.distributed_* so the version-spanning "
+                    "surface stays in one file",
+                ))
+    return findings, compat_sites
 
 
 #: handler calls that count as "the failure was handled, not swallowed"

@@ -141,6 +141,14 @@ def runtime_identity() -> dict:
             "backend": jax.default_backend(),
             "device_kind": dev.device_kind.replace(" ", "_"),
             "n_devices": len(jax.devices()),
+            # pod posture folds into every store digest: a program
+            # lowered for a process-spanning mesh is only valid on the
+            # same process count AND per-process device topology
+            # (DESIGN.md §27) — n_devices alone cannot tell 1×8 from 2×4
+            "processes": int(jax.process_count()),
+            "topology": (
+                f"{jax.process_count()}x{len(jax.local_devices())}"
+            ),
             "jax": jax.__version__,
             "jaxlib": jaxlib.__version__,
             "package": _package_version(),
@@ -529,13 +537,18 @@ def cohort_args(arrays, opts, sharding=None) -> tuple:
     under a mesh plan, shardings — `sharding(ndim)` places each
     batch-leading array on the dp axis) or the loaded executable
     rejects its own traffic."""
-    import jax
     import jax.numpy as jnp
 
     if sharding is None:
         dev = tuple(jnp.asarray(a) for a in arrays)
     else:
-        dev = tuple(jax.device_put(a, sharding(a.ndim)) for a in arrays)
+        # the one placement chokepoint: device_put locally, callback
+        # placement on process-spanning (pod) shardings
+        from kindel_tpu.parallel import meshexec
+
+        dev = tuple(
+            meshexec.put_sharded(a, sharding(a.ndim)) for a in arrays
+        )
     return dev + (
         jnp.int32(opts.min_depth),
         jnp.int32(1 if opts.fix_clip_artifacts else 0),

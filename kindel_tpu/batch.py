@@ -472,6 +472,11 @@ def _assemble_outputs(units, device_out, opts: BatchOptions, pool,
     unit, in unit order. `paths` maps sample_idx → input path for the
     report header (required when build_reports)."""
     out, (L_pad, d_pad, i_pad) = device_out
+    # pod-mesh results span processes: land them on host first (the
+    # measured allgather wire tax); classic results pass through
+    from kindel_tpu.parallel import meshexec
+
+    out = meshexec.fetch_global(out)
     if opts.realign:
         wire, *dense = out
     else:

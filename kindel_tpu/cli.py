@@ -162,12 +162,13 @@ def _consensus_parser(sub):
              "winner). Applies to the fast (no-changes) path",
     )
     p.add_argument(
-        "--mesh", type=int, default=None, metavar="N",
-        help="device-mesh width: fan the call across up to N local "
-             "devices (1 pins single-device; top of the explicit > "
-             "$KINDEL_TPU_MESH > tune store > all-local-devices order; "
-             "`kindel tune --mesh-budget-s` measures a winner). "
-             "Byte-identical output at every width",
+        "--mesh", type=str, default=None, metavar="SPEC",
+        help="device-mesh spec: '<dp>' fans the call across up to dp "
+             "local devices (1 pins single-device); 'pod' / 'pod:<dp>' "
+             "spans every process of the JAX group (DESIGN.md §27). "
+             "Top of the explicit > $KINDEL_TPU_MESH > tune store > "
+             "all-local-devices order; `kindel tune --mesh-budget-s` "
+             "measures a winner. Byte-identical output at every width",
     )
     _add_backend(p)
 
@@ -518,13 +519,15 @@ def _serve_parser(sub):
              "> host",
     )
     p.add_argument(
-        "--mesh", type=int, default=None, metavar="N",
-        help="per-replica device-mesh width: every dispatch tier "
-             "(lanes, ragged, paged) fans one flush across up to N "
-             "local devices (kindel_tpu.parallel.meshexec, DESIGN.md "
-             "§23). 1 pins single-device; top of the explicit > "
-             "$KINDEL_TPU_MESH > tune store > all-local-devices order. "
-             "Byte-identical output at every width",
+        "--mesh", type=str, default=None, metavar="SPEC",
+        help="per-replica device-mesh spec: every dispatch tier "
+             "(lanes, ragged, paged) fans one flush across up to "
+             "'<dp>' local devices (kindel_tpu.parallel.meshexec, "
+             "DESIGN.md §23); 'pod' / 'pod:<dp>' spans every process "
+             "of the JAX group as ONE program (DESIGN.md §27). 1 pins "
+             "single-device; top of the explicit > $KINDEL_TPU_MESH > "
+             "tune store > all-local-devices order. Byte-identical "
+             "output at every width",
     )
     p.add_argument(
         "--replicas", type=int, default=1, metavar="N",

@@ -1,11 +1,23 @@
-"""Reference-shaped compatibility API.
+"""Compatibility layer: the reference-shaped API and the jax
+version-spanning shims.
 
-Exposes the dense kindel-tpu tensors through the exact object shapes the
-reference's public Python API returns — `parse_bam(path)` yielding an
-OrderedDict of 12-field `alignment` namedtuples whose weights are lists of
-{"A","T","G","C","N"} dicts (/root/reference/kindel/kindel.py:97-128,
-131-153) — so code (and tests) written against the reference run unmodified
-against this framework.
+Two compatibility surfaces live here, both "one spelling everywhere":
+
+* **Reference shapes** — the dense kindel-tpu tensors exposed through
+  the exact object shapes the reference's public Python API returns —
+  `parse_bam(path)` yielding an OrderedDict of 12-field `alignment`
+  namedtuples whose weights are lists of {"A","T","G","C","N"} dicts
+  (/root/reference/kindel/kindel.py:97-128, 131-153) — so code (and
+  tests) written against the reference run unmodified.
+
+* **jax version shims** — the multi-host surface moved between jax
+  releases (`jax.shard_map` graduated from `jax.experimental.shard_map`
+  after 0.4.x; `jax.distributed.is_initialized` does not exist on the
+  pinned 0.4.37). Every module spells them `compat.shard_map` /
+  `compat.distributed_is_initialized()` / `compat.distributed_initialize()`
+  — raw `jax.shard_map` / `jax.distributed` attribute access anywhere
+  else is a lint error (analysis rule ``jax-compat-confinement``), so a
+  jax upgrade touches exactly this file.
 """
 
 from __future__ import annotations
@@ -14,9 +26,85 @@ from collections import OrderedDict, defaultdict, namedtuple
 
 import numpy as np
 
+import jax
+
 from kindel_tpu.events import BASES, N_CHANNELS, extract_events
 from kindel_tpu.io import load_alignment
 from kindel_tpu.pileup import InsertionTable, Pileup, build_pileups
+
+# --------------------------------------------------------------------------
+# jax version shims (the multi-host surface)
+# --------------------------------------------------------------------------
+
+try:  # jax >= 0.5: the stable top-level spelling
+    from jax import shard_map as shard_map  # noqa: F401  (re-export)
+except ImportError:  # pinned 0.4.x: the experimental home
+    from jax.experimental.shard_map import shard_map as shard_map  # noqa: F401
+
+
+def axis_size(axis_name):
+    """``jax.lax.axis_size`` across versions: absent on 0.4.x, where
+    ``lax.psum(1, axis)`` is the canonical (constant-folded) spelling
+    inside a mapped body."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def distributed_is_initialized() -> bool:
+    """``jax.distributed.is_initialized()`` across jax versions.
+
+    0.4.x has no public predicate; the client handle on the runtime's
+    distributed ``global_state`` is the documented-by-source equivalent
+    (``jax._src.distributed.global_state.client`` is set by
+    ``initialize()`` and cleared by ``shutdown()``). Falls back to False
+    when even the private surface is missing — "no process group" is
+    always a safe answer for a predicate that gates multi-host setup."""
+    dist = jax.distributed
+    if hasattr(dist, "is_initialized"):
+        return bool(dist.is_initialized())
+    try:
+        from jax._src import distributed as _distributed
+
+        return getattr(_distributed.global_state, "client", None) is not None
+    except (ImportError, AttributeError):
+        return False
+
+
+def distributed_initialize(*args, **kwargs):
+    """``jax.distributed.initialize`` behind the one compat chokepoint
+    (same signature, all versions) — callers never touch
+    ``jax.distributed`` attributes directly."""
+    return jax.distributed.initialize(*args, **kwargs)
+
+
+def ensure_cpu_collectives() -> None:
+    """Give XLA:CPU a cross-process collectives implementation.
+
+    The CPU backend refuses multi-process computations outright
+    ("Multiprocess computations aren't implemented on the CPU backend")
+    unless ``jax_cpu_collectives_implementation`` selects one; gloo is
+    the one bundled with jaxlib. Must run BEFORE the process group (and
+    backend) initialize, which is why `initialize_distributed` calls it
+    ahead of the coordinator handshake. A jax build without the option,
+    or an already-initialized backend, degrades to a no-op — TPU/GPU
+    groups never needed it."""
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # unknown option / backend already up: leave as-is
+        pass
+
+
+def process_count() -> int:
+    """``jax.process_count()`` — stable across versions; re-exported so
+    pod-plan call sites read their whole multi-host vocabulary from
+    compat."""
+    return int(jax.process_count())
+
+
+def process_index() -> int:
+    """``jax.process_index()`` — see `process_count`."""
+    return int(jax.process_index())
 
 alignment = namedtuple(
     "alignment",

@@ -47,6 +47,7 @@ from kindel_tpu.fleet.router import (  # noqa: F401
     FleetRouter,
     rendezvous_score,
     routing_key,
+    weighted_rendezvous_score,
 )
 from kindel_tpu.fleet.rpc import (  # noqa: F401
     RpcServerAdapter,
@@ -56,6 +57,7 @@ from kindel_tpu.fleet.rpc import (  # noqa: F401
 from kindel_tpu.fleet.service import (  # noqa: F401
     FleetService,
     parse_replica_addrs,
+    parse_replica_roster,
     static_fleet,
 )
 from kindel_tpu.fleet.supervisor import (  # noqa: F401
@@ -74,9 +76,11 @@ __all__ = [
     "RpcServiceClient",
     "RpcTransportError",
     "parse_replica_addrs",
+    "parse_replica_roster",
     "rendezvous_score",
     "routing_key",
     "static_fleet",
+    "weighted_rendezvous_score",
 ]
 
 

@@ -56,11 +56,16 @@ class Replica:
     """One supervised service instance inside a FleetService."""
 
     def __init__(self, replica_id: str, factory,
-                 probe_policy_factory=ProbePolicy):
+                 probe_policy_factory=ProbePolicy, capacity: int = 1):
         self.replica_id = replica_id
         self._factory = factory
         self._probe_policy_factory = probe_policy_factory
         self._probe_policy = probe_policy_factory()
+        #: placement weight for the router's capacity-weighted
+        #: rendezvous (DESIGN.md §27): 1 for a single-process replica,
+        #: the process count for a pod group behind one front — the
+        #: group is one big replica, not `procs` small ones
+        self.capacity = max(1, int(capacity))
         self.service = None
         self.generation = 0
         self._state = "starting"
@@ -226,6 +231,7 @@ class Replica:
             "generation": self.generation,
             "inflight": self.inflight_count,
             "queue_depth": self.queue_depth,
+            "capacity": self.capacity,
         }
         if self._last_probe_error is not None:
             doc["last_probe_error"] = self._last_probe_error

@@ -46,6 +46,7 @@ never arrays.
 from __future__ import annotations
 
 import hashlib
+import math
 import threading
 from concurrent.futures import Future, InvalidStateError
 
@@ -96,6 +97,20 @@ def rendezvous_score(key: str, replica_id: str) -> int:
         f"{key}|{replica_id}".encode(), digest_size=8
     ).digest()
     return int.from_bytes(digest, "big")
+
+
+def weighted_rendezvous_score(key: str, replica_id: str,
+                              capacity: int = 1) -> float:
+    """Capacity-weighted rendezvous (the logarithmic method): map the
+    64-bit digest to u ∈ (0, 1) and score ``-capacity / ln(u)``. At
+    capacity 1 this is a strictly monotone transform of the classic
+    score — equal-capacity fleets rank exactly as before — and a pod
+    group registered as one capacity-``k`` replica (DESIGN.md §27)
+    wins a fraction k/(k + peers) of the keyspace, i.e. the group is
+    one big replica and its share scales with the processes behind
+    it."""
+    u = (rendezvous_score(key, replica_id) + 0.5) / 2.0 ** 64
+    return -float(max(1, capacity)) / math.log(u)
 
 
 class _Ticket:
@@ -157,7 +172,9 @@ class FleetRouter:
         ranked = sorted(
             (r for r in list(self.replicas)
              if r.admitting and r.replica_id not in exclude),
-            key=lambda r: rendezvous_score(key, r.replica_id),
+            key=lambda r: weighted_rendezvous_score(
+                key, r.replica_id, getattr(r, "capacity", 1)
+            ),
             reverse=True,
         )
         return (

@@ -48,24 +48,47 @@ from kindel_tpu.obs.metrics import (
 from kindel_tpu.resilience.policy import ProbePolicy
 
 
-def parse_replica_addrs(spec) -> list:
-    """``host:port,host:port,...`` → [(host, port), ...] — the
-    `--replica-addrs` grammar. Accepts a pre-split sequence too."""
+def parse_replica_roster(spec) -> list:
+    """``host:port[*capacity],...`` → [(host, port, capacity), ...] —
+    the full `--replica-addrs` grammar. The optional ``*capacity``
+    suffix declares a POD GROUP behind one front (DESIGN.md §27): the
+    address is the group's coordinator process, the capacity its
+    process count, and the router's capacity-weighted rendezvous sends
+    it that many single-process replicas' worth of keyspace. Accepts a
+    pre-split sequence too."""
     if isinstance(spec, str):
         parts = [p.strip() for p in spec.split(",") if p.strip()]
     else:
         parts = [str(p).strip() for p in spec if str(p).strip()]
-    addrs = []
+    roster = []
     for part in parts:
-        host, sep, port = part.rpartition(":")
+        addr, _sep, cap = part.partition("*")
+        host, sep, port = addr.rpartition(":")
         if not sep or not host:
             raise ValueError(
-                f"bad replica address {part!r} (want host:port)"
+                f"bad replica address {part!r} "
+                "(want host:port or host:port*capacity)"
             )
-        addrs.append((host, int(port)))
-    if not addrs:
+        try:
+            capacity = int(cap) if cap else 1
+            if capacity < 1:
+                raise ValueError
+        except ValueError:
+            raise ValueError(
+                f"bad replica capacity in {part!r} "
+                "(want a positive process count after '*')"
+            ) from None
+        roster.append((host, int(port), capacity))
+    if not roster:
         raise ValueError("no replica addresses given")
-    return addrs
+    return roster
+
+
+def parse_replica_addrs(spec) -> list:
+    """``host:port,host:port,...`` → [(host, port), ...] — the
+    address-only view of `parse_replica_roster` (pod capacities
+    dropped), kept as the stable surface for address-only callers."""
+    return [(h, p) for h, p, _cap in parse_replica_roster(spec)]
 
 
 def static_fleet(addrs, *, rpc_timeout_ms=None, **fleet_kwargs):
@@ -80,13 +103,13 @@ def static_fleet(addrs, *, rpc_timeout_ms=None, **fleet_kwargs):
     with the RPC adapter routes) and joins the fleet today.
 
     Autoscaling is refused — the roster is the capacity."""
-    addrs = parse_replica_addrs(addrs)
+    roster = parse_replica_roster(addrs)
     if fleet_kwargs.get("min_replicas") or fleet_kwargs.get("max_replicas"):
         raise ValueError(
             "a static roster cannot autoscale: the fleet can neither "
             "spawn a new remote machine nor retire one it did not spawn"
         )
-    by_index = {f"r{i}": addr for i, addr in enumerate(addrs)}
+    by_index = {f"r{i}": (h, p) for i, (h, p, _c) in enumerate(roster)}
 
     def attach_factory(rid, registry):
         from kindel_tpu.fleet.rpc import RpcServiceClient
@@ -103,7 +126,8 @@ def static_fleet(addrs, *, rpc_timeout_ms=None, **fleet_kwargs):
         )
 
     return FleetService(
-        replicas=len(addrs), service_factory=attach_factory,
+        replicas=len(roster), service_factory=attach_factory,
+        replica_capacities=[c for _h, _p, c in roster],
         **fleet_kwargs,
     )
 
@@ -126,6 +150,7 @@ class FleetService:
                  slo: str | None = None,
                  trace_collect: str | None = None,
                  trace_buffer: int | None = None,
+                 replica_capacities: list | None = None,
                  **service_kwargs):
         """`service_kwargs` are ConsensusService knobs applied to every
         replica (max_batch_rows, max_wait_s, warmup, consensus opts,
@@ -143,6 +168,12 @@ class FleetService:
         it; resolved through kindel_tpu.tune)."""
         if replicas < 1:
             raise ValueError("a fleet needs at least one replica")
+        if replica_capacities is not None \
+                and len(replica_capacities) != replicas:
+            raise ValueError(
+                f"replica_capacities has {len(replica_capacities)} "
+                f"entries for {replicas} replicas"
+            )
         self._service_kwargs = dict(service_kwargs)
         self._service_kwargs["http_port"] = None
         self._service_factory = service_factory
@@ -161,7 +192,9 @@ class FleetService:
                                          service_factory)
             self.replicas.append(
                 Replica(rid, factory,
-                        probe_policy_factory=probe_policy_factory)
+                        probe_policy_factory=probe_policy_factory,
+                        capacity=(replica_capacities[i]
+                                  if replica_capacities else 1))
             )
         self._next_index = replicas
         self._by_id = {r.replica_id: r for r in self.replicas}

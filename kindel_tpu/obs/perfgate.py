@@ -128,6 +128,36 @@ def ingest_doc(store: HistoryStore, doc, source: str) -> None:
             return
         store.samples.append(sample)
         return
+    if isinstance(doc.get("pod"), dict):
+        # MULTICHIP pod sweep (DESIGN.md §27): the 2-process legs'
+        # sweep throughput as (backend, pod_dp<dp>) series — each
+        # config runs the fixed pod cohort in a fresh process, so
+        # 1/wall is proportional to end-to-end throughput incl. the
+        # cross-process allgather tax; the 0.35 default tolerance
+        # absorbs the CPU-fallback noise like every other cpu series
+        backend = normalize_backend(doc.get("backend"))
+        added = False
+        for row in (doc["pod"].get("configs") or []):
+            wall = (row or {}).get("wall_s")
+            if row.get("procs") != 2 or \
+                    not isinstance(wall, (int, float)) or wall <= 0:
+                continue
+            store.samples.append(
+                PerfSample(
+                    series=f"pod_dp{row.get('dp')}",
+                    backend=backend,
+                    value=round(1.0 / float(wall), 4),
+                    unit="sweeps_per_s",
+                    source=name,
+                    round=round_no,
+                )
+            )
+            added = True
+        if not added:
+            store.skipped.append(
+                (name, "pod sweep without a 2-process wall")
+            )
+        return
     if "ragged" in doc or "paged" in doc:
         # MULTICHIP mesh sweep: occupancy per lane width as SLI series
         backend = normalize_backend(doc.get("backend"))

@@ -325,6 +325,7 @@ class DeviceResidency:
         #: admission patches update the owning shard in place and the
         #: launch runs the vmapped sharded kernel; 1 = classic layout
         self.mesh_dp = 1
+        self.mesh_plan = None
         if (
             mesh_plan is not None
             and getattr(mesh_plan, "active", False)
@@ -333,8 +334,11 @@ class DeviceResidency:
             from kindel_tpu.parallel import meshexec
 
             self.mesh_dp = meshexec.paged_dp(
-                page_class, page_slots, mesh_plan.dp
+                page_class, page_slots, mesh_plan.dp,
+                procs=getattr(mesh_plan, "procs", 1),
             )
+            if self.mesh_dp > 1:
+                self.mesh_plan = mesh_plan
         self._state: tuple | None = None
         self._stale = False
         self._broken = False
@@ -362,6 +366,28 @@ class DeviceResidency:
 
     def _shard_of(self, seg) -> int:
         return seg.page0 // self.pages_per_shard
+
+    def _placement(self):
+        """What `place_stacked` builds the state mesh from: the pod
+        plan narrowed to this pool's width when one is active, else the
+        classic local width."""
+        if self.mesh_plan is not None:
+            return self.mesh_plan.narrow(self.mesh_dp)
+        return self.mesh_dp
+
+    def _dev(self, a):
+        """One small operand (patch / offsets / shard id / scalar) on
+        the launch mesh: replicated over the pod mesh when the state
+        spans processes (a process-local array mixed into a
+        process-spanning program is a dispatch error), plain
+        `jnp.asarray` otherwise."""
+        if self.mesh_plan is not None:
+            from kindel_tpu.parallel import meshexec
+
+            return meshexec.replicated(
+                a, self.mesh_plan.narrow(self.mesh_dp), self.mesh_dp
+            )
+        return jnp.asarray(a)
 
     def sub_geometry(self):
         """The per-shard kernel geometry of a mesh-resident launch."""
@@ -472,7 +498,7 @@ class DeviceResidency:
             ]
             h2d, _admit_h2d = self._counters()
             h2d.inc(sum(int(a.nbytes) for a in host))
-            self._state = meshexec.place_stacked(self.mesh_dp, host)
+            self._state = meshexec.place_stacked(self._placement(), host)
             self._stale = False
             self._overflow.clear()
             return
@@ -620,10 +646,10 @@ class DeviceResidency:
                     parts.append(pad32(b[keep], pc, 0))
             parts.append(self._table_patch(pool, shard))
             patch = np.concatenate(parts)
-            offs = jnp.asarray(
+            offs = np.asarray(
                 [local["span"], local["ev"] // 2, local["del"],
                  local["ins"], local["clip"]],
-                jnp.int32,
+                np.int32,
             )
             h2d, admit_h2d = self._counters()
             h2d.inc(int(patch.nbytes))
@@ -632,8 +658,8 @@ class DeviceResidency:
             if self.mesh_dp > 1:
                 self._state = self._run_kernel(
                     _patch_state_mesh, _patch_state_mesh_donated,
-                    self._state, jnp.asarray(patch), offs,
-                    jnp.int32(shard), sizes=sizes,
+                    self._state, self._dev(patch), self._dev(offs),
+                    self._dev(np.int32(shard)), sizes=sizes,
                 )
             else:
                 self._state = self._run_kernel(
@@ -666,10 +692,10 @@ class DeviceResidency:
         try:
             sizes = self._sizes_for(seg)
             shard, local, _s0 = self._local(seg)
-            offs = jnp.asarray(
+            offs = np.asarray(
                 [local["span"], local["ev"] // 2, local["del"],
                  local["ins"], local["clip"]],
-                jnp.int32,
+                np.int32,
             )
             tab = self._table_patch(pool, shard)
             h2d, admit_h2d = self._counters()
@@ -677,8 +703,8 @@ class DeviceResidency:
             if self.mesh_dp > 1:
                 self._state = self._run_kernel(
                     _clear_state_mesh, _clear_state_mesh_donated,
-                    self._state, jnp.asarray(tab), offs,
-                    jnp.int32(shard), sizes=sizes,
+                    self._state, self._dev(tab), self._dev(offs),
+                    self._dev(np.int32(shard)), sizes=sizes,
                     quota=(self.quotas[0], self.quotas[1]),
                 )
             else:
@@ -797,8 +823,19 @@ class DeviceResidency:
 
             sub = self.sub_geometry()
             opp, epp, *_rest = self.quotas
-            n_ev = jnp.full((self.mesh_dp,), epp * self.pages_per_shard,
-                            jnp.int32)
+            if self.mesh_plan is not None:
+                # pod state: the ride-along operands must be global too
+                (n_ev,) = meshexec.place_stacked(
+                    self._placement(),
+                    [np.full((self.mesh_dp,),
+                             epp * self.pages_per_shard, np.int32)],
+                )
+                scalars = tuple(self._dev(s) for s in scalars)
+            else:
+                n_ev = jnp.full(
+                    (self.mesh_dp,), epp * self.pages_per_shard,
+                    jnp.int32,
+                )
             dev = st[:6] + (st[-2], st[-1], n_ev) + scalars
             if self.realign:
                 dev = dev + st[6:10]

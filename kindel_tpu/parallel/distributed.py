@@ -28,6 +28,7 @@ import numpy as np
 import jax
 from jax.sharding import Mesh
 
+from kindel_tpu import compat
 from kindel_tpu.parallel.mesh import make_mesh
 
 __all__ = ["initialize_distributed", "make_global_mesh"]
@@ -50,7 +51,7 @@ def initialize_distributed(
     cluster metadata itself (not the default: the probe can fail or stall
     on plain CPU hosts and single tunneled chips). Safe to call twice: a
     second call with a live group is a no-op."""
-    if jax.distributed.is_initialized():
+    if compat.distributed_is_initialized():
         return jax.process_count() > 1
 
     coordinator_address = coordinator_address or os.environ.get(
@@ -69,7 +70,8 @@ def initialize_distributed(
         if not auto_detect:
             # no cluster context advertised anywhere → single process
             return False
-        jax.distributed.initialize()  # cluster auto-detection
+        compat.ensure_cpu_collectives()
+        compat.distributed_initialize()  # cluster auto-detection
         return jax.process_count() > 1
 
     # partially-specified cluster config must fail loudly here, not
@@ -97,7 +99,8 @@ def initialize_distributed(
             "single-process)"
         )
 
-    jax.distributed.initialize(
+    compat.ensure_cpu_collectives()
+    compat.distributed_initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id,

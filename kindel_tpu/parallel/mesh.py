@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from kindel_tpu import compat
 from kindel_tpu.events import N_CHANNELS, BASES
 from kindel_tpu.pileup_jax import PAD_POS, _bucket, _pad, check_pad_safe_block
 
@@ -103,7 +104,7 @@ def _local_call(match_pos, match_base, del_pos, ins_pos, ins_cnt, min_depth,
 
     # halo: neighbor's first element becomes this shard's lookahead at its
     # last position; the final shard's lookahead past L is 0 (:406-410)
-    n = jax.lax.axis_size(axis)
+    n = compat.axis_size(axis)
     idx = jax.lax.axis_index(axis)
     recv = jax.lax.ppermute(
         acgt_depth[:1], axis, [((i + 1) % n, i) for i in range(n)]
@@ -134,7 +135,7 @@ def _sharded_call_jit(match_pos, match_base, del_pos, ins_pos, ins_cnt,
                       min_depth, *, mesh: Mesh, block: int, axis: str):
     fn = partial(_local_call, block=block, axis=axis)
     ev_spec = P(axis, None)  # [n_shards, E] event buckets
-    mapped = jax.shard_map(
+    mapped = compat.shard_map(
         lambda mp, mb, dp, ip, ic, md: tuple(
             x[None] for x in fn(mp[0], mb[0], dp[0], ip[0], ic[0], md)
         ),
@@ -225,7 +226,7 @@ def _batched_call_jit(match_pos, match_base, del_pos, ins_pos, ins_cnt,
         return (w[:, None], bc[:, None], dm[:, None], nm[:, None], im[:, None])
 
     ev_spec = P("dp", "sp", None)
-    mapped = jax.shard_map(
+    mapped = compat.shard_map(
         local,
         mesh=mesh,
         in_specs=(ev_spec,) * 5 + (P(),),

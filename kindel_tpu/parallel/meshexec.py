@@ -104,36 +104,122 @@ def _plan_info():
     return _PLAN_INFO
 
 
+_POD_INFO = None
+_POD_FETCH = None
+
+
+def _pod_info():
+    """The resolved pod-plan Info metric (dp + procs + source) — only
+    stamped when a pod plan actually spans processes."""
+    global _POD_INFO
+    if _POD_INFO is None:
+        from kindel_tpu.obs.metrics import default_registry
+
+        _POD_INFO = default_registry().info(
+            "kindel_pod_plan",
+            "resolved pod mesh posture (dp, process count, source)",
+        )
+    return _POD_INFO
+
+
+def _pod_fetch_counter():
+    """Bytes allgathered off process-spanning launch results — the pod
+    tier's one DCN wire tax (`fetch_global`), kept separate from the
+    d2h transfer counter so bench and /metrics can price it alone."""
+    global _POD_FETCH
+    if _POD_FETCH is None:
+        from kindel_tpu.obs.metrics import default_registry
+
+        _POD_FETCH = default_registry().counter(
+            "kindel_pod_allgather_bytes_total",
+            "bytes fetched cross-process off pod-mesh launch results",
+        )
+    return _POD_FETCH
+
+
 @dataclass(frozen=True)
 class MeshPlan:
     """One replica's resolved device-mesh plan. ``dp == 1`` means the
     exact pre-mesh single-device dispatch everywhere (no mesh object,
-    no shardings, no new jit keys)."""
+    no shardings, no new jit keys). ``procs > 1`` is the POD tier
+    (DESIGN.md §27): the dp axis spans every process of the JAX group
+    — each process contributes ``dp / procs`` local devices, shard
+    blocks stay process-local (the zero-collective rule carries over
+    verbatim), and only the OUTPUT fetch crosses DCN (the measured
+    allgather wire tax, `fetch_global`)."""
 
     dp: int
     source: str
+    procs: int = 1
+    proc_id: int = 0
 
     @property
     def active(self) -> bool:
         return self.dp > 1
 
+    @property
+    def pod(self) -> bool:
+        return self.procs > 1
+
     def key(self) -> int:
-        """The AOT-signature mesh dimension."""
+        """The AOT-signature mesh dimension (the pod keying rides in
+        `aot.runtime_identity` — process_count/topology fold into every
+        store digest, so a pod program never collides with a
+        single-process one even at equal dp)."""
         return int(self.dp)
 
+    def narrow(self, dp: int) -> "MeshPlan":
+        """This plan at a narrower width (a flush whose row/page count
+        cannot fill the full dp). A width that no longer tiles the
+        process group drops to the classic local plan — every process
+        then runs the same single-device program redundantly (SPMD:
+        identical inputs, identical outputs)."""
+        dp = int(dp)
+        if self.procs > 1 and dp % self.procs:
+            return MeshPlan(dp=dp, source=self.source)
+        return MeshPlan(dp=dp, source=self.source, procs=self.procs,
+                        proc_id=self.proc_id)
+
     def mesh_for(self, dp: int) -> Mesh:
-        devices = np.asarray(jax.devices()[:dp])
-        return Mesh(devices, (DP_AXIS,))
+        if self.procs <= 1:
+            devices = np.asarray(jax.devices()[:dp])
+            return Mesh(devices, (DP_AXIS,))
+        # pod tier: dp/procs devices from EVERY process, grouped so each
+        # process's shard blocks are contiguous on the axis (shard k
+        # belongs to process k // (dp/procs) — `owning_process` below)
+        per = dp // self.procs
+        by_proc: dict[int, list] = {}
+        for d in jax.devices():
+            by_proc.setdefault(int(d.process_index), []).append(d)
+        picked = []
+        for pid in sorted(by_proc):
+            picked.extend(by_proc[pid][:per])
+        return Mesh(np.asarray(picked), (DP_AXIS,))
+
+    def owning_process(self, shard: int, dp: int) -> int:
+        """Which process owns shard ``shard`` of a width-``dp`` launch
+        (the contiguous grouping `mesh_for` lays out)."""
+        if self.procs <= 1:
+            return 0
+        return int(shard) // (int(dp) // self.procs)
 
     # ------------------------------------------------------ cohort rows
 
     def row_dp(self, n_rows: int) -> int:
         """Effective row-sharding width for one cohort flush: the plan
         width clamped to the row count (a 2-row flush on an 8-chip mesh
-        shards 2-wide; the caller pads rows to a dp multiple)."""
+        shards 2-wide; the caller pads rows to a dp multiple). Under a
+        pod plan the width additionally floors to a procs multiple —
+        every process must own whole shard blocks — or drops to 1
+        (redundant local dispatch, still byte-identical)."""
         if not self.active or n_rows <= 1:
             return 1
-        return min(self.dp, int(n_rows))
+        dp = min(self.dp, int(n_rows))
+        if self.procs > 1:
+            dp = (dp // self.procs) * self.procs
+            if dp < self.procs:
+                return 1
+        return dp
 
     def pad_rows(self, n_rows: int) -> int:
         """Round a padded row count up to a row_dp multiple so the
@@ -163,28 +249,49 @@ def visible_devices() -> int:
     return len(jax.devices())
 
 
-def plan(explicit: int | None = None) -> MeshPlan:
-    """Build this replica's MeshPlan: resolve the width knob
+def plan(explicit: int | str | None = None) -> MeshPlan:
+    """Build this replica's MeshPlan: resolve the mesh spec
     (kindel_tpu.tune — explicit > env > store > all-local-devices
-    default), clamp it to the devices actually visible, and honor the
-    documented single-chip pin. The result is stamped on the
-    ``kindel_mesh_plan`` Info metric so /metrics and bench both show
-    the serving mesh posture."""
+    default; ``pod``/``pod:<dp>`` specs request the cross-process
+    tier), clamp it to the devices (and processes) actually visible,
+    and honor the documented single-chip pin. A pod spec brings the JAX
+    process group up first (`parallel.distributed`, a no-op when no
+    cluster context is advertised — the plan then degrades to the
+    classic local tier). The result is stamped on the
+    ``kindel_mesh_plan`` / ``kindel_pod_plan`` Info metrics so /metrics
+    and bench both show the serving mesh posture."""
     import os
 
     from kindel_tpu import tune
 
-    requested, source = tune.resolve_mesh_dp(explicit)
+    spec = tune.resolve_mesh_spec(explicit)
     if os.environ.get("KINDEL_TPU_FORCE_FUSED"):
         # README: "benchmark one chip in isolation" — the pin outranks
         # every resolution source, exactly as it does in batch/workloads
         p = MeshPlan(dp=1, source="forced-single")
         _plan_info().set(dp="1", source=p.source)
         return p
-    n_dev = visible_devices()
-    dp = n_dev if requested is None else min(int(requested), n_dev)
-    p = MeshPlan(dp=max(1, dp), source=source)
+    procs, proc_id = 1, 0
+    if spec.pod:
+        from kindel_tpu import compat
+        from kindel_tpu.parallel.distributed import initialize_distributed
+
+        if initialize_distributed():
+            procs = compat.process_count()
+            proc_id = compat.process_index()
+    n_dev = visible_devices()  # GLOBAL device count once the group is up
+    dp = n_dev if spec.dp is None else min(int(spec.dp), n_dev)
+    if procs > 1:
+        # every process contributes dp/procs local devices: floor dp to
+        # a procs multiple, capped by the local pool (the narrowest
+        # process bounds the pod — homogeneous by the SPMD contract)
+        per = max(1, min(dp // procs, len(jax.local_devices())))
+        dp = per * procs
+    p = MeshPlan(dp=max(1, dp), source=spec.source, procs=procs,
+                 proc_id=proc_id)
     _plan_info().set(dp=str(p.dp), source=p.source)
+    if p.pod:
+        _pod_info().set(dp=str(p.dp), procs=str(p.procs), source=p.source)
     return p
 
 
@@ -192,20 +299,24 @@ def plan(explicit: int | None = None) -> MeshPlan:
 # Ragged tier: page-aligned slot-axis sharding via dp sub-superbatches
 # --------------------------------------------------------------------------
 
-def ragged_dp(page_class, dp: int, n_units: int | None = None) -> int:
+def ragged_dp(page_class, dp: int, n_units: int | None = None,
+              procs: int = 1) -> int:
     """Largest mesh width ``d ≤ dp`` the class's slot axis shards to,
     page-aligned: ``d`` must divide the class's rows so each shard is a
     whole-page-run block (rows/d × length slots — a multiple of the
     class length, hence of the 8-slot granule and of every per-page
     wire plane boundary). With fewer units than shards a narrower width
-    is used (an empty shard packs nothing)."""
+    is used (an empty shard packs nothing). Under a pod plan (``procs``
+    > 1) the width must also be a procs multiple — each process owns
+    whole shard blocks — else the flush drops to 1 (redundant local
+    dispatch)."""
     if dp <= 1:
         return 1
     cap = min(int(dp), int(page_class.rows))
     if n_units is not None:
         cap = min(cap, max(1, int(n_units)))
     for d in range(cap, 1, -1):
-        if page_class.rows % d == 0:
+        if page_class.rows % d == 0 and d % max(1, int(procs)) == 0:
             return d
     return 1
 
@@ -229,6 +340,15 @@ class ShardedSuperbatch:
     groups: list  # per-shard unit lists
     orders: list  # per-shard original unit indices
     tables: list  # per-shard SegmentTable (sub-class geometry)
+    plan: MeshPlan | None = None  # pod-aware placement mesh (None=local)
+
+    def placement(self):
+        """What `place_stacked` should build the mesh from: the narrow
+        plan (pod-spanning when the flush width still tiles the
+        process group) or the classic bare width."""
+        if self.plan is not None:
+            return self.plan.narrow(self.dp)
+        return self.dp
 
     @property
     def payload_slots(self) -> int:
@@ -253,7 +373,8 @@ def shard_superbatch(units, page_class, plan_: MeshPlan,
     single-device superbatch, byte-identically."""
     from kindel_tpu.ragged import pack as rpack
 
-    d = ragged_dp(page_class, plan_.dp, n_units=len(units))
+    d = ragged_dp(page_class, plan_.dp, n_units=len(units),
+                  procs=plan_.procs)
     if d <= 1:
         return None
     sub = sub_class(page_class, d)
@@ -281,7 +402,7 @@ def shard_superbatch(units, page_class, plan_: MeshPlan,
     tables = [rpack.build_segment_table(g, sub) for g in groups]
     return ShardedSuperbatch(
         page_class=page_class, sub=sub, dp=d,
-        groups=groups, orders=idxs, tables=tables,
+        groups=groups, orders=idxs, tables=tables, plan=plan_,
     )
 
 
@@ -324,10 +445,63 @@ def stack_shards(per_shard_arrays) -> tuple:
     )
 
 
+def put_sharded(a, sharding):
+    """Place ONE host array under ``sharding`` — the single placement
+    chokepoint of every dispatch tier. `jax.device_put` where every
+    shard is locally addressable; on a process-spanning (pod) sharding
+    — which device_put cannot place — each process hands its own
+    devices exactly their blocks via `make_array_from_callback` (the
+    SPMD contract: every process holds the same global host array)."""
+    if getattr(sharding, "is_fully_addressable", True):
+        return jax.device_put(a, sharding)
+    arr = np.asarray(a)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx]
+    )
+
+
+def replicated(a, plan_: MeshPlan, dp: int):
+    """A small operand replicated over the plan's width-``dp`` mesh —
+    scalars and delta patches riding next to pod-sharded state must be
+    global arrays too (a process-local array mixed into a
+    process-spanning program is a dispatch error). Classic plans pass
+    through untouched (jit replicates local inputs itself)."""
+    if not plan_.pod:
+        return jnp.asarray(a)
+    mesh = plan_.mesh_for(dp)
+    return put_sharded(np.asarray(a), NamedSharding(mesh, P()))
+
+
+def fetch_global(out):
+    """Materialize a launch result on host, whatever its span: numpy
+    and fully-addressable arrays pass through (the classic zero-copy
+    d2h path); a process-spanning pod result is allgathered tiled —
+    every process gets the full array, the bytes are the pod tier's
+    wire tax (``kindel_pod_allgather_bytes_total``). Tuples (realign's
+    wire + dense) fetch element-wise."""
+    if isinstance(out, (tuple, list)):
+        return tuple(fetch_global(a) for a in out)
+    if isinstance(out, np.ndarray):
+        return out
+    sharding = getattr(out, "sharding", None)
+    if sharding is None or getattr(sharding, "is_fully_addressable", True):
+        return out
+    from jax.experimental import multihost_utils
+
+    with dispatch_guard():
+        host = np.asarray(
+            multihost_utils.process_allgather(out, tiled=True)
+        )
+    _pod_fetch_counter().inc(int(host.nbytes))
+    return host
+
+
 def place_stacked(plan_or_dp, arrays) -> tuple:
     """Place arrays on a dp mesh, sharded along axis 0 (the leading
     axis must divide by dp — stacked ``[dp, ...]`` shard layouts and
-    dp-divisible flat axes alike)."""
+    dp-divisible flat axes alike). A MeshPlan routes through its own
+    (possibly pod-spanning) mesh; a bare int is always the classic
+    local mesh."""
     if isinstance(plan_or_dp, MeshPlan):
         dp = plan_or_dp.dp
         mesh = plan_or_dp.mesh_for(dp)
@@ -335,7 +509,7 @@ def place_stacked(plan_or_dp, arrays) -> tuple:
         dp = int(plan_or_dp)
         mesh = Mesh(np.asarray(jax.devices()[:dp]), (DP_AXIS,))
     return tuple(
-        jax.device_put(
+        put_sharded(
             a, NamedSharding(mesh, P(DP_AXIS, *([None] * (a.ndim - 1))))
         )
         for a in arrays
@@ -366,7 +540,9 @@ def launch_sharded_superbatch(ssb: ShardedSuperbatch, opts):
             opts.realign, opts.emit_device, ssb.dp,
         )
         with dispatch_guard():
-            dev = aot.ragged_args(place_stacked(ssb.dp, stacked), opts)
+            dev = aot.ragged_args(
+                place_stacked(ssb.placement(), stacked), opts
+            )
             out = aot.call(sig, dev)
             aot_hit = out is not None
             if out is None:
@@ -396,7 +572,7 @@ def export_sharded(ssb: ShardedSuperbatch, opts, verify: bool = True):
         for g, t in zip(ssb.groups, ssb.tables)
     ]
     dev = aot.ragged_args(
-        place_stacked(ssb.dp, stack_shards(packs)), opts
+        place_stacked(ssb.placement(), stack_shards(packs)), opts
     )
     statics = {
         "n_slots": ssb.sub.n_slots, "s_pad": ssb.sub.s_pad,
@@ -416,8 +592,11 @@ def _shard_block(arr, shard: int):
     programs racing from different serve threads deadlock the
     backend's multi-device rendezvous (observed on XLA:CPU under the
     3-replica chaos suite). `addressable_shards` reads are device-local
-    by construction."""
+    by construction. Host numpy (a pod result already fetched by
+    `fetch_global`) indexes directly."""
     shard = int(shard)
+    if isinstance(arr, np.ndarray):
+        return arr[shard]
     for s in arr.addressable_shards:
         idx = s.index[0]
         lo = idx.start or 0
@@ -450,6 +629,7 @@ def unpack_sharded_superbatch(out, ssb: ShardedSuperbatch, opts, pool,
     emits them)."""
     from kindel_tpu.ragged.unpack import unpack_superbatch
 
+    out = fetch_global(out)  # pod results land on host first (wire tax)
     n_total = sum(len(g) for g in ssb.groups)
     results: list = [None] * n_total
     for s in range(ssb.dp):
@@ -466,12 +646,13 @@ def unpack_sharded_superbatch(out, ssb: ShardedSuperbatch, opts, pool,
 # Paged tier: mesh geometry of the persistent residency arrays
 # --------------------------------------------------------------------------
 
-def paged_dp(page_class, page_slots: int, dp: int) -> int:
+def paged_dp(page_class, page_slots: int, dp: int, procs: int = 1) -> int:
     """Largest mesh width ``d ≤ dp`` the paged pool's page grid shards
     to: ``d`` must divide the page count so each shard is a whole block
     of pages (quotas are per-page, so every stream extent then lives
     wholly inside one shard block — the page-aligned invariant the
-    in-place patches rely on)."""
+    in-place patches rely on). Under a pod plan ``d`` must also be a
+    procs multiple (whole shard blocks per process), else 1."""
     if dp <= 1:
         return 1
     n_pages = page_class.n_slots // page_slots
@@ -479,7 +660,8 @@ def paged_dp(page_class, page_slots: int, dp: int) -> int:
     for d in range(min(int(dp), n_pages), 1, -1):
         # each shard block must hold the largest admissible page run
         # (class length), or an oversize unit could never place
-        if n_pages % d == 0 and (n_pages // d) >= max_run:
+        if (n_pages % d == 0 and (n_pages // d) >= max_run
+                and d % max(1, int(procs)) == 0):
             return d
     return 1
 
@@ -521,6 +703,7 @@ def unpack_sharded_rows(out, stables: ShardedPagedTables, row_units, opts,
     that shard's wire view and LOCAL table, results re-assembled in
     pair order (the subset semantics — cached panel segments ride along
     unread — carry over per shard)."""
+    out = fetch_global(out)  # pod results land on host first (wire tax)
     per_shard: dict[int, list] = {}
     for pos, ((shard, row), unit) in enumerate(row_units):
         per_shard.setdefault(int(shard), []).append((pos, int(row), unit))

@@ -38,6 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from kindel_tpu import compat
 from kindel_tpu.call import CallMasks, CallResult, _insertion_calls, assemble
 from kindel_tpu.call_jax import (
     EMIT_ASCII,
@@ -196,7 +197,7 @@ def _call_from_channels(
     w_sum = weights.sum(axis=1)
 
     # --- halo: aligned_depth_next lookahead (kindel.py:406-408) ---
-    n = jax.lax.axis_size(axis)
+    n = compat.axis_size(axis)
     idx = jax.lax.axis_index(axis)
     recv = jax.lax.ppermute(
         acgt[:1], axis, [((i + 1) % n, i) for i in range(n)]
@@ -275,7 +276,7 @@ def _product_jit(
         _reduce_and_call_local, block=block, L=L, axis=axis, realign=realign
     )
     row = P(axis, None)
-    mapped = jax.shard_map(
+    mapped = compat.shard_map(
         fn,
         mesh=mesh,
         in_specs=(row,) * 3 + (P(axis),) + (row,) * 7 + (P(), P()),
@@ -381,7 +382,7 @@ def _counts_product_jit(
         _counts_call_local, block=block, L=L, axis=axis, realign=realign
     )
     row = P(axis, None)
-    mapped = jax.shard_map(
+    mapped = compat.shard_map(
         fn,
         mesh=mesh,
         in_specs=(row,) * 6 + (P(), P()),

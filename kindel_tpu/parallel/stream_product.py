@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from kindel_tpu import compat
 from kindel_tpu.events import N_CHANNELS
 from kindel_tpu.parallel.mesh import bucket_events_by_position, make_mesh
 from kindel_tpu.parallel.product import ShardedRef
@@ -61,7 +62,7 @@ def _add_weighted(state, pos_b, base_b, *, mesh: Mesh, axis: str):
         return st[0].at[p[0] * N_CHANNELS + b[0]].add(1, mode="drop")[None]
 
     row = P(axis, None)
-    return jax.shard_map(
+    return compat.shard_map(
         local, mesh=mesh, in_specs=(row, row, row), out_specs=row
     )(state, pos_b, base_b)
 
@@ -74,7 +75,7 @@ def _add_scalar(state, pos_b, *, mesh: Mesh, axis: str):
         return st[0].at[p[0]].add(1, mode="drop")[None]
 
     row = P(axis, None)
-    return jax.shard_map(
+    return compat.shard_map(
         local, mesh=mesh, in_specs=(row, row), out_specs=row
     )(state, pos_b)
 

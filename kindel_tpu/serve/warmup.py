@@ -141,6 +141,10 @@ def warm_shapes(opts, row_bucket: int = 8, payloads=(),
         out, _meta = launch_cohort_kernel(arrays, meta, opts,
                                           sharding=sharding,
                                           mesh_dp=mesh_dp)
+        if mesh_dp > 1:
+            from kindel_tpu.parallel import meshexec
+
+            out = meshexec.fetch_global(out)  # pod results via allgather
         wire = out[0] if opts.realign else out
         np.asarray(wire)  # block: load/compile + execute must be done
         total = time.monotonic() - t0
@@ -260,7 +264,8 @@ def _warm_ragged_mesh(cls, variants, units, realign_units,
     timings: dict[str, dict] = {}
     for suffix, vopts in variants:
         vunits = realign_units if vopts.realign else units
-        d = meshexec.ragged_dp(cls, mesh_plan.dp, n_units=None)
+        d = meshexec.ragged_dp(cls, mesh_plan.dp, n_units=None,
+                               procs=getattr(mesh_plan, "procs", 1))
         if d <= 1:
             continue
         # one unit per shard: the synthetic cohort replicated wide
@@ -285,6 +290,7 @@ def _warm_ragged_mesh(cls, variants, units, realign_units,
         else:
             source = "disabled"
         out = meshexec.launch_sharded_superbatch(ssb, vopts)
+        out = meshexec.fetch_global(out)  # pod results land via allgather
         wire = out[0] if vopts.realign else out
         np.asarray(wire)  # block: load/compile + execute must be done
         total = time.monotonic() - t0

@@ -190,7 +190,7 @@ class TuningConfig:
     ingest_workers: int | None = None
     ingest_mode: str | None = None
     emit_mode: str | None = None
-    mesh: int | None = None
+    mesh: int | str | None = None  # width, or 'pod' / 'pod:<dp>'
     lane_coalesce: int | None = None
     batch_mode: str | None = None
     ragged_classes: str | None = None
@@ -725,27 +725,90 @@ def mesh_store_key() -> str:
     return "mesh|" + host_fingerprint()
 
 
+@dataclass(frozen=True)
+class MeshSpec:
+    """One resolved mesh request: the data-parallel width plus the POD
+    flag (one shard_map program spanning every process in the JAX
+    group, DESIGN.md §27). ``dp is None`` means "auto" — the plan
+    builder (kindel_tpu.parallel.meshexec) resolves it to the visible
+    device count; under ``pod`` that count is the GLOBAL one."""
+
+    dp: int | None
+    pod: bool
+    source: str
+
+
+def parse_mesh_spec(raw) -> tuple[int | None, bool] | None:
+    """``<dp>`` | ``pod`` | ``pod:<dp>`` → (dp | None, pod), or None on
+    a malformed spec. An int is the classic per-replica width; the
+    ``pod`` forms request the cross-process tier (``pod`` alone =
+    every device of every process)."""
+    if isinstance(raw, bool):
+        return None
+    if isinstance(raw, int):
+        return max(1, raw), False
+    s = str(raw).strip()
+    if not s:
+        return None
+    low = s.lower()
+    if low == "pod":
+        return None, True
+    if low.startswith("pod:"):
+        try:
+            return max(1, int(s[4:])), True
+        except ValueError:
+            return None
+    try:
+        return max(1, int(s)), False
+    except ValueError:
+        return None
+
+
+def resolve_mesh_spec(explicit: int | str | None = None) -> MeshSpec:
+    """The mesh knob's full grammar: explicit arg > KINDEL_TPU_MESH >
+    host-keyed store > default, where every source may spell a width
+    (``4``), a pod request (``pod`` / ``pod:8``), or both. A malformed
+    EXPLICIT spec raises (operator typo on the command line); a
+    malformed env pin is explicit operator intent to override the
+    store and falls through to the default; a malformed store entry is
+    ignored. Same REQUEST semantics as ever: meshexec clamps to the
+    devices (and processes) actually present, and
+    KINDEL_TPU_FORCE_FUSED still pins single-device everywhere."""
+    if explicit is not None:
+        parsed = parse_mesh_spec(explicit)
+        if parsed is None:
+            raise ValueError(
+                f"malformed mesh spec {explicit!r}: expected '<dp>', "
+                "'pod', or 'pod:<dp>'"
+            )
+        return MeshSpec(dp=parsed[0], pod=parsed[1], source="explicit")
+    raw = os.environ.get("KINDEL_TPU_MESH")
+    if raw is not None:
+        parsed = parse_mesh_spec(raw)
+        if parsed is not None:
+            return MeshSpec(dp=parsed[0], pod=parsed[1], source="env")
+        # malformed pin — explicit operator intent to override
+        return MeshSpec(dp=MESH_DP_DEFAULT, pod=False, source="default")
+    entry = lookup(mesh_store_key())
+    if entry and isinstance(entry.get("mesh_dp"), int):
+        return MeshSpec(
+            dp=max(1, entry["mesh_dp"]),
+            pod=bool(entry.get("mesh_pod")),
+            source="cache",
+        )
+    return MeshSpec(dp=MESH_DP_DEFAULT, pod=False, source="default")
+
+
 def resolve_mesh_dp(explicit: int | None = None) -> tuple[int | None, str]:
     """The per-replica mesh-width knob (data-parallel fan-out of one
     flush — kindel_tpu.parallel.meshexec): explicit arg > KINDEL_TPU_MESH
     > host-keyed store > default (None = all local devices). Returns
     (dp | None, source); None means "auto" — the plan builder resolves
-    it to the visible device count. A malformed env pin is explicit
-    operator intent to override the store and falls through to the
-    default; a malformed store entry is ignored. The value here is a
-    REQUEST: meshexec clamps it to the devices actually present, and
-    KINDEL_TPU_FORCE_FUSED still pins single-device everywhere."""
-    if explicit is not None:
-        return max(1, int(explicit)), "explicit"
-    pin, present = _env_int("KINDEL_TPU_MESH")
-    if pin is not None:
-        return max(1, pin), "env"
-    if present:  # malformed pin — explicit operator intent to override
-        return MESH_DP_DEFAULT, "default"
-    entry = lookup(mesh_store_key())
-    if entry and isinstance(entry.get("mesh_dp"), int):
-        return max(1, entry["mesh_dp"]), "cache"
-    return MESH_DP_DEFAULT, "default"
+    it to the visible device count. The width-only view of
+    `resolve_mesh_spec` (the pod flag dropped) — kept as the stable
+    surface every width-only caller reads."""
+    spec = resolve_mesh_spec(explicit)
+    return spec.dp, spec.source
 
 
 def search_mesh_dp(measure, candidates=(1, 2, 4, 8),
@@ -1133,7 +1196,14 @@ def resolve(explicit: TuningConfig | None = None, backend: str = "cpu",
     ingest_mode, s8 = resolve_ingest_mode(e.ingest_mode)
     rpc_timeout, s9 = resolve_rpc_timeout_ms(e.rpc_timeout_ms)
     max_body, s10 = resolve_max_body_mb(e.max_body_mb)
-    mesh_dp, s11 = resolve_mesh_dp(e.mesh)
+    mesh_spec = resolve_mesh_spec(e.mesh)
+    s11 = mesh_spec.source
+    # a pod request survives resolution as the spec string, so the
+    # service hands meshexec.plan the full grammar, not just the width
+    if mesh_spec.pod:
+        mesh_dp = "pod" if mesh_spec.dp is None else f"pod:{mesh_spec.dp}"
+    else:
+        mesh_dp = mesh_spec.dp
     # knob provenance into the shared exposition: one Info sample per
     # (knob, source, value) — the serve /metrics and bench snapshots show
     # WHERE each performance knob came from, not just its value

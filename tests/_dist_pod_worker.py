@@ -1,0 +1,63 @@
+"""Worker for the two-process pod data-plane tests: join the localhost
+JAX group (4 virtual CPU devices per process → 8 global) purely through
+the `--mesh pod:<dp>` knob surface — the plan builder brings the group
+up from the standard cluster env vars — then drive all three dispatch
+tiers through the shared podfixture drivers and print the digests.
+
+Usage:
+  python tests/_dist_pod_worker.py <process_id> <port> <dp> <tmpdir> \
+      [realign]
+
+(underscore prefix: not collected by pytest)."""
+
+import os
+import sys
+
+proc_id = int(sys.argv[1])
+port = int(sys.argv[2])
+dp = int(sys.argv[3])
+tmpdir = sys.argv[4]
+realign = len(sys.argv) > 5 and sys.argv[5] == "realign"
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+# the pod plan reads the standard cluster env vars — the knob surface
+# under test is `--mesh pod:<dp>`, not an explicit initialize() call
+os.environ["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+os.environ["JAX_NUM_PROCESSES"] = "2"
+os.environ["JAX_PROCESS_ID"] = str(proc_id)
+os.environ["KINDEL_TPU_MESH"] = f"pod:{dp}"
+# isolate the tune/AOT store per process (never read the host's)
+os.environ["KINDEL_TPU_TUNE_CACHE"] = os.path.join(
+    tmpdir, f"proc{proc_id}", "tune.json"
+)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_here))
+sys.path.insert(0, _here)
+
+from tests import podfixture  # noqa: E402
+from kindel_tpu.parallel import meshexec  # noqa: E402
+
+plan = meshexec.plan()
+assert plan.procs == 2, f"pod group did not come up: {plan}"
+assert plan.proc_id == proc_id
+assert plan.dp == dp, f"requested dp {dp}, planned {plan.dp}"
+assert jax.device_count() == 8, jax.device_count()
+
+# the mesh must span both processes, each owning contiguous shard blocks
+mesh = plan.mesh_for(plan.dp)
+owners = [int(d.process_index) for d in mesh.devices.flat]
+assert owners == sorted(owners) and set(owners) == {0, 1}, owners
+
+digests = podfixture.all_digests(
+    os.path.join(tmpdir, f"proc{proc_id}", "sams"), plan,
+    realign=realign,
+)
+for tier, d in sorted(digests.items()):
+    print(f"DIGEST:{tier}={d}", flush=True)
+print(f"PODPLAN:dp={plan.dp},procs={plan.procs}", flush=True)

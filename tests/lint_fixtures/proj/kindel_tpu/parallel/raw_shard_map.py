@@ -1,0 +1,20 @@
+"""Known-bad fixture: raw version-sensitive jax multi-host spellings
+outside compat.py (jax-compat-confinement) — the exact AttributeError
+class that broke the seed's 9 shard_map tests on the jax pin."""
+
+import jax
+from jax.experimental.shard_map import shard_map as raw_shard_map  # BAD
+
+
+def bad_mapped(mesh, spec, fn):
+    # BAD: jax.shard_map attribute access outside compat.py
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec,), out_specs=spec)
+
+
+def bad_probe() -> bool:
+    # BAD: jax.distributed attribute access outside compat.py
+    return jax.distributed.is_initialized()
+
+
+def bad_raw_call(mesh, spec, fn):
+    return raw_shard_map(fn, mesh, in_specs=(spec,), out_specs=spec)

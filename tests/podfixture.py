@@ -1,0 +1,158 @@
+"""Deterministic dispatch-tier drivers + digests shared between the
+two-process pod workers (tests/_dist_pod_worker.py) and their
+in-process oracles (tests/test_meshexec.py pod matrix): the SPMD pod
+contract needs every process to issue the SAME device programs in the
+SAME order, so these drivers speak to the dispatch layer directly —
+no threaded batcher timing in the loop — and the oracle runs the very
+same code at dp=1, so the two sides can never drift apart."""
+
+import hashlib
+from pathlib import Path
+
+from kindel_tpu.batch import BatchOptions, _call_and_assemble
+from kindel_tpu.parallel import meshexec
+from kindel_tpu.ragged import pack as rpack
+from kindel_tpu.ragged import parse_classes
+from kindel_tpu.serve.queue import ServeRequest
+from kindel_tpu.serve.worker import decode_request
+
+#: one small page class: 32 rows so every dp ∈ {1, 2, 4} (and every
+#: procs-multiple width) divides the rows/pages evenly
+CLASSES = parse_classes("small:32x2048")
+
+
+def make_units(tmpdir, realign: bool = False, n: int = 6,
+               seed_base: int = 31) -> list:
+    """The fixed synthetic cohort of the pod matrix — varied lengths
+    and depths, decoded with the realign channels when asked."""
+    from tests import distfixture
+    from tests.test_serve import make_sam
+
+    tmpdir = Path(tmpdir)
+    tmpdir.mkdir(parents=True, exist_ok=True)
+    opts = BatchOptions(realign=realign)
+    units = []
+    for i in range(n):
+        sam = make_sam(
+            tmpdir / f"pod{i}.sam", ref=f"pod{i}", L=260 + 97 * i,
+            n_reads=10 + 3 * i, seed=seed_base + i,
+        )
+        units.extend(
+            decode_request(
+                ServeRequest(payload=str(sam), opts=opts)
+            )
+        )
+    # one clip-flanked-gap sample (distfixture.product_sam layout): under
+    # realign the CDR walk actually produces a gap-closing patch, so the
+    # pod matrix exercises the dense-tensor window fetches for real
+    units.extend(
+        decode_request(
+            ServeRequest(
+                payload=distfixture.product_sam(ref_len=1280,
+                                                seed=seed_base),
+                opts=opts,
+            )
+        )
+    )
+    return units
+
+
+def _seq_digest(seqs) -> str:
+    h = hashlib.sha256()
+    for s in seqs:
+        h.update(s.encode())
+        h.update(b"\x00")
+    return h.hexdigest()
+
+
+def cohort_digest(units, opts: BatchOptions) -> str:
+    """Lane-tier FASTA digest: pack + mesh-plan dispatch + assembly —
+    the plan (and so the pod mesh) resolves from the environment inside
+    `_dispatch_device_call`, exactly as the serve worker's flush
+    does."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(max_workers=2) as pool:
+        outs = _call_and_assemble(units, opts, pool)
+    return _seq_digest([o[0].sequence for o in outs])
+
+
+def ragged_digest(units, plan, opts: BatchOptions) -> str:
+    """Ragged-tier digest through the sharded sub-superbatch path; at a
+    plan that cannot shard (dp=1) the classic single-device superbatch
+    runs instead — the byte-identity oracle."""
+    from kindel_tpu.paged.retire import _InlineMap
+
+    cls = CLASSES[0]
+    ssb = meshexec.shard_superbatch(units, cls, plan,
+                                    realign=opts.realign)
+    if ssb is None:
+        from kindel_tpu.ragged.kernel import launch_ragged
+        from kindel_tpu.ragged.unpack import unpack_superbatch
+
+        table = rpack.build_segment_table(units, cls)
+        arrays = rpack.pack_superbatch(units, table,
+                                       realign=opts.realign)
+        out = launch_ragged(arrays, cls, opts)
+        outs = unpack_superbatch(out, table, units, opts, _InlineMap())
+    else:
+        out = meshexec.launch_sharded_superbatch(ssb, opts)
+        outs = meshexec.unpack_sharded_superbatch(
+            out, ssb, opts, _InlineMap()
+        )
+    return _seq_digest([o[0].sequence for o in outs])
+
+
+def paged_digest(units, plan, opts: BatchOptions) -> str:
+    """Paged-tier digest over a mesh-resident pool with admit/retire
+    churn in the middle (the in-place patch + clear programs run for
+    real before the final launch), extracted through the sharded or
+    classic table as the plan dictates."""
+    from kindel_tpu.paged import PagedBatcher
+    from kindel_tpu.paged.retire import _InlineMap
+    from kindel_tpu.ragged.unpack import unpack_rows
+
+    cls = CLASSES[0]
+    b = PagedBatcher([cls], mesh_plan=plan, max_wait_s=0.01)
+    lane = b._lane_for(("podlane",), cls, opts)
+    res = lane.pool.residency
+
+    def admit(us):
+        segs = []
+        for u in us:
+            seg = lane.pool.admit_unit(u, rpack.consumption([u]))
+            assert seg is not None, f"unit {u.ref_id} did not place"
+            segs.append(seg)
+        return segs
+
+    segs = admit(units[:4])
+    # churn: retire two, admit the rest — clear + re-patch programs run
+    for seg in segs[:2]:
+        seg.panel = None
+        lane.pool.release(seg)
+    live = list(zip(segs[2:], units[2:4]))
+    live += list(zip(admit(units[4:] + units[:2]),
+                     units[4:] + units[:2]))
+    u2, tables, row_of = res.table(lane.pool)
+    out = res.launch(opts)
+    pairs = [(row_of[seg.seg_id], u) for seg, u in live]
+    if res.mesh_dp > 1:
+        outs = meshexec.unpack_sharded_rows(
+            out, tables, pairs, opts, _InlineMap()
+        )
+    else:
+        out = meshexec.fetch_global(out)
+        outs = unpack_rows(out, tables, pairs, opts, _InlineMap())
+    return _seq_digest([o[0].sequence for o in outs])
+
+
+def all_digests(tmpdir, plan, realign: bool = False) -> dict:
+    """The full tier × digest map one pod (or oracle) process
+    computes."""
+    opts = BatchOptions(realign=realign)
+    units = make_units(tmpdir, realign=realign)
+    return {
+        "cohort": cohort_digest(units, opts),
+        "ragged": ragged_digest(units, plan, opts),
+        "paged": paged_digest(units, plan, opts),
+    }

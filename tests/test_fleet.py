@@ -173,6 +173,62 @@ def test_rendezvous_routing_is_sticky_and_rehomes_only_removed_keys():
             assert now != gone
 
 
+def test_capacity_weighted_rendezvous_treats_pod_group_as_big_replica():
+    """A pod group registered as ONE capacity-k replica (DESIGN.md
+    §27) wins ~k/(k + peers) of the keyspace; equal-capacity fleets
+    rank exactly as the classic unweighted score did (the weighted
+    score is a monotone transform of it), so placement is still sticky
+    and nothing moved for existing rosters."""
+    from kindel_tpu.fleet import weighted_rendezvous_score
+
+    keys = [routing_key(f"/data/s{i}.bam", {}) for i in range(400)]
+    # equal capacity ⇒ identical ranking to the classic digest order
+    from kindel_tpu.fleet.router import rendezvous_score
+
+    for k in keys[:50]:
+        classic = sorted(
+            ("r0", "r1", "r2"),
+            key=lambda r: rendezvous_score(k, r), reverse=True,
+        )
+        weighted = sorted(
+            ("r0", "r1", "r2"),
+            key=lambda r: weighted_rendezvous_score(k, r, 1),
+            reverse=True,
+        )
+        assert classic == weighted
+    # a capacity-4 pod group vs two singles: ~4/6 of keys land on it
+    reps = [_stub_replica("pod", _FakeService()),
+            _stub_replica("a", _FakeService()),
+            _stub_replica("b", _FakeService())]
+    reps[0].capacity = 4
+    router = FleetRouter(reps)
+    wins = sum(router.rank(k)[0].replica_id == "pod" for k in keys)
+    assert 0.5 < wins / len(keys) < 0.8, (
+        f"capacity-4 pod won {wins}/{len(keys)} keys"
+    )
+    # placement stays sticky under weighting
+    assert [router.rank(k)[0].replica_id for k in keys[:20]] \
+        == [router.rank(k)[0].replica_id for k in keys[:20]]
+
+
+def test_parse_replica_roster_pod_capacity_grammar():
+    from kindel_tpu.fleet import parse_replica_roster, static_fleet
+
+    assert parse_replica_roster("a:1, b:2*4,") \
+        == [("a", 1, 1), ("b", 2, 4)]
+    with pytest.raises(ValueError, match="capacity"):
+        parse_replica_roster("a:1*0")
+    with pytest.raises(ValueError, match="capacity"):
+        parse_replica_roster("a:1*pod")
+    # the static roster hands capacities to the fleet's replicas
+    fleet = static_fleet("10.0.0.1:7701,10.0.0.2:7701*4")
+    try:
+        assert [r.capacity for r in fleet.roster()] == [1, 4]
+        assert fleet.roster()[1].snapshot()["capacity"] == 4
+    finally:
+        fleet.stop(drain=False)
+
+
 def test_router_fails_over_past_a_shedding_replica():
     before = default_registry().snapshot()
     reps = [

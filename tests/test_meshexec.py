@@ -33,6 +33,7 @@ from kindel_tpu.serve.queue import ServeRequest
 from kindel_tpu.serve.worker import decode_request
 from kindel_tpu.tune import TuningConfig
 
+from tests import podfixture
 from tests.test_paged import _mixed_sams
 from tests.test_serve import make_sam
 
@@ -246,7 +247,7 @@ def test_zero_compile_warm_mesh(tmp_path, monkeypatch):
     _zero_compile_warm_mesh(tmp_path)
 
 
-def _zero_compile_warm_mesh(tmp_path):
+def _zero_compile_warm_mesh(tmp_path, spec=4):
     """Changing traffic on a warm mesh compiles nothing: after warmup
     of the synthetic lane + the page classes under an active plan,
     unseen requests that land in warmed lane shapes / page classes add
@@ -259,7 +260,7 @@ def _zero_compile_warm_mesh(tmp_path):
     from kindel_tpu.pileup_jax import _bucket
     from kindel_tpu.serve import warmup
 
-    plan = meshexec.plan(4)
+    plan = meshexec.plan(spec)
     opts = BatchOptions()
     warmup.warm_shapes(opts, mesh_plan=plan)
     warmup.warm_ragged(opts, CLASSES[:1], mesh_plan=plan)
@@ -405,3 +406,120 @@ def test_fetch_window_flat_stitches_across_shards():
         flat, 1000, 128, lambda: pytest.fail("fallback taken")
     )
     assert np.array_equal(win, arr[1000:1128])
+
+
+# ----------------------------------------------------------- pod tier
+
+
+def test_pod_mesh_spec_resolution(monkeypatch, tmp_path):
+    """The `--mesh` grammar grew the pod forms: '<dp>' | 'pod' |
+    'pod:<dp>', the pod flag surviving every resolution source
+    (explicit > env > host-keyed store), a malformed explicit spec
+    raising, and the width-only `resolve_mesh_dp` view staying exactly
+    what the legacy callers pinned."""
+    monkeypatch.setenv("KINDEL_TPU_TUNE_CACHE", str(tmp_path / "t.json"))
+    monkeypatch.delenv("KINDEL_TPU_MESH", raising=False)
+    assert tune.parse_mesh_spec(4) == (4, False)
+    assert tune.parse_mesh_spec("4") == (4, False)
+    assert tune.parse_mesh_spec("pod") == (None, True)
+    assert tune.parse_mesh_spec("POD:2") == (2, True)
+    assert tune.parse_mesh_spec("pod:x") is None
+    assert tune.parse_mesh_spec(True) is None
+
+    spec = tune.resolve_mesh_spec("pod:4")
+    assert (spec.dp, spec.pod, spec.source) == (4, True, "explicit")
+    with pytest.raises(ValueError, match="malformed mesh spec"):
+        tune.resolve_mesh_spec("pod:")
+    monkeypatch.setenv("KINDEL_TPU_MESH", "pod:2")
+    spec = tune.resolve_mesh_spec()
+    assert (spec.dp, spec.pod, spec.source) == (2, True, "env")
+    assert tune.resolve_mesh_dp() == (2, "env")
+    monkeypatch.delenv("KINDEL_TPU_MESH")
+    tune.record(tune.mesh_store_key(), {"mesh_dp": 2, "mesh_pod": True})
+    spec = tune.resolve_mesh_spec()
+    assert (spec.dp, spec.pod, spec.source) == (2, True, "cache")
+    # outside a cluster env the pod plan degrades to the local tier —
+    # same width, one process, byte-identity intact
+    p = meshexec.plan("pod:2")
+    assert (p.dp, p.procs, p.pod) == (2, 1, False)
+
+
+def test_pod_matrix_in_process(tmp_path, monkeypatch):
+    """procs=1 half of the pod byte-identity matrix: the degraded
+    single-process pod:<dp> plans at dp ∈ {2, 4} produce FASTA digests
+    identical to the dp=1 oracle across all three dispatch tiers, and
+    the realign leg (whose CDR patch fires on the clip-flanked-gap
+    sample) matches the realign oracle — so the pod spec never changes
+    bytes, only placement."""
+    monkeypatch.setenv("KINDEL_TPU_TUNE_CACHE", str(tmp_path / "t.json"))
+    with tune.env_pin("KINDEL_TPU_MESH", "1"):
+        base = podfixture.all_digests(tmp_path / "base", meshexec.plan())
+        base_re = podfixture.all_digests(
+            tmp_path / "base_re", meshexec.plan(), realign=True
+        )
+    assert base != base_re, "realign changed nothing — fixture is inert"
+    for dp in (2, 4):
+        with tune.env_pin("KINDEL_TPU_MESH", f"pod:{dp}"):
+            got = podfixture.all_digests(
+                tmp_path / f"p{dp}", meshexec.plan()
+            )
+        assert got == base, f"pod:{dp} diverged from the dp=1 oracle"
+    with tune.env_pin("KINDEL_TPU_MESH", "pod:4"):
+        got = podfixture.all_digests(
+            tmp_path / "p4r", meshexec.plan(), realign=True
+        )
+    assert got == base_re, "pod:4 realign diverged from the oracle"
+
+
+@pytest.mark.parametrize("dp", [2, 4])
+def test_pod_two_process_byte_identity(tmp_path, dp):
+    """procs=2 half of the matrix: an actual two-process JAX group
+    (localhost coordinator, 4 virtual CPU devices each, brought up by
+    the plan builder purely from `KINDEL_TPU_MESH=pod:<dp>` + the
+    cluster env) runs all three dispatch tiers over process-spanning
+    NamedShardings — both workers' FASTA digests equal each other and
+    the in-process single-device oracle, realign included at dp=4."""
+    from pathlib import Path
+
+    import distfixture
+
+    worker = Path(__file__).parent / "_dist_pod_worker.py"
+    with tune.env_pin("KINDEL_TPU_MESH", "1"):
+        base = podfixture.all_digests(tmp_path / "base", meshexec.plan())
+
+    def pod_digests(extra):
+        outs = distfixture.run_two_process(worker, extra_argv=extra)
+        got = []
+        for rc, out, err in outs:
+            assert rc == 0, (out[-2000:], err[-2000:])
+            assert f"PODPLAN:dp={dp},procs=2" in out
+            got.append(dict(
+                line.split("DIGEST:", 1)[1].split("=", 1)
+                for line in out.splitlines()
+                if line.startswith("DIGEST:")
+            ))
+        assert got[0] == got[1], "pod workers disagree"
+        return got[0]
+
+    assert pod_digests((dp, str(tmp_path))) == base, (
+        f"pod dp={dp} procs=2 diverged from the dp=1 oracle"
+    )
+    if dp == 4:
+        with tune.env_pin("KINDEL_TPU_MESH", "1"):
+            base_re = podfixture.all_digests(
+                tmp_path / "base_re", meshexec.plan(), realign=True
+            )
+        assert pod_digests((dp, str(tmp_path / "re"), "realign")) \
+            == base_re, "pod realign diverged from the realign oracle"
+
+
+def test_zero_compile_warm_pod_mesh(tmp_path, monkeypatch):
+    """The warm-mesh zero-compile pin holds under a pod spec: a
+    pod:4 plan (degraded to one process here — the pod keying of
+    warmup and the AOT digests is what's under test) warms the lane
+    shapes and page classes, then unseen traffic adds zero jit-cache
+    entries."""
+    monkeypatch.setenv(
+        "KINDEL_TPU_TUNE_CACHE", str(tmp_path / "tune.json")
+    )
+    _zero_compile_warm_mesh(tmp_path, spec="pod:4")

@@ -19,6 +19,32 @@ export JAX_PLATFORMS=cpu
 echo "== kindel lint --strict =="
 python -m kindel_tpu.cli lint --strict
 
+echo "== pod two-process smoke (DESIGN.md §27) =="
+# an actual localhost 2-process JAX group through the pod data plane:
+# both workers must come up from the knob surface alone and produce
+# identical digests across all three dispatch tiers (~15 s on CPU)
+SMOKE_TMP="$(mktemp -d)"
+trap 'rm -rf "$SMOKE_TMP"' EXIT
+python - "$SMOKE_TMP" <<'PY'
+import sys
+
+sys.path.insert(0, "tests")
+sys.path.insert(0, ".")
+import distfixture
+
+outs = distfixture.run_two_process(
+    "tests/_dist_pod_worker.py", extra_argv=(2, sys.argv[1])
+)
+digests = []
+for rc, out, err in outs:
+    assert rc == 0, err[-2000:]
+    digests.append(sorted(
+        line for line in out.splitlines() if line.startswith("DIGEST:")
+    ))
+assert digests[0] and digests[0] == digests[1], "pod workers disagree"
+print("pod smoke ok:", *digests[0], sep="\n  ")
+PY
+
 echo "== kindel perf --gate =="
 python -m kindel_tpu.cli perf --gate
 
